@@ -1,0 +1,39 @@
+"""Test env: force CPU JAX with an 8-device virtual mesh BEFORE jax import.
+
+Mirrors the reference test strategy (SURVEY.md §4): numpy is the golden
+backend always available in CI; accelerated paths are cross-checked against
+it; distributed paths run on a virtual multi-device CPU mesh.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"   # env presets axon (TPU); tests run CPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    """Every test starts from the same global seed (reference StandardTest
+    pins seeds, SURVEY.md §4)."""
+    from znicz_tpu import prng
+    prng.seed_all(1234)
+    np.random.seed(1234)
+    yield
+
+
+@pytest.fixture
+def numpy_device():
+    from znicz_tpu.backends import NumpyDevice
+    return NumpyDevice()
+
+
+@pytest.fixture
+def xla_device():
+    from znicz_tpu.backends import XLADevice
+    return XLADevice()

@@ -1,0 +1,178 @@
+"""Foundation tests: config tree, mutable gates, Vector coherence,
+unit graph scheduling (reference test strategy §4: unit-level fixtures)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu import (Bool, Config, NumpyDevice, Unit, Vector, Workflow,
+                       XLADevice)
+from znicz_tpu import prng
+
+
+class TestConfig:
+    def test_auto_vivification(self):
+        c = Config("root")
+        c.a.b.c = 3
+        assert c.to_dict() == {"a": {"b": {"c": 3}}}
+
+    def test_update_merge(self):
+        c = Config("root")
+        c.update({"x": {"y": 1}})
+        c.x.update({"z": 2})
+        assert c.to_dict() == {"x": {"y": 1, "z": 2}}
+
+    def test_set_path_and_get(self):
+        c = Config("root")
+        c.set_path("a.b", 7)
+        assert c.get("a.b") == 7
+        assert c.get("a.missing", "dflt") == "dflt"
+
+
+class TestBool:
+    def test_assign_through(self):
+        b = Bool(False)
+        b <<= True
+        assert bool(b)
+
+    def test_invert_is_live(self):
+        b = Bool(False)
+        nb = ~b
+        assert bool(nb)
+        b <<= True
+        assert not bool(nb)
+
+    def test_watchers(self):
+        b = Bool(False)
+        seen = []
+        b.on_change(lambda x: seen.append(bool(x)))
+        b <<= True
+        b <<= True   # no change → no event
+        b <<= False
+        assert seen == [True, False]
+
+    def test_composition(self):
+        a, b = Bool(True), Bool(False)
+        both = a & b
+        either = a | b
+        assert not bool(both) and bool(either)
+        b <<= True
+        assert bool(both)
+
+
+class TestVector:
+    def test_roundtrip_numpy_device(self):
+        v = Vector(np.arange(6, dtype=np.float32).reshape(2, 3))
+        v.initialize(NumpyDevice())
+        assert v.shape == (2, 3)
+        np.testing.assert_array_equal(v.mem[0], [0, 1, 2])
+
+    def test_xla_coherence(self, xla_device):
+        v = Vector(np.ones((4, 4), np.float32))
+        v.initialize(xla_device)
+        dev = v.devmem                    # implicit unmap: device owns
+        assert not v._host_owned
+        host = v.mem                      # implicit map_read
+        np.testing.assert_array_equal(host, np.ones((4, 4)))
+        v.map_write()
+        v.mem[0, 0] = 5.0
+        assert float(v.devmem[0, 0]) == 5.0   # re-uploaded on unmap
+        del dev
+
+    def test_device_side_store(self, xla_device):
+        import jax.numpy as jnp
+        v = Vector()
+        v.initialize(xla_device)
+        v.devmem = jnp.full((2, 2), 3.0)
+        np.testing.assert_array_equal(v.mem, np.full((2, 2), 3.0))
+
+
+class TestPrng:
+    def test_streams_reproducible(self):
+        prng.seed_all(42)
+        a = prng.get("w").normal(size=(4,))
+        prng.seed_all(42)
+        b = prng.get("w").normal(size=(4,))
+        np.testing.assert_array_equal(a, b)
+
+    def test_streams_independent(self):
+        x = prng.get("s1").normal(size=(4,))
+        y = prng.get("s2").normal(size=(4,))
+        assert not np.allclose(x, y)
+
+    def test_counter_keys_pure(self):
+        g = prng.get("drop")
+        k1 = g.key_for(1, 2, 3)
+        k2 = g.key_for(1, 2, 3)
+        import jax
+        assert jax.random.uniform(k1) == jax.random.uniform(k2)
+
+
+class Tick(Unit):
+    """Counts its own firings."""
+
+    def __init__(self, workflow, name):
+        super().__init__(workflow, name)
+        self.count = 0
+
+    def run(self):
+        self.count += 1
+
+
+class TestWorkflowGraph:
+    def _loop_workflow(self, n_ticks):
+        """start → a → b → end, with b gating the end until n_ticks."""
+        w = Workflow(name="wf")
+        a, b = Tick(w, "a"), Tick(w, "b")
+        a.link_from(w.start_point)
+        b.link_from(a)
+        w.end_point.link_from(b)
+        done = Bool(False)
+
+        orig = b.run
+        def run_and_maybe_finish():
+            orig()
+            if b.count >= n_ticks:
+                done.set(True)
+        b.run = run_and_maybe_finish
+        w.end_point.gate_block = ~done
+        a.link_from(b)   # loop back-edge
+        return w, a, b
+
+    def test_loop_runs_until_gate_opens(self, numpy_device):
+        w, a, b = self._loop_workflow(5)
+        w.initialize(device=numpy_device)
+        w.run()
+        assert a.count == 5 and b.count == 5
+
+    def test_gate_skip(self, numpy_device):
+        w, a, b = self._loop_workflow(3)
+        a.gate_skip = Bool(True)
+        w.initialize(device=numpy_device)
+        w.run()
+        assert a.count == 0 and b.count == 3
+
+    def test_link_attrs_live(self):
+        w = Workflow(name="wf2")
+        src, dst = Tick(w, "src"), Tick(w, "dst")
+        src.output = Vector(np.zeros(3))
+        dst.link_attrs(src, ("input", "output"))
+        assert dst.input is src.output
+        src.output = Vector(np.ones(3))
+        assert dst.input is src.output
+
+    def test_deadlock_detected(self, numpy_device):
+        w = Workflow(name="wf3")
+        a = Tick(w, "a")
+        a.link_from(w.start_point)
+        a.gate_block = Bool(True)
+        w.end_point.link_from(a)
+        w.initialize(device=numpy_device)
+        with pytest.raises(RuntimeError, match="deadlock"):
+            w.run()
+
+    def test_time_table(self, numpy_device):
+        w, a, b = self._loop_workflow(2)
+        w.initialize(device=numpy_device)
+        w.run()
+        names = [r[0] for r in w.time_table()]
+        assert "a" in names and "b" in names

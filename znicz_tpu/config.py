@@ -1,0 +1,136 @@
+"""Attribute-tree global configuration.
+
+Capability parity with the reference's config system (upstream layout
+``veles/config.py``; the /root/reference mount was empty during the survey —
+see SURVEY.md caveat — so this is built to the surveyed contract, not to
+file:line citations): a process-global ``root`` attribute tree; config files
+are plain Python executed against ``root`` (``root.mnist.update({...})``);
+any dotted path can be read/written/overridden from the CLI.
+
+TPU-first notes: config values feed *static* arguments of jitted train steps
+(shapes, layer specs, hyperparameters), so the tree converts cleanly to
+hashable tuples via :meth:`Config.to_dict`.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+
+
+_MISSING = object()
+
+
+class Config:
+    """A node in the attribute tree.
+
+    Accessing an unknown attribute creates an empty child node, so config
+    files can write ``root.a.b.c = 1`` without pre-declaring anything.
+    """
+
+    def __init__(self, path: str = "root", **kwargs):
+        self.__dict__["_path"] = path
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    # -- tree behaviour ----------------------------------------------------
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        child = Config(f"{self._path}.{name}")
+        self.__dict__[name] = child
+        return child
+
+    def __setattr__(self, name: str, value):
+        if isinstance(value, dict):
+            node = self.__dict__.get(name)
+            if not isinstance(node, Config):
+                node = Config(f"{self._path}.{name}")
+                self.__dict__[name] = node
+            node.update(value)
+        else:
+            self.__dict__[name] = value
+
+    def update(self, values: dict) -> "Config":
+        """Recursively merge a dict into this node (reference ``update`` UX)."""
+        for k, v in values.items():
+            if isinstance(v, dict):
+                node = self.__dict__.get(k)
+                if not isinstance(node, Config):
+                    node = Config(f"{self._path}.{k}")
+                    self.__dict__[k] = node
+                node.update(v)
+            else:
+                self.__dict__[k] = v
+        return self
+
+    # -- access helpers ----------------------------------------------------
+    def get(self, name: str, default=None):
+        """Read a leaf without creating intermediate nodes."""
+        parts = name.split(".")
+        node = self
+        for i, part in enumerate(parts):
+            value = node.__dict__.get(part, _MISSING)
+            is_last = i == len(parts) - 1
+            if value is _MISSING or (not is_last
+                                     and not isinstance(value, Config)):
+                return default
+            node = value
+        return default if isinstance(node, Config) and not node.to_dict() \
+            else node
+
+    def set_path(self, dotted: str, value):
+        """CLI-style override: ``set_path("mnist.lr", 0.01)``."""
+        parts = dotted.split(".")
+        node = self
+        for part in parts[:-1]:
+            node = getattr(node, part)
+        setattr(node, parts[-1], value)
+
+    def to_dict(self) -> dict:
+        out = {}
+        for k, v in self.__dict__.items():
+            if k.startswith("_"):
+                continue
+            out[k] = v.to_dict() if isinstance(v, Config) else v
+        return out
+
+    def clone(self) -> "Config":
+        c = Config(self._path)
+        for k, v in self.__dict__.items():
+            if k.startswith("_"):
+                continue
+            c.__dict__[k] = v.clone() if isinstance(v, Config) \
+                else copy.deepcopy(v)
+        return c
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.__dict__ and not name.startswith("_")
+
+    def __repr__(self):
+        return f"Config({self._path}: {json.dumps(self.to_dict(), default=str)})"
+
+
+#: Process-global configuration tree (reference: global ``root``).
+root = Config("root")
+root.common.update({
+    "precision_type": "float32",
+    "compute_dtype": "bfloat16",   # TPU MXU-native accumulation input dtype
+    "engine": {"backend": "auto"},  # auto | numpy | xla
+    "seed": 1234,
+    "snapshot_dir": "snapshots",
+    "cache_dir": ".cache",
+})
+
+
+def apply_overrides(overrides: list[str], tree: Config = root) -> None:
+    """Apply CLI ``path=value`` overrides; values parsed as Python literals."""
+    import ast
+
+    for item in overrides:
+        path, _, raw = item.partition("=")
+        try:
+            value = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            value = raw
+        tree.set_path(path.strip(), value)

@@ -1,0 +1,50 @@
+"""Distributable protocol — per-unit hooks for distributed execution.
+
+Capability parity with the reference's ``veles/distributable.py`` (mount
+empty — surveyed contract, SURVEY.md §2.1): the master–slave job protocol
+``generate_data_for_slave → apply_data_from_master → run →
+generate_data_for_master → apply_data_from_slave``.
+
+TPU-first redesign (SURVEY.md §2.4, the north star): the asynchronous
+parameter-server star becomes synchronous SPMD data parallelism — gradient
+aggregation (the reference's ``apply_data_from_slave`` fold) is a
+``jax.lax.psum`` over the mesh's data axis inside the jitted step, riding
+ICI.  The protocol methods are retained as the *sharding contract*: they
+describe which state a unit owns globally (weights: replicated) vs
+per-shard (minibatches: split), which is exactly what
+``znicz_tpu.parallel`` needs to build shardings.  Units that carry no
+distributed state inherit these no-ops.
+"""
+
+from __future__ import annotations
+
+
+class Distributable:
+    """Per-unit distributed-state contract (reference IDistributable)."""
+
+    #: Does this unit need cross-replica negotiation at setup time?
+    negotiates_on_connect = False
+
+    def generate_data_for_slave(self, slave=None):
+        """Master→slave payload (reference).  TPU mapping: the per-shard
+        slice spec this unit consumes (e.g. loader minibatch indices)."""
+        return None
+
+    def apply_data_from_master(self, data) -> None:
+        """Slave applies master payload (reference).  TPU mapping: install
+        the shard slice before the step."""
+
+    def generate_data_for_master(self):
+        """Slave→master payload (reference: gradients/stats).  TPU mapping:
+        the pytree this unit contributes to the cross-replica reduction."""
+        return None
+
+    def apply_data_from_slave(self, data, slave=None) -> None:
+        """Master folds a slave's payload (reference: gradient aggregation
+        point [baseline]).  TPU mapping: psum over the data axis — performed
+        by the compiled step, not by this Python hook; kept for API parity
+        and for host-side reductions of non-traced stats."""
+
+    def drop_slave(self, slave=None) -> None:
+        """Reference: master requeues a lost slave's job.  TPU mapping:
+        slice failure → restart from checkpoint (SURVEY.md §5)."""

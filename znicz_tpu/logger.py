@@ -1,0 +1,85 @@
+"""Logger mixin + structured JSONL metrics sink.
+
+Capability parity with the reference's logging (upstream layout
+``veles/logger.py``; mount was empty — surveyed contract, see SURVEY.md §5):
+a ``Logger`` mixin giving every unit named ``info/debug/warning/error``
+methods and file redirection. The reference's optional MongoDB event sink and
+zmq plot stream are replaced TPU-first with a structured JSONL metrics writer
+(:class:`MetricsWriter`) that plotting/decision units append to — trivially
+consumable by TensorBoard-style tooling and by the test-suite.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+
+_configured = False
+
+
+def configure(level=logging.INFO, filename: str | None = None) -> None:
+    """Set up process-wide logging once (reference: Logger.setup_logging)."""
+    global _configured
+    handlers = [logging.StreamHandler(sys.stderr)]
+    if filename:
+        handlers.append(logging.FileHandler(filename))
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+        handlers=handlers,
+        force=True,
+    )
+    _configured = True
+
+
+class Logger:
+    """Mixin: named logger per instance (reference Logger mixin contract)."""
+
+    @property
+    def logger(self) -> logging.Logger:
+        if not _configured:
+            configure()
+        name = getattr(self, "name", None) or type(self).__name__
+        return logging.getLogger(name)
+
+    def debug(self, msg, *args):
+        self.logger.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self.logger.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self.logger.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self.logger.error(msg, *args)
+
+    @staticmethod
+    def redirect_all_logging_to_file(filename: str) -> None:
+        configure(filename=filename)
+
+
+class MetricsWriter:
+    """Append-only JSONL metrics stream (TPU-first stand-in for the
+    reference's MongoDB sink / zmq graphics stream)."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._fh = open(path, "a") if path else None
+        self.records: list[dict] = []
+
+    def write(self, **fields) -> dict:
+        rec = {"ts": time.time(), **fields}
+        self.records.append(rec)
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec, default=float) + "\n")
+            self._fh.flush()
+        return rec
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

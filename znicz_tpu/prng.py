@@ -1,0 +1,91 @@
+"""Seeded, stream-addressable randomness.
+
+Capability parity with the reference's PRNG (upstream layout ``veles/prng/``;
+mount empty — surveyed contract, SURVEY.md §2.1): a process-global seeded
+generator registry (``get(name)``) so every consumer (weight init, loader
+shuffles, dropout) draws from a named, reproducible stream.
+
+TPU-first design: each stream owns BOTH a numpy ``Generator`` (golden
+``numpy_run`` path) and a JAX threefry key, derived from the same 64-bit
+seed.  Dropout-style in-graph randomness is *counter-based*: keys are folded
+from ``(seed, unit_id, epoch, minibatch)`` so the numpy and XLA/Pallas paths
+can be made bit-identical per (unit, step) without carrying mutable RNG state
+through jitted code (SURVEY.md §7 hard-part (c))."""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import numpy as np
+
+
+class RandomGenerator:
+    """One named random stream with twin numpy/JAX sources."""
+
+    def __init__(self, name: str = "default", seed: int | None = None):
+        self.name = name
+        self.seed(seed if seed is not None else 1234)
+
+    def seed(self, seed: int) -> None:
+        self._seed = int(seed)
+        # Derive a per-stream 64-bit seed from (global seed, stream name).
+        digest = hashlib.sha256(
+            f"{self._seed}:{self.name}".encode()).digest()
+        self.stream_seed = int.from_bytes(digest[:8], "little")
+        self.numpy = np.random.Generator(np.random.PCG64(self.stream_seed))
+        self.key = jax.random.key(self.stream_seed % (2 ** 63))
+        self._fold_count = 0
+
+    # -- JAX side ---------------------------------------------------------
+    def next_key(self):
+        """Stateful convenience for host-side (non-jitted) key consumption."""
+        self._fold_count += 1
+        return jax.random.fold_in(self.key, self._fold_count)
+
+    def key_for(self, *counters: int):
+        """Counter-based key: fold (unit_id, epoch, minibatch, ...) into the
+        stream key.  Pure — safe to call inside jit with traced counters."""
+        key = self.key
+        for c in counters:
+            key = jax.random.fold_in(key, c)
+        return key
+
+    # -- numpy side (golden path) -----------------------------------------
+    def normal(self, loc=0.0, scale=1.0, size=None, dtype=np.float32):
+        return self.numpy.normal(loc, scale, size).astype(dtype)
+
+    def uniform(self, low=-1.0, high=1.0, size=None, dtype=np.float32):
+        return self.numpy.uniform(low, high, size).astype(dtype)
+
+    def fill(self, arr: np.ndarray, vmin=-1.0, vmax=1.0) -> None:
+        """In-place uniform fill (reference ``prng.fill`` contract)."""
+        arr[...] = self.numpy.uniform(vmin, vmax, arr.shape).astype(arr.dtype)
+
+    def shuffle(self, arr) -> None:
+        self.numpy.shuffle(arr)
+
+    def permutation(self, n: int) -> np.ndarray:
+        return self.numpy.permutation(n)
+
+    def randint(self, low, high=None, size=None):
+        return self.numpy.integers(low, high, size)
+
+
+_streams: dict[str, RandomGenerator] = {}
+_global_seed = 1234
+
+
+def seed_all(seed: int) -> None:
+    """Reseed every existing stream and set the seed for future ones."""
+    global _global_seed
+    _global_seed = int(seed)
+    for gen in _streams.values():
+        gen.seed(_global_seed)
+
+
+def get(name: str = "default") -> RandomGenerator:
+    """Named-stream registry (reference ``veles.prng.get()`` contract)."""
+    if name not in _streams:
+        _streams[name] = RandomGenerator(name, _global_seed)
+    return _streams[name]
