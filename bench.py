@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line.
+
+Metric (BASELINE.md plan, step 1–2): MNIST MLP training throughput
+(images/sec) through the fused TPU path, with the numpy golden path on this
+host as the stand-in reference baseline (the reference's own numbers are
+unrecoverable — BASELINE.md provenance note).  ``vs_baseline`` is the
+speedup of the TPU path over that baseline."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def measure_numpy_baseline(epochs: int = 2) -> float:
+    """Images/sec of the unit-graph numpy_run path (reference-equivalent
+    CPU execution model: per-unit Python dispatch + numpy math)."""
+    from znicz_tpu import prng
+    prng.seed_all(1234)
+    from znicz_tpu.backends import Device
+    from znicz_tpu.config import root
+    from znicz_tpu.models import mnist
+
+    root.mnist.synthetic.update({"n_train": 5000, "n_valid": 1000,
+                                 "n_test": 1000})
+    wf = mnist.MnistWorkflow()
+    wf.decision.max_epochs = epochs
+    wf.initialize(device=Device.create("numpy"))
+    t0 = time.perf_counter()
+    wf.run()
+    dt = time.perf_counter() - t0
+    # each epoch processes every class (train fwd+bwd, valid/test fwd)
+    images = wf.loader.total_samples * epochs
+    return images / dt
+
+
+def measure_fused_tpu(epochs: int = 20) -> float:
+    from znicz_tpu import prng
+    prng.seed_all(1234)
+    from znicz_tpu.backends import Device
+    from znicz_tpu.config import root
+    from znicz_tpu.models import mnist
+    from znicz_tpu.parallel import FusedTrainer
+
+    root.mnist.synthetic.update({"n_train": 5000, "n_valid": 1000,
+                                 "n_test": 1000})
+    wf = mnist.MnistWorkflow()
+    wf.initialize(device=Device.create("xla"))
+    tr = FusedTrainer(wf)
+    ld = wf.loader
+    data, target = ld.original_data.devmem, ld.original_labels.devmem
+    n0, n1, n2 = ld.class_lengths
+    test_idx = np.arange(0, n0)
+    valid_idx = np.arange(n0, n0 + n1)
+    train_idx = np.arange(n0 + n1, n0 + n1 + n2)
+    batch = ld.max_minibatch_size
+
+    def one_epoch():
+        """Same per-epoch work as the baseline: train fwd+bwd over the
+        train set, eval fwd over valid+test."""
+        m = tr.train_epoch(data, target, train_idx, batch, sync=False)
+        tr.eval_epoch(data, target, valid_idx, batch, sync=False)
+        tr.eval_epoch(data, target, test_idx, batch, sync=False)
+        return m
+
+    one_epoch()                                   # compile+warm
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(epochs):
+        last = one_epoch()
+    np.asarray(last["loss"])          # one sync at the end
+    dt = time.perf_counter() - t0
+    return epochs * (n0 + n1 + n2) / dt
+
+
+def main() -> None:
+    fused = measure_fused_tpu()
+    baseline = measure_numpy_baseline()
+    print(json.dumps({
+        "metric": "mnist_mlp_train_images_per_sec",
+        "value": round(fused, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(fused / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,11 +7,22 @@ it; distributed paths run on a virtual multi-device CPU mesh.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"   # env presets axon (TPU); tests run CPU
+# NOTE: a sitecustomize in this environment imports jax at interpreter
+# start, so plain env-var overrides are too late.  Setting XLA_FLAGS still
+# works as long as no backend has been initialized, and jax.config can
+# switch the platform post-import.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on CPU; backend was initialized before conftest")
+assert len(jax.devices()) == 8, "virtual 8-device CPU mesh expected"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

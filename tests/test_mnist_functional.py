@@ -1,0 +1,77 @@
+"""Functional convergence tests (reference pattern, SURVEY.md §4): run the
+whole MNIST sample for a few epochs with fixed seeds and assert the error
+trajectory.  Uses the deterministic synthetic dataset (no network)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.config import root
+from znicz_tpu.models import mnist
+from znicz_tpu.snapshotter import SnapshotterToFile
+
+
+@pytest.fixture(autouse=True)
+def small_synthetic():
+    root.mnist.synthetic.update({"n_train": 600, "n_valid": 200,
+                                 "n_test": 200, "noise": 0.35})
+    yield
+
+
+def _run(backend: str, epochs=3):
+    prng.seed_all(1234)
+    return mnist.run(device=Device.create(backend), epochs=epochs)
+
+
+class TestMnistWorkflow:
+    def test_converges_numpy(self):
+        wf = _run("numpy")
+        last = wf.decision.epoch_metrics[-1]
+        assert last["validation_err_pct"] < 5.0, wf.decision.epoch_metrics
+        assert last["train_loss"] < 0.5
+
+    def test_converges_xla(self):
+        wf = _run("xla")
+        last = wf.decision.epoch_metrics[-1]
+        assert last["validation_err_pct"] < 5.0, wf.decision.epoch_metrics
+
+    def test_backends_agree(self):
+        m_np = _run("numpy", epochs=2).decision.epoch_metrics
+        m_x = _run("xla", epochs=2).decision.epoch_metrics
+        # same epoch count and loss trajectories within float tolerance
+        assert len(m_np) == len(m_x)
+        for a, b in zip(m_np, m_x):
+            assert abs(a["train_loss"] - b["train_loss"]) < 5e-2
+            assert abs(a["validation_n_err"] - b["validation_n_err"]) <= 4
+
+    def test_early_stop_on_fail_iterations(self):
+        prng.seed_all(1234)
+        wf = mnist.MnistWorkflow(
+            decision_config={"max_epochs": 50, "fail_iterations": 1})
+        wf.initialize(device=Device.create("numpy"))
+        wf.run()
+        # stops well before 50 epochs once validation stops improving
+        assert wf.loader.epoch_number < 49
+
+    def test_snapshot_resume(self, tmp_path):
+        prng.seed_all(1234)
+        wf = mnist.MnistWorkflow(
+            snapshotter_config={"directory": str(tmp_path), "interval": 1})
+        wf.decision.max_epochs = 2
+        wf.initialize(device=Device.create("numpy"))
+        wf.run()
+        path = wf.snapshotter.last_path
+        assert path is not None
+
+        prng.seed_all(1234)
+        wf2 = mnist.MnistWorkflow()
+        wf2.initialize(device=Device.create("numpy"))
+        meta = SnapshotterToFile.load(wf2, path)
+        assert meta["epoch_number"] >= 1
+        np.testing.assert_array_equal(wf.forwards[0].weights.mem,
+                                      wf2.forwards[0].weights.mem)
+        # resumed workflow continues training without error
+        wf2.decision.max_epochs = 3
+        wf2.run()
+        assert wf2.decision.epoch_metrics[-1]["validation_err_pct"] < 5.0

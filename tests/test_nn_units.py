@@ -1,0 +1,148 @@
+"""Unit-level NN tests (reference pattern, SURVEY.md §4): single units in a
+dummy workflow, numpy vs XLA backend cross-check, and the hand-written GD
+math cross-checked against jax.grad (SURVEY.md §7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from znicz_tpu import Vector, Workflow, prng
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.nn import (All2All, All2AllSoftmax, All2AllTanh,
+                          EvaluatorSoftmax, GDSoftmax, GDTanh)
+from znicz_tpu.ops import activations
+
+
+class Dummy(Workflow):
+    """Minimal parent (reference DummyWorkflow fixture)."""
+
+
+def make_fc(cls, n_in=20, n_out=10, batch=8, device=None, **kw):
+    wf = Dummy(name="dummy")
+    unit = cls(wf, output_sample_shape=n_out, **kw)
+    src = Vector(prng.get("x").normal(size=(batch, n_in)))
+    holder = type("Src", (), {})()
+    holder.output = src
+    unit.link_attrs2 = None
+    unit.__dict__["input"] = src
+    unit.initialize(device or NumpyDevice())
+    return wf, unit
+
+
+class TestAll2All:
+    def test_numpy_vs_xla(self, xla_device):
+        prng.seed_all(3)
+        _, u_np = make_fc(All2AllTanh)
+        prng.seed_all(3)
+        _, u_x = make_fc(All2AllTanh, device=xla_device)
+        np.testing.assert_allclose(u_np.weights.mem, u_x.weights.mem)
+        u_np.run()
+        u_x.run()
+        np.testing.assert_allclose(u_np.output.mem, u_x.output.mem,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_softmax_max_idx(self, xla_device):
+        prng.seed_all(3)
+        _, u = make_fc(All2AllSoftmax, device=xla_device)
+        u.run()
+        y = u.output.mem
+        np.testing.assert_allclose(y.sum(axis=1), 1.0, rtol=1e-5)
+        np.testing.assert_array_equal(u.max_idx.mem, y.argmax(axis=1))
+
+    def test_output_shape_multi_dim(self):
+        _, u = make_fc(All2All, n_out=(2, 5))
+        u.run()
+        assert u.output.mem.shape == (8, 10)
+        assert u.neurons == 10
+
+
+def _loss_fn(params, x, labels):
+    """Functional replica of All2AllTanh → All2AllSoftmax → mean CE."""
+    w1, b1, w2, b2 = params
+    h = activations.Tanh.fwd(x @ w1 + b1, jnp)
+    logits = h @ w2 + b2
+    logp = jax.nn.log_softmax(logits, axis=1)
+    onehot = jax.nn.one_hot(labels, logits.shape[1])
+    return -jnp.mean(jnp.sum(logp * onehot, axis=1))
+
+
+class TestGDvsJaxGrad:
+    """The hand-written backward chain must equal autodiff (SURVEY.md §7:
+    'their math is also cross-checked against jax.grad in tests')."""
+
+    def test_two_layer_chain(self):
+        prng.seed_all(11)
+        batch, n_in, n_hid, n_out = 16, 12, 9, 7
+        x = prng.get("x").normal(size=(batch, n_in))
+        labels = prng.get("y").randint(0, n_out, batch).astype(np.int32)
+
+        wf = Dummy(name="d")
+        f1 = All2AllTanh(wf, output_sample_shape=n_hid)
+        f1.__dict__["input"] = Vector(x)
+        f1.initialize(NumpyDevice())
+        f2 = All2AllSoftmax(wf, output_sample_shape=n_out)
+        f2.link_attrs(f1, ("input", "output"))
+        f2.initialize(NumpyDevice())
+        f1.run()
+        f2.run()
+
+        # evaluator error (y − onehot)/batch
+        probs = f2.output.mem
+        onehot = np.zeros_like(probs)
+        onehot[np.arange(batch), labels] = 1.0
+        err = (probs - onehot) / batch
+
+        g2 = GDSoftmax(wf, apply_gradient=False)
+        g2.setup_from_forward(f2)
+        g2.__dict__["err_output"] = Vector(err)
+        g2.initialize(NumpyDevice())
+        g2.run()
+        g1 = GDTanh(wf, apply_gradient=False, need_err_input=False)
+        g1.setup_from_forward(f1)
+        g1.link_attrs(g2, ("err_output", "err_input"))
+        g1.initialize(NumpyDevice())
+        g1.run()
+
+        params = [jnp.asarray(v) for v in
+                  (f1.weights.mem, f1.bias.mem, f2.weights.mem,
+                   f2.bias.mem)]
+        grads = jax.grad(_loss_fn)(params, jnp.asarray(x),
+                                   jnp.asarray(labels))
+        np.testing.assert_allclose(g1.gradient_weights.mem,
+                                   np.asarray(grads[0]), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(g1.gradient_bias.mem,
+                                   np.asarray(grads[1]), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(g2.gradient_weights.mem,
+                                   np.asarray(grads[2]), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(g2.gradient_bias.mem,
+                                   np.asarray(grads[3]), rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestEvaluatorSoftmax:
+    def test_metrics(self):
+        wf = Dummy(name="d")
+        ev = EvaluatorSoftmax(wf, name="ev")
+        probs = np.array([[0.8, 0.1, 0.1],
+                          [0.2, 0.7, 0.1],
+                          [0.3, 0.3, 0.4],
+                          [0.1, 0.8, 0.1]], np.float32)
+        labels = np.array([0, 1, 1, 0], np.int64)   # 2 wrong
+        ev.__dict__["output"] = Vector(probs)
+        ev.__dict__["max_idx"] = Vector(probs.argmax(1).astype(np.int32))
+        ev.__dict__["labels"] = Vector(labels)
+        loader = type("L", (), {"minibatch_size": 4})()
+        ev.link_loader(loader)
+        ev.initialize(NumpyDevice())
+        ev.run()
+        assert ev.n_err == 2
+        assert ev.err_output.mem.shape == probs.shape
+        # err row 0: (0.8−1)/4 …
+        np.testing.assert_allclose(ev.err_output.mem[0, 0],
+                                   (0.8 - 1.0) / 4, rtol=1e-5)
+        assert ev.confusion_matrix.mem.sum() == 4
+        assert ev.confusion_matrix.mem[1, 1] == 1

@@ -1,0 +1,115 @@
+"""Per-op backend-equivalence tests (SURVEY.md §4: numpy_run is golden;
+accelerated paths must match within dtype tolerance).  Pallas kernels run
+in interpret mode on CPU."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from znicz_tpu.ops import activations, matmul, softmax, tuning, update
+
+
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    monkeypatch.setattr(tuning, "_INTERPRET", True)
+    yield
+
+
+rng = np.random.default_rng(7)
+
+
+class TestMatmul:
+    def test_xla_matches_numpy(self):
+        x = rng.standard_normal((64, 100)).astype(np.float32)
+        w = rng.standard_normal((100, 32)).astype(np.float32)
+        g = matmul.np_matmul(x, w)
+        j = np.asarray(matmul.xla_matmul(jnp.asarray(x), jnp.asarray(w)))
+        np.testing.assert_allclose(g, j, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("shape", [(32, 100, 16), (100, 784, 130),
+                                       (8, 8, 8), (1, 5, 3)])
+    def test_pallas_matches_numpy(self, pallas_interpret, shape):
+        m, k, n = shape
+        x = rng.standard_normal((m, k)).astype(np.float32)
+        w = rng.standard_normal((k, n)).astype(np.float32)
+        g = matmul.np_matmul(x, w)
+        p = np.asarray(matmul.pallas_matmul(jnp.asarray(x),
+                                            jnp.asarray(w)))
+        np.testing.assert_allclose(g, p, rtol=1e-4, atol=1e-4)
+
+
+class TestSoftmax:
+    def test_pallas_softmax(self, pallas_interpret):
+        x = rng.standard_normal((50, 10)).astype(np.float32) * 3
+        gy, gidx = softmax.np_softmax(x)
+        py, pidx = softmax.pallas_softmax(jnp.asarray(x))
+        np.testing.assert_allclose(gy, np.asarray(py), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_array_equal(gidx, np.asarray(pidx))
+
+    def test_fused_ce_matches_golden(self, pallas_interpret):
+        logits = rng.standard_normal((50, 10)).astype(np.float32) * 2
+        labels = rng.integers(0, 10, 50)
+        gy, _ = softmax.np_softmax(logits)
+        gloss, gerr = softmax.np_softmax_ce(gy, labels)
+        py, ploss, perr = softmax.pallas_softmax_ce_from_logits(
+            jnp.asarray(logits), jnp.asarray(labels))
+        np.testing.assert_allclose(gy, np.asarray(py), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(gloss, np.asarray(ploss), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(gerr, np.asarray(perr), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_xla_ce_from_logits(self):
+        logits = rng.standard_normal((20, 10)).astype(np.float32)
+        labels = rng.integers(0, 10, 20)
+        gy, _ = softmax.np_softmax(logits)
+        gloss, gerr = softmax.np_softmax_ce(gy, labels)
+        y, loss, err = softmax.xla_softmax_ce_from_logits(
+            jnp.asarray(logits), jnp.asarray(labels))
+        np.testing.assert_allclose(gloss, np.asarray(loss), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(gerr, np.asarray(err), rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestUpdate:
+    def test_pallas_update_matches_golden(self, pallas_interpret):
+        w = rng.standard_normal((37, 13)).astype(np.float32)
+        g = rng.standard_normal((37, 13)).astype(np.float32)
+        v = rng.standard_normal((37, 13)).astype(np.float32)
+        gw, gv = update.np_sgd_update(w, g, v, 0.01, 5e-4, 0.3, 0.9)
+        hyp = jnp.asarray([0.01, 5e-4, 0.3, 0.9], jnp.float32)
+        pw, pv = update.pallas_sgd_update(jnp.asarray(w), jnp.asarray(g),
+                                          jnp.asarray(v), hyp)
+        np.testing.assert_allclose(gw, np.asarray(pw), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(gv, np.asarray(pv), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_no_momentum_no_decay_is_plain_sgd(self):
+        w = np.ones((4, 4), np.float32)
+        g = np.full((4, 4), 2.0, np.float32)
+        v = np.zeros((4, 4), np.float32)
+        w2, v2 = update.np_sgd_update(w, g, v, 0.5)
+        np.testing.assert_allclose(w2, w - 1.0)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name", sorted(activations.BY_NAME))
+    def test_fwd_numpy_vs_jnp(self, name):
+        cls = activations.BY_NAME[name]
+        x = (rng.standard_normal((16, 32)) * 2).astype(np.float32)
+        yn = cls.fwd(x, np)
+        yj = np.asarray(cls.fwd(jnp.asarray(x), jnp))
+        np.testing.assert_allclose(yn, yj, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("name", sorted(activations.BY_NAME))
+    def test_bwd_matches_finite_difference(self, name):
+        cls = activations.BY_NAME[name]
+        x = (rng.standard_normal((8, 16)) * 2).astype(np.float64)
+        h = 1e-6
+        num = (cls.fwd(x + h, np) - cls.fwd(x - h, np)) / (2 * h)
+        ana = cls.bwd(np.ones_like(x), cls.fwd(x, np), x, np)
+        np.testing.assert_allclose(num, ana, rtol=1e-3, atol=1e-3)

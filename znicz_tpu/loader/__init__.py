@@ -1,0 +1,12 @@
+"""Minibatch serving: train/valid/test splits, shuffling, normalization.
+
+Parity target: the reference loader layer (SURVEY.md §2.1 Loader base row:
+``Loader``, ``FullBatchLoader`` with the whole dataset in one ``Vector``,
+``LoaderMSE``, normalizer family).
+"""
+
+from .base import TEST, TRAIN, VALID, Loader
+from .fullbatch import FullBatchLoader, FullBatchLoaderMSE
+
+__all__ = ["TEST", "TRAIN", "VALID", "Loader", "FullBatchLoader",
+           "FullBatchLoaderMSE"]

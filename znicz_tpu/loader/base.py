@@ -1,0 +1,113 @@
+"""Loader base: minibatch scheduling over test/valid/train sets.
+
+Parity target: the reference ``veles/loader/base.py`` contract (mount empty
+— surveyed contract, SURVEY.md §2.1): class indices 0=test, 1=validation,
+2=train; ``class_lengths``; per-epoch train shuffling from the seeded PRNG;
+``minibatch_data`` / ``minibatch_labels`` / ``minibatch_indices`` Vectors;
+``minibatch_class``, ``last_minibatch``, ``epoch_ended``, ``epoch_number``
+attributes that Decision consumes.
+
+Serve order within an epoch: ascending class index (test → valid → train),
+skipping empty classes; the epoch ends after the train set's last
+minibatch.  The final minibatch of a class may be short; ``minibatch_size``
+holds the *current* batch's size, ``max_minibatch_size`` the configured one
+(shapes stay static for XLA by padding short batches and masking via
+``minibatch_size`` — the TPU-first twist)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import prng
+from ..memory import Vector
+from ..mutable import Bool
+from ..units import Unit
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAMES = ("test", "validation", "train")
+
+
+class Loader(Unit):
+    """Abstract minibatch scheduler; subclasses fill the minibatch."""
+
+    def __init__(self, workflow=None, name=None, minibatch_size=100,
+                 shuffle_limit=np.inf, **kwargs):
+        super().__init__(workflow, name or "loader", **kwargs)
+        self.max_minibatch_size = int(minibatch_size)
+        self.minibatch_size = int(minibatch_size)
+        self.class_lengths = [0, 0, 0]
+        self.epoch_number = 0
+        self.minibatch_class = TRAIN
+        self.minibatch_offset = 0
+        self.minibatch_data = Vector()
+        self.minibatch_labels = Vector()
+        self.minibatch_indices = Vector()
+        self.last_minibatch = Bool(False)
+        self.epoch_ended = Bool(False)
+        self.shuffle_limit = shuffle_limit
+        self._order: list[tuple[int, int]] = []   # (class, offset) queue
+        self._pos = 0
+        self._shuffled: dict[int, np.ndarray] = {}
+        self.prng = prng.get("loader")
+
+    # -- subclass API ------------------------------------------------------
+    def load_data(self) -> None:
+        """Populate class_lengths + backing data.  Subclass hook."""
+        raise NotImplementedError
+
+    def fill_minibatch(self, indices: np.ndarray, klass: int) -> None:
+        """Copy rows ``indices`` into minibatch_data/labels. Subclass hook."""
+        raise NotImplementedError
+
+    # -- scheduling --------------------------------------------------------
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        self.load_data()
+        self.total_samples = int(sum(self.class_lengths))
+        if self.class_lengths[TRAIN] <= 0:
+            raise ValueError("loader has no training samples")
+        for v in (self.minibatch_data, self.minibatch_labels,
+                  self.minibatch_indices):
+            v.initialize(device)
+        self._build_epoch_plan()
+
+    def _class_indices(self, klass: int) -> np.ndarray:
+        start = int(sum(self.class_lengths[:klass]))
+        idx = np.arange(start, start + self.class_lengths[klass])
+        if klass == TRAIN and self.epoch_number < self.shuffle_limit:
+            idx = idx.copy()
+            self.prng.shuffle(idx)
+        return idx
+
+    def _build_epoch_plan(self) -> None:
+        self._order = []
+        self._shuffled = {}
+        for klass in (TEST, VALID, TRAIN):
+            n = self.class_lengths[klass]
+            if n == 0:
+                continue
+            self._shuffled[klass] = self._class_indices(klass)
+            for off in range(0, n, self.max_minibatch_size):
+                self._order.append((klass, off))
+        self._pos = 0
+
+    def run(self) -> None:
+        if self._pos >= len(self._order):          # new epoch
+            self.epoch_number += 1
+            self._build_epoch_plan()
+        klass, off = self._order[self._pos]
+        n = self.class_lengths[klass]
+        size = min(self.max_minibatch_size, n - off)
+        indices = self._shuffled[klass][off:off + size]
+        self.minibatch_class = klass
+        self.minibatch_offset = off + size
+        self.minibatch_size = int(size)
+        self.fill_minibatch(indices, klass)
+        self.minibatch_indices.mem = indices
+        self._pos += 1
+        self.last_minibatch.set(self._pos >= len(self._order))
+        self.epoch_ended.set(bool(self.last_minibatch))
+
+    def reset_state(self) -> None:
+        """For checkpoint-resume: rebuild the plan at the stored epoch."""
+        self._build_epoch_plan()

@@ -1,0 +1,120 @@
+"""FullBatchLoader: entire dataset resident in Vectors.
+
+Parity target: the reference ``FullBatchLoader`` (SURVEY.md §2.1: entire
+dataset in one ``Vector``) and ``LoaderMSE`` (separate target tensor).
+
+TPU-first: ``initialize`` uploads the whole dataset to HBM once; minibatch
+assembly is a device-side gather when running accelerated (no host↔device
+traffic per step), or a numpy fancy-index on the golden path.  Short final
+batches are padded to ``max_minibatch_size`` so XLA sees one static shape;
+consumers mask by ``minibatch_size``."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..memory import Vector
+from .base import Loader
+
+
+class FullBatchLoader(Loader):
+    """Serves minibatches out of in-memory arrays.
+
+    Subclasses (or callers) set ``original_data`` (N, …), ``original_labels``
+    (N,) and ``class_lengths`` in ``load_data``."""
+
+    def __init__(self, workflow=None, name=None, normalization_type="none",
+                 **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.original_data = Vector()
+        self.original_labels = Vector()
+        self.normalization_type = normalization_type
+
+    def load_data(self) -> None:
+        raise NotImplementedError
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        self._normalize()
+        self.original_data.initialize(device)
+        self.original_labels.initialize(device)
+        # Allocate fixed-shape minibatch buffers (static shapes for XLA).
+        sample_shape = self.original_data.shape[1:]
+        self.minibatch_data.mem = np.zeros(
+            (self.max_minibatch_size, *sample_shape),
+            self.original_data.dtype)
+        self.minibatch_labels.mem = np.zeros(
+            (self.max_minibatch_size,), self.original_labels.dtype)
+
+    def _normalize(self) -> None:
+        """Reference normalizer family (linear/mean-disp/none)."""
+        if self.normalization_type == "none":
+            return
+        data = self.original_data.mem.astype(np.float32)
+        if self.normalization_type == "linear":      # to [-1, 1]
+            lo, hi = data.min(), data.max()
+            scale = 2.0 / max(hi - lo, 1e-8)
+            self.original_data.mem = (data - lo) * scale - 1.0
+        elif self.normalization_type == "mean_disp":  # zero mean, unit std
+            mu, sd = data.mean(axis=0), data.std(axis=0) + 1e-8
+            self.original_data.mem = (data - mu) / sd
+        else:
+            raise ValueError(self.normalization_type)
+
+    def fill_minibatch(self, indices: np.ndarray, klass: int) -> None:
+        size = len(indices)
+        if self.device is not None and self.device.is_xla:
+            # device-side gather; pad short batches to the static shape
+            idx = jnp.asarray(indices)
+            if size < self.max_minibatch_size:
+                idx = jnp.pad(idx, (0, self.max_minibatch_size - size),
+                              mode="edge")
+            self.minibatch_data.devmem = jnp.take(
+                self.original_data.devmem, idx, axis=0)
+            self.minibatch_labels.devmem = jnp.take(
+                self.original_labels.devmem, idx, axis=0)
+        else:
+            data = self.minibatch_data.mem
+            labels = self.minibatch_labels.mem
+            data[:size] = self.original_data.mem[indices]
+            labels[:size] = self.original_labels.mem[indices]
+            if size < self.max_minibatch_size:   # pad with last row
+                data[size:] = data[size - 1]
+                labels[size:] = labels[size - 1]
+
+
+class FullBatchLoaderMSE(FullBatchLoader):
+    """Adds a regression target tensor (reference LoaderMSE contract)."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.original_targets = Vector()
+        self.minibatch_targets = Vector()
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if not self.original_targets:
+            # autoencoder-style: target is the input itself
+            self.original_targets.mem = self.original_data.mem
+        self.original_targets.initialize(device)
+        self.minibatch_targets.mem = np.zeros(
+            (self.max_minibatch_size, *self.original_targets.shape[1:]),
+            self.original_targets.dtype)
+        self.minibatch_targets.initialize(device)
+
+    def fill_minibatch(self, indices: np.ndarray, klass: int) -> None:
+        super().fill_minibatch(indices, klass)
+        size = len(indices)
+        if self.device is not None and self.device.is_xla:
+            idx = jnp.asarray(indices)
+            if size < self.max_minibatch_size:
+                idx = jnp.pad(idx, (0, self.max_minibatch_size - size),
+                              mode="edge")
+            self.minibatch_targets.devmem = jnp.take(
+                self.original_targets.devmem, idx, axis=0)
+        else:
+            t = self.minibatch_targets.mem
+            t[:size] = self.original_targets.mem[indices]
+            if size < self.max_minibatch_size:
+                t[size:] = t[size - 1]

@@ -1,0 +1,2 @@
+"""Runnable sample models (reference: veles/znicz/samples — SURVEY.md §2.2):
+MNIST MLP, CIFAR-10 conv, AlexNet, MNIST autoencoder, Kohonen SOM."""

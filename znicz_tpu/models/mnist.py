@@ -1,0 +1,156 @@
+"""MnistWorkflow: the reference's canonical MNIST MLP sample.
+
+Parity target: the reference MNIST sample (SURVEY.md §2.2 Samples row /
+BASELINE.json config 1): ``All2AllTanh(100) → All2AllSoftmax(10)`` with
+``GradientDescent`` training via ``StandardWorkflow``.
+
+Data: real MNIST IDX files are used when present (searched in
+``root.common.mnist_dir`` and conventional locations); otherwise a
+deterministic synthetic MNIST stand-in is generated (class prototypes +
+noise, seeded) — this environment has no network, and the convergence
+tests only need a learnable, reproducible 10-class 28×28 problem.
+
+Run:  ``python -m znicz_tpu.models.mnist [--backend=numpy|xla] [--epochs=N]``
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from .. import prng
+from ..backends import Device
+from ..config import root
+from ..loader.fullbatch import FullBatchLoader
+from ..standard_workflow import StandardWorkflow
+
+root.mnist.update({
+    "minibatch_size": 100,
+    "layers": [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 100},
+         "<-": {"learning_rate": 0.03, "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.03, "gradient_moment": 0.9}},
+    ],
+    "decision": {"max_epochs": 10, "fail_iterations": 50},
+    "synthetic": {"n_train": 5000, "n_valid": 1000, "n_test": 1000,
+                  "noise": 0.35},
+})
+
+
+def _find_mnist_idx() -> str | None:
+    for cand in (root.common.get("mnist_dir"), "/root/data/mnist",
+                 os.path.expanduser("~/.cache/mnist")):
+        if cand and os.path.exists(
+                os.path.join(cand, "train-images-idx3-ubyte.gz")):
+            return cand
+    return None
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as fh:
+        magic, = struct.unpack(">H", fh.read(4)[2:])
+        dims = magic & 0xFF
+        # IDX: magic(4) then dims×uint32 sizes
+        fh.seek(4)
+        shape = struct.unpack(f">{dims}I", fh.read(4 * dims))
+        return np.frombuffer(fh.read(), np.uint8).reshape(shape)
+
+
+class MnistLoader(FullBatchLoader):
+    """Real MNIST when available, deterministic synthetic otherwise."""
+
+    def __init__(self, workflow=None, name=None, synthetic_sizes=None,
+                 **kwargs):
+        kwargs.setdefault("normalization_type", "linear")
+        super().__init__(workflow, name or "mnist_loader", **kwargs)
+        self.synthetic_sizes = synthetic_sizes
+
+    def load_data(self) -> None:
+        mnist_dir = _find_mnist_idx()
+        if mnist_dir:
+            self._load_real(mnist_dir)
+        else:
+            self._load_synthetic()
+
+    def _load_real(self, d: str) -> None:
+        tr_x = _read_idx(os.path.join(d, "train-images-idx3-ubyte.gz"))
+        tr_y = _read_idx(os.path.join(d, "train-labels-idx1-ubyte.gz"))
+        te_x = _read_idx(os.path.join(d, "t10k-images-idx3-ubyte.gz"))
+        te_y = _read_idx(os.path.join(d, "t10k-labels-idx1-ubyte.gz"))
+        n_valid = 10000
+        # order: [test | validation | train] to match class indices
+        self.original_data.mem = np.concatenate(
+            [te_x, tr_x[:n_valid], tr_x[n_valid:]]).astype(
+                np.float32).reshape(-1, 784)
+        self.original_labels.mem = np.concatenate(
+            [te_y, tr_y[:n_valid], tr_y[n_valid:]]).astype(np.int32)
+        self.class_lengths = [len(te_x), n_valid, len(tr_x) - n_valid]
+
+    def _load_synthetic(self) -> None:
+        cfg = self.synthetic_sizes or root.mnist.synthetic.to_dict()
+        n_test, n_valid, n_train = (cfg["n_test"], cfg["n_valid"],
+                                    cfg["n_train"])
+        noise = cfg.get("noise", 0.35)
+        gen = prng.get("mnist_synthetic")
+        protos = gen.normal(0.0, 1.0, (10, 784))
+        n = n_test + n_valid + n_train
+        labels = gen.randint(0, 10, n).astype(np.int32)
+        data = (protos[labels]
+                + gen.normal(0.0, noise, (n, 784))).astype(np.float32)
+        self.original_data.mem = data
+        self.original_labels.mem = labels
+        self.class_lengths = [n_test, n_valid, n_train]
+
+
+class MnistWorkflow(StandardWorkflow):
+    """BASELINE config 1: All2AllTanh → All2AllSoftmax + GD chain."""
+
+    def __init__(self, workflow=None, name="MnistWorkflow", layers=None,
+                 decision_config=None, snapshotter_config=None, **kwargs):
+        loader = MnistLoader(
+            minibatch_size=root.mnist.get("minibatch_size", 100),
+            **{k: v for k, v in kwargs.items()
+               if k in ("synthetic_sizes",)})
+        super().__init__(
+            None, name,
+            layers=layers or root.mnist.get("layers")
+            or root.mnist.layers,
+            loader=loader,
+            loss_function="softmax",
+            decision_config=decision_config
+            or root.mnist.decision.to_dict(),
+            snapshotter_config=snapshotter_config)
+
+
+def run(device: Device | None = None, epochs: int | None = None,
+        **kwargs) -> MnistWorkflow:
+    """Build, initialize and train; returns the finished workflow."""
+    wf = MnistWorkflow(**kwargs)
+    if epochs is not None:
+        wf.decision.max_epochs = epochs
+    wf.initialize(device=device or Device.create("auto"))
+    wf.run()
+    return wf
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--backend", default="auto",
+                        choices=("auto", "numpy", "xla"))
+    parser.add_argument("--epochs", type=int, default=None)
+    args = parser.parse_args(argv)
+    wf = run(device=Device.create(args.backend), epochs=args.epochs)
+    for m in wf.decision.epoch_metrics:
+        print(m)
+    print("time table:", wf.time_table()[:6])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""The NN unit zoo (reference: veles/znicz plugin — SURVEY.md §2.2).
+
+Forward/gradient unit pairs, evaluators, decision logic.  Each forward unit
+has a ``numpy_run`` golden path and an ``xla_run`` accelerated path (XLA +
+Pallas kernels from ``znicz_tpu.ops``); gradient units carry the
+hand-written backward math the reference shipped (cross-checked against
+``jax.grad`` in tests)."""
+
+from .all2all import (All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
+                      All2AllStrictRELU, All2AllTanh)
+from .decision import DecisionBase, DecisionGD, DecisionMSE
+from .evaluator import EvaluatorMSE, EvaluatorSoftmax
+from .gd import (GD, GDRELU, GDSigmoid, GDSoftmax, GDStrictRELU, GDTanh,
+                 GradientDescent)
+from .nn_units import Forward, GradientDescentBase
+
+__all__ = [
+    "All2All", "All2AllRELU", "All2AllSigmoid", "All2AllSoftmax",
+    "All2AllStrictRELU", "All2AllTanh", "DecisionBase", "DecisionGD",
+    "DecisionMSE", "EvaluatorMSE", "EvaluatorSoftmax", "Forward", "GD",
+    "GDRELU", "GDSigmoid", "GDSoftmax", "GDStrictRELU", "GDTanh",
+    "GradientDescent", "GradientDescentBase",
+]

@@ -1,0 +1,130 @@
+"""Fully-connected forward units.
+
+Parity target: the reference ``veles/znicz/all2all.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 [baseline]): ``All2All`` + fused
+activation variants and ``All2AllSoftmax`` with its ``max_idx`` argmax
+output.  The reference's tiled-matmul ``.cl``/``.cu`` kernel is replaced by
+the Pallas MXU matmul (``ops.matmul``); the fused bias+activation the GPU
+kernel did by hand is fused by XLA into the same HBM pass under jit."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..memory import Vector
+from ..ops import activations, matmul, softmax
+from .nn_units import Forward
+
+
+class All2All(Forward):
+    """y = act(x·W + b), x flattened to (batch, features)."""
+
+    MAPPING = ("all2all",)
+    ACTIVATION = activations.Activation
+
+    def __init__(self, workflow=None, name=None, output_sample_shape=None,
+                 output_samples_number=None, **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        if output_sample_shape is None:
+            raise ValueError("output_sample_shape is required")
+        self.output_sample_shape = (
+            (output_sample_shape,) if isinstance(output_sample_shape, int)
+            else tuple(output_sample_shape))
+        self.neurons = int(np.prod(self.output_sample_shape))
+        del output_samples_number  # reference alias, shape comes from input
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        n_in = int(np.prod(self.input.shape[1:]))
+        self.create_weights((n_in, self.neurons), (self.neurons,))
+        if not self.output:   # static output shape → downstream units chain
+            self.output.mem = np.zeros((self.input.shape[0], self.neurons),
+                                       np.float32)
+        self.init_vectors(self.weights, self.bias, self.output)
+        act = self.ACTIVATION
+
+        def fwd(x, w, b):
+            y = matmul.matmul(x.reshape(x.shape[0], -1), w)
+            if b is not None:
+                y = y + b
+            return act.fwd(y, jnp)
+
+        self._fwd_fn = fwd
+
+    def numpy_run(self) -> None:
+        x = self.input.mem.reshape(len(self.input.mem), -1)
+        y = matmul.np_matmul(x, self.weights.mem)
+        if self.include_bias:
+            y = y + self.bias.mem
+        self.output.mem = self.ACTIVATION.fwd(y, np)
+
+    def xla_run(self) -> None:
+        fn = self.jit(self._fwd_fn)
+        self.output.devmem = fn(
+            self.input.devmem, self.weights.devmem,
+            self.bias.devmem if self.include_bias else None)
+
+
+class All2AllTanh(All2All):
+    MAPPING = ("all2all_tanh",)
+    ACTIVATION = activations.Tanh
+
+
+class All2AllRELU(All2All):
+    """Smooth relu log(1+eˣ) — the reference's RELU (SURVEY.md §2.2)."""
+
+    MAPPING = ("all2all_relu",)
+    ACTIVATION = activations.Relu
+
+
+class All2AllStrictRELU(All2All):
+    MAPPING = ("all2all_str",)
+    ACTIVATION = activations.StrictRelu
+
+
+class All2AllSigmoid(All2All):
+    MAPPING = ("all2all_sigmoid",)
+    ACTIVATION = activations.Sigmoid
+
+
+class All2AllSoftmax(All2All):
+    """FC + row softmax; also emits ``max_idx`` (argmax) [baseline].
+
+    Uses the fused Pallas softmax kernel on TPU (ops.softmax); the
+    reference used a separate softmax kernel after the matmul."""
+
+    MAPPING = ("softmax",)
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.max_idx = Vector()
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        self.init_vectors(self.max_idx)
+
+        def fwd_softmax(x, w, b):
+            logits = matmul.matmul(x.reshape(x.shape[0], -1), w)
+            if b is not None:
+                logits = logits + b
+            return softmax.softmax(logits)
+
+        self._fwd_softmax_fn = fwd_softmax
+
+    def numpy_run(self) -> None:
+        x = self.input.mem.reshape(len(self.input.mem), -1)
+        logits = matmul.np_matmul(x, self.weights.mem)
+        if self.include_bias:
+            logits = logits + self.bias.mem
+        y, idx = softmax.np_softmax(logits)
+        self.output.mem = y
+        self.max_idx.mem = idx.astype(np.int32)
+
+    def xla_run(self) -> None:
+        fn = self.jit(self._fwd_softmax_fn)
+        y, idx = fn(self.input.devmem, self.weights.devmem,
+                    self.bias.devmem if self.include_bias else None)
+        self.output.devmem = y
+        self.max_idx.devmem = idx

@@ -1,0 +1,153 @@
+"""Backprop units for fully-connected layers.
+
+Parity target: the reference ``veles/znicz/gd.py`` (mount empty — surveyed
+contract, SURVEY.md §2.2 [baseline GradientDescent*]): hand-written
+gradients — err_input via matmul with Wᵀ, weight/bias gradients via xᵀ·err,
+SGD + momentum + L1/L2 update (the reference's matmul + ``weights_update``
+kernels → Pallas matmul + fused update kernel here).
+
+Math (per activation variant): ``err_y = act.bwd(err_output, y)``;
+``∇W = xᵀ·err_y``; ``∇b = Σ err_y``; ``err_input = err_y·Wᵀ``.  The
+evaluator already scales err_output by 1/batch and zeroes padded rows, so
+no batch normalization happens here (matches the reference's division of
+labor).  Tests cross-check this chain against ``jax.grad`` (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops import activations, matmul, update
+from .nn_units import GradientDescentBase
+
+
+class GradientDescent(GradientDescentBase):
+    """Gradient unit for All2All (linear activation)."""
+
+    MAPPING = ("all2all",)
+    ACTIVATION = activations.Activation
+
+    def _hypers(self):
+        return (self.learning_rate, self.weights_decay, self.l1_vs_l2,
+                self.gradient_moment)
+
+    def _hypers_bias(self):
+        return (self.learning_rate_bias, self.weights_decay_bias,
+                self.l1_vs_l2_bias, self.gradient_moment_bias)
+
+    def numpy_run(self) -> None:
+        act = self.ACTIVATION
+        y = self.output.mem
+        y2 = y.reshape(len(y), -1)
+        err_y = act.bwd(self.err_output.mem.reshape(y2.shape), y2,
+                        self.input.mem.reshape(y2.shape[0], -1)
+                        if act.needs_input else None, np)
+        x = self.input.mem.reshape(len(self.input.mem), -1)
+        gw = matmul.np_matmul(x.T, err_y)
+        gb = err_y.sum(axis=0) if self.include_bias else None
+        if self.accumulate_gradient and self.gradient_weights:
+            gw = gw + self.gradient_weights.mem
+            if gb is not None:
+                gb = gb + self.gradient_bias.mem
+        self.gradient_weights.mem = gw
+        if gb is not None:
+            self.gradient_bias.mem = gb
+        if self.need_err_input:
+            self.err_input.mem = matmul.np_matmul(
+                err_y, self.weights.mem.T).reshape(self.input.shape)
+        if self.apply_gradient:
+            w, vw = update.np_sgd_update(self.weights.mem, gw,
+                                         self.velocity_weights.mem,
+                                         *self._hypers())
+            self.weights.mem = w
+            self.velocity_weights.mem = vw
+            if self.include_bias:
+                b, vb = update.np_sgd_update(self.bias.mem, gb,
+                                             self.velocity_bias.mem,
+                                             *self._hypers_bias())
+                self.bias.mem = b
+                self.velocity_bias.mem = vb
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        act = self.ACTIVATION
+        need_err = self.need_err_input
+        include_bias = self.include_bias
+
+        def bwd(x, w, err_out, y):
+            b = x.shape[0]
+            x2 = x.reshape(b, -1)
+            y2 = y.reshape(b, -1)
+            err_y = act.bwd(err_out.reshape(y2.shape), y2,
+                            x2 if act.needs_input else None, jnp)
+            gw = matmul.matmul(x2.T, err_y)
+            gb = jnp.sum(err_y, axis=0) if include_bias else None
+            err_in = (matmul.matmul(err_y, w.T).reshape(x.shape)
+                      if need_err else None)
+            return gw, gb, err_in
+
+        self._bwd_fn = bwd
+        # one dispatch point for the fused update kernel (ops.update)
+        self._apply_fn = update.sgd_update_h
+
+    def xla_run(self) -> None:
+        bwd = self.jit(self._bwd_fn)
+        gw, gb, err_in = bwd(self.input.devmem, self.weights.devmem,
+                             self.err_output.devmem, self.output.devmem)
+        if self.accumulate_gradient and self.gradient_weights:
+            gw = gw + self.gradient_weights.devmem
+            if gb is not None:
+                gb = gb + self.gradient_bias.devmem
+        self.gradient_weights.devmem = gw
+        if gb is not None:
+            self.gradient_bias.devmem = gb
+        if self.need_err_input:
+            self.err_input.devmem = err_in
+        if self.apply_gradient:
+            apply_fn = self.jit(self._apply_fn)
+            hw = jnp.asarray(self._hypers(), jnp.float32)
+            w, vw = apply_fn(self.weights.devmem, gw,
+                             self.velocity_weights.devmem, hw)
+            self.weights.devmem = w
+            self.velocity_weights.devmem = vw
+            if self.include_bias:
+                hb = jnp.asarray(self._hypers_bias(), jnp.float32)
+                b, vb = apply_fn(self.bias.devmem, gb,
+                                 self.velocity_bias.devmem, hb)
+                self.bias.devmem = b
+                self.velocity_bias.devmem = vb
+
+
+class GDTanh(GradientDescent):
+    MAPPING = ("all2all_tanh",)
+    ACTIVATION = activations.Tanh
+
+
+class GDRELU(GradientDescent):
+    MAPPING = ("all2all_relu",)
+    ACTIVATION = activations.Relu
+
+
+class GDStrictRELU(GradientDescent):
+    MAPPING = ("all2all_str",)
+    ACTIVATION = activations.StrictRelu
+
+
+class GDSigmoid(GradientDescent):
+    MAPPING = ("all2all_sigmoid",)
+    ACTIVATION = activations.Sigmoid
+
+
+class GDSoftmax(GradientDescent):
+    """Softmax layer backprop: EvaluatorSoftmax supplies the error already
+    w.r.t. the *logits* (y − onehot), so the activation pass-through is the
+    identity (matches the reference's GDSoftmax)."""
+
+    MAPPING = ("softmax",)
+    ACTIVATION = activations.Activation
+
+
+#: Reference short alias
+GD = GradientDescent

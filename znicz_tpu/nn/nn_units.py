@@ -1,0 +1,161 @@
+"""Shared forward/gradient unit bases.
+
+Parity target: the reference ``veles/znicz/nn_units.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 NN bases row): ``Forward`` with
+weights/bias Vectors and gaussian/uniform/constant weight init from the
+seeded PRNG; ``GradientDescentBase`` with learning_rate, weights_decay,
+l1_vs_l2, gradient_moment (momentum), gradient accumulation, and separate
+bias hyperparameters.
+
+Layout note (TPU-first deviation, documented for migrating users): weights
+are stored as (n_input, n_output) so the forward matmul is ``x @ W`` with
+no transpose — the MXU-friendly layout — where the reference stored
+(n_output, n_input) plus a ``weights_transposed`` flag."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import prng
+from ..accelerated_units import AcceleratedUnit
+from ..memory import Vector
+from ..ops import activations
+
+
+class Forward(AcceleratedUnit):
+    """Forward-propagation base unit."""
+
+    #: StandardWorkflow layer-type names this class serves.
+    MAPPING: tuple[str, ...] = ()
+    ACTIVATION = activations.Activation
+
+    def __init__(self, workflow=None, name=None, weights_filling="uniform",
+                 weights_stddev=None, bias_filling="uniform",
+                 bias_stddev=None, include_bias=True, **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.weights_filling = weights_filling
+        self.weights_stddev = weights_stddev
+        self.bias_filling = bias_filling
+        self.bias_stddev = bias_stddev
+        self.include_bias = include_bias
+        self.output = Vector()
+        self.weights = Vector()
+        self.bias = Vector()
+        self.prng = prng.get("weights")
+
+    # -- weight init (reference fill semantics) ---------------------------
+    def _fill(self, shape: tuple[int, ...], filling: str,
+              stddev: float | None) -> np.ndarray:
+        fan_in = int(np.prod(shape[:-1])) if len(shape) > 1 else shape[0]
+        stddev = stddev if stddev is not None else 1.0 / max(
+            np.sqrt(fan_in), 1.0)
+        if filling == "uniform":
+            return self.prng.uniform(-stddev, stddev, shape)
+        if filling == "gaussian":
+            return self.prng.normal(0.0, stddev, shape)
+        if filling == "constant":
+            return np.full(shape, stddev, np.float32)
+        raise ValueError(f"unknown filling {filling!r}")
+
+    def create_weights(self, w_shape: tuple[int, ...],
+                       b_shape: tuple[int, ...]) -> None:
+        if not self.weights:
+            self.weights.mem = self._fill(w_shape, self.weights_filling,
+                                          self.weights_stddev)
+        if self.include_bias and not self.bias:
+            self.bias.mem = self._fill(b_shape, self.bias_filling,
+                                       self.bias_stddev
+                                       if self.bias_stddev is not None
+                                       else 0.0)
+            if self.bias_filling == "uniform" and self.bias_stddev is None:
+                self.bias.mem = np.zeros(b_shape, np.float32)
+
+    @property
+    def current_batch_size(self) -> int:
+        """Rows of the minibatch that are real (loader pads short ones)."""
+        wf = self.workflow
+        loader = getattr(wf, "loader", None) if wf is not None else None
+        return loader.minibatch_size if loader is not None \
+            else len(self.input.mem)
+
+
+class GradientDescentBase(AcceleratedUnit):
+    """Backprop base unit (the reference's hand-written gradient units).
+
+    Wired to its paired Forward via ``setup_from_forward``: shares the
+    *same* weights/bias Vectors (updates are visible to the forward unit),
+    links input/output, and produces ``err_input`` for the previous GD unit
+    from ``err_output`` supplied by the next one (or the evaluator)."""
+
+    MAPPING: tuple[str, ...] = ()
+    ACTIVATION = activations.Activation
+
+    def __init__(self, workflow=None, name=None, learning_rate=0.01,
+                 learning_rate_bias=None, weights_decay=0.0,
+                 weights_decay_bias=0.0, l1_vs_l2=0.0, l1_vs_l2_bias=0.0,
+                 gradient_moment=0.0, gradient_moment_bias=None,
+                 apply_gradient=True, need_err_input=True,
+                 accumulate_gradient=False, **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.learning_rate = learning_rate
+        self.learning_rate_bias = (learning_rate_bias
+                                   if learning_rate_bias is not None
+                                   else learning_rate)
+        self.weights_decay = weights_decay
+        self.weights_decay_bias = weights_decay_bias
+        self.l1_vs_l2 = l1_vs_l2
+        self.l1_vs_l2_bias = l1_vs_l2_bias
+        self.gradient_moment = gradient_moment
+        self.gradient_moment_bias = (gradient_moment_bias
+                                     if gradient_moment_bias is not None
+                                     else gradient_moment)
+        self.apply_gradient = apply_gradient
+        self.need_err_input = need_err_input
+        self.accumulate_gradient = accumulate_gradient
+        self.err_input = Vector()
+        self.gradient_weights = Vector()
+        self.gradient_bias = Vector()
+        self.velocity_weights = Vector()
+        self.velocity_bias = Vector()
+        self.forward_unit: Forward | None = None
+
+    def setup_from_forward(self, fwd: Forward) -> "GradientDescentBase":
+        self.forward_unit = fwd
+        self.link_attrs(fwd, "weights", "bias", "input", "output")
+        self.include_bias = fwd.include_bias
+        return self
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if self.weights and not self.velocity_weights:
+            self.velocity_weights.mem = np.zeros(self.weights.shape,
+                                                 np.float32)
+        if self.include_bias and self.bias and not self.velocity_bias:
+            self.velocity_bias.mem = np.zeros(self.bias.shape, np.float32)
+        self.init_vectors(self.err_input, self.gradient_weights,
+                          self.gradient_bias, self.velocity_weights,
+                          self.velocity_bias)
+
+    @property
+    def current_batch_size(self) -> int:
+        wf = self.workflow
+        loader = getattr(wf, "loader", None) if wf is not None else None
+        return loader.minibatch_size if loader is not None \
+            else len(self.output.mem)
+
+    # -- distributed contract (SURVEY.md §2.4) ----------------------------
+    def generate_data_for_master(self):
+        """The pytree this unit contributes to gradient aggregation."""
+        return {"weights": self.gradient_weights.mem,
+                "bias": self.gradient_bias.mem if self.include_bias
+                else None}
+
+    def apply_data_from_slave(self, data, slave=None) -> None:
+        """Host-side fold (golden path only; the XLA path psums on-device)."""
+        if data is None:
+            return
+        self.gradient_weights.map_write()
+        self.gradient_weights.mem += data["weights"]
+        if self.include_bias and data.get("bias") is not None:
+            self.gradient_bias.map_write()
+            self.gradient_bias.mem += data["bias"]

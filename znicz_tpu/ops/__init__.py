@@ -1,0 +1,21 @@
+"""Pure functional math layer.
+
+This package replaces the reference's native kernel surface (SURVEY.md §2.3:
+``.cl``/``.cu`` files for matmul, conv, pooling, LRN, softmax, activations,
+dropout, weight updates, Kohonen) with a three-tier TPU-native design:
+
+1. **numpy goldens** — every op has a plain-numpy implementation; this is
+   the testing contract the reference enforced via ``numpy_run``.
+2. **XLA implementations** — jnp/lax formulations that XLA fuses and tiles
+   onto the MXU/VPU automatically (``lax.dot_general``,
+   ``lax.conv_general_dilated``, ``lax.reduce_window``).
+3. **Pallas kernels** — hand-tiled TPU kernels for the ops the reference
+   shipped as hand-written GPU kernels (the native-parity requirement),
+   cross-checked against tiers 1–2 in tests.
+
+Dispatch: ``znicz_tpu.ops.tuning`` decides per-op whether the Pallas kernel
+or the XLA formulation runs on the current backend (Pallas requires real TPU
+or interpret mode).
+"""
+
+from . import activations, matmul, softmax, update  # noqa: F401

@@ -1,0 +1,180 @@
+"""Activation math: forward + derivative pairs.
+
+Parity target: the reference's activation kernel family (SURVEY.md §2.2
+Activation row: Tanh, RELU, StrictRELU, Sigmoid, Log, SinCos, Mul, TanhLog
+— elementwise ``.cl``/``.cu`` kernels).  Here each activation is a pair of
+pure functions generic over the array namespace (``xp`` = numpy for the
+golden path, ``jax.numpy`` for XLA, where they fuse into adjacent matmuls —
+the TPU-native replacement for hand-fused GPU kernels).
+
+Derivative convention (matches the reference's gradient units): ``bwd``
+receives the upstream error plus whichever of (output, input) the formula
+needs, and returns the error w.r.t. the activation input.
+
+Reference formula notes (Veles-specific, kept for behavioural parity):
+* ``tanh``  is the scaled LeCun tanh ``1.7159·tanh(0.6666·x)`` whose
+  derivative in terms of the *output* is ``1.14381894 − 0.388484177·y²``.
+* ``relu``  is the *smooth* relu ``log(1+eˣ)`` (softplus); ``strict_relu``
+  is the familiar ``max(0, x)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TANH_A = 1.7159
+TANH_B = 0.6666
+_TANH_D1 = TANH_A * TANH_B            # 1.14381894
+_TANH_D2 = TANH_B / TANH_A            # 0.388484177 = d1 / a²
+
+
+class Activation:
+    """Namespace-style activation definition."""
+
+    name = "linear"
+    needs_input = False    # bwd uses only output unless set
+
+    @staticmethod
+    def fwd(x, xp=np):
+        return x
+
+    @staticmethod
+    def bwd(err_y, y, x=None, xp=np):
+        return err_y
+
+
+class Tanh(Activation):
+    name = "tanh"
+
+    @staticmethod
+    def fwd(x, xp=np):
+        return TANH_A * xp.tanh(TANH_B * x)
+
+    @staticmethod
+    def bwd(err_y, y, x=None, xp=np):
+        return err_y * (_TANH_D1 - _TANH_D2 * y * y)
+
+
+class Relu(Activation):
+    """Smooth relu: y = log(1+eˣ); dy/dx = 1 − e^(−y) (= sigmoid(x))."""
+
+    name = "relu"
+
+    @staticmethod
+    def fwd(x, xp=np):
+        # numerically stable softplus: max(x, 0) + log1p(exp(-|x|))
+        return xp.maximum(x, 0.0) + xp.log1p(xp.exp(-xp.abs(x)))
+
+    @staticmethod
+    def bwd(err_y, y, x=None, xp=np):
+        return err_y * (1.0 - xp.exp(-y))
+
+
+class StrictRelu(Activation):
+    name = "strict_relu"
+
+    @staticmethod
+    def fwd(x, xp=np):
+        return xp.maximum(x, 0.0)
+
+    @staticmethod
+    def bwd(err_y, y, x=None, xp=np):
+        return err_y * (y > 0)
+
+
+class Sigmoid(Activation):
+    name = "sigmoid"
+
+    @staticmethod
+    def fwd(x, xp=np):
+        return 1.0 / (1.0 + xp.exp(-x))
+
+    @staticmethod
+    def bwd(err_y, y, x=None, xp=np):
+        return err_y * y * (1.0 - y)
+
+
+class Log(Activation):
+    """y = log(x + sqrt(x²+1)) (asinh); derivative needs the input."""
+
+    name = "log"
+    needs_input = True
+
+    @staticmethod
+    def fwd(x, xp=np):
+        return xp.log(x + xp.sqrt(x * x + 1.0))
+
+    @staticmethod
+    def bwd(err_y, y, x=None, xp=np):
+        return err_y / xp.sqrt(x * x + 1.0)
+
+
+class SinCos(Activation):
+    """Alternating sin/cos over the last axis (reference SinCos unit)."""
+
+    name = "sincos"
+    needs_input = True
+
+    @staticmethod
+    def fwd(x, xp=np):
+        idx = xp.arange(x.shape[-1])
+        return xp.where(idx % 2 == 0, xp.sin(x), xp.cos(x))
+
+    @staticmethod
+    def bwd(err_y, y, x=None, xp=np):
+        idx = xp.arange(x.shape[-1])
+        return err_y * xp.where(idx % 2 == 0, xp.cos(x), -xp.sin(x))
+
+
+class Mul(Activation):
+    """y = x·k (reference ActivationMul with constant factor)."""
+
+    name = "mul"
+    k = 1.0
+
+    @staticmethod
+    def fwd(x, xp=np, k=1.0):
+        return x * k
+
+    @staticmethod
+    def bwd(err_y, y, x=None, xp=np, k=1.0):
+        return err_y * k
+
+
+class TanhLog(Activation):
+    """Scaled tanh in the linear region, log growth outside (reference
+    TanhLog hybrid): |x| ≤ t → 1.7159·tanh(0.6666·x);
+    |x| > t → sign(x)·(A·log(|x·0.6666|) + C) chosen C¹-continuous at t."""
+
+    name = "tanhlog"
+    needs_input = True
+    THRESHOLD = 1.5 / TANH_B   # switch where tanh saturates (~2.25)
+
+    @staticmethod
+    def fwd(x, xp=np):
+        t = TanhLog.THRESHOLD
+        yt = TANH_A * xp.tanh(TANH_B * x)
+        # match value & slope at |x| = t
+        y_t = TANH_A * np.tanh(TANH_B * t)
+        s_t = _TANH_D1 * (1.0 - np.tanh(TANH_B * t) ** 2)
+        a = s_t * t
+        ylog = xp.sign(x) * (a * xp.log(xp.maximum(xp.abs(x), t) / t) + y_t)
+        return xp.where(xp.abs(x) <= t, yt, ylog)
+
+    @staticmethod
+    def bwd(err_y, y, x=None, xp=np):
+        t = TanhLog.THRESHOLD
+        th = xp.tanh(TANH_B * x)
+        d_tanh = _TANH_D1 * (1.0 - th * th)
+        s_t = _TANH_D1 * (1.0 - np.tanh(TANH_B * t) ** 2)
+        d_log = s_t * t / xp.maximum(xp.abs(x), t)
+        return err_y * xp.where(xp.abs(x) <= t, d_tanh, d_log)
+
+
+#: Registry keyed by reference-style activation name.
+BY_NAME: dict[str, type[Activation]] = {
+    cls.name: cls
+    for cls in (Activation, Tanh, Relu, StrictRelu, Sigmoid, Log, SinCos,
+                Mul, TanhLog)
+}
+BY_NAME["linear"] = Activation

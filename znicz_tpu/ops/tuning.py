@@ -1,0 +1,51 @@
+"""Kernel dispatch + tile-size selection.
+
+Replaces the reference's device-info database of tuned per-(device, dtype,
+op) BLOCK_SIZEs (SURVEY.md §2.1 Backends row): on TPU the MXU/VPU geometry
+is fixed (128×128 MXU, 8×128 VPU lanes), so tiles are derived from dtype
+min-tile rules instead of an empirical database.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+#: Force-disable Pallas kernels (fall back to pure-XLA formulations).
+_DISABLE = os.environ.get("ZNICZ_TPU_NO_PALLAS", "0") == "1"
+#: Force interpret-mode Pallas (CPU testing of kernel logic).
+_INTERPRET = os.environ.get("ZNICZ_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def on_tpu() -> bool:
+    platform = jax.default_backend()
+    return platform not in ("cpu", "gpu")
+
+
+def use_pallas() -> bool:
+    """Pallas kernels run on real TPU, or anywhere under interpret mode."""
+    if _DISABLE:
+        return False
+    return on_tpu() or _INTERPRET
+
+
+def interpret_mode() -> bool:
+    return _INTERPRET and not on_tpu()
+
+
+# dtype → (sublane, lane) minimum tile (pallas_guide.md tiling table)
+_MIN_TILE = {
+    jnp.float32: (8, 128),
+    jnp.bfloat16: (16, 128),
+    jnp.int8: (32, 128),
+}
+
+
+def min_tile(dtype) -> tuple[int, int]:
+    return _MIN_TILE.get(jnp.dtype(dtype).type, (8, 128))
+
+
+def round_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult

@@ -1,0 +1,17 @@
+"""Distributed execution: mesh-sharded fused training steps.
+
+Replaces the reference's entire master–slave layer (SURVEY.md §2.4:
+Twisted TCP control plane + ZeroMQ data plane, pickled tensors,
+``apply_data_from_slave`` Python-side aggregation) with the TPU-native
+design from the north star: the whole train step (forwards + evaluator +
+backward + update) compiles to ONE jitted function laid out over a
+``jax.sharding.Mesh``; gradient aggregation is the all-reduce XLA inserts
+for the sharded batch dimension, riding ICI.  Multi-host runs bootstrap
+via ``jax.distributed`` (DCN coordination) instead of a Twisted server.
+"""
+
+from .fused import (FusedTrainer, ModelSpec, extract_model)
+from .mesh import make_mesh, shard_batch, shard_params
+
+__all__ = ["FusedTrainer", "ModelSpec", "extract_model", "make_mesh",
+           "shard_batch", "shard_params"]

@@ -1,0 +1,317 @@
+"""Fused train step: the whole unit chain as one jitted function.
+
+This is the TPU-native execution model (SURVEY.md §7): the unit graph built
+by ``StandardWorkflow`` stays the assembly/testing surface, while this
+module compiles the SAME math — forward chain + evaluator + hand-written
+backward chain + SGD update — into one ``jit``-ted, mesh-shardable step,
+eliminating the per-minibatch Python dispatch the reference paid
+(SURVEY.md §3.1 hot-loop note).  A whole epoch runs as a ``lax.scan`` over
+a precomputed index matrix with the dataset HBM-resident, so the host
+touches the device once per epoch, not once per unit per minibatch.
+
+Gradient aggregation across the ``data`` mesh axis is the all-reduce XLA
+inserts automatically for the sharded batch dim — the TPU replacement for
+the reference's ``apply_data_from_slave`` fold [baseline]."""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import activations, softmax as softmax_ops
+from . import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerSpec:
+    kind: str                     # "fc" (conv variants arrive with §7.4)
+    activation: str               # activations.BY_NAME key; last fc layer
+    include_bias: bool            # of a softmax model keeps "linear"
+    hypers: tuple                 # (lr, weights_decay, l1_vs_l2, momentum)
+    hypers_bias: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    layers: tuple[LayerSpec, ...]
+    loss: str                     # "softmax" | "mse"
+    compute_dtype: str = "float32"
+
+    def __post_init__(self):
+        for layer in self.layers:
+            act = activations.BY_NAME[layer.activation]
+            if act.needs_input:
+                # forward() caches post-activation values only, so
+                # derivative-needs-input activations can't run fused;
+                # use the unit-graph path for those.
+                raise NotImplementedError(
+                    f"activation {layer.activation!r} needs its input "
+                    f"for the backward pass and is not supported by the "
+                    f"fused step")
+
+    def act(self, i: int):
+        return activations.BY_NAME[self.layers[i].activation]
+
+
+def extract_model(workflow) -> tuple[ModelSpec, list, list]:
+    """Read (spec, params, velocities) out of an initialized
+    StandardWorkflow.  params/velocities: list of (w, b) numpy pairs."""
+    layers, params, vels = [], [], []
+    for fwd, gdu in zip(workflow.forwards, workflow.gds):
+        from ..nn.all2all import All2All, All2AllSoftmax
+        if not isinstance(fwd, All2All):
+            raise NotImplementedError(
+                f"fused path supports FC layers for now, got {type(fwd)}")
+        act = ("linear" if isinstance(fwd, All2AllSoftmax)
+               else fwd.ACTIVATION.name)
+        layers.append(LayerSpec(
+            kind="fc", activation=act, include_bias=fwd.include_bias,
+            hypers=(gdu.learning_rate, gdu.weights_decay, gdu.l1_vs_l2,
+                    gdu.gradient_moment),
+            hypers_bias=(gdu.learning_rate_bias, gdu.weights_decay_bias,
+                         gdu.l1_vs_l2_bias, gdu.gradient_moment_bias)))
+        params.append((np.asarray(fwd.weights.mem),
+                       np.asarray(fwd.bias.mem) if fwd.include_bias
+                       else None))
+        vels.append((np.asarray(gdu.velocity_weights.mem),
+                     np.asarray(gdu.velocity_bias.mem)
+                     if fwd.include_bias else None))
+    loss = workflow.loss_function
+    return ModelSpec(tuple(layers), loss), params, vels
+
+
+# -- pure math (all traced; spec is static) --------------------------------
+def forward(spec: ModelSpec, params, x, *, want_caches: bool):
+    """Returns (net_output_pre_loss, caches).  For softmax loss the last
+    layer's output is the *logits* (loss fusion happens in the step)."""
+    cdt = jnp.dtype(spec.compute_dtype)
+    h = x.reshape(x.shape[0], -1)
+    caches = [h]
+    n = len(spec.layers)
+    for i, (layer, (w, b)) in enumerate(zip(spec.layers, params)):
+        pre = jnp.dot(h.astype(cdt), w.astype(cdt),
+                      preferred_element_type=jnp.float32)
+        if b is not None:
+            pre = pre + b
+        is_last = i == n - 1
+        if is_last and spec.loss == "softmax":
+            h = pre                       # logits; softmax fused with CE
+        else:
+            h = spec.act(i).fwd(pre, jnp)
+        if want_caches and not is_last:
+            caches.append(h)
+    return h, caches
+
+
+def predict(spec: ModelSpec, params, x):
+    out, _ = forward(spec, params, x, want_caches=False)
+    if spec.loss == "softmax":
+        return jax.nn.softmax(out, axis=1)
+    return out
+
+
+def _loss_and_err(spec: ModelSpec, out, target, mask):
+    """(mean loss, err w.r.t. last pre-activation, n_err); ``mask`` is a
+    per-row 0/1 vector zeroing the wrap-padded tail of a short final
+    minibatch, so fused metrics/gradients match the unit-graph exactly."""
+    bs = jnp.maximum(jnp.sum(mask), 1.0)
+    if spec.loss == "softmax":
+        # dispatcher: fused Pallas softmax-CE kernel on TPU, XLA otherwise
+        probs, loss, err = softmax_ops.softmax_ce_from_logits(out, target)
+        n_err = jnp.sum((jnp.argmax(probs, axis=1) != target) * mask)
+        return (jnp.sum(loss * mask) / bs, err * mask[:, None] / bs,
+                n_err.astype(jnp.int32))
+    diff = (out - target.reshape(out.shape)) * mask[:, None]
+    loss = jnp.sum(diff * diff) / (bs * out.shape[1])
+    # err w.r.t. the activated output, scaled 1/batch (matches
+    # EvaluatorMSE); train_minibatch folds it through the last activation
+    return loss, diff / bs, jnp.zeros((), jnp.int32)
+
+
+def backward(spec: ModelSpec, params, caches, err_y):
+    """Hand-written gradient chain (same math as the GD* units)."""
+    cdt = jnp.dtype(spec.compute_dtype)
+    grads = [None] * len(spec.layers)
+    for i in reversed(range(len(spec.layers))):
+        w, b = params[i]
+        x_i = caches[i]
+        gw = jnp.dot(x_i.astype(cdt).T, err_y.astype(cdt),
+                     preferred_element_type=jnp.float32)
+        gb = jnp.sum(err_y, axis=0) if b is not None else None
+        grads[i] = (gw, gb)
+        if i > 0:
+            err_h = jnp.dot(err_y.astype(cdt), w.astype(cdt).T,
+                            preferred_element_type=jnp.float32)
+            y_prev = caches[i]
+            err_y = spec.act(i - 1).bwd(err_h, y_prev, None, jnp)
+    return grads
+
+
+def apply_updates(spec: ModelSpec, params, vels, grads):
+    # Inline update math (not the Pallas update kernel): inside the fused
+    # step XLA fuses these elementwise ops into the surrounding graph; the
+    # Pallas kernel serves the unit-graph path where each op dispatches
+    # separately (the reference's kernel-per-op model).
+    new_p, new_v = [], []
+    for layer, (w, b), (vw, vb), (gw, gb) in zip(spec.layers, params,
+                                                 vels, grads):
+        lr, wd, l1, mom = layer.hypers
+        reg = wd * ((1.0 - l1) * w + 0.5 * l1 * jnp.sign(w))
+        vw2 = mom * vw - lr * (gw + reg)
+        w2 = w + vw2
+        if b is not None:
+            lrb, wdb, l1b, momb = layer.hypers_bias
+            regb = wdb * ((1.0 - l1b) * b + 0.5 * l1b * jnp.sign(b))
+            vb2 = momb * vb - lrb * (gb + regb)
+            b2 = b + vb2
+        else:
+            b2, vb2 = None, None
+        new_p.append((w2, b2))
+        new_v.append((vw2, vb2))
+    return new_p, new_v
+
+
+def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None):
+    if mask is None:
+        mask = jnp.ones((x.shape[0],), jnp.float32)
+    out, caches = forward(spec, params, x, want_caches=True)
+    loss, err, n_err = _loss_and_err(spec, out, target, mask)
+    if spec.loss == "mse":   # fold through the last layer's activation
+        err = spec.act(len(spec.layers) - 1).bwd(err, out, None, jnp)
+    grads = backward(spec, params, caches, err)
+    params, vels = apply_updates(spec, params, vels, grads)
+    metrics = {"loss": loss, "n_err": n_err}
+    return params, vels, metrics
+
+
+def eval_minibatch(spec: ModelSpec, params, x, target, mask=None):
+    if mask is None:
+        mask = jnp.ones((x.shape[0],), jnp.float32)
+    out, _ = forward(spec, params, x, want_caches=False)
+    loss, _, n_err = _loss_and_err(spec, out, target, mask)
+    return {"loss": loss, "n_err": n_err}
+
+
+class FusedTrainer:
+    """Owns device-resident params and compiled epoch functions.
+
+    ``mesh``: optional ``jax.sharding.Mesh`` with ("data", "model") axes —
+    params get TP shardings (mesh.shard_params), batches shard over
+    ``data``; XLA inserts the gradient all-reduce.  With no mesh,
+    single-device jit."""
+
+    def __init__(self, workflow=None, spec: ModelSpec | None = None,
+                 params=None, vels=None, mesh=None):
+        if workflow is not None:
+            spec, params, vels = extract_model(workflow)
+        self.spec = spec
+        self.mesh = mesh
+        self.workflow = workflow
+        if mesh is not None:
+            self._param_shardings = [
+                (mesh_lib.shard_params(mesh, i, 2),
+                 mesh_lib.replicated(mesh))
+                for i in range(len(spec.layers))]
+            put = lambda a, s: jax.device_put(a, s)      # noqa: E731
+            self.params = [
+                (put(w, sh[0]), put(b, sh[1]) if b is not None else None)
+                for (w, b), sh in zip(params, self._param_shardings)]
+            self.vels = [
+                (put(vw, sh[0]),
+                 put(vb, sh[1]) if vb is not None else None)
+                for (vw, vb), sh in zip(vels, self._param_shardings)]
+            self._batch_sharding = mesh_lib.shard_batch(mesh)
+            self._repl = mesh_lib.replicated(mesh)
+        else:
+            self.params = jax.device_put(params)
+            self.vels = jax.device_put(vels)
+            self._batch_sharding = None
+        self._train_epoch_fn = None
+        self._eval_epoch_fn = None
+
+    # -- epoch-granular compiled drivers ----------------------------------
+    def _build(self):
+        spec = self.spec
+
+        def train_epoch(params, vels, data, target, idx, mask):
+            def body(carry, step):
+                params, vels = carry
+                step_idx, step_mask = step
+                x = jnp.take(data, step_idx, axis=0)
+                t = jnp.take(target, step_idx, axis=0)
+                if self._batch_sharding is not None:
+                    x = jax.lax.with_sharding_constraint(
+                        x, self._batch_sharding)
+                params, vels, m = train_minibatch(spec, params, vels, x,
+                                                  t, step_mask)
+                return (params, vels), m
+            (params, vels), ms = jax.lax.scan(body, (params, vels),
+                                              (idx, mask))
+            return params, vels, ms
+
+        def eval_epoch(params, data, target, idx, mask):
+            def body(_, step):
+                step_idx, step_mask = step
+                x = jnp.take(data, step_idx, axis=0)
+                t = jnp.take(target, step_idx, axis=0)
+                if self._batch_sharding is not None:
+                    x = jax.lax.with_sharding_constraint(
+                        x, self._batch_sharding)
+                return None, eval_minibatch(spec, params, x, t, step_mask)
+            _, ms = jax.lax.scan(body, None, (idx, mask))
+            return ms
+
+        self._train_epoch_fn = jax.jit(train_epoch, donate_argnums=(0, 1))
+        self._eval_epoch_fn = jax.jit(eval_epoch)
+
+    def _idx_matrix(self, indices: np.ndarray,
+                    batch: int) -> tuple[np.ndarray, np.ndarray]:
+        """(steps, batch) int32 indices + 0/1 mask.  The final short batch
+        wraps around for a static shape; the mask zeroes the padded tail
+        so metrics and gradients count each sample exactly once."""
+        n = len(indices)
+        steps = max(1, -(-n // batch))
+        padded = np.resize(indices, steps * batch)
+        mask = np.zeros(steps * batch, np.float32)
+        mask[:n] = 1.0
+        return (padded.reshape(steps, batch).astype(np.int32),
+                mask.reshape(steps, batch))
+
+    def train_epoch(self, data, target, indices, batch: int,
+                    sync: bool = True) -> dict:
+        """One epoch on device.  ``sync=False`` returns device arrays
+        without a host readback — on tunneled TPUs a device→host fetch
+        costs ~100× a step, so throughput loops should defer syncing."""
+        if self._train_epoch_fn is None:
+            self._build()
+        idx, mask = self._idx_matrix(np.asarray(indices), batch)
+        self.params, self.vels, ms = self._train_epoch_fn(
+            self.params, self.vels, data, target, idx, mask)
+        return {k: np.asarray(v) for k, v in ms.items()} if sync else ms
+
+    def eval_epoch(self, data, target, indices, batch: int,
+                   sync: bool = True) -> dict:
+        if self._eval_epoch_fn is None:
+            self._build()
+        idx, mask = self._idx_matrix(np.asarray(indices), batch)
+        ms = self._eval_epoch_fn(self.params, data, target, idx, mask)
+        return {k: np.asarray(v) for k, v in ms.items()} if sync else ms
+
+    # -- sync back into the unit graph ------------------------------------
+    def write_back(self) -> None:
+        """Install trained params into the workflow's unit Vectors."""
+        if self.workflow is None:
+            return
+        for fwd, gdu, (w, b), (vw, vb) in zip(
+                self.workflow.forwards, self.workflow.gds, self.params,
+                self.vels):
+            fwd.weights.mem = np.asarray(w)
+            if b is not None:
+                fwd.bias.mem = np.asarray(b)
+            gdu.velocity_weights.mem = np.asarray(vw)
+            if vb is not None:
+                gdu.velocity_bias.mem = np.asarray(vb)

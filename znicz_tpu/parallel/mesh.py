@@ -1,0 +1,51 @@
+"""Mesh construction + sharding rules.
+
+The scaling recipe (How to Scale Your Model): pick a mesh, annotate
+shardings, let XLA insert collectives.  Axes:
+
+* ``data``  — batch dimension; gradient aggregation becomes the ICI
+  all-reduce XLA inserts (the reference's ``apply_data_from_slave``).
+* ``model`` — optional tensor parallelism for wide FC/conv layers:
+  alternate layers shard weights on the output / input feature dim, so
+  activations stay sharded and XLA inserts reduce-scatter/all-gather
+  pairs between layers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_data: int | None = None, n_model: int = 1,
+              devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n_total = len(devices)
+    if n_data is None:
+        n_data = n_total // n_model
+    assert n_data * n_model <= n_total, (n_data, n_model, n_total)
+    arr = np.asarray(devices[:n_data * n_model]).reshape(n_data, n_model)
+    return Mesh(arr, ("data", "model"))
+
+
+def shard_batch(mesh: Mesh):
+    """Batch tensors: leading dim over ``data``."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_params(mesh: Mesh, layer_index: int, ndim: int):
+    """Tensor-parallel weight sharding: even layers split the output
+    features, odd layers the input features (Megatron-style pairing, so
+    the activation stays sharded across the pair).  With ``model`` axis
+    size 1 this degenerates to replication."""
+    if mesh.shape["model"] == 1 or ndim < 2:
+        return replicated(mesh)
+    if layer_index % 2 == 0:
+        return NamedSharding(mesh, P(None, "model"))    # column parallel
+    return NamedSharding(mesh, P("model", None))        # row parallel

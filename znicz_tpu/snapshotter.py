@@ -1,0 +1,127 @@
+"""Checkpoint/resume: pytree snapshots of workflow state.
+
+Parity target: the reference ``veles/snapshotter.py`` (mount empty —
+surveyed contract, SURVEY.md §2.1/§3.4/§5): periodic + on-improvement
+snapshots, "best" snapshot kept separately, compression, CLI resume.
+
+TPU-first redesign (SURVEY.md §5): instead of pickling live Python objects
+(units, device buffers), snapshots are *data*: an ``.npz`` of every
+parameter/optimizer array addressed by ``unit_name/vector_name``, plus a
+JSON sidecar of host-side counters (epoch, best error, decision state).
+Restore rebuilds the workflow from code and loads arrays in — robust across
+code changes, and exactly how Orbax-style TPU checkpointing treats state."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from .units import Unit
+
+#: Vector attributes captured per unit, in precedence order.
+_STATE_VECTORS = ("weights", "bias", "velocity_weights", "velocity_bias",
+                  "gradient_weights", "gradient_bias")
+
+
+def collect_state(workflow) -> tuple[dict[str, np.ndarray], dict]:
+    """(arrays keyed unit/vector, host-side counters)."""
+    arrays: dict[str, np.ndarray] = {}
+    seen_vectors: set[int] = set()
+    for unit in workflow.units:
+        for attr in _STATE_VECTORS:
+            vec = unit.__dict__.get(attr)   # skip link_attrs aliases
+            if vec is None or not vec:
+                continue
+            if id(vec) in seen_vectors:
+                continue
+            seen_vectors.add(id(vec))
+            arrays[f"{unit.name}/{attr}"] = np.asarray(vec.mem)
+    meta = {"time": time.time()}
+    loader = getattr(workflow, "loader", None)
+    if loader is not None:
+        meta["epoch_number"] = loader.epoch_number
+    decision = getattr(workflow, "decision", None)
+    if decision is not None:
+        meta["best_n_err"] = float(getattr(decision, "best_n_err",
+                                           np.inf))
+        meta["best_mse"] = float(getattr(decision, "best_mse", np.inf))
+        meta["epoch_metrics"] = decision.epoch_metrics
+    return arrays, meta
+
+
+def restore_state(workflow, arrays: dict, meta: dict) -> None:
+    for unit in workflow.units:
+        for attr in _STATE_VECTORS:
+            key = f"{unit.name}/{attr}"
+            vec = unit.__dict__.get(attr)
+            if key in arrays and vec is not None:
+                vec.mem = arrays[key]
+                if getattr(unit, "device", None) is not None \
+                        and unit.device is not None and unit.device.is_xla:
+                    vec.unmap()
+    loader = getattr(workflow, "loader", None)
+    if loader is not None and "epoch_number" in meta:
+        loader.epoch_number = int(meta["epoch_number"])
+        loader.reset_state()
+    decision = getattr(workflow, "decision", None)
+    if decision is not None:
+        if "best_n_err" in meta:
+            decision.best_n_err = meta["best_n_err"]
+        if "best_mse" in meta and hasattr(decision, "best_mse"):
+            decision.best_mse = meta["best_mse"]
+        if "epoch_metrics" in meta:
+            decision.epoch_metrics = list(meta["epoch_metrics"])
+
+
+class SnapshotterBase(Unit):
+    def __init__(self, workflow=None, name=None, prefix="snapshot",
+                 directory="snapshots", interval=1, keep_best=True,
+                 **kwargs):
+        super().__init__(workflow, name or "snapshotter", **kwargs)
+        self.prefix = prefix
+        self.directory = directory
+        self.interval = interval
+        self.keep_best = keep_best
+        self._epochs_seen = 0
+        self.last_path: str | None = None
+        self.best_path: str | None = None
+
+
+class SnapshotterToFile(SnapshotterBase):
+    """Writes ``<dir>/<prefix>_current.npz`` every ``interval`` epochs and
+    ``<prefix>_best.npz`` whenever Decision reports improvement."""
+
+    def run(self) -> None:
+        decision = self.workflow.decision
+        if not bool(self.workflow.loader.last_minibatch):
+            return
+        self._epochs_seen += 1
+        improved = bool(decision.snapshot_suggested)
+        if improved:
+            decision.snapshot_suggested.set(False)
+        if self._epochs_seen % self.interval == 0 or improved:
+            self.last_path = self.save("current")
+        if improved and self.keep_best:
+            self.best_path = self.save("best")
+
+    def save(self, tag: str) -> str:
+        os.makedirs(self.directory, exist_ok=True)
+        arrays, meta = collect_state(self.workflow)
+        path = os.path.join(self.directory, f"{self.prefix}_{tag}.npz")
+        np.savez_compressed(path, **arrays)
+        with open(path + ".json", "w") as fh:
+            json.dump(meta, fh, default=float)
+        self.debug("snapshot → %s", path)
+        return path
+
+    @staticmethod
+    def load(workflow, path: str) -> dict:
+        """Restore a snapshot into an *initialized* workflow; returns meta."""
+        arrays = dict(np.load(path, allow_pickle=False))
+        with open(path + ".json") as fh:
+            meta = json.load(fh)
+        restore_state(workflow, arrays, meta)
+        return meta

@@ -1,0 +1,247 @@
+"""StandardWorkflow: declarative model assembly.
+
+Parity target: the reference ``veles/znicz/standard_workflow.py`` (mount
+empty — surveyed contract, SURVEY.md §2.2 [baseline]): a declarative
+``layers=[{"type": ..., "->": {...}, "<-": {...}}, ...]`` config expands to
+the forward chain + evaluator + decision + mirrored GD chain + snapshotter,
+via the ``link_loader / link_forwards / link_evaluator / link_decision /
+link_gds / link_snapshotter`` family.
+
+Control graph (reconstructed reference shape, SURVEY.md §3.1)::
+
+    start → loader → fwd₁ → … → fwdₙ → evaluator → decision
+    decision → gdₙ → … → gd₁ ─(loop back-edge)→ loader
+    decision → snapshotter ;  decision → end_point [gate: ~complete]
+
+GD units gate_skip on non-train minibatches; the loop runs until Decision
+sets ``complete``.
+
+TPU-first: this unit graph is the assembly + per-unit-testing surface; for
+the hot path the same chain is compiled into ONE jitted train step (forward
++ evaluator + backward + update, optionally mesh-sharded) by
+``znicz_tpu.parallel.compile_fused_step`` — eliminating the per-minibatch
+Python overhead the reference suffered (SURVEY.md §3.1 hot-loop note)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .accelerated_units import AcceleratedWorkflow
+from .logger import MetricsWriter
+from .mutable import DerivedBool
+from .loader.base import TRAIN
+from .nn import all2all, gd
+from .nn.decision import DecisionGD, DecisionMSE
+from .nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from .snapshotter import SnapshotterToFile
+
+
+def _build_registries():
+    fwd_map, gd_map = {}, {}
+    modules = [all2all, gd]
+    try:
+        from .nn import conv, gd_conv, pooling, gd_pooling  # noqa
+        from .nn import normalization, dropout, activation  # noqa
+        modules += [conv, gd_conv, pooling, gd_pooling, normalization,
+                    dropout, activation]
+    except ImportError:
+        pass
+    from .nn.nn_units import Forward, GradientDescentBase
+    for mod in modules:
+        for obj in vars(mod).values():
+            if isinstance(obj, type) and issubclass(obj, Forward):
+                for key in obj.MAPPING:
+                    fwd_map[key] = obj
+            if isinstance(obj, type) \
+                    and issubclass(obj, GradientDescentBase):
+                for key in obj.MAPPING:
+                    gd_map[key] = obj
+    return fwd_map, gd_map
+
+
+class StandardWorkflowBase(AcceleratedWorkflow):
+    """Builds the forward chain from a ``layers`` list."""
+
+    def __init__(self, workflow=None, name=None, layers=None,
+                 loss_function="softmax", **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.layers_config = list(layers or [])
+        self.loss_function = loss_function
+        self.forwards = []
+        self.gds = []
+        self.metrics_writer = MetricsWriter()
+        self.fwd_map, self.gd_map = _build_registries()
+
+    # -- link_* family (reference API) ------------------------------------
+    def link_loader(self, loader) -> None:
+        self.loader = loader
+        self.add_unit(loader)   # membership: stop()/time_table()/graph/state
+        loader.link_from(self.start_point)
+
+    def link_forwards(self) -> None:
+        prev = self.loader
+        for i, spec in enumerate(self.layers_config):
+            ltype = spec["type"]
+            cls = self.fwd_map.get(ltype)
+            if cls is None:
+                raise ValueError(f"unknown layer type {ltype!r}; known: "
+                                 f"{sorted(self.fwd_map)}")
+            unit = cls(self, name=f"fwd{i}_{ltype}", **spec.get("->", {}))
+            if prev is self.loader:
+                unit.link_attrs(self.loader, ("input", "minibatch_data"))
+            else:
+                unit.link_attrs(prev, ("input", "output"))
+            unit.link_from(prev)
+            self.forwards.append(unit)
+            prev = unit
+
+    def link_evaluator(self) -> None:
+        last = self.forwards[-1]
+        if self.loss_function == "softmax":
+            ev = EvaluatorSoftmax(self, name="evaluator")
+            ev.link_attrs(last, "output", "max_idx")
+            ev.link_attrs(self.loader, ("labels", "minibatch_labels"))
+        elif self.loss_function == "mse":
+            ev = EvaluatorMSE(self, name="evaluator")
+            ev.link_attrs(last, "output")
+            ev.link_attrs(self.loader, ("target", "minibatch_targets"))
+        else:
+            raise ValueError(self.loss_function)
+        ev.link_loader(self.loader)
+        ev.link_from(last)
+        self.evaluator = ev
+
+    def link_decision(self, **config) -> None:
+        cls = DecisionGD if self.loss_function == "softmax" else DecisionMSE
+        self.decision = cls(self, name="decision", **config)
+        self.decision.link_loader(self.loader)
+        self.decision.link_evaluator(self.evaluator)
+        self.decision.link_from(self.evaluator)
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+    def link_gds(self, **defaults) -> None:
+        """Mirrored gradient chain, last layer first (reference link_gds)."""
+        prev = self.decision
+        loader = self.loader
+        decision = self.decision
+        # skip backprop on valid/test minibatches and once training is
+        # complete (so the final weights equal the last snapshot)
+        train_only = DerivedBool(
+            lambda: loader.minibatch_class != TRAIN
+            or bool(decision.complete), ())
+        for i in reversed(range(len(self.forwards))):
+            spec = self.layers_config[i]
+            cls = self.gd_map.get(spec["type"])
+            if cls is None:
+                raise ValueError(
+                    f"no gradient unit for layer type {spec['type']!r}")
+            kwargs = {**defaults, **spec.get("<-", {})}
+            unit = cls(self, name=f"gd{i}_{spec['type']}",
+                       need_err_input=(i > 0), **kwargs)
+            unit.setup_from_forward(self.forwards[i])
+            if prev is self.decision:
+                unit.link_attrs(self.evaluator, "err_output")
+            else:
+                unit.link_attrs(prev, ("err_output", "err_input"))
+            unit.link_from(prev)
+            unit.gate_skip = train_only
+            self.gds.insert(0, unit)
+            prev = unit
+        # close the minibatch loop
+        self.loader.link_from(self.gds[0])
+
+    def link_snapshotter(self, **config) -> None:
+        self.snapshotter = SnapshotterToFile(self, **config)
+        self.snapshotter.link_from(self.decision)
+
+    # -- fused execution (the TPU hot path) -------------------------------
+    def run_fused(self, mesh=None, max_epochs: int | None = None,
+                  compute_dtype: str | None = None):
+        """Train via the compiled fused step instead of the unit-graph
+        tick loop: whole epochs run as one device-side ``lax.scan``
+        (optionally mesh-sharded), with Decision's improvement/stop logic
+        applied between epochs on host.  Weights are written back into
+        the unit Vectors afterwards, so snapshotting/inspection work
+        unchanged.  Returns the FusedTrainer (kept for further use)."""
+        from .loader.base import TEST, TRAIN, VALID
+        from .parallel import FusedTrainer, fused
+
+        assert self.initialized, "initialize() first"
+        spec, params, vels = fused.extract_model(self)
+        if compute_dtype is not None:
+            spec = fused.ModelSpec(spec.layers, spec.loss, compute_dtype)
+        trainer = FusedTrainer(spec=spec, params=params, vels=vels,
+                               mesh=mesh)
+        trainer.workflow = self
+        loader, decision = self.loader, self.decision
+        data = loader.original_data.devmem
+        target = (loader.original_targets.devmem
+                  if self.loss_function == "mse"
+                  else loader.original_labels.devmem)
+        bounds = np.cumsum([0] + list(loader.class_lengths))
+        cls_idx = {k: np.arange(bounds[k], bounds[k + 1])
+                   for k in (TEST, VALID, TRAIN)}
+        batch = loader.max_minibatch_size
+        epochs = max_epochs or decision.max_epochs or 10
+        from .loader.base import CLASS_NAMES
+        for epoch in range(loader.epoch_number, epochs):
+            metrics = {"epoch": epoch}
+            perm = cls_idx[TRAIN].copy()
+            loader.prng.shuffle(perm)
+            tm = trainer.train_epoch(data, target, perm, batch)
+            metrics["train_loss"] = float(tm["loss"].mean())
+            n_train = len(cls_idx[TRAIN])
+            metrics["train_n_err"] = int(tm["n_err"].sum())
+            metrics["train_err_pct"] = 100.0 * metrics["train_n_err"] \
+                / max(n_train, 1)
+            for k in (VALID, TEST):
+                if len(cls_idx[k]) == 0:
+                    continue
+                em = trainer.eval_epoch(data, target, cls_idx[k], batch)
+                name = CLASS_NAMES[k]
+                metrics[f"{name}_loss"] = float(em["loss"].mean())
+                metrics[f"{name}_n_err"] = int(em["n_err"].sum())
+                metrics[f"{name}_err_pct"] = (100.0
+                                              * metrics[f"{name}_n_err"]
+                                              / len(cls_idx[k]))
+            if self.loss_function == "mse":
+                metrics["train_mse"] = metrics["train_loss"]
+                if "validation_loss" in metrics:
+                    metrics["validation_mse"] = metrics["validation_loss"]
+            decision.epoch_metrics.append(metrics)
+            loader.epoch_number = epoch + 1
+            self.metrics_writer.write(kind="epoch", **metrics)
+            if decision.better_than_best(metrics):
+                decision.improved.set(True)
+                decision._fails = 0
+            else:
+                decision._fails += 1
+            if decision._fails >= decision.fail_iterations:
+                break
+        decision.complete.set(True)
+        trainer.write_back()
+        return trainer
+
+
+class StandardWorkflow(StandardWorkflowBase):
+    """One-call assembly (the reference's usual entry point)."""
+
+    def __init__(self, workflow=None, name=None, layers=None,
+                 loader=None, loss_function="softmax", decision_config=None,
+                 snapshotter_config=None, **kwargs):
+        super().__init__(workflow, name, layers=layers,
+                         loss_function=loss_function, **kwargs)
+        if loader is not None:
+            self.create_workflow(loader, decision_config or {},
+                                 snapshotter_config)
+
+    def create_workflow(self, loader, decision_config: dict,
+                        snapshotter_config: dict | None) -> None:
+        self.link_loader(loader)
+        self.link_forwards()
+        self.link_evaluator()
+        self.link_decision(**decision_config)
+        self.link_gds()
+        if snapshotter_config is not None:
+            self.link_snapshotter(**snapshotter_config)
