@@ -18,4 +18,5 @@ or the XLA formulation runs on the current backend (Pallas requires real TPU
 or interpret mode).
 """
 
-from . import activations, matmul, softmax, update  # noqa: F401
+from . import (activations, conv, dropout, matmul, normalization,  # noqa
+               pooling, rngbits, softmax, update)

@@ -1,0 +1,45 @@
+"""Dropout mask generation and application.
+
+Parity target: the reference's ``dropout.cl/.cu`` + device RNG
+(SURVEY.md §2.3 row 7; DropoutForward/Backward units §2.2 [baseline]).
+
+TPU-native: the mask comes from the counter-based hash RNG
+(``ops.rngbits``) keyed by (stream seed, unit id, epoch, minibatch), so the
+numpy golden path and the XLA/Pallas path produce the SAME mask bit-for-bit
+— the property the reference lacked across its backends and the fix
+SURVEY.md §7 hard part (c) prescribes.  Inverted-dropout scaling keeps the
+activation scale constant, so evaluation is a plain identity (the reference
+scaled at train time too, via its ``dropout_ratio`` multiplier)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import rngbits
+
+
+def make_mask(stream_seed: int, counters, shape, ratio: float, xp=np):
+    """0 / 1/(1−ratio) mask; ``counters`` = (unit_id, epoch, minibatch)."""
+    key = rngbits.fold(stream_seed, *counters, xp=xp)
+    n = int(np.prod(shape))
+    u = rngbits.uniform01(key, n, xp=xp).reshape(shape)
+    keep = u >= xp.float32(ratio)
+    return keep.astype(xp.float32) * xp.float32(1.0 / (1.0 - ratio))
+
+
+def np_dropout(x, mask):
+    return x * mask
+
+
+def xla_dropout(x, mask):
+    return x * mask
+
+
+def np_gd_dropout(err, mask):
+    return err * mask
+
+
+def xla_gd_dropout(err, mask):
+    return err * mask

@@ -1,0 +1,70 @@
+"""Local-response normalization (across channels), forward + backward.
+
+Parity target: the reference's ``normalization.cl/.cu`` LRN kernels
+(SURVEY.md §2.3 row 4; AlexNet-style LRN [baseline]).
+
+Math (cross-channel window of size n, symmetric):
+
+    S_i = Σ_{j ∈ [i−n/2, i+n/2]} x_j²          (clipped to valid channels)
+    d_i = k + α·S_i
+    y_i = x_i · d_i^{−β}
+
+Hand-written backward (the reference's LRNormalizerBackward contract): with
+q_j = err_j · x_j · d_j^{−β−1},
+
+    dx_i = err_i · d_i^{−β} − 2αβ · x_i · Σ_{j: i ∈ win(j)} q_j
+
+and for a symmetric window the adjoint window equals the window itself, so
+both passes reuse one windowed-channel-sum primitive — on TPU this is a
+cumsum difference along the minor (lane) axis, one VPU pass, no im2col."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+#: Reference defaults (AlexNet LRN).
+DEFAULTS = dict(n=5, alpha=1e-4, beta=0.75, k=2.0)
+
+
+def _window_sum(a, n: int, xp):
+    """Sum over a centered channel window of size n (last axis), clipped."""
+    half_lo = (n - 1) // 2
+    half_hi = n // 2
+    c = a.shape[-1]
+    cs = xp.cumsum(a, axis=-1)
+    zeros = xp.zeros_like(cs[..., :1])
+    cs = xp.concatenate([zeros, cs], axis=-1)       # cs[i] = Σ a[:i]
+    hi = xp.minimum(xp.arange(c) + half_hi + 1, c)
+    lo = xp.maximum(xp.arange(c) - half_lo, 0)
+    return xp.take(cs, hi, axis=-1) - xp.take(cs, lo, axis=-1)
+
+
+def _fwd(x, n, alpha, beta, k, xp):
+    s = _window_sum(x * x, n, xp)
+    d = k + alpha * s
+    return x * d ** (-beta), d
+
+
+def np_lrn(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    """→ (y, denom); denom is cached for the backward pass."""
+    return _fwd(x, n, alpha, beta, k, np)
+
+
+def xla_lrn(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    return _fwd(x, n, alpha, beta, k, jnp)
+
+
+def _bwd(err, x, d, n, alpha, beta, xp):
+    q = err * x * d ** (-beta - 1.0)
+    return err * d ** (-beta) - 2.0 * alpha * beta * x * _window_sum(
+        q, n, xp)
+
+
+def np_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    return _bwd(err, x, d, n, alpha, beta, np)
+
+
+def xla_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    return _bwd(err, x, d, n, alpha, beta, jnp)

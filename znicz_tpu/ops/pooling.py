@@ -1,0 +1,225 @@
+"""Pooling: max / max-abs / avg / stochastic, with winner offsets for
+backprop.
+
+Parity target: the reference's ``pooling.cl/.cu`` + ``gd_pooling`` kernels
+(SURVEY.md §2.3 row 3: max/avg pool forward storing winner offsets, and the
+offset-scatter backward).
+
+TPU-native design (SURVEY.md §7 hard part (a) — irregular scatter):
+
+* Winner offsets are stored as a *dense* int32 window-slot index in
+  ``[0, KH·KW)`` per output element (not flat input offsets as the
+  reference's GPU kernels used) — a static-shape tensor XLA handles.
+* Forward runs as a static KH·KW-step running max/argmax over strided
+  slices (unrolled at trace time; XLA fuses it into one VPU pass per tap).
+* Backward scatters by equality-select against the stored slot index and
+  strided ``.at[].add`` — dense compare+add, no gather/scatter engine
+  needed, MXU-free and VPU-friendly.
+* Max pooling pads with −∞ (a padded zero must never win); avg pooling
+  pads with 0 and divides by the full window area (reference semantics).
+
+Layout NHWC throughout (channels minor → VPU lanes)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import rngbits
+
+
+def _norm2(v) -> tuple[int, int]:
+    return (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
+
+
+def out_size(size: int, k: int, stride: int, pad: int) -> int:
+    return (size + 2 * pad - k) // stride + 1
+
+
+def _taps(kh: int, kw: int):
+    return [(t, t // kw, t % kw) for t in range(kh * kw)]
+
+
+def _pad(x, ph, pw, value, xp):
+    if ph == 0 and pw == 0:
+        return x
+    if xp is np:
+        return np.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)),
+                      constant_values=value)
+    return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)),
+                   constant_values=value)
+
+
+def _slices(xp_arr, kh, kw, sh, sw, oh, ow):
+    """Strided window slices, one per tap: each (B, OH, OW, C)."""
+    return [xp_arr[:, i:i + oh * sh:sh, j:j + ow * sw:sw, :]
+            for _, i, j in _taps(kh, kw)]
+
+
+# -- forward (generic over numpy / jnp namespace) --------------------------
+def _max_pool(x, ksize, stride, padding, xp, use_abs: bool):
+    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), _norm2(stride), \
+        _norm2(padding)
+    b, h, w, c = x.shape
+    oh, ow = out_size(h, kh, sh, ph), out_size(w, kw, sw, pw)
+    xpad = _pad(x, ph, pw, -np.inf if not use_abs else 0.0, xp)
+    best = None
+    best_val = None
+    idx = None
+    for t, sl in enumerate(_slices(xpad, kh, kw, sh, sw, oh, ow)):
+        score = xp.abs(sl) if use_abs else sl
+        if best is None:
+            best, best_val = score, sl
+            idx = xp.zeros(sl.shape, np.int32)
+        else:
+            take = score > best
+            best = xp.where(take, score, best)
+            best_val = xp.where(take, sl, best_val)
+            idx = xp.where(take, np.int32(t), idx)
+    return best_val, idx
+
+
+def np_max_pooling(x, ksize, stride=None, padding=0):
+    """→ (y, offsets).  Golden path."""
+    return _max_pool(x, ksize, stride or ksize, padding, np, False)
+
+
+def xla_max_pooling(x, ksize, stride=None, padding=0):
+    return _max_pool(x, ksize, stride or ksize, padding, jnp, False)
+
+
+def np_maxabs_pooling(x, ksize, stride=None, padding=0):
+    """Winner is the element with max |value|; output keeps its sign."""
+    return _max_pool(x, ksize, stride or ksize, padding, np, True)
+
+
+def xla_maxabs_pooling(x, ksize, stride=None, padding=0):
+    return _max_pool(x, ksize, stride or ksize, padding, jnp, True)
+
+
+def _avg_pool(x, ksize, stride, padding, xp):
+    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), _norm2(stride), \
+        _norm2(padding)
+    b, h, w, c = x.shape
+    oh, ow = out_size(h, kh, sh, ph), out_size(w, kw, sw, pw)
+    xpad = _pad(x, ph, pw, 0.0, xp)
+    acc = None
+    for sl in _slices(xpad, kh, kw, sh, sw, oh, ow):
+        acc = sl if acc is None else acc + sl
+    return acc * (1.0 / (kh * kw))
+
+
+def np_avg_pooling(x, ksize, stride=None, padding=0):
+    return _avg_pool(x, ksize, stride or ksize, padding, np)
+
+
+def xla_avg_pooling(x, ksize, stride=None, padding=0):
+    return _avg_pool(x, ksize, stride or ksize, padding, jnp)
+
+
+def _stochastic_pool(x, ksize, stride, padding, u, xp, use_abs: bool,
+                     deterministic: bool):
+    """Zeiler–Fergus stochastic pooling.  ``u``: uniforms shaped like the
+    output (ignored when deterministic).  Train: sample a window element
+    with probability ∝ max(x,0) (or |x|); eval: probability-weighted sum."""
+    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), _norm2(stride), \
+        _norm2(padding)
+    b, h, w, c = x.shape
+    oh, ow = out_size(h, kh, sh, ph), out_size(w, kw, sw, pw)
+    xpad = _pad(x, ph, pw, 0.0, xp)
+    slices = _slices(xpad, kh, kw, sh, sw, oh, ow)
+    weights = [xp.abs(sl) if use_abs else xp.maximum(sl, 0.0)
+               for sl in slices]
+    total = weights[0]
+    for a in weights[1:]:
+        total = total + a
+    if deterministic:
+        num = slices[0] * weights[0]
+        for sl, a in zip(slices[1:], weights[1:]):
+            num = num + sl * a
+        y = xp.where(total > 0, num / xp.maximum(total, 1e-30), 0.0)
+        return y, xp.zeros((b, oh, ow, c), np.int32)
+    thr = u * total
+    cum = xp.zeros_like(total)
+    idx = xp.zeros((b, oh, ow, c), np.int32)
+    chosen = xp.zeros_like(total)
+    done = cum > thr                      # all-zero windows never trigger
+    for t, (sl, a) in enumerate(zip(slices, weights)):
+        cum = cum + a
+        hit = (cum > thr) & ~done
+        idx = xp.where(hit, np.int32(t), idx)
+        chosen = xp.where(hit, sl, chosen)
+        done = done | hit
+    y = xp.where(total > 0, chosen, 0.0)
+    return y, idx
+
+
+def np_stochastic_pooling(x, ksize, stride=None, padding=0, u=None,
+                          use_abs=False, deterministic=False):
+    return _stochastic_pool(x, ksize, stride or ksize, padding, u, np,
+                            use_abs, deterministic)
+
+
+def xla_stochastic_pooling(x, ksize, stride=None, padding=0, u=None,
+                           use_abs=False, deterministic=False):
+    return _stochastic_pool(x, ksize, stride or ksize, padding, u, jnp,
+                            use_abs, deterministic)
+
+
+def stochastic_uniform(stream_seed: int, counters, out_shape, xp=np):
+    """Output-shaped uniforms from the counter RNG (same bits all tiers)."""
+    key = rngbits.fold(stream_seed, *counters, xp=xp)
+    n = int(np.prod(out_shape))
+    return rngbits.uniform01(key, n, xp=xp).reshape(out_shape)
+
+
+# -- backward --------------------------------------------------------------
+def np_gd_max_pooling(err, offsets, x_shape, ksize, stride=None, padding=0):
+    """Scatter err to the stored winner slot of each window."""
+    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), \
+        _norm2(stride or ksize), _norm2(padding)
+    b, h, w, c = x_shape
+    _, oh, ow, _ = err.shape
+    dx = np.zeros((b, h + 2 * ph, w + 2 * pw, c), np.float32)
+    for t, i, j in _taps(kh, kw):
+        dx[:, i:i + oh * sh:sh, j:j + ow * sw:sw, :] += \
+            err * (offsets == t)
+    return dx[:, ph:ph + h, pw:pw + w, :]
+
+
+def xla_gd_max_pooling(err, offsets, x_shape, ksize, stride=None,
+                       padding=0):
+    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), \
+        _norm2(stride or ksize), _norm2(padding)
+    b, h, w, c = x_shape
+    _, oh, ow, _ = err.shape
+    dx = jnp.zeros((b, h + 2 * ph, w + 2 * pw, c), jnp.float32)
+    for t, i, j in _taps(kh, kw):
+        dx = dx.at[:, i:i + oh * sh:sh, j:j + ow * sw:sw, :].add(
+            err * (offsets == t))
+    return dx[:, ph:ph + h, pw:pw + w, :]
+
+
+def np_gd_avg_pooling(err, x_shape, ksize, stride=None, padding=0):
+    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), \
+        _norm2(stride or ksize), _norm2(padding)
+    b, h, w, c = x_shape
+    _, oh, ow, _ = err.shape
+    scaled = err * (1.0 / (kh * kw))
+    dx = np.zeros((b, h + 2 * ph, w + 2 * pw, c), np.float32)
+    for t, i, j in _taps(kh, kw):
+        dx[:, i:i + oh * sh:sh, j:j + ow * sw:sw, :] += scaled
+    return dx[:, ph:ph + h, pw:pw + w, :]
+
+
+def xla_gd_avg_pooling(err, x_shape, ksize, stride=None, padding=0):
+    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), \
+        _norm2(stride or ksize), _norm2(padding)
+    b, h, w, c = x_shape
+    _, oh, ow, _ = err.shape
+    scaled = err * (1.0 / (kh * kw))
+    dx = jnp.zeros((b, h + 2 * ph, w + 2 * pw, c), jnp.float32)
+    for t, i, j in _taps(kh, kw):
+        dx = dx.at[:, i:i + oh * sh:sh, j:j + ow * sw:sw, :].add(scaled)
+    return dx[:, ph:ph + h, pw:pw + w, :]

@@ -1,0 +1,59 @@
+"""Counter-based uniform bits, bit-identical between numpy and JAX.
+
+The reference generated dropout masks with device RNG (SURVEY.md §2.3
+dropout row), which made the numpy and GPU paths produce *different* masks.
+The TPU rebuild instead derives randomness from a pure integer hash of
+``(stream seed, counters..., element index)`` — the murmur3 finalizer over
+uint32 lanes — evaluated with identical wrap-around arithmetic by numpy
+(golden path) and XLA/Pallas (device path), so every tier sees the SAME
+mask for the same (unit, epoch, minibatch) coordinates (SURVEY.md §7 hard
+part (c))."""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+
+def _wrapctx(xp):
+    """uint32 wrap-around is intended; silence numpy's scalar warning."""
+    return np.errstate(over="ignore") if xp is np \
+        else contextlib.nullcontext()
+
+_C1 = 0x85EB_CA6B
+_C2 = 0xC2B2_AE35
+_GOLDEN = 0x9E37_79B9
+
+
+def _mix(x, xp):
+    """murmur3 fmix32 avalanche; x is a uint32 array in namespace ``xp``."""
+    u32 = xp.uint32
+    with _wrapctx(xp):
+        x = x ^ (x >> u32(16))
+        x = x * u32(_C1)
+        x = x ^ (x >> u32(13))
+        x = x * u32(_C2)
+        x = x ^ (x >> u32(16))
+    return x
+
+
+def fold(seed: int, *counters, xp=np):
+    """Fold integer counters (may be traced under jit) into a u32 key."""
+    u32 = xp.uint32
+    key = _mix(xp.asarray(seed & 0xFFFF_FFFF, dtype=xp.uint32), xp)
+    for c in counters:
+        c32 = xp.asarray(c, dtype=xp.uint32) if not hasattr(c, "dtype") \
+            else c.astype(xp.uint32)
+        with _wrapctx(xp):
+            key = _mix((key ^ c32) + u32(_GOLDEN), xp)
+    return key
+
+
+def uniform01(key, n: int, xp=np):
+    """n float32 values in [0, 1): hash of (key, lane index) ≫ 8 / 2²⁴."""
+    u32 = xp.uint32
+    idx = xp.arange(n, dtype=xp.uint32)
+    with _wrapctx(xp):
+        h = _mix(idx * u32(_C2) ^ key, xp)
+    return (h >> u32(8)).astype(xp.float32) * xp.float32(1.0 / (1 << 24))
