@@ -1,0 +1,61 @@
+"""CIFAR conv-workflow functional tests (reference pattern, SURVEY.md §4):
+whole-sample runs with fixed seeds on the synthetic dataset; asserts
+convergence and numpy-vs-XLA backend agreement through the full
+Conv+Pool+LRN+FC chain (BASELINE config 2)."""
+
+import pytest
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.config import root
+from znicz_tpu.models import cifar
+
+
+@pytest.fixture(autouse=True)
+def small_synthetic():
+    saved = root.cifar.synthetic.to_dict()
+    root.cifar.synthetic.update({"n_train": 300, "n_valid": 100,
+                                 "n_test": 100, "noise": 0.3, "size": 16})
+    root.cifar.minibatch_size = 50
+    yield
+    root.cifar.synthetic.update(saved)
+    root.cifar.minibatch_size = 100
+
+
+def _run(backend: str, epochs=4):
+    prng.seed_all(1234)
+    return cifar.run(device=Device.create(backend), epochs=epochs)
+
+
+class TestCifarWorkflow:
+    def test_builds_full_conv_chain(self):
+        prng.seed_all(1234)
+        wf = cifar.CifarWorkflow()
+        types = [type(u).__name__ for u in wf.forwards]
+        assert types == ["ConvTanh", "MaxPooling", "LRNormalizerForward",
+                        "ConvTanh", "AvgPooling", "All2AllTanh",
+                        "All2AllSoftmax"]
+        gd_types = [type(u).__name__ for u in wf.gds]
+        assert gd_types == ["GDTanhConv", "GDMaxPooling",
+                            "LRNormalizerBackward", "GDTanhConv",
+                            "GDAvgPooling", "GDTanh", "GDSoftmax"]
+
+    def test_converges_numpy(self):
+        wf = _run("numpy", epochs=4)
+        last = wf.decision.epoch_metrics[-1]
+        assert last["validation_err_pct"] < 15.0, wf.decision.epoch_metrics
+        first = wf.decision.epoch_metrics[0]
+        assert last["train_loss"] < first["train_loss"]
+
+    def test_converges_xla(self):
+        wf = _run("xla", epochs=4)
+        last = wf.decision.epoch_metrics[-1]
+        assert last["validation_err_pct"] < 15.0, wf.decision.epoch_metrics
+
+    def test_backends_agree(self):
+        m_np = _run("numpy", epochs=2).decision.epoch_metrics
+        m_x = _run("xla", epochs=2).decision.epoch_metrics
+        assert len(m_np) == len(m_x)
+        for a, b in zip(m_np, m_x):
+            assert abs(a["train_loss"] - b["train_loss"]) < 5e-2
+            assert abs(a["validation_n_err"] - b["validation_n_err"]) <= 5

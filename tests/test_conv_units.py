@@ -1,0 +1,273 @@
+"""Conv-stack unit tests (reference pattern, SURVEY.md §4): single units in
+a dummy workflow, numpy-vs-XLA backend cross-check, and the hand-written GD
+chain cross-checked against jax.grad through a conv→pool→fc model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from znicz_tpu import Vector, Workflow, prng
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.nn import activation as act_units
+from znicz_tpu.nn.conv import Conv, ConvTanh
+from znicz_tpu.nn.dropout import DropoutBackward, DropoutForward
+from znicz_tpu.nn.gd_conv import GDTanhConv
+from znicz_tpu.nn.gd_pooling import (GDAvgPooling, GDMaxPooling)
+from znicz_tpu.nn.normalization import (LRNormalizerBackward,
+                                        LRNormalizerForward)
+from znicz_tpu.nn.pooling import (AvgPooling, MaxAbsPooling, MaxPooling,
+                                  StochasticPooling)
+from znicz_tpu.ops import activations, conv as conv_ops, pooling as pool_ops
+
+
+class Dummy(Workflow):
+    pass
+
+
+def wire(cls, x, device=None, **kw):
+    wf = Dummy(name="dummy")
+    unit = cls(wf, **kw)
+    unit.__dict__["input"] = Vector(np.asarray(x, np.float32))
+    unit.initialize(device or NumpyDevice())
+    return unit
+
+
+def wire_gd(cls, fwd, err, device=None, **kw):
+    unit = cls(fwd.workflow, **kw)
+    unit.setup_from_forward(fwd)
+    unit.__dict__["err_output"] = Vector(np.asarray(err, np.float32))
+    unit.initialize(device or NumpyDevice())
+    return unit
+
+
+def _x(shape, stream="x"):
+    return prng.get(stream).normal(size=shape)
+
+
+class TestConvUnit:
+    def test_numpy_vs_xla(self, xla_device):
+        x = _x((4, 8, 8, 3))
+        prng.seed_all(5)
+        u_np = wire(ConvTanh, x, n_kernels=6, kx=3, padding=1)
+        prng.seed_all(5)
+        u_x = wire(ConvTanh, x, n_kernels=6, kx=3, padding=1,
+                   device=xla_device)
+        np.testing.assert_allclose(u_np.weights.mem, u_x.weights.mem)
+        u_np.run()
+        u_x.run()
+        assert u_np.output.mem.shape == (4, 8, 8, 6)
+        np.testing.assert_allclose(u_np.output.mem, u_x.output.mem,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_stride_shape(self):
+        u = wire(Conv, _x((2, 9, 9, 1)), n_kernels=2, kx=3, sliding=2)
+        u.run()
+        assert u.output.mem.shape == (2, 4, 4, 2)
+
+    def test_gd_conv_numpy_vs_xla(self, xla_device):
+        x = _x((4, 8, 8, 3))
+        err = _x((4, 8, 8, 6), "err") * 0.1
+        prng.seed_all(7)
+        f_np = wire(ConvTanh, x, n_kernels=6, kx=3, padding=1)
+        f_np.run()
+        g_np = wire_gd(GDTanhConv, f_np, err, apply_gradient=False)
+        g_np.run()
+        prng.seed_all(7)
+        f_x = wire(ConvTanh, x, n_kernels=6, kx=3, padding=1,
+                   device=xla_device)
+        f_x.run()
+        g_x = wire_gd(GDTanhConv, f_x, err, device=xla_device,
+                      apply_gradient=False)
+        g_x.run()
+        for a, b in ((g_np.gradient_weights, g_x.gradient_weights),
+                     (g_np.gradient_bias, g_x.gradient_bias),
+                     (g_np.err_input, g_x.err_input)):
+            np.testing.assert_allclose(a.mem, b.mem, rtol=1e-4, atol=1e-5)
+
+
+class TestPoolingUnits:
+    @pytest.mark.parametrize("cls", [MaxPooling, MaxAbsPooling, AvgPooling])
+    def test_numpy_vs_xla(self, cls, xla_device):
+        x = _x((3, 8, 8, 4))
+        u_np = wire(cls, x, kx=2)
+        u_x = wire(cls, x, kx=2, device=xla_device)
+        u_np.run()
+        u_x.run()
+        assert u_np.output.mem.shape == (3, 4, 4, 4)
+        np.testing.assert_allclose(u_np.output.mem, u_x.output.mem,
+                                   rtol=1e-6)
+        if hasattr(u_np, "input_offset"):
+            np.testing.assert_array_equal(u_np.input_offset.mem,
+                                          u_x.input_offset.mem)
+
+    def test_gd_max_scatter(self, xla_device):
+        x = _x((3, 8, 8, 4))
+        err = _x((3, 4, 4, 4), "err")
+        f = wire(MaxPooling, x, kx=2)
+        f.run()
+        g = wire_gd(GDMaxPooling, f, err)
+        g.run()
+        # each window's error lands on exactly its winner
+        total_in = g.err_input.mem.sum()
+        np.testing.assert_allclose(total_in, err.sum(), rtol=1e-5)
+        f_x = wire(MaxPooling, x, kx=2, device=xla_device)
+        f_x.run()
+        g_x = wire_gd(GDMaxPooling, f_x, err, device=xla_device)
+        g_x.run()
+        np.testing.assert_allclose(g.err_input.mem, g_x.err_input.mem,
+                                   rtol=1e-6)
+
+    def test_gd_avg(self, xla_device):
+        x = _x((2, 6, 6, 3))
+        err = _x((2, 3, 3, 3), "err")
+        f = wire(AvgPooling, x, kx=2)
+        f.run()
+        g = wire_gd(GDAvgPooling, f, err)
+        g.run()
+        np.testing.assert_allclose(g.err_input.mem.sum(), err.sum(),
+                                   rtol=1e-5)
+
+    def test_stochastic_train_eval(self, xla_device):
+        x = np.abs(_x((2, 6, 6, 3))) + 0.1
+        u_np = wire(StochasticPooling, x, kx=2)
+        u_x = wire(StochasticPooling, x, kx=2, device=xla_device)
+        u_np.run()
+        u_x.run()
+        # counter-based RNG → identical winner choice on both backends
+        np.testing.assert_array_equal(u_np.input_offset.mem,
+                                      u_x.input_offset.mem)
+        np.testing.assert_allclose(u_np.output.mem, u_x.output.mem,
+                                   rtol=1e-6)
+
+
+class TestLRNUnit:
+    def test_numpy_vs_xla_fwd_bwd(self, xla_device):
+        x = _x((2, 4, 4, 16))
+        err = _x((2, 4, 4, 16), "err")
+        f = wire(LRNormalizerForward, x)
+        f.run()
+        g = wire_gd(LRNormalizerBackward, f, err)
+        g.run()
+        f_x = wire(LRNormalizerForward, x, device=xla_device)
+        f_x.run()
+        g_x = wire_gd(LRNormalizerBackward, f_x, err, device=xla_device)
+        g_x.run()
+        np.testing.assert_allclose(f.output.mem, f_x.output.mem,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(g.err_input.mem, g_x.err_input.mem,
+                                   rtol=1e-4, atol=1e-6)
+
+
+class TestDropoutUnit:
+    def test_train_mask_identical(self, xla_device):
+        x = _x((4, 10))
+        u_np = wire(DropoutForward, x, dropout_ratio=0.4)
+        u_x = wire(DropoutForward, x, dropout_ratio=0.4, device=xla_device)
+        u_np.run()
+        u_x.run()
+        np.testing.assert_array_equal(u_np.mask.mem, u_x.mask.mem)
+        np.testing.assert_allclose(u_np.output.mem, u_x.output.mem,
+                                   rtol=1e-6)
+        kept = u_np.mask.mem > 0
+        assert 0.3 < kept.mean() < 0.9     # ≈ 60% keep rate
+        np.testing.assert_allclose(u_np.output.mem[~kept], 0.0)
+
+    def test_eval_identity(self):
+        x = _x((4, 10))
+        u = wire(DropoutForward, x, dropout_ratio=0.4)
+        u.training = False
+        u.run()
+        np.testing.assert_allclose(u.output.mem, x, rtol=1e-6)
+
+    def test_backward_uses_mask(self):
+        x = _x((4, 10))
+        err = _x((4, 10), "err")
+        f = wire(DropoutForward, x, dropout_ratio=0.4)
+        f.run()
+        g = wire_gd(DropoutBackward, f, err)
+        g.run()
+        np.testing.assert_allclose(g.err_input.mem, err * f.mask.mem,
+                                   rtol=1e-6)
+
+
+class TestActivationUnits:
+    @pytest.mark.parametrize("suffix", ["Tanh", "StrictRELU", "Sigmoid",
+                                        "Log", "SinCos", "TanhLog"])
+    def test_pair_numpy_vs_xla(self, suffix, xla_device):
+        fwd_cls = getattr(act_units, f"Activation{suffix}")
+        bwd_cls = getattr(act_units, f"GDActivation{suffix}")
+        x = _x((5, 12))
+        err = _x((5, 12), "err")
+        f = wire(fwd_cls, x)
+        f.run()
+        g = wire_gd(bwd_cls, f, err)
+        g.run()
+        f_x = wire(fwd_cls, x, device=xla_device)
+        f_x.run()
+        g_x = wire_gd(bwd_cls, f_x, err, device=xla_device)
+        g_x.run()
+        np.testing.assert_allclose(f.output.mem, f_x.output.mem,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(g.err_input.mem, g_x.err_input.mem,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestConvChainVsJaxGrad:
+    """Conv→MaxPool→FC-softmax: hand-written GD chain == jax.grad."""
+
+    def test_full_chain(self):
+        from znicz_tpu.nn.all2all import All2AllSoftmax
+        from znicz_tpu.nn.gd import GDSoftmax
+        batch, classes = 6, 5
+        x = _x((batch, 8, 8, 2))
+        labels = prng.get("y").randint(0, classes, batch).astype(np.int32)
+
+        prng.seed_all(21)
+        f1 = wire(ConvTanh, x, n_kernels=4, kx=3, padding=1)
+        wf = f1.workflow
+        f2 = MaxPooling(wf, kx=2)
+        f2.link_attrs(f1, ("input", "output"))
+        f3 = All2AllSoftmax(wf, output_sample_shape=classes)
+        f3.link_attrs(f2, ("input", "output"))
+        f1.run()
+        f2.initialize(NumpyDevice())
+        f2.run()
+        f3.initialize(NumpyDevice())
+        f3.run()
+
+        probs = f3.output.mem
+        onehot = np.zeros_like(probs)
+        onehot[np.arange(batch), labels] = 1.0
+        err = (probs - onehot) / batch
+
+        g3 = wire_gd(GDSoftmax, f3, err, apply_gradient=False)
+        g3.run()
+        g2 = wire_gd(GDMaxPooling, f2, g3.err_input.mem)
+        g2.run()
+        g1 = wire_gd(GDTanhConv, f1, g2.err_input.mem,
+                     apply_gradient=False, need_err_input=False)
+        g1.run()
+
+        def loss_fn(params):
+            wc, bc, wfc, bfc = params
+            h = activations.Tanh.fwd(
+                conv_ops.xla_conv2d(jnp.asarray(x), wc, 1, 1) + bc, jnp)
+            h, _ = pool_ops.xla_max_pooling(h, 2)
+            logits = h.reshape(batch, -1) @ wfc + bfc
+            logp = jax.nn.log_softmax(logits, axis=1)
+            return -jnp.mean(jnp.sum(logp * jnp.asarray(onehot), axis=1))
+
+        grads = jax.grad(loss_fn)([jnp.asarray(f1.weights.mem),
+                                   jnp.asarray(f1.bias.mem),
+                                   jnp.asarray(f3.weights.mem),
+                                   jnp.asarray(f3.bias.mem)])
+        np.testing.assert_allclose(g1.gradient_weights.mem,
+                                   np.asarray(grads[0]), rtol=1e-3,
+                                   atol=1e-6)
+        np.testing.assert_allclose(g1.gradient_bias.mem,
+                                   np.asarray(grads[1]), rtol=1e-3,
+                                   atol=1e-6)
+        np.testing.assert_allclose(g3.gradient_weights.mem,
+                                   np.asarray(grads[2]), rtol=1e-3,
+                                   atol=1e-6)

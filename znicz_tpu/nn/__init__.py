@@ -8,16 +8,32 @@ hand-written backward math the reference shipped (cross-checked against
 
 from .all2all import (All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
                       All2AllStrictRELU, All2AllTanh)
+from .conv import Conv, ConvRELU, ConvSigmoid, ConvStrictRELU, ConvTanh
 from .decision import DecisionBase, DecisionGD, DecisionMSE
+from .dropout import DropoutBackward, DropoutForward
 from .evaluator import EvaluatorMSE, EvaluatorSoftmax
 from .gd import (GD, GDRELU, GDSigmoid, GDSoftmax, GDStrictRELU, GDTanh,
                  GradientDescent)
+from .gd_conv import (GDRELUConv, GDSigmoidConv, GDStrictRELUConv,
+                      GDTanhConv, GradientDescentConv)
+from .gd_pooling import (GDAvgPooling, GDMaxAbsPooling, GDMaxPooling,
+                         GDStochasticAbsPooling, GDStochasticPooling)
 from .nn_units import Forward, GradientDescentBase
+from .normalization import LRNormalizerBackward, LRNormalizerForward
+from .pooling import (AvgPooling, MaxAbsPooling, MaxPooling, Pooling,
+                      StochasticAbsPooling, StochasticPooling)
 
 __all__ = [
     "All2All", "All2AllRELU", "All2AllSigmoid", "All2AllSoftmax",
-    "All2AllStrictRELU", "All2AllTanh", "DecisionBase", "DecisionGD",
-    "DecisionMSE", "EvaluatorMSE", "EvaluatorSoftmax", "Forward", "GD",
-    "GDRELU", "GDSigmoid", "GDSoftmax", "GDStrictRELU", "GDTanh",
-    "GradientDescent", "GradientDescentBase",
+    "All2AllStrictRELU", "All2AllTanh", "AvgPooling", "Conv", "ConvRELU",
+    "ConvSigmoid", "ConvStrictRELU", "ConvTanh", "DecisionBase",
+    "DecisionGD", "DecisionMSE", "DropoutBackward", "DropoutForward",
+    "EvaluatorMSE", "EvaluatorSoftmax", "Forward", "GD", "GDAvgPooling",
+    "GDMaxAbsPooling", "GDMaxPooling", "GDRELU", "GDRELUConv",
+    "GDSigmoid", "GDSigmoidConv", "GDSoftmax", "GDStochasticAbsPooling",
+    "GDStochasticPooling", "GDStrictRELU", "GDStrictRELUConv", "GDTanh",
+    "GDTanhConv", "GradientDescent", "GradientDescentBase",
+    "GradientDescentConv", "LRNormalizerBackward", "LRNormalizerForward",
+    "MaxAbsPooling", "MaxPooling", "Pooling", "StochasticAbsPooling",
+    "StochasticPooling",
 ]

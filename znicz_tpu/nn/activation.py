@@ -1,0 +1,98 @@
+"""Standalone activation forward/backward unit pairs.
+
+Parity target: the reference ``veles/znicz/activation.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 [baseline Activation]):
+``ActivationForward``/``ActivationBackward`` × {Tanh, RELU, StrictRELU,
+Sigmoid, Log, SinCos, Mul, TanhLog} as separate graph units (vs the fused
+variants built into All2All*/Conv*).  Math lives in ``ops.activations``;
+under jit XLA fuses these into the neighbouring ops — the TPU replacement
+for the reference's standalone elementwise kernels."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops import activations
+from .nn_units import Forward, GradientDescentBase
+
+
+class ActivationForward(Forward):
+    """y = act(x), shape-preserving, no parameters."""
+
+    MAPPING: tuple[str, ...] = ()
+    ACTIVATION = activations.Activation
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        kwargs["include_bias"] = False
+        super().__init__(workflow, name, **kwargs)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if not self.output:
+            self.output.mem = np.zeros(self.input.shape, np.float32)
+        self.init_vectors(self.output)
+        act = self.ACTIVATION
+        self._fwd_fn = lambda x: act.fwd(x, jnp)
+
+    def numpy_run(self) -> None:
+        self.output.mem = self.ACTIVATION.fwd(self.input.mem, np)
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.jit(self._fwd_fn)(self.input.devmem)
+
+
+class ActivationBackward(GradientDescentBase):
+    """err_input = act.bwd(err_output); no parameters."""
+
+    MAPPING: tuple[str, ...] = ()
+    ACTIVATION = activations.Activation
+
+    def setup_from_forward(self, fwd) -> "ActivationBackward":
+        super().setup_from_forward(fwd)
+        self.include_bias = False
+        return self
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        act = self.ACTIVATION
+        self.err_input.mem = act.bwd(
+            self.err_output.mem, self.output.mem,
+            self.input.mem if act.needs_input else None, np)
+
+    def xla_run(self) -> None:
+        if not self.need_err_input:
+            return
+        if not hasattr(self, "_bwd_fn"):
+            act = self.ACTIVATION
+            self._bwd_fn = self.jit(
+                lambda e, y, x: act.bwd(e, y, x, jnp))
+        self.err_input.devmem = self._bwd_fn(
+            self.err_output.devmem, self.output.devmem,
+            self.input.devmem if self.ACTIVATION.needs_input else None)
+
+
+def _make_pairs():
+    """Generate Forward/Backward classes for every activation."""
+    out = {}
+    for act in (activations.Tanh, activations.Relu,
+                activations.StrictRelu, activations.Sigmoid,
+                activations.Log, activations.SinCos, activations.Mul,
+                activations.TanhLog):
+        key = f"activation_{act.name}"
+        cls_suffix = {"tanh": "Tanh", "relu": "RELU",
+                      "strict_relu": "StrictRELU", "sigmoid": "Sigmoid",
+                      "log": "Log", "sincos": "SinCos", "mul": "Mul",
+                      "tanhlog": "TanhLog"}[act.name]
+        fwd = type(f"Activation{cls_suffix}", (ActivationForward,),
+                   {"MAPPING": (key,), "ACTIVATION": act})
+        bwd = type(f"GDActivation{cls_suffix}", (ActivationBackward,),
+                   {"MAPPING": (key,), "ACTIVATION": act})
+        out[fwd.__name__] = fwd
+        out[bwd.__name__] = bwd
+    return out
+
+
+globals().update(_make_pairs())

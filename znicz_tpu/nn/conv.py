@@ -1,0 +1,112 @@
+"""2-D convolution forward units.
+
+Parity target: the reference ``veles/znicz/conv.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 [baseline Conv]): ``Conv`` with
+``n_kernels``/``kx``/``ky``/``sliding``/``padding`` and fused-activation
+variants ``ConvTanh``/``ConvRELU``/``ConvStrictRELU``.  The reference's
+block-tiled unpack-in-kernel ``conv.cl``/``conv.cu`` becomes the
+``ops.conv`` tiers (XLA ``conv_general_dilated`` onto the MXU; Pallas
+implicit-GEMM option).
+
+TPU-first deviations (documented for migrating users):
+
+* Layout is NHWC with HWIO weights — channels ride the 128-lane minor dim
+  (the reference flattened samples row-major and unpacked inside the
+  kernel).
+* ``padding`` is symmetric ``int`` or ``(pad_h, pad_w)`` — the reference's
+  4-tuple (left, top, right, bottom) collapses to the symmetric case used
+  by every shipped sample.
+* Bias + activation fuse into the conv's HBM pass under jit (the GPU
+  kernel did this by hand)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops import activations, conv as conv_ops
+from .nn_units import Forward
+
+
+class Conv(Forward):
+    """y = act(conv2d(x, W) + b); x is (B, H, W, C), W is (ky, kx, C, OC)."""
+
+    MAPPING = ("conv",)
+    ACTIVATION = activations.Activation
+
+    def __init__(self, workflow=None, name=None, n_kernels=None, kx=None,
+                 ky=None, sliding=1, padding=0, **kwargs):
+        kwargs.setdefault("weights_filling", "gaussian")
+        super().__init__(workflow, name, **kwargs)
+        if n_kernels is None or kx is None:
+            raise ValueError("n_kernels and kx are required")
+        self.n_kernels = int(n_kernels)
+        self.kx = int(kx)
+        self.ky = int(ky if ky is not None else kx)
+        self.sliding = conv_ops._norm2(sliding)
+        self.padding = conv_ops._norm2(padding)
+
+    def output_shape_for(self, x_shape) -> tuple[int, ...]:
+        b, h, w, _ = x_shape
+        oh = conv_ops.out_size(h, self.ky, self.sliding[0], self.padding[0])
+        ow = conv_ops.out_size(w, self.kx, self.sliding[1], self.padding[1])
+        return (b, oh, ow, self.n_kernels)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if len(self.input.shape) != 4:
+            raise ValueError(
+                f"{self.name}: Conv expects NHWC input, got shape "
+                f"{self.input.shape}")
+        c = self.input.shape[3]
+        self.create_weights((self.ky, self.kx, c, self.n_kernels),
+                            (self.n_kernels,))
+        if not self.output:
+            self.output.mem = np.zeros(
+                self.output_shape_for(self.input.shape), np.float32)
+        self.init_vectors(self.weights, self.bias, self.output)
+        act, sliding, padding = self.ACTIVATION, self.sliding, self.padding
+
+        def fwd(x, w, b):
+            y = conv_ops.conv2d(x, w, sliding, padding)
+            if b is not None:
+                y = y + b
+            return act.fwd(y, jnp)
+
+        self._fwd_fn = fwd
+
+    def numpy_run(self) -> None:
+        y = conv_ops.np_conv2d(self.input.mem, self.weights.mem,
+                               self.sliding, self.padding)
+        if self.include_bias:
+            y = y + self.bias.mem
+        self.output.mem = self.ACTIVATION.fwd(y, np)
+
+    def xla_run(self) -> None:
+        fn = self.jit(self._fwd_fn)
+        self.output.devmem = fn(
+            self.input.devmem, self.weights.devmem,
+            self.bias.devmem if self.include_bias else None)
+
+
+class ConvTanh(Conv):
+    MAPPING = ("conv_tanh",)
+    ACTIVATION = activations.Tanh
+
+
+class ConvRELU(Conv):
+    """Smooth relu log(1+eˣ) — the reference's RELU (SURVEY.md §2.2)."""
+
+    MAPPING = ("conv_relu",)
+    ACTIVATION = activations.Relu
+
+
+class ConvStrictRELU(Conv):
+    MAPPING = ("conv_str",)
+    ACTIVATION = activations.StrictRelu
+
+
+class ConvSigmoid(Conv):
+    MAPPING = ("conv_sigmoid",)
+    ACTIVATION = activations.Sigmoid

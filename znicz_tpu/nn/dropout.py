@@ -1,0 +1,117 @@
+"""Dropout units.
+
+Parity target: the reference ``veles/znicz/dropout.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 [baseline Dropout]): ``DropoutForward``
+generates a Bernoulli keep-mask at train time (identity on validation/test),
+``DropoutBackward`` scales the error by the same mask.
+
+TPU-first (SURVEY.md §7 hard part (c)): the mask comes from the
+counter-based hash RNG keyed by (unit, epoch, minibatch), so numpy and XLA
+paths produce bit-identical masks; inverted scaling (kept units ×
+1/(1−ratio)) keeps eval a plain identity."""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import prng
+from ..loader.base import TRAIN
+from ..memory import Vector
+from ..ops import dropout as drop_ops
+from .nn_units import Forward, GradientDescentBase
+
+
+class DropoutForward(Forward):
+    MAPPING = ("dropout",)
+
+    def __init__(self, workflow=None, name=None, dropout_ratio=0.5,
+                 **kwargs):
+        kwargs["include_bias"] = False
+        super().__init__(workflow, name, **kwargs)
+        self.dropout_ratio = float(dropout_ratio)
+        self.mask = Vector()
+        self.rng = prng.get("dropout")
+        # full-name hash: distinct units must get distinct RNG streams
+        self.unit_id = zlib.crc32((self.name or "dropout").encode())
+        self.training = True   # loader-less (unit-test) default
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if not self.output:
+            self.output.mem = np.zeros(self.input.shape, np.float32)
+        if not self.mask:
+            self.mask.mem = np.ones(self.input.shape, np.float32)
+        self.init_vectors(self.output, self.mask)
+
+    def _counters(self) -> tuple[int, int, int]:
+        loader = getattr(self.workflow, "loader", None) \
+            if self.workflow is not None else None
+        if loader is None:
+            return (self.unit_id, 0, 0)
+        return (self.unit_id, loader.epoch_number, loader.minibatch_offset)
+
+    def _is_training(self) -> bool:
+        loader = getattr(self.workflow, "loader", None) \
+            if self.workflow is not None else None
+        return self.training if loader is None \
+            else loader.minibatch_class == TRAIN
+
+    def numpy_run(self) -> None:
+        if not self._is_training():
+            self.mask.mem = np.ones(self.input.shape, np.float32)
+            self.output.mem = self.input.mem.copy()
+            return
+        mask = drop_ops.make_mask(self.rng.stream_seed, self._counters(),
+                                  self.input.shape, self.dropout_ratio, np)
+        self.mask.mem = mask
+        self.output.mem = drop_ops.np_dropout(self.input.mem, mask)
+
+    def xla_run(self) -> None:
+        if not self._is_training():
+            self.mask.devmem = jnp.ones(self.input.shape, jnp.float32)
+            self.output.devmem = self.input.devmem
+            return
+        if not hasattr(self, "_fwd_fn"):
+            seed, ratio = self.rng.stream_seed, self.dropout_ratio
+            shape = tuple(self.input.shape)
+
+            def fwd(x, counters):
+                mask = drop_ops.make_mask(seed, counters, shape, ratio,
+                                          jnp)
+                return drop_ops.xla_dropout(x, mask), mask
+
+            self._fwd_fn = fwd
+        y, mask = self.jit(self._fwd_fn)(
+            self.input.devmem,
+            jnp.asarray(self._counters(), jnp.uint32))
+        self.output.devmem, self.mask.devmem = y, mask
+
+
+class DropoutBackward(GradientDescentBase):
+    """err_input = err_output ⊙ mask; no parameters."""
+
+    MAPPING = ("dropout",)
+
+    def setup_from_forward(self, fwd) -> "DropoutBackward":
+        super().setup_from_forward(fwd)
+        self.link_attrs(fwd, "mask")
+        self.include_bias = False
+        return self
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        self.err_input.mem = drop_ops.np_gd_dropout(self.err_output.mem,
+                                                    self.mask.mem)
+
+    def xla_run(self) -> None:
+        if not self.need_err_input:
+            return
+        if not hasattr(self, "_bwd_fn"):
+            self._bwd_fn = self.jit(drop_ops.xla_gd_dropout)
+        self.err_input.devmem = self._bwd_fn(self.err_output.devmem,
+                                             self.mask.devmem)

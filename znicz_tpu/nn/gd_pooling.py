@@ -1,0 +1,91 @@
+"""Pooling backprop units.
+
+Parity target: the reference ``veles/znicz/gd_pooling.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 [baseline GDPooling]): ``GDMaxPooling``
+scatters ``err_output`` to the stored winner offsets; ``GDAvgPooling``
+spreads it uniformly over each window.  Pooling has no parameters, so these
+units only produce ``err_input`` (apply_gradient is a no-op).
+
+TPU-first: the scatter is an equality-select against the dense window-slot
+index plus strided ``.at[].add`` — one VPU pass per window tap, no
+gather/scatter engine (SURVEY.md §7 hard part (a))."""
+
+from __future__ import annotations
+
+from ..ops import pooling as pool_ops
+from .nn_units import GradientDescentBase
+
+
+class GDPoolingBase(GradientDescentBase):
+    """Shared geometry capture; no weights/bias to update."""
+
+    def setup_from_forward(self, fwd) -> "GDPoolingBase":
+        super().setup_from_forward(fwd)
+        self.ksize, self.sliding, self.padding = (fwd.ksize, fwd.sliding,
+                                                  fwd.padding)
+        self.include_bias = False
+        return self
+
+
+class GDMaxPooling(GDPoolingBase):
+    """Scatter to the stored winner slot (max / max-abs / stochastic)."""
+
+    MAPPING = ("max_pooling",)
+
+    def setup_from_forward(self, fwd) -> "GDMaxPooling":
+        super().setup_from_forward(fwd)
+        self.link_attrs(fwd, "input_offset")
+        return self
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        self.err_input.mem = pool_ops.np_gd_max_pooling(
+            self.err_output.mem, self.input_offset.mem, self.input.shape,
+            self.ksize, self.sliding, self.padding)
+
+    def xla_run(self) -> None:
+        if not self.need_err_input:
+            return
+        if not hasattr(self, "_bwd_fn"):
+            ks, sl, pad = self.ksize, self.sliding, self.padding
+            x_shape = tuple(self.input.shape)
+            self._bwd_fn = self.jit(
+                lambda e, off: pool_ops.xla_gd_max_pooling(
+                    e, off, x_shape, ks, sl, pad))
+        self.err_input.devmem = self._bwd_fn(self.err_output.devmem,
+                                             self.input_offset.devmem)
+
+
+class GDMaxAbsPooling(GDMaxPooling):
+    MAPPING = ("maxabs_pooling",)
+
+
+class GDStochasticPooling(GDMaxPooling):
+    MAPPING = ("stochastic_pooling",)
+
+
+class GDStochasticAbsPooling(GDMaxPooling):
+    MAPPING = ("stochastic_abs_pooling",)
+
+
+class GDAvgPooling(GDPoolingBase):
+    MAPPING = ("avg_pooling",)
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        self.err_input.mem = pool_ops.np_gd_avg_pooling(
+            self.err_output.mem, self.input.shape, self.ksize,
+            self.sliding, self.padding)
+
+    def xla_run(self) -> None:
+        if not self.need_err_input:
+            return
+        if not hasattr(self, "_bwd_fn"):
+            ks, sl, pad = self.ksize, self.sliding, self.padding
+            x_shape = tuple(self.input.shape)
+            self._bwd_fn = self.jit(
+                lambda e: pool_ops.xla_gd_avg_pooling(
+                    e, x_shape, ks, sl, pad))
+        self.err_input.devmem = self._bwd_fn(self.err_output.devmem)

@@ -1,0 +1,79 @@
+"""Local-response normalization units (AlexNet LRN).
+
+Parity target: the reference ``veles/znicz/normalization.py`` (mount empty
+— surveyed contract, SURVEY.md §2.2 [baseline Normalization (LRN)]):
+``LRNormalizerForward`` / ``LRNormalizerBackward`` over a cross-channel
+window, with the reference defaults n=5, α=1e-4, β=0.75, k=2.
+
+TPU-first: channels are the minor (lane) axis, so the windowed channel sum
+is a cumsum difference — one VPU pass (``ops.normalization``); the forward
+caches the denominator tensor for the hand-written backward."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..memory import Vector
+from ..ops import normalization as lrn_ops
+from .nn_units import Forward, GradientDescentBase
+
+
+class LRNormalizerForward(Forward):
+    MAPPING = ("norm", "lrn")
+
+    def __init__(self, workflow=None, name=None, n=5, alpha=1e-4,
+                 beta=0.75, k=2.0, **kwargs):
+        kwargs["include_bias"] = False
+        super().__init__(workflow, name, **kwargs)
+        self.n, self.alpha, self.beta, self.k = int(n), alpha, beta, k
+        self.denom = Vector()
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if not self.output:
+            self.output.mem = np.zeros(self.input.shape, np.float32)
+        if not self.denom:
+            self.denom.mem = np.zeros(self.input.shape, np.float32)
+        self.init_vectors(self.output, self.denom)
+        n, a, b, k = self.n, self.alpha, self.beta, self.k
+        self._fwd_fn = lambda x: lrn_ops.xla_lrn(x, n, a, b, k)
+
+    def numpy_run(self) -> None:
+        y, d = lrn_ops.np_lrn(self.input.mem, self.n, self.alpha,
+                              self.beta, self.k)
+        self.output.mem, self.denom.mem = y, d
+
+    def xla_run(self) -> None:
+        y, d = self.jit(self._fwd_fn)(self.input.devmem)
+        self.output.devmem, self.denom.devmem = y, d
+
+
+class LRNormalizerBackward(GradientDescentBase):
+    """No parameters — only err_input from the cached denominator."""
+
+    MAPPING = ("norm", "lrn")
+
+    def setup_from_forward(self, fwd) -> "LRNormalizerBackward":
+        super().setup_from_forward(fwd)
+        self.link_attrs(fwd, "denom")
+        self.n, self.alpha, self.beta, self.k = (fwd.n, fwd.alpha,
+                                                 fwd.beta, fwd.k)
+        self.include_bias = False
+        return self
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        self.err_input.mem = lrn_ops.np_gd_lrn(
+            self.err_output.mem, self.input.mem, self.denom.mem,
+            self.n, self.alpha, self.beta, self.k)
+
+    def xla_run(self) -> None:
+        if not self.need_err_input:
+            return
+        if not hasattr(self, "_bwd_fn"):
+            n, a, b, k = self.n, self.alpha, self.beta, self.k
+            self._bwd_fn = self.jit(
+                lambda e, x, d: lrn_ops.xla_gd_lrn(e, x, d, n, a, b, k))
+        self.err_input.devmem = self._bwd_fn(
+            self.err_output.devmem, self.input.devmem, self.denom.devmem)

@@ -1,0 +1,176 @@
+"""Pooling forward units.
+
+Parity target: the reference ``veles/znicz/pooling.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 [baseline Pooling]): ``MaxPooling``,
+``MaxAbsPooling``, ``AvgPooling``, ``StochasticPooling`` (+abs variant),
+storing winner offsets for the backprop scatter.
+
+TPU-first deviations (SURVEY.md §7 hard part (a)): ``input_offset`` holds a
+*dense window-slot index* in [0, KH·KW) per output element rather than the
+reference's flat global input offsets — a static-shape tensor the XLA
+backward turns into compare+add scatter (no gather/scatter engine).
+Stochastic pooling draws from the counter-based RNG keyed by
+(unit, epoch, minibatch), so numpy and XLA paths pick identical winners
+(hard part (c))."""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from .. import prng
+from ..loader.base import TRAIN
+from ..memory import Vector
+from ..ops import pooling as pool_ops
+from .nn_units import Forward
+
+
+class Pooling(Forward):
+    """Shared geometry: kx/ky window, sliding (default = window), padding."""
+
+    MAPPING: tuple[str, ...] = ()
+
+    def __init__(self, workflow=None, name=None, kx=None, ky=None,
+                 sliding=None, padding=0, **kwargs):
+        kwargs["include_bias"] = False
+        super().__init__(workflow, name, **kwargs)
+        if kx is None:
+            raise ValueError("kx is required")
+        self.kx = int(kx)
+        self.ky = int(ky if ky is not None else kx)
+        self.ksize = (self.ky, self.kx)
+        self.sliding = (pool_ops._norm2(sliding) if sliding is not None
+                        else self.ksize)
+        self.padding = pool_ops._norm2(padding)
+
+    def output_shape_for(self, x_shape) -> tuple[int, ...]:
+        b, h, w, c = x_shape
+        oh = pool_ops.out_size(h, self.ky, self.sliding[0], self.padding[0])
+        ow = pool_ops.out_size(w, self.kx, self.sliding[1], self.padding[1])
+        return (b, oh, ow, c)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if len(self.input.shape) != 4:
+            raise ValueError(
+                f"{self.name}: pooling expects NHWC input, got "
+                f"{self.input.shape}")
+        if not self.output:
+            self.output.mem = np.zeros(
+                self.output_shape_for(self.input.shape), np.float32)
+        self.init_vectors(self.output)
+
+
+class _OffsetPooling(Pooling):
+    """Pooling that records the winner slot for the backward scatter."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.input_offset = Vector()
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if not self.input_offset:
+            self.input_offset.mem = np.zeros(self.output.shape, np.int32)
+        self.init_vectors(self.input_offset)
+
+
+class MaxPooling(_OffsetPooling):
+    MAPPING = ("max_pooling",)
+    _np_fn = staticmethod(pool_ops.np_max_pooling)
+    _xla_fn = staticmethod(pool_ops.xla_max_pooling)
+
+    def numpy_run(self) -> None:
+        y, idx = self._np_fn(self.input.mem, self.ksize, self.sliding,
+                             self.padding)
+        self.output.mem, self.input_offset.mem = y, idx
+
+    def xla_run(self) -> None:
+        if not hasattr(self, "_fwd_fn"):
+            ks, sl, pad = self.ksize, self.sliding, self.padding
+            xla_fn = self._xla_fn
+            self._fwd_fn = self.jit(lambda x: xla_fn(x, ks, sl, pad))
+        y, idx = self._fwd_fn(self.input.devmem)
+        self.output.devmem, self.input_offset.devmem = y, idx
+
+
+class MaxAbsPooling(MaxPooling):
+    """Winner is max |value|; output keeps the sign (AlexNet-era trick)."""
+
+    MAPPING = ("maxabs_pooling",)
+    _np_fn = staticmethod(pool_ops.np_maxabs_pooling)
+    _xla_fn = staticmethod(pool_ops.xla_maxabs_pooling)
+
+
+class AvgPooling(Pooling):
+    MAPPING = ("avg_pooling",)
+
+    def numpy_run(self) -> None:
+        self.output.mem = pool_ops.np_avg_pooling(
+            self.input.mem, self.ksize, self.sliding, self.padding)
+
+    def xla_run(self) -> None:
+        if not hasattr(self, "_fwd_fn"):
+            ks, sl, pad = self.ksize, self.sliding, self.padding
+            self._fwd_fn = self.jit(
+                lambda x: pool_ops.xla_avg_pooling(x, ks, sl, pad))
+        self.output.devmem = self._fwd_fn(self.input.devmem)
+
+
+class StochasticPooling(_OffsetPooling):
+    """Zeiler–Fergus stochastic pooling; deterministic weighted mean on
+    validation/test minibatches (reference semantics)."""
+
+    MAPPING = ("stochastic_pooling",)
+    USE_ABS = False
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.rng = prng.get("pooling")
+        # full-name hash: distinct units must get distinct RNG streams
+        self.unit_id = zlib.crc32((self.name or "pool").encode())
+
+    def _counters(self) -> tuple[int, int, int]:
+        loader = getattr(self.workflow, "loader", None) \
+            if self.workflow is not None else None
+        if loader is None:
+            return (self.unit_id, 0, 0)
+        return (self.unit_id, loader.epoch_number, loader.minibatch_offset)
+
+    def _is_training(self) -> bool:
+        loader = getattr(self.workflow, "loader", None) \
+            if self.workflow is not None else None
+        return loader is None or loader.minibatch_class == TRAIN
+
+    def numpy_run(self) -> None:
+        det = not self._is_training()
+        u = None if det else pool_ops.stochastic_uniform(
+            self.rng.stream_seed, self._counters(),
+            self.output.shape, np)
+        y, idx = pool_ops.np_stochastic_pooling(
+            self.input.mem, self.ksize, self.sliding, self.padding, u,
+            use_abs=self.USE_ABS, deterministic=det)
+        self.output.mem, self.input_offset.mem = y, idx
+
+    def xla_run(self) -> None:
+        import jax.numpy as jnp
+        det = not self._is_training()
+        u = None if det else pool_ops.stochastic_uniform(
+            self.rng.stream_seed, self._counters(),
+            self.output.shape, jnp)
+        ks, sl, pad, abs_ = self.ksize, self.sliding, self.padding, \
+            self.USE_ABS
+        key = "det" if det else "rand"
+        cache = self.__dict__.setdefault("_fns", {})
+        if key not in cache:
+            cache[key] = self.jit(
+                lambda x, uu: pool_ops.xla_stochastic_pooling(
+                    x, ks, sl, pad, uu, use_abs=abs_, deterministic=det))
+        y, idx = cache[key](self.input.devmem, u)
+        self.output.devmem, self.input_offset.devmem = y, idx
+
+
+class StochasticAbsPooling(StochasticPooling):
+    MAPPING = ("stochastic_abs_pooling",)
+    USE_ABS = True

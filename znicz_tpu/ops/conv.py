@@ -33,16 +33,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import matmul, tuning
+from .geometry import norm2 as _norm2, out_size
 
 _DIMNUMS = ("NHWC", "HWIO", "NHWC")
-
-
-def out_size(size: int, k: int, stride: int, pad: int) -> int:
-    return (size + 2 * pad - k) // stride + 1
-
-
-def _norm2(v) -> tuple[int, int]:
-    return (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
 
 
 # -- numpy golden tier -----------------------------------------------------

@@ -27,14 +27,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from . import rngbits
-
-
-def _norm2(v) -> tuple[int, int]:
-    return (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
-
-
-def out_size(size: int, k: int, stride: int, pad: int) -> int:
-    return (size + 2 * pad - k) // stride + 1
+from .geometry import norm2 as _norm2, out_size
 
 
 def _taps(kh: int, kw: int):
