@@ -1,0 +1,150 @@
+"""Fused-step conv-stack tests: the compiled whole-chain step must
+reproduce the unit-graph path through Conv/Pool/LRN/Dropout layers
+(SURVEY.md §7 — the fused step is the TPU hot path, the unit graph the
+contract), and run sharded on the virtual mesh."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.config import root
+from znicz_tpu.models import cifar
+from znicz_tpu.parallel import FusedTrainer, extract_model, make_mesh
+
+
+@pytest.fixture(autouse=True)
+def small_synthetic():
+    saved = root.cifar.synthetic.to_dict()
+    root.cifar.synthetic.update({"n_train": 200, "n_valid": 80,
+                                 "n_test": 80, "noise": 0.3, "size": 16})
+    root.cifar.minibatch_size = 40
+    yield
+    root.cifar.synthetic.update(saved)
+    root.cifar.minibatch_size = 100
+
+
+def _workflow(layers=None):
+    prng.seed_all(1234)
+    wf = cifar.CifarWorkflow(layers=layers)
+    wf.initialize(device=Device.create("xla"))
+    return wf
+
+
+DROPOUT_LAYERS = [
+    {"type": "conv_tanh", "->": {"n_kernels": 8, "kx": 3, "padding": 1},
+     "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+    {"type": "max_pooling", "->": {"kx": 2}},
+    {"type": "dropout", "->": {"dropout_ratio": 0.3}},
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 32},
+     "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 10},
+     "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+]
+
+
+def _drive_graph(wf, idx):
+    """Drive the unit graph manually over the identical minibatches the
+    fused path consumed (same pattern as test_fused_parallel)."""
+    ld = wf.loader
+    n = len(idx)
+    for off in range(0, n, ld.max_minibatch_size):
+        mb = idx[off:off + ld.max_minibatch_size]
+        ld.minibatch_class = 2
+        ld.minibatch_size = len(mb)
+        # counters the stochastic units key their RNG on
+        ld.minibatch_offset = min(off + ld.max_minibatch_size, n)
+        ld.fill_minibatch(mb, 2)
+        for f in wf.forwards:
+            f.run()
+        wf.evaluator.run()
+        for g in reversed(wf.gds):
+            g.run()
+
+
+def _assert_params_match(wf, tr):
+    for i, (fwd, (w, b)) in enumerate(zip(wf.forwards, tr.params)):
+        if w is None:
+            continue
+        np.testing.assert_allclose(
+            np.asarray(w), fwd.weights.mem, rtol=5e-4, atol=1e-5,
+            err_msg=f"layer {i} weights diverged")
+
+
+class TestFusedConvEquivalence:
+    def test_fused_matches_unit_graph(self):
+        """Deterministic conv chain: fused weights == unit-graph weights
+        after one epoch over the same minibatch order."""
+        wf = _workflow()
+        spec, params, vels = extract_model(wf)
+        kinds = [layer.kind for layer in spec.layers]
+        assert kinds == ["conv", "max_pool", "lrn", "conv", "avg_pool",
+                         "fc", "fc"]
+        tr = FusedTrainer(spec=spec, params=params, vels=vels)
+        ld = wf.loader
+        n0, n1, n2 = ld.class_lengths
+        idx = np.arange(n0 + n1, n0 + n1 + n2)   # unshuffled train set
+        tr.train_epoch(ld.original_data.devmem,
+                       ld.original_labels.devmem, idx,
+                       ld.max_minibatch_size)
+        _drive_graph(wf, idx)
+        _assert_params_match(wf, tr)
+
+    def test_fused_matches_unit_graph_with_dropout(self):
+        """Counter-RNG alignment: the fused step reproduces the unit
+        path's dropout masks (same epoch/offset counters)."""
+        wf = _workflow(layers=DROPOUT_LAYERS)
+        spec, params, vels = extract_model(wf)
+        assert [la.kind for la in spec.layers] == \
+            ["conv", "max_pool", "dropout", "fc", "fc"]
+        tr = FusedTrainer(spec=spec, params=params, vels=vels)
+        ld = wf.loader
+        n0, n1, n2 = ld.class_lengths
+        idx = np.arange(n0 + n1, n0 + n1 + n2)
+        tr.train_epoch(ld.original_data.devmem,
+                       ld.original_labels.devmem, idx,
+                       ld.max_minibatch_size, epoch=0)
+        _drive_graph(wf, idx)
+        _assert_params_match(wf, tr)
+
+    def test_run_fused_converges_conv(self):
+        wf = _workflow()
+        trainer = wf.run_fused(max_epochs=4)
+        last = wf.decision.epoch_metrics[-1]
+        assert last["validation_err_pct"] < 15.0, wf.decision.epoch_metrics
+        # weights written back into the unit graph
+        assert np.isfinite(wf.forwards[0].weights.mem).all()
+        del trainer
+
+
+class TestFusedConvMesh:
+    def test_dp_mesh_conv(self):
+        import jax
+        wf = _workflow()
+        spec, params, vels = extract_model(wf)
+        mesh = make_mesh(n_data=4, n_model=1,
+                         devices=jax.devices()[:4])
+        tr = FusedTrainer(spec=spec, params=params, vels=vels, mesh=mesh)
+        ld = wf.loader
+        n0, n1, n2 = ld.class_lengths
+        order = np.arange(n0 + n1, n0 + n1 + n2)
+        m = tr.train_epoch(np.asarray(ld.original_data.mem),
+                           np.asarray(ld.original_labels.mem), order,
+                           ld.max_minibatch_size)
+        assert np.isfinite(m["loss"]).all()
+
+    def test_dp_tp_mesh_conv(self):
+        import jax
+        wf = _workflow()
+        spec, params, vels = extract_model(wf)
+        mesh = make_mesh(n_data=4, n_model=2, devices=jax.devices())
+        tr = FusedTrainer(spec=spec, params=params, vels=vels, mesh=mesh)
+        ld = wf.loader
+        n0, n1, n2 = ld.class_lengths
+        order = np.arange(n0 + n1, n0 + n1 + n2)
+        m = tr.train_epoch(np.asarray(ld.original_data.mem),
+                           np.asarray(ld.original_labels.mem), order,
+                           ld.max_minibatch_size)
+        assert np.isfinite(m["loss"]).all()
+        # conv weights actually sharded over the model axis
+        assert len(tr.params[0][0].sharding.device_set) == 8

@@ -44,7 +44,7 @@ def test_short_final_batch_not_double_counted():
 
 
 def test_needs_input_activation_rejected():
-    with pytest.raises(NotImplementedError, match="needs its input"):
+    with pytest.raises(NotImplementedError, match="needs its pre-activation input"):
         fused.ModelSpec(layers=(
             fused.LayerSpec("fc", "log", True, (0.01, 0, 0, 0),
                             (0.01, 0, 0, 0)),), loss="softmax")

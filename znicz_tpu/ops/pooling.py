@@ -30,6 +30,14 @@ from . import rngbits
 from .geometry import norm2 as _norm2, out_size
 
 
+def pool_out_shape(x_shape, ksize, stride=None, padding=0):
+    """NHWC output shape of a pooling window over ``x_shape``."""
+    (kh, kw), (ph, pw) = _norm2(ksize), _norm2(padding)
+    (sh, sw) = _norm2(stride if stride is not None else ksize)
+    b, h, w, c = x_shape
+    return (b, out_size(h, kh, sh, ph), out_size(w, kw, sw, pw), c)
+
+
 def _taps(kh: int, kw: int):
     return [(t, t // kw, t % kw) for t in range(kh * kw)]
 

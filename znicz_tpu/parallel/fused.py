@@ -9,6 +9,13 @@ eliminating the per-minibatch Python dispatch the reference paid
 a precomputed index matrix with the dataset HBM-resident, so the host
 touches the device once per epoch, not once per unit per minibatch.
 
+Layer coverage matches the unit zoo: fc (All2All*), conv (Conv*), the
+pooling family, LRN, dropout, and standalone activations.  Stochastic
+layers (dropout, stochastic pooling) draw from the same counter-based RNG
+as the units, keyed by (unit, epoch, samples-consumed) — so the fused path
+reproduces the unit-graph path bit-for-bit even through randomness
+(SURVEY.md §7 hard part (c)).
+
 Gradient aggregation across the ``data`` mesh axis is the all-reduce XLA
 inserts automatically for the sharded batch dim — the TPU replacement for
 the reference's ``apply_data_from_slave`` fold [baseline]."""
@@ -16,23 +23,35 @@ the reference's ``apply_data_from_slave`` fold [baseline]."""
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import activations, softmax as softmax_ops
+from ..ops import (activations, conv as conv_ops, dropout as drop_ops,
+                   normalization as lrn_ops, pooling as pool_ops,
+                   softmax as softmax_ops)
 from . import mesh as mesh_lib
+
+#: Layer kinds with trainable parameters.
+PARAM_KINDS = ("fc", "conv")
 
 
 @dataclasses.dataclass(frozen=True)
 class LayerSpec:
-    kind: str                     # "fc" (conv variants arrive with §7.4)
+    kind: str                     # fc | conv | max_pool | maxabs_pool |
+    #                               avg_pool | stochastic_pool |
+    #                               stochastic_abs_pool | lrn | dropout |
+    #                               activation
     activation: str               # activations.BY_NAME key; last fc layer
     include_bias: bool            # of a softmax model keeps "linear"
     hypers: tuple                 # (lr, weights_decay, l1_vs_l2, momentum)
     hypers_bias: tuple
+    config: tuple = ()            # static kind-specific kv pairs (sorted)
+
+    @property
+    def cfg(self) -> dict:
+        return dict(self.config)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,16 +61,26 @@ class ModelSpec:
     compute_dtype: str = "float32"
 
     def __post_init__(self):
+        # the loss head consumes a 2D (batch, features) tensor and
+        # backward() hands the last layer a pre-activation error — both
+        # are only well-defined for a final fc layer
+        if self.layers and self.layers[-1].kind != "fc":
+            raise NotImplementedError(
+                f"the fused path requires a final fc layer (got "
+                f"{self.layers[-1].kind!r}); use the unit-graph path for "
+                f"other heads")
         for layer in self.layers:
             act = activations.BY_NAME[layer.activation]
-            if act.needs_input:
-                # forward() caches post-activation values only, so
-                # derivative-needs-input activations can't run fused;
-                # use the unit-graph path for those.
+            if act.needs_input and layer.kind in PARAM_KINDS:
+                # fc/conv cache only the layer *input*, not the
+                # pre-activation tensor these derivatives need; use a
+                # standalone activation layer (which is supported) or the
+                # unit-graph path.
                 raise NotImplementedError(
-                    f"activation {layer.activation!r} needs its input "
-                    f"for the backward pass and is not supported by the "
-                    f"fused step")
+                    f"activation {layer.activation!r} fused into a "
+                    f"{layer.kind} layer needs its pre-activation input "
+                    f"for the backward pass; insert it as a standalone "
+                    f"'activation' layer instead")
 
     def act(self, i: int):
         return activations.BY_NAME[self.layers[i].activation]
@@ -59,56 +88,165 @@ class ModelSpec:
 
 def extract_model(workflow) -> tuple[ModelSpec, list, list]:
     """Read (spec, params, velocities) out of an initialized
-    StandardWorkflow.  params/velocities: list of (w, b) numpy pairs."""
+    StandardWorkflow.  params/velocities: list of (w, b) numpy pairs,
+    ``(None, None)`` for parameter-less layers."""
+    from ..nn import activation as act_units
+    from ..nn.all2all import All2All, All2AllSoftmax
+    from ..nn.conv import Conv
+    from ..nn.dropout import DropoutForward
+    from ..nn.normalization import LRNormalizerForward
+    from ..nn import pooling as pool_units
+
     layers, params, vels = [], [], []
     for fwd, gdu in zip(workflow.forwards, workflow.gds):
-        from ..nn.all2all import All2All, All2AllSoftmax
-        if not isinstance(fwd, All2All):
+        hypers = (getattr(gdu, "learning_rate", 0.0),
+                  getattr(gdu, "weights_decay", 0.0),
+                  getattr(gdu, "l1_vs_l2", 0.0),
+                  getattr(gdu, "gradient_moment", 0.0))
+        hypers_bias = (getattr(gdu, "learning_rate_bias", 0.0),
+                       getattr(gdu, "weights_decay_bias", 0.0),
+                       getattr(gdu, "l1_vs_l2_bias", 0.0),
+                       getattr(gdu, "gradient_moment_bias", 0.0))
+        act = "linear"
+        config: dict = {}
+        has_params = False
+        if isinstance(fwd, All2All):
+            kind = "fc"
+            has_params = True
+            act = ("linear" if isinstance(fwd, All2AllSoftmax)
+                   else fwd.ACTIVATION.name)
+        elif isinstance(fwd, Conv):
+            kind = "conv"
+            has_params = True
+            act = fwd.ACTIVATION.name
+            config = {"stride": fwd.sliding, "padding": fwd.padding}
+        elif isinstance(fwd, pool_units.Pooling):
+            kind = {"MaxPooling": "max_pool",
+                    "MaxAbsPooling": "maxabs_pool",
+                    "AvgPooling": "avg_pool",
+                    "StochasticPooling": "stochastic_pool",
+                    "StochasticAbsPooling": "stochastic_abs_pool",
+                    }[type(fwd).__name__]
+            config = {"ksize": fwd.ksize, "stride": fwd.sliding,
+                      "padding": fwd.padding}
+            if kind.startswith("stochastic"):
+                config.update(unit_id=fwd.unit_id,
+                              seed=fwd.rng.stream_seed)
+        elif isinstance(fwd, LRNormalizerForward):
+            kind = "lrn"
+            config = {"n": fwd.n, "alpha": fwd.alpha, "beta": fwd.beta,
+                      "k": fwd.k}
+        elif isinstance(fwd, DropoutForward):
+            kind = "dropout"
+            config = {"ratio": fwd.dropout_ratio, "unit_id": fwd.unit_id,
+                      "seed": fwd.rng.stream_seed}
+        elif isinstance(fwd, act_units.ActivationForward):
+            kind = "activation"
+            act = fwd.ACTIVATION.name
+        else:
             raise NotImplementedError(
-                f"fused path supports FC layers for now, got {type(fwd)}")
-        act = ("linear" if isinstance(fwd, All2AllSoftmax)
-               else fwd.ACTIVATION.name)
+                f"fused path does not support {type(fwd).__name__}")
         layers.append(LayerSpec(
-            kind="fc", activation=act, include_bias=fwd.include_bias,
-            hypers=(gdu.learning_rate, gdu.weights_decay, gdu.l1_vs_l2,
-                    gdu.gradient_moment),
-            hypers_bias=(gdu.learning_rate_bias, gdu.weights_decay_bias,
-                         gdu.l1_vs_l2_bias, gdu.gradient_moment_bias)))
-        params.append((np.asarray(fwd.weights.mem),
-                       np.asarray(fwd.bias.mem) if fwd.include_bias
-                       else None))
-        vels.append((np.asarray(gdu.velocity_weights.mem),
-                     np.asarray(gdu.velocity_bias.mem)
-                     if fwd.include_bias else None))
+            kind=kind, activation=act,
+            include_bias=has_params and fwd.include_bias,
+            hypers=hypers, hypers_bias=hypers_bias,
+            config=tuple(sorted(config.items()))))
+        if has_params:
+            params.append((np.asarray(fwd.weights.mem),
+                           np.asarray(fwd.bias.mem) if fwd.include_bias
+                           else None))
+            vels.append((np.asarray(gdu.velocity_weights.mem),
+                         np.asarray(gdu.velocity_bias.mem)
+                         if fwd.include_bias else None))
+        else:
+            params.append((None, None))
+            vels.append((None, None))
     loss = workflow.loss_function
     return ModelSpec(tuple(layers), loss), params, vels
 
 
 # -- pure math (all traced; spec is static) --------------------------------
-def forward(spec: ModelSpec, params, x, *, want_caches: bool):
-    """Returns (net_output_pre_loss, caches).  For softmax loss the last
-    layer's output is the *logits* (loss fusion happens in the step)."""
+def forward(spec: ModelSpec, params, x, *, want_caches: bool,
+            train: bool = False, epoch=0, ctr=0):
+    """Returns (net_output_pre_loss, caches).
+
+    For softmax loss the last layer's output is the *logits* (loss fusion
+    happens in the step).  ``caches[i]`` = (layer input, kind-specific
+    residual: pooling winner slots / LRN denom / dropout mask).
+    ``epoch``/``ctr`` (may be traced) feed the counter RNG of stochastic
+    layers when ``train``."""
     cdt = jnp.dtype(spec.compute_dtype)
-    h = x.reshape(x.shape[0], -1)
-    caches = [h]
+    h = x
+    caches = []
     n = len(spec.layers)
     for i, (layer, (w, b)) in enumerate(zip(spec.layers, params)):
-        pre = jnp.dot(h.astype(cdt), w.astype(cdt),
-                      preferred_element_type=jnp.float32)
-        if b is not None:
-            pre = pre + b
+        x_in, aux = h, None
+        cfg = layer.cfg
         is_last = i == n - 1
-        if is_last and spec.loss == "softmax":
-            h = pre                       # logits; softmax fused with CE
-        else:
+        if layer.kind == "fc":
+            pre = jnp.dot(h.reshape(h.shape[0], -1).astype(cdt),
+                          w.astype(cdt),
+                          preferred_element_type=jnp.float32)
+            if b is not None:
+                pre = pre + b
+            if is_last and spec.loss == "softmax":
+                h = pre                   # logits; softmax fused with CE
+            else:
+                h = spec.act(i).fwd(pre, jnp)
+        elif layer.kind == "conv":
+            pre = conv_ops.conv2d(h.astype(cdt), w.astype(cdt),
+                                  cfg["stride"], cfg["padding"],
+                                  out_dtype=jnp.float32)
+            if b is not None:
+                pre = pre + b
             h = spec.act(i).fwd(pre, jnp)
-        if want_caches and not is_last:
-            caches.append(h)
+        elif layer.kind == "max_pool":
+            h, aux = pool_ops.xla_max_pooling(h, cfg["ksize"],
+                                              cfg["stride"],
+                                              cfg["padding"])
+        elif layer.kind == "maxabs_pool":
+            h, aux = pool_ops.xla_maxabs_pooling(h, cfg["ksize"],
+                                                 cfg["stride"],
+                                                 cfg["padding"])
+        elif layer.kind == "avg_pool":
+            h = pool_ops.xla_avg_pooling(h, cfg["ksize"], cfg["stride"],
+                                         cfg["padding"])
+        elif layer.kind in ("stochastic_pool", "stochastic_abs_pool"):
+            use_abs = layer.kind == "stochastic_abs_pool"
+            if train:
+                oshape = pool_ops.pool_out_shape(
+                    h.shape, cfg["ksize"], cfg["stride"], cfg["padding"])
+                u = pool_ops.stochastic_uniform(
+                    cfg["seed"], (cfg["unit_id"], epoch, ctr), oshape,
+                    jnp)
+                h, aux = pool_ops.xla_stochastic_pooling(
+                    h, cfg["ksize"], cfg["stride"], cfg["padding"], u,
+                    use_abs=use_abs, deterministic=False)
+            else:
+                h, aux = pool_ops.xla_stochastic_pooling(
+                    h, cfg["ksize"], cfg["stride"], cfg["padding"], None,
+                    use_abs=use_abs, deterministic=True)
+        elif layer.kind == "lrn":
+            h, aux = lrn_ops.xla_lrn(h, cfg["n"], cfg["alpha"],
+                                     cfg["beta"], cfg["k"])
+        elif layer.kind == "dropout":
+            if train:
+                aux = drop_ops.make_mask(
+                    cfg["seed"], (cfg["unit_id"], epoch, ctr),
+                    tuple(h.shape), cfg["ratio"], jnp)
+                h = h * aux
+            # eval: inverted dropout → identity
+        elif layer.kind == "activation":
+            h = spec.act(i).fwd(h, jnp)
+        else:
+            raise NotImplementedError(layer.kind)
+        if want_caches:
+            caches.append((x_in, aux))
     return h, caches
 
 
 def predict(spec: ModelSpec, params, x):
-    out, _ = forward(spec, params, x, want_caches=False)
+    out, _ = forward(spec, params, x, want_caches=False, train=False)
     if spec.loss == "softmax":
         return jax.nn.softmax(out, axis=1)
     return out
@@ -132,22 +270,64 @@ def _loss_and_err(spec: ModelSpec, out, target, mask):
     return loss, diff / bs, jnp.zeros((), jnp.int32)
 
 
-def backward(spec: ModelSpec, params, caches, err_y):
-    """Hand-written gradient chain (same math as the GD* units)."""
+def backward(spec: ModelSpec, params, caches, out, err):
+    """Hand-written gradient chain (same math as the GD* units).
+
+    ``err`` on entry: w.r.t. the last layer's pre-activation (softmax
+    fused with CE; MSE pre-folded by the caller)."""
     cdt = jnp.dtype(spec.compute_dtype)
     grads = [None] * len(spec.layers)
-    for i in reversed(range(len(spec.layers))):
+    n = len(spec.layers)
+    for i in reversed(range(n)):
+        layer = spec.layers[i]
         w, b = params[i]
-        x_i = caches[i]
-        gw = jnp.dot(x_i.astype(cdt).T, err_y.astype(cdt),
-                     preferred_element_type=jnp.float32)
-        gb = jnp.sum(err_y, axis=0) if b is not None else None
-        grads[i] = (gw, gb)
-        if i > 0:
-            err_h = jnp.dot(err_y.astype(cdt), w.astype(cdt).T,
-                            preferred_element_type=jnp.float32)
-            y_prev = caches[i]
-            err_y = spec.act(i - 1).bwd(err_h, y_prev, None, jnp)
+        x_in, aux = caches[i]
+        y_i = caches[i + 1][0] if i < n - 1 else out
+        cfg = layer.cfg
+        if layer.kind in PARAM_KINDS:
+            # fold through the fused activation (last layer already is
+            # pre-activation — see docstring)
+            err_pre = err if i == n - 1 \
+                else spec.act(i).bwd(err.reshape(y_i.shape), y_i, None,
+                                     jnp)
+            if layer.kind == "fc":
+                x2 = x_in.reshape(x_in.shape[0], -1)
+                err2 = err_pre.reshape(x2.shape[0], -1)
+                gw = jnp.dot(x2.astype(cdt).T, err2.astype(cdt),
+                             preferred_element_type=jnp.float32)
+                gb = jnp.sum(err2, axis=0) if b is not None else None
+                err = jnp.dot(err2.astype(cdt), w.astype(cdt).T,
+                              preferred_element_type=jnp.float32
+                              ).reshape(x_in.shape)
+            else:                                         # conv
+                gw = conv_ops.xla_conv2d_grad_weights(
+                    x_in, err_pre, w.shape, cfg["stride"],
+                    cfg["padding"])
+                gb = (jnp.sum(err_pre, axis=(0, 1, 2))
+                      if b is not None else None)
+                err = conv_ops.xla_conv2d_grad_input(
+                    err_pre, w, x_in.shape, cfg["stride"], cfg["padding"])
+            grads[i] = (gw, gb)
+        elif layer.kind in ("max_pool", "maxabs_pool", "stochastic_pool",
+                           "stochastic_abs_pool"):
+            err = pool_ops.xla_gd_max_pooling(
+                err.reshape(y_i.shape), aux, x_in.shape, cfg["ksize"],
+                cfg["stride"], cfg["padding"])
+        elif layer.kind == "avg_pool":
+            err = pool_ops.xla_gd_avg_pooling(
+                err.reshape(y_i.shape), x_in.shape, cfg["ksize"],
+                cfg["stride"], cfg["padding"])
+        elif layer.kind == "lrn":
+            err = lrn_ops.xla_gd_lrn(err.reshape(y_i.shape), x_in, aux,
+                                     cfg["n"], cfg["alpha"], cfg["beta"],
+                                     cfg["k"])
+        elif layer.kind == "dropout":
+            if aux is not None:
+                err = err.reshape(x_in.shape) * aux
+        elif layer.kind == "activation":
+            err = spec.act(i).bwd(err.reshape(y_i.shape), y_i, x_in, jnp)
+        else:
+            raise NotImplementedError(layer.kind)
     return grads
 
 
@@ -157,8 +337,13 @@ def apply_updates(spec: ModelSpec, params, vels, grads):
     # Pallas kernel serves the unit-graph path where each op dispatches
     # separately (the reference's kernel-per-op model).
     new_p, new_v = [], []
-    for layer, (w, b), (vw, vb), (gw, gb) in zip(spec.layers, params,
-                                                 vels, grads):
+    for layer, (w, b), (vw, vb), grad in zip(spec.layers, params, vels,
+                                             grads):
+        if w is None:
+            new_p.append((None, None))
+            new_v.append((None, None))
+            continue
+        gw, gb = grad
         lr, wd, l1, mom = layer.hypers
         reg = wd * ((1.0 - l1) * w + 0.5 * l1 * jnp.sign(w))
         vw2 = mom * vw - lr * (gw + reg)
@@ -175,14 +360,19 @@ def apply_updates(spec: ModelSpec, params, vels, grads):
     return new_p, new_v
 
 
-def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None):
+def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None,
+                    epoch=0, ctr=0):
     if mask is None:
         mask = jnp.ones((x.shape[0],), jnp.float32)
-    out, caches = forward(spec, params, x, want_caches=True)
+    out, caches = forward(spec, params, x, want_caches=True, train=True,
+                          epoch=epoch, ctr=ctr)
     loss, err, n_err = _loss_and_err(spec, out, target, mask)
-    if spec.loss == "mse":   # fold through the last layer's activation
-        err = spec.act(len(spec.layers) - 1).bwd(err, out, None, jnp)
-    grads = backward(spec, params, caches, err)
+    last = len(spec.layers) - 1
+    if spec.loss == "mse" and spec.layers[last].kind in PARAM_KINDS:
+        # backward() expects pre-activation err at a param layer; other
+        # last-layer kinds fold their own activation in backward()
+        err = spec.act(last).bwd(err, out, None, jnp)
+    grads = backward(spec, params, caches, out, err)
     params, vels = apply_updates(spec, params, vels, grads)
     metrics = {"loss": loss, "n_err": n_err}
     return params, vels, metrics
@@ -191,7 +381,7 @@ def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None):
 def eval_minibatch(spec: ModelSpec, params, x, target, mask=None):
     if mask is None:
         mask = jnp.ones((x.shape[0],), jnp.float32)
-    out, _ = forward(spec, params, x, want_caches=False)
+    out, _ = forward(spec, params, x, want_caches=False, train=False)
     loss, _, n_err = _loss_and_err(spec, out, target, mask)
     return {"loss": loss, "n_err": n_err}
 
@@ -212,16 +402,23 @@ class FusedTrainer:
         self.mesh = mesh
         self.workflow = workflow
         if mesh is not None:
-            self._param_shardings = [
-                (mesh_lib.shard_params(mesh, i, 2),
-                 mesh_lib.replicated(mesh))
-                for i in range(len(spec.layers))]
+            self._param_shardings = []
+            pidx = 0   # alternate TP axis over *parameterized* layers only
+            for (w, b) in params:
+                if w is None:
+                    self._param_shardings.append((None, None))
+                else:
+                    self._param_shardings.append(
+                        (mesh_lib.shard_params(mesh, pidx, w.ndim),
+                         mesh_lib.replicated(mesh)))
+                    pidx += 1
             put = lambda a, s: jax.device_put(a, s)      # noqa: E731
             self.params = [
-                (put(w, sh[0]), put(b, sh[1]) if b is not None else None)
+                (put(w, sh[0]) if w is not None else None,
+                 put(b, sh[1]) if b is not None else None)
                 for (w, b), sh in zip(params, self._param_shardings)]
             self.vels = [
-                (put(vw, sh[0]),
+                (put(vw, sh[0]) if vw is not None else None,
                  put(vb, sh[1]) if vb is not None else None)
                 for (vw, vb), sh in zip(vels, self._param_shardings)]
             self._batch_sharding = mesh_lib.shard_batch(mesh)
@@ -232,25 +429,28 @@ class FusedTrainer:
             self._batch_sharding = None
         self._train_epoch_fn = None
         self._eval_epoch_fn = None
+        self._auto_epoch = 0
 
     # -- epoch-granular compiled drivers ----------------------------------
     def _build(self):
         spec = self.spec
 
-        def train_epoch(params, vels, data, target, idx, mask):
+        def train_epoch(params, vels, data, target, idx, mask, ctrs,
+                        epoch):
             def body(carry, step):
                 params, vels = carry
-                step_idx, step_mask = step
+                step_idx, step_mask, step_ctr = step
                 x = jnp.take(data, step_idx, axis=0)
                 t = jnp.take(target, step_idx, axis=0)
                 if self._batch_sharding is not None:
                     x = jax.lax.with_sharding_constraint(
                         x, self._batch_sharding)
-                params, vels, m = train_minibatch(spec, params, vels, x,
-                                                  t, step_mask)
+                params, vels, m = train_minibatch(
+                    spec, params, vels, x, t, step_mask, epoch=epoch,
+                    ctr=step_ctr)
                 return (params, vels), m
             (params, vels), ms = jax.lax.scan(body, (params, vels),
-                                              (idx, mask))
+                                              (idx, mask, ctrs))
             return params, vels, ms
 
         def eval_epoch(params, data, target, idx, mask):
@@ -269,35 +469,49 @@ class FusedTrainer:
         self._eval_epoch_fn = jax.jit(eval_epoch)
 
     def _idx_matrix(self, indices: np.ndarray,
-                    batch: int) -> tuple[np.ndarray, np.ndarray]:
-        """(steps, batch) int32 indices + 0/1 mask.  The final short batch
-        wraps around for a static shape; the mask zeroes the padded tail
-        so metrics and gradients count each sample exactly once."""
+                    batch: int) -> tuple[np.ndarray, np.ndarray,
+                                         np.ndarray]:
+        """(steps, batch) int32 indices + 0/1 mask + per-step counter.
+        The final short batch wraps around for a static shape; the mask
+        zeroes the padded tail so metrics and gradients count each sample
+        exactly once.  The counter equals the loader's
+        ``minibatch_offset`` after the corresponding unit-graph step, so
+        stochastic layers reproduce the unit path's RNG draws."""
         n = len(indices)
         steps = max(1, -(-n // batch))
         padded = np.resize(indices, steps * batch)
         mask = np.zeros(steps * batch, np.float32)
         mask[:n] = 1.0
+        ctrs = np.minimum((np.arange(steps) + 1) * batch, n).astype(
+            np.uint32)
         return (padded.reshape(steps, batch).astype(np.int32),
-                mask.reshape(steps, batch))
+                mask.reshape(steps, batch), ctrs)
 
     def train_epoch(self, data, target, indices, batch: int,
-                    sync: bool = True) -> dict:
+                    sync: bool = True, epoch: int | None = None) -> dict:
         """One epoch on device.  ``sync=False`` returns device arrays
         without a host readback — on tunneled TPUs a device→host fetch
-        costs ~100× a step, so throughput loops should defer syncing."""
+        costs ~100× a step, so throughput loops should defer syncing.
+
+        ``epoch`` keys the stochastic layers' counter RNG; when omitted
+        an internal counter advances per call, so repeated calls never
+        silently reuse dropout masks."""
+        if epoch is None:
+            epoch = self._auto_epoch
+        self._auto_epoch = epoch + 1
         if self._train_epoch_fn is None:
             self._build()
-        idx, mask = self._idx_matrix(np.asarray(indices), batch)
+        idx, mask, ctrs = self._idx_matrix(np.asarray(indices), batch)
         self.params, self.vels, ms = self._train_epoch_fn(
-            self.params, self.vels, data, target, idx, mask)
+            self.params, self.vels, data, target, idx, mask, ctrs,
+            jnp.uint32(epoch))
         return {k: np.asarray(v) for k, v in ms.items()} if sync else ms
 
     def eval_epoch(self, data, target, indices, batch: int,
                    sync: bool = True) -> dict:
         if self._eval_epoch_fn is None:
             self._build()
-        idx, mask = self._idx_matrix(np.asarray(indices), batch)
+        idx, mask, _ = self._idx_matrix(np.asarray(indices), batch)
         ms = self._eval_epoch_fn(self.params, data, target, idx, mask)
         return {k: np.asarray(v) for k, v in ms.items()} if sync else ms
 
@@ -309,6 +523,8 @@ class FusedTrainer:
         for fwd, gdu, (w, b), (vw, vb) in zip(
                 self.workflow.forwards, self.workflow.gds, self.params,
                 self.vels):
+            if w is None:
+                continue
             fwd.weights.mem = np.asarray(w)
             if b is not None:
                 fwd.bias.mem = np.asarray(b)

@@ -46,6 +46,8 @@ def shard_params(mesh: Mesh, layer_index: int, ndim: int):
     size 1 this degenerates to replication."""
     if mesh.shape["model"] == 1 or ndim < 2:
         return replicated(mesh)
-    if layer_index % 2 == 0:
-        return NamedSharding(mesh, P(None, "model"))    # column parallel
-    return NamedSharding(mesh, P("model", None))        # row parallel
+    spec = [None] * ndim
+    # fc (in, out): last dim = output features; conv HWIO: last dim =
+    # output channels, second-to-last = input channels — same rule
+    spec[-1 if layer_index % 2 == 0 else -2] = "model"
+    return NamedSharding(mesh, P(*spec))

@@ -189,7 +189,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             metrics = {"epoch": epoch}
             perm = cls_idx[TRAIN].copy()
             loader.prng.shuffle(perm)
-            tm = trainer.train_epoch(data, target, perm, batch)
+            tm = trainer.train_epoch(data, target, perm, batch,
+                                     epoch=epoch)
             metrics["train_loss"] = float(tm["loss"].mean())
             n_train = len(cls_idx[TRAIN])
             metrics["train_n_err"] = int(tm["n_err"].sum())
