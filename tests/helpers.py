@@ -1,0 +1,33 @@
+"""Shared single-unit test harness (reference DummyWorkflow pattern,
+SURVEY.md §4): wire one unit into a dummy workflow with fixed inputs."""
+
+import numpy as np
+
+from znicz_tpu import Vector, Workflow, prng
+from znicz_tpu.backends import NumpyDevice
+
+
+class Dummy(Workflow):
+    """Minimal parent (reference DummyWorkflow fixture)."""
+
+
+def _x(shape, stream="x"):
+    return prng.get(stream).normal(size=shape)
+
+
+def wire(cls, x, device=None, **kw):
+    """Instantiate a Forward unit over a fixed input tensor."""
+    wf = Dummy(name="dummy")
+    unit = cls(wf, **kw)
+    unit.__dict__["input"] = Vector(np.asarray(x, np.float32))
+    unit.initialize(device or NumpyDevice())
+    return unit
+
+
+def wire_gd(cls, fwd, err, device=None, **kw):
+    """Pair a gradient unit with its forward, feeding a fixed error."""
+    unit = cls(fwd.workflow, **kw)
+    unit.setup_from_forward(fwd)
+    unit.__dict__["err_output"] = Vector(np.asarray(err, np.float32))
+    unit.initialize(device or NumpyDevice())
+    return unit

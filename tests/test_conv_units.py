@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from helpers import _x, wire, wire_gd
+
 from znicz_tpu import Vector, Workflow, prng
 from znicz_tpu.backends import NumpyDevice, XLADevice
 from znicz_tpu.nn import activation as act_units
@@ -21,28 +23,6 @@ from znicz_tpu.nn.pooling import (AvgPooling, MaxAbsPooling, MaxPooling,
 from znicz_tpu.ops import activations, conv as conv_ops, pooling as pool_ops
 
 
-class Dummy(Workflow):
-    pass
-
-
-def wire(cls, x, device=None, **kw):
-    wf = Dummy(name="dummy")
-    unit = cls(wf, **kw)
-    unit.__dict__["input"] = Vector(np.asarray(x, np.float32))
-    unit.initialize(device or NumpyDevice())
-    return unit
-
-
-def wire_gd(cls, fwd, err, device=None, **kw):
-    unit = cls(fwd.workflow, **kw)
-    unit.setup_from_forward(fwd)
-    unit.__dict__["err_output"] = Vector(np.asarray(err, np.float32))
-    unit.initialize(device or NumpyDevice())
-    return unit
-
-
-def _x(shape, stream="x"):
-    return prng.get(stream).normal(size=shape)
 
 
 class TestConvUnit:

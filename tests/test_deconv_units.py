@@ -1,0 +1,259 @@
+"""Deconv/Depooling tests (reference pattern, SURVEY.md §4): numpy-vs-XLA
+backend cross-check per unit, the adjoint identity pinning deconv to conv,
+hand-written gradients vs jax.grad, and the autoencoder sample end-to-end
+(unit graph and fused path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from helpers import _x, wire, wire_gd
+
+from znicz_tpu import Vector, prng
+from znicz_tpu.backends import Device, NumpyDevice
+from znicz_tpu.config import root
+from znicz_tpu.nn.deconv import Deconv, DeconvTanh, compute_padding
+from znicz_tpu.nn.depooling import Depooling, GDDepooling
+from znicz_tpu.nn.gd_deconv import GDDeconv, GDDeconvTanh
+from znicz_tpu.nn.pooling import MaxPooling
+from znicz_tpu.ops import conv as conv_ops, deconv as deconv_ops, \
+    pooling as pool_ops
+
+
+class TestDeconvOps:
+    def test_adjoint_identity(self):
+        """<conv(x, w), y> == <x, deconv(y, w)> — deconv IS the conv
+        adjoint, the property every tier is built on (ops.deconv)."""
+        x = _x((2, 9, 9, 3))
+        w = _x((3, 3, 3, 5), "w") * 0.1
+        cx = conv_ops.np_conv2d(x, w, stride=2, padding=1)
+        y = np.asarray(_x(cx.shape, "y"), np.float32)
+        dy = deconv_ops.np_deconv2d(y, w, stride=2, padding=1)
+        assert dy.shape == x.shape
+        np.testing.assert_allclose(np.vdot(cx, y), np.vdot(x, dy),
+                                   rtol=1e-4)
+
+    def test_np_vs_xla_forward(self):
+        x = _x((2, 5, 5, 4))
+        w = _x((3, 3, 2, 4), "w") * 0.1
+        for stride, pad in ((1, 0), (2, 1), ((2, 1), (1, 0))):
+            ref = deconv_ops.np_deconv2d(x, w, stride, pad)
+            got = deconv_ops.xla_deconv2d(jnp.asarray(x), jnp.asarray(w),
+                                          stride, pad)
+            np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_grads_vs_jax(self):
+        x = _x((2, 4, 4, 3))
+        w = _x((3, 3, 2, 3), "w") * 0.1
+        err = _x(deconv_ops.deconv_out_shape(x.shape, w.shape, 2, 1),
+                 "err")
+
+        def loss(x, w):
+            return jnp.vdot(deconv_ops.xla_deconv2d(x, w, 2, 1),
+                            jnp.asarray(err))
+
+        gx_ref, gw_ref = jax.grad(loss, argnums=(0, 1))(jnp.asarray(x),
+                                                        jnp.asarray(w))
+        gx = deconv_ops.np_deconv2d_grad_input(err, w, 2, 1)
+        gw = deconv_ops.np_deconv2d_grad_weights(err, x, w.shape, 2, 1)
+        np.testing.assert_allclose(gx, np.asarray(gx_ref), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(gw, np.asarray(gw_ref), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_compute_padding_invertible_geometry(self):
+        ph, pw = compute_padding(28, 28, 5, 5, 1)
+        assert (ph, pw) == (2, 2)
+        assert deconv_ops.deconv_out_size(28, 5, 1, 2) == 28
+
+
+class TestDeconvUnit:
+    def test_numpy_vs_xla(self, xla_device):
+        x = _x((4, 7, 7, 6))
+        prng.seed_all(5)
+        u_np = wire(DeconvTanh, x, n_kernels=6, kx=3, padding=1,
+                    n_channels=2)
+        prng.seed_all(5)
+        u_x = wire(DeconvTanh, x, n_kernels=6, kx=3, padding=1,
+                   n_channels=2, device=xla_device)
+        np.testing.assert_allclose(u_np.weights.mem, u_x.weights.mem)
+        u_np.run()
+        u_x.run()
+        assert u_np.output.mem.shape == (4, 7, 7, 2)
+        np.testing.assert_allclose(u_np.output.mem, u_x.output.mem,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_stride_upsamples(self):
+        u = wire(Deconv, _x((2, 4, 4, 3)), n_kernels=3, kx=2, sliding=2,
+                 n_channels=1)
+        u.run()
+        assert u.output.mem.shape == (2, 8, 8, 1)
+
+    def test_tie_shares_weight_vector(self):
+        from znicz_tpu.nn.conv import Conv
+        conv = wire(Conv, _x((2, 8, 8, 1)), n_kernels=4, kx=3, padding=1)
+        conv.run()
+        dec = Deconv(conv.workflow)
+        dec.tie(conv)
+        dec.__dict__["input"] = Vector(
+            np.asarray(conv.output.mem, np.float32))
+        dec.initialize(NumpyDevice())
+        assert dec.weights is conv.weights
+        assert dec.n_channels == 1
+        dec.run()
+        assert dec.output.mem.shape == (2, 8, 8, 1)
+
+    def test_gd_numpy_vs_xla(self, xla_device):
+        x = _x((4, 6, 6, 5))
+        err = _x((4, 6, 6, 2), "err") * 0.1
+        prng.seed_all(7)
+        f_np = wire(DeconvTanh, x, n_kernels=5, kx=3, padding=1,
+                    n_channels=2)
+        f_np.run()
+        g_np = wire_gd(GDDeconvTanh, f_np, err, apply_gradient=False)
+        g_np.run()
+        prng.seed_all(7)
+        f_x = wire(DeconvTanh, x, n_kernels=5, kx=3, padding=1,
+                   n_channels=2, device=xla_device)
+        f_x.run()
+        g_x = wire_gd(GDDeconvTanh, f_x, err, device=xla_device,
+                      apply_gradient=False)
+        g_x.run()
+        for attr in ("gradient_weights", "err_input"):
+            np.testing.assert_allclose(
+                getattr(g_np, attr).mem, getattr(g_x, attr).mem,
+                rtol=1e-4, atol=1e-5, err_msg=attr)
+
+    def test_gd_chain_vs_jax_grad(self):
+        """The hand-written GDDeconv must equal autodiff through the
+        deconv+tanh layer."""
+        x = _x((2, 5, 5, 4))
+        err = _x((2, 5, 5, 3), "err") * 0.1
+        prng.seed_all(3)
+        fwd = wire(DeconvTanh, x, n_kernels=4, kx=3, padding=1,
+                   n_channels=3)
+        fwd.run()
+        gd = wire_gd(GDDeconvTanh, fwd, err, apply_gradient=False)
+        gd.run()
+        w0 = np.asarray(fwd.weights.mem)
+
+        def loss(xx, ww):
+            y = deconv_ops.xla_deconv2d(xx, ww, 1, 1)
+            return jnp.vdot(jnp.tanh(y * 0.6666) * 1.7159,
+                            jnp.asarray(err))
+
+        gx_ref, gw_ref = jax.grad(loss, argnums=(0, 1))(
+            jnp.asarray(x, jnp.float32), jnp.asarray(w0))
+        np.testing.assert_allclose(gd.gradient_weights.mem,
+                                   np.asarray(gw_ref), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(gd.err_input.mem, np.asarray(gx_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestDepooling:
+    def _pair(self, device=None, positive=False):
+        x = _x((2, 6, 6, 3))
+        if positive:   # zeros must not outrank real winners on re-pool
+            x = np.abs(x) + 0.1
+        pool = wire(MaxPooling, x, kx=2, device=device)
+        pool.run()
+        dep = Depooling(pool.workflow)
+        dep.tie(pool)
+        dep.__dict__["input"] = Vector(
+            np.asarray(pool.output.mem, np.float32))
+        dep.initialize(device or NumpyDevice())
+        return pool, dep
+
+    def test_scatter_restores_winners(self):
+        pool, dep = self._pair(positive=True)
+        dep.run()
+        assert dep.output.mem.shape == tuple(pool.input.shape)
+        # every pooled value lands exactly once → sums match
+        np.testing.assert_allclose(dep.output.mem.sum(),
+                                   pool.output.mem.sum(), rtol=1e-6)
+        # scattering the pool output reproduces winners in place:
+        # re-pooling the depooled map gives the pool output back
+        y2, _ = pool_ops.np_max_pooling(dep.output.mem, (2, 2), (2, 2),
+                                        (0, 0))
+        np.testing.assert_allclose(y2, pool.output.mem)
+
+    def test_numpy_vs_xla(self, xla_device):
+        prng.seed_all(11)
+        _, d_np = self._pair()
+        prng.seed_all(11)
+        _, d_x = self._pair(device=xla_device)
+        d_np.run()
+        d_x.run()
+        np.testing.assert_allclose(d_np.output.mem, d_x.output.mem)
+
+    def test_gd_gathers(self):
+        pool, dep = self._pair()
+        dep.run()
+        err = _x(tuple(dep.output.shape), "err")
+        gd = wire_gd(GDDepooling, dep, err)
+        gd.run()
+        assert gd.err_input.mem.shape == tuple(dep.input.shape)
+        # adjoint check: <scatter(x), err> == <x, gather(err)>
+        np.testing.assert_allclose(
+            np.vdot(dep.output.mem, err),
+            np.vdot(dep.input.mem, gd.err_input.mem), rtol=1e-5)
+
+
+@pytest.fixture
+def small_ae():
+    saved = root.mnist_ae.synthetic.to_dict()
+    saved_mb = root.mnist_ae.minibatch_size
+    root.mnist_ae.synthetic.update({"n_train": 300, "n_valid": 60,
+                                    "n_test": 60, "noise": 0.35})
+    root.mnist_ae.minibatch_size = 60
+    yield
+    root.mnist_ae.synthetic.update(saved)
+    root.mnist_ae.minibatch_size = saved_mb
+
+
+class TestAutoencoderSample:
+    def test_unit_graph_learns(self, small_ae):
+        from znicz_tpu.models import autoencoder
+        wf = autoencoder.run(device=Device.create("numpy"), epochs=3)
+        ms = wf.decision.epoch_metrics
+        assert len(ms) == 3
+        assert ms[-1]["train_mse"] < ms[0]["train_mse"] * 0.7
+        assert wf.decision.complete
+
+    def test_fused_matches_unit_graph(self, small_ae):
+        from znicz_tpu.models.autoencoder import MnistAEWorkflow
+        from znicz_tpu.parallel import FusedTrainer, extract_model
+        prng.seed_all(1234)
+        wf = MnistAEWorkflow()
+        wf.initialize(device=Device.create("xla"))
+        spec, params, vels = extract_model(wf)
+        assert [la.kind for la in spec.layers] == \
+            ["conv", "max_pool", "depooling", "deconv"]
+        tr = FusedTrainer(spec=spec, params=params, vels=vels)
+        ld = wf.loader
+        n0, n1, n2 = ld.class_lengths
+        idx = np.arange(n0 + n1, n0 + n1 + n2)
+        tr.train_epoch(ld.original_data.devmem,
+                       ld.original_targets.devmem, idx,
+                       ld.max_minibatch_size)
+        # drive the unit graph over the identical minibatch order
+        for off in range(0, n2, ld.max_minibatch_size):
+            mb = idx[off:off + ld.max_minibatch_size]
+            ld.minibatch_class = 2
+            ld.minibatch_size = len(mb)
+            ld.minibatch_offset = min(off + ld.max_minibatch_size, n2)
+            ld.fill_minibatch(mb, 2)
+            for f in wf.forwards:
+                f.run()
+            wf.evaluator.run()
+            for g in reversed(wf.gds):
+                g.run()
+        for i, (fwd, (w, b)) in enumerate(zip(wf.forwards, tr.params)):
+            if w is None:
+                continue
+            np.testing.assert_allclose(
+                np.asarray(w), fwd.weights.mem, rtol=5e-4, atol=1e-5,
+                err_msg=f"layer {i} weights diverged")

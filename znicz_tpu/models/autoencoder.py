@@ -1,0 +1,102 @@
+"""MnistAEWorkflow: the reference's MNIST convolutional autoencoder.
+
+Parity target: the reference ``mnist_ae`` sample (SURVEY.md §2.2 Samples
+row "MNIST autoencoder (Conv/Deconv)" / BASELINE.json config 4): a
+Conv + Pooling encoder mirrored by a Depooling + Deconv decoder, trained
+with MSE against the input image — exercising ``Deconv``/``GDDeconv``/
+``Depooling`` (SURVEY.md §7 build-plan stage 7).
+
+Topology (via ``StandardWorkflow`` layers config; ``tie`` back-references
+give the decoder its encoder pairing): conv 5×5×16 pad 2 → maxpool 2×2 →
+depooling(tie=pool) → deconv 5×5 (16→1) pad 2, loss = MSE(input).
+
+Run: ``python -m znicz_tpu.models.autoencoder [--backend=…] [--epochs=N]``
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backends import Device
+from ..config import root
+from ..loader.fullbatch import FullBatchLoaderMSE
+from ..standard_workflow import StandardWorkflow
+from .mnist import MnistLoader
+
+root.mnist_ae.update({
+    "minibatch_size": 100,
+    "layers": [
+        # conv-MSE gradients sum over all 28×28 output positions, so the
+        # stable lr is ~2 orders below the classifier samples'
+        {"type": "conv", "->": {"n_kernels": 16, "kx": 5, "ky": 5,
+                                "padding": 2},
+         "<-": {"learning_rate": 0.0002, "gradient_moment": 0.9}},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "depooling", "->": {"tie": 1}},
+        {"type": "deconv", "->": {"n_kernels": 16, "kx": 5, "ky": 5,
+                                  "padding": 2, "n_channels": 1},
+         "<-": {"learning_rate": 0.0002, "gradient_moment": 0.9}},
+    ],
+    "decision": {"max_epochs": 10, "fail_iterations": 50},
+    "synthetic": {"n_train": 2000, "n_valid": 400, "n_test": 400,
+                  "noise": 0.35},
+})
+
+
+class MnistAELoader(FullBatchLoaderMSE, MnistLoader):
+    """MNIST images as NHWC (28, 28, 1) with target = input (the
+    FullBatchLoaderMSE autoencoder default)."""
+
+    def load_data(self) -> None:
+        MnistLoader.load_data(self)
+        self.original_data.mem = self.original_data.mem.reshape(
+            -1, 28, 28, 1).astype(np.float32)
+
+
+class MnistAEWorkflow(StandardWorkflow):
+    """BASELINE config 4: Conv/Pool encoder + Depool/Deconv decoder, MSE."""
+
+    def __init__(self, workflow=None, name="MnistAEWorkflow", layers=None,
+                 decision_config=None, snapshotter_config=None, **kwargs):
+        loader = MnistAELoader(
+            minibatch_size=root.mnist_ae.get("minibatch_size", 100),
+            synthetic_sizes=kwargs.get("synthetic_sizes")
+            or root.mnist_ae.synthetic.to_dict())
+        super().__init__(
+            None, name,
+            layers=layers or root.mnist_ae.get("layers")
+            or root.mnist_ae.layers,
+            loader=loader,
+            loss_function="mse",
+            decision_config=decision_config
+            or root.mnist_ae.decision.to_dict(),
+            snapshotter_config=snapshotter_config)
+
+
+def run(device: Device | None = None, epochs: int | None = None,
+        **kwargs) -> MnistAEWorkflow:
+    """Build, initialize and train; returns the finished workflow."""
+    wf = MnistAEWorkflow(**kwargs)
+    if epochs is not None:
+        wf.decision.max_epochs = epochs
+    wf.initialize(device=device or Device.create("auto"))
+    wf.run()
+    return wf
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--backend", default="auto",
+                        choices=("auto", "numpy", "xla"))
+    parser.add_argument("--epochs", type=int, default=None)
+    args = parser.parse_args(argv)
+    wf = run(device=Device.create(args.backend), epochs=args.epochs)
+    for m in wf.decision.epoch_metrics:
+        print(m)
+    print("time table:", wf.time_table()[:6])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Transposed-convolution forward unit (autoencoder decoder).
+
+Parity target: the reference ``veles/znicz/deconv.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 [baseline Deconv]): ``Deconv`` with shape
+inference from (and optional weight tying to) a paired encoder ``Conv``,
+plus the ``compute_padding`` geometry helper.
+
+TPU-first deviations (documented for migrating users):
+
+* NHWC activations; weights keep the paired conv's HWIO layout
+  ``(ky, kx, n_channels, n_kernels)`` so tying is a plain Vector share
+  (see ``ops.deconv`` module docstring for the adjoint formulation).
+* The reference's Deconv carried no bias (the decoder reconstruction is
+  purely linear); ``include_bias`` defaults to False but is supported.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops import activations, deconv as deconv_ops
+from ..ops.geometry import norm2 as _norm2
+from .nn_units import Forward
+
+
+def compute_padding(h: int, w: int, ky: int, kx: int, sliding
+                    ) -> tuple[int, int]:
+    """Symmetric padding that makes a conv over (h, w) exactly invertible
+    by a same-geometry deconv (no remainder): the reference helper's
+    symmetric case.  Raises if the window doesn't tile (h, w) evenly
+    with that padding (a deconv would then under-cover the image)."""
+    sh, sw = _norm2(sliding)
+    ph, pw = (ky - sh) // 2, (kx - sw) // 2
+    if (h + 2 * ph - ky) % sh or (w + 2 * pw - kx) % sw:
+        raise ValueError(
+            f"window {ky}x{kx} sliding {sh}x{sw} does not tile "
+            f"({h}, {w}) evenly with padding ({ph}, {pw})")
+    return (ph, pw)
+
+
+class Deconv(Forward):
+    """y = act(deconv2d(x, W) [+ b]); x is (B, OH, OW, n_kernels),
+    W is (ky, kx, n_channels, n_kernels), y is (B, H, W, n_channels)."""
+
+    MAPPING = ("deconv",)
+    ACTIVATION = activations.Activation
+
+    def __init__(self, workflow=None, name=None, n_kernels=None, kx=None,
+                 ky=None, sliding=1, padding=0, n_channels=None, **kwargs):
+        kwargs.setdefault("weights_filling", "gaussian")
+        kwargs.setdefault("include_bias", False)
+        super().__init__(workflow, name, **kwargs)
+        # geometry may instead come from tie(conv); validated at initialize
+        self.n_kernels = None if n_kernels is None else int(n_kernels)
+        self.kx = None if kx is None else int(kx)
+        self.ky = int(ky if ky is not None else kx) if kx is not None \
+            else None
+        self.sliding = _norm2(sliding)
+        self.padding = _norm2(padding)
+        self.n_channels = n_channels   # inferred from tied conv if None
+        self.conv_unit = None
+
+    def tie(self, conv) -> "Deconv":
+        """Tie weights + geometry to an encoder Conv (reference weight
+        tying: both units update the *same* Vector)."""
+        self.conv_unit = conv
+        self.link_attrs(conv, "weights")
+        self.n_kernels = conv.n_kernels
+        self.kx, self.ky = conv.kx, conv.ky
+        self.sliding, self.padding = conv.sliding, conv.padding
+        return self
+
+    def output_shape_for(self, x_shape) -> tuple[int, ...]:
+        w_shape = (self.ky, self.kx, self.n_channels, self.n_kernels)
+        return deconv_ops.deconv_out_shape(x_shape, w_shape, self.sliding,
+                                           self.padding)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if self.n_kernels is None or self.kx is None:
+            raise ValueError(f"{self.name}: n_kernels and kx are required "
+                             "(directly or via tie(conv))")
+        if len(self.input.shape) != 4:
+            raise ValueError(
+                f"{self.name}: Deconv expects NHWC input, got shape "
+                f"{self.input.shape}")
+        if self.input.shape[3] != self.n_kernels:
+            raise ValueError(
+                f"{self.name}: input has {self.input.shape[3]} channels, "
+                f"n_kernels={self.n_kernels}")
+        if self.n_channels is None:
+            if self.conv_unit is not None:
+                self.n_channels = int(self.conv_unit.input.shape[3])
+            else:
+                raise ValueError(f"{self.name}: n_channels is required "
+                                 "for an untied Deconv")
+        if self.weights_stddev is None:
+            # the (ky, kx, n_channels, n_kernels) layout puts the INPUT
+            # channels last, so Forward._fill's prod(shape[:-1]) fan-in
+            # heuristic would use the output channels — supply the true
+            # forward fan-in explicitly
+            self.weights_stddev = 1.0 / np.sqrt(
+                self.ky * self.kx * self.n_kernels)
+        self.create_weights(
+            (self.ky, self.kx, self.n_channels, self.n_kernels),
+            (self.n_channels,))
+        if not self.output:
+            self.output.mem = np.zeros(
+                self.output_shape_for(self.input.shape), np.float32)
+        self.init_vectors(self.weights, self.bias, self.output)
+        act, sliding, padding = self.ACTIVATION, self.sliding, self.padding
+
+        def fwd(x, w, b):
+            y = deconv_ops.xla_deconv2d(x, w, sliding, padding)
+            if b is not None:
+                y = y + b
+            return act.fwd(y, jnp)
+
+        self._fwd_fn = fwd
+
+    def numpy_run(self) -> None:
+        y = deconv_ops.np_deconv2d(self.input.mem, self.weights.mem,
+                                   self.sliding, self.padding)
+        if self.include_bias:
+            y = y + self.bias.mem
+        self.output.mem = self.ACTIVATION.fwd(y, np)
+
+    def xla_run(self) -> None:
+        fn = self.jit(self._fwd_fn)
+        self.output.devmem = fn(
+            self.input.devmem, self.weights.devmem,
+            self.bias.devmem if self.include_bias else None)
+
+
+class DeconvTanh(Deconv):
+    MAPPING = ("deconv_tanh",)
+    ACTIVATION = activations.Tanh
+
+
+class DeconvSigmoid(Deconv):
+    MAPPING = ("deconv_sigmoid",)
+    ACTIVATION = activations.Sigmoid

@@ -1,0 +1,109 @@
+"""Depooling (unpooling) forward + gradient units (decoder path).
+
+Parity target: the reference ``veles/znicz/depooling.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 Depooling row): scatter each pooled
+value back to the winner slot recorded by a paired ``_OffsetPooling``
+unit, restoring the pre-pooling spatial extent.
+
+TPU-first: the scatter/gather pair reuses the dense window-slot
+compare+add machinery from ``ops.pooling`` (SURVEY.md §7 hard part (a)) —
+no gather/scatter engine, one VPU pass per window tap."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..memory import Vector
+from ..ops import pooling as pool_ops
+from .nn_units import Forward, GradientDescentBase
+
+
+class Depooling(Forward):
+    """Scatter input through the tied pooling unit's winner offsets.
+
+    ``tie(pool)`` links the offsets Vector and the geometry; the output
+    shape equals the tied pool's *input* shape (spatial upsampling)."""
+
+    MAPPING = ("depooling",)
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        kwargs["include_bias"] = False
+        super().__init__(workflow, name, **kwargs)
+        self.pool_unit = None
+
+    def tie(self, pool) -> "Depooling":
+        if not hasattr(pool, "input_offset"):
+            raise ValueError(f"{self.name}: tied unit {pool.name} records "
+                             "no winner offsets (avg pooling cannot be "
+                             "depooled)")
+        self.pool_unit = pool
+        self.link_attrs(pool, "input_offset")
+        self.ksize, self.sliding, self.padding = (pool.ksize, pool.sliding,
+                                                  pool.padding)
+        return self
+
+    def output_shape_for(self, x_shape) -> tuple[int, ...]:
+        return tuple(self.pool_unit.input.shape)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if self.pool_unit is None:
+            raise ValueError(f"{self.name}: Depooling requires tie(pool)")
+        if tuple(self.input.shape) != tuple(self.pool_unit.output.shape):
+            raise ValueError(
+                f"{self.name}: input shape {tuple(self.input.shape)} != "
+                f"tied pool output {tuple(self.pool_unit.output.shape)}")
+        if not self.output:
+            self.output.mem = np.zeros(
+                self.output_shape_for(self.input.shape), np.float32)
+        self.init_vectors(self.output)
+
+    def numpy_run(self) -> None:
+        self.output.mem = pool_ops.np_depooling(
+            self.input.mem, self.input_offset.mem, self.output.shape,
+            self.ksize, self.sliding, self.padding)
+
+    def xla_run(self) -> None:
+        if not hasattr(self, "_fwd_fn"):
+            ks, sl, pad = self.ksize, self.sliding, self.padding
+            out_shape = tuple(self.output.shape)
+            self._fwd_fn = self.jit(
+                lambda x, off: pool_ops.xla_depooling(
+                    x, off, out_shape, ks, sl, pad))
+        self.output.devmem = self._fwd_fn(self.input.devmem,
+                                          self.input_offset.devmem)
+
+
+class GDDepooling(GradientDescentBase):
+    """Gather err_output back through the recorded winner offsets (the
+    adjoint of the depooling scatter); no parameters."""
+
+    MAPPING = ("depooling",)
+
+    def setup_from_forward(self, fwd) -> "GDDepooling":
+        super().setup_from_forward(fwd)
+        self.link_attrs(fwd, "input_offset")
+        self.ksize, self.sliding, self.padding = (fwd.ksize, fwd.sliding,
+                                                  fwd.padding)
+        self.include_bias = False
+        return self
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        err = self.err_output.mem.reshape(self.output.shape)
+        self.err_input.mem = pool_ops.np_gd_depooling(
+            err, self.input_offset.mem, self.ksize, self.sliding,
+            self.padding)
+
+    def xla_run(self) -> None:
+        if not self.need_err_input:
+            return
+        if not hasattr(self, "_bwd_fn"):
+            ks, sl, pad = self.ksize, self.sliding, self.padding
+            out_shape = tuple(self.output.shape)
+            self._bwd_fn = self.jit(
+                lambda e, off: pool_ops.xla_gd_depooling(
+                    e.reshape(out_shape), off, ks, sl, pad))
+        self.err_input.devmem = self._bwd_fn(self.err_output.devmem,
+                                             self.input_offset.devmem)

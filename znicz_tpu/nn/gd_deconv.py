@@ -1,0 +1,124 @@
+"""Backprop unit for the transposed convolution.
+
+Parity target: the reference ``veles/znicz/gd_deconv.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 [baseline GDDeconv]).
+
+The adjoint relationship makes the gradients *conv* ops (see
+``ops.deconv``): err_input is a plain conv of err_output with the shared
+weights; the weight grad is the conv weight-grad with the input/error
+roles swapped.  Tests cross-check the whole chain against ``jax.grad``."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops import activations, deconv as deconv_ops, update
+from .nn_units import GradientDescentBase
+
+
+class GDDeconv(GradientDescentBase):
+    """Gradient unit for Deconv."""
+
+    MAPPING = ("deconv",)
+    ACTIVATION = activations.Activation
+
+    def _hypers(self):
+        return (self.learning_rate, self.weights_decay, self.l1_vs_l2,
+                self.gradient_moment)
+
+    def _hypers_bias(self):
+        return (self.learning_rate_bias, self.weights_decay_bias,
+                self.l1_vs_l2_bias, self.gradient_moment_bias)
+
+    def setup_from_forward(self, fwd) -> "GDDeconv":
+        super().setup_from_forward(fwd)
+        self.sliding, self.padding = fwd.sliding, fwd.padding
+        return self
+
+    def numpy_run(self) -> None:
+        act = self.ACTIVATION
+        y = self.output.mem
+        err_y = act.bwd(self.err_output.mem.reshape(y.shape), y,
+                        self.input.mem if act.needs_input else None, np)
+        x = self.input.mem
+        gw = deconv_ops.np_deconv2d_grad_weights(
+            err_y, x, self.weights.shape, self.sliding, self.padding)
+        gb = err_y.sum(axis=(0, 1, 2)) if self.include_bias else None
+        if self.accumulate_gradient and self.gradient_weights:
+            gw = gw + self.gradient_weights.mem
+            if gb is not None:
+                gb = gb + self.gradient_bias.mem
+        self.gradient_weights.mem = gw
+        if gb is not None:
+            self.gradient_bias.mem = gb
+        if self.need_err_input:
+            self.err_input.mem = deconv_ops.np_deconv2d_grad_input(
+                err_y, self.weights.mem, self.sliding, self.padding)
+        if self.apply_gradient:
+            w, vw = update.np_sgd_update(self.weights.mem, gw,
+                                         self.velocity_weights.mem,
+                                         *self._hypers())
+            self.weights.mem, self.velocity_weights.mem = w, vw
+            if self.include_bias:
+                b, vb = update.np_sgd_update(self.bias.mem, gb,
+                                             self.velocity_bias.mem,
+                                             *self._hypers_bias())
+                self.bias.mem, self.velocity_bias.mem = b, vb
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        act = self.ACTIVATION
+        need_err = self.need_err_input
+        include_bias = self.include_bias
+        sliding, padding = self.sliding, self.padding
+        w_shape = tuple(self.weights.shape)
+
+        def bwd(x, w, err_out, y):
+            err_y = act.bwd(err_out.reshape(y.shape), y,
+                            x if act.needs_input else None, jnp)
+            gw = deconv_ops.xla_deconv2d_grad_weights(err_y, x, w_shape,
+                                                      sliding, padding)
+            gb = jnp.sum(err_y, axis=(0, 1, 2)) if include_bias else None
+            err_in = (deconv_ops.xla_deconv2d_grad_input(
+                err_y, w, sliding, padding) if need_err else None)
+            return gw, gb, err_in
+
+        self._bwd_fn = bwd
+        self._apply_fn = update.sgd_update_h
+
+    def xla_run(self) -> None:
+        bwd = self.jit(self._bwd_fn)
+        gw, gb, err_in = bwd(self.input.devmem, self.weights.devmem,
+                             self.err_output.devmem, self.output.devmem)
+        if self.accumulate_gradient and self.gradient_weights:
+            gw = gw + self.gradient_weights.devmem
+            if gb is not None:
+                gb = gb + self.gradient_bias.devmem
+        self.gradient_weights.devmem = gw
+        if gb is not None:
+            self.gradient_bias.devmem = gb
+        if self.need_err_input:
+            self.err_input.devmem = err_in
+        if self.apply_gradient:
+            apply_fn = self.jit(self._apply_fn)
+            hw = jnp.asarray(self._hypers(), jnp.float32)
+            w, vw = apply_fn(self.weights.devmem, gw,
+                             self.velocity_weights.devmem, hw)
+            self.weights.devmem, self.velocity_weights.devmem = w, vw
+            if self.include_bias:
+                hb = jnp.asarray(self._hypers_bias(), jnp.float32)
+                b, vb = apply_fn(self.bias.devmem, gb,
+                                 self.velocity_bias.devmem, hb)
+                self.bias.devmem, self.velocity_bias.devmem = b, vb
+
+
+class GDDeconvTanh(GDDeconv):
+    MAPPING = ("deconv_tanh",)
+    ACTIVATION = activations.Tanh
+
+
+class GDDeconvSigmoid(GDDeconv):
+    MAPPING = ("deconv_sigmoid",)
+    ACTIVATION = activations.Sigmoid

@@ -202,6 +202,40 @@ def xla_gd_max_pooling(err, offsets, x_shape, ksize, stride=None,
     return dx[:, ph:ph + h, pw:pw + w, :]
 
 
+def np_depooling(x, offsets, out_shape, ksize, stride=None, padding=0):
+    """Unpooling (decoder path): scatter each pooled value back to its
+    recorded winner slot — the same dense compare+add scatter as the
+    max-pool backward, used as a *forward* op (reference Depooling)."""
+    return np_gd_max_pooling(x, offsets, out_shape, ksize, stride, padding)
+
+
+def xla_depooling(x, offsets, out_shape, ksize, stride=None, padding=0):
+    return xla_gd_max_pooling(x, offsets, out_shape, ksize, stride, padding)
+
+
+def _depool_gather(err, offsets, ksize, stride, padding, xp):
+    """Adjoint of the depooling scatter: gather err at each window's
+    recorded winner slot → (B, OH, OW, C) shaped like the pooled tensor."""
+    (kh, kw), (ph, pw) = _norm2(ksize), _norm2(padding)
+    (sh, sw) = _norm2(stride if stride is not None else ksize)
+    b, oh, ow, c = offsets.shape
+    epad = _pad(err, ph, pw, 0.0, xp)
+    acc = None
+    for t, i, j in _taps(kh, kw):
+        sl = epad[:, i:i + oh * sh:sh, j:j + ow * sw:sw, :]
+        term = sl * (offsets == t)
+        acc = term if acc is None else acc + term
+    return acc
+
+
+def np_gd_depooling(err, offsets, ksize, stride=None, padding=0):
+    return _depool_gather(err, offsets, ksize, stride, padding, np)
+
+
+def xla_gd_depooling(err, offsets, ksize, stride=None, padding=0):
+    return _depool_gather(err, offsets, ksize, stride, padding, jnp)
+
+
 def np_gd_avg_pooling(err, x_shape, ksize, stride=None, padding=0):
     (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), \
         _norm2(stride or ksize), _norm2(padding)

@@ -28,13 +28,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import (activations, conv as conv_ops, dropout as drop_ops,
-                   normalization as lrn_ops, pooling as pool_ops,
-                   softmax as softmax_ops)
+from ..ops import (activations, conv as conv_ops, deconv as deconv_ops,
+                   dropout as drop_ops, normalization as lrn_ops,
+                   pooling as pool_ops, softmax as softmax_ops)
 from . import mesh as mesh_lib
 
 #: Layer kinds with trainable parameters.
-PARAM_KINDS = ("fc", "conv")
+PARAM_KINDS = ("fc", "conv", "deconv")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,12 +61,13 @@ class ModelSpec:
     compute_dtype: str = "float32"
 
     def __post_init__(self):
-        # the loss head consumes a 2D (batch, features) tensor and
-        # backward() hands the last layer a pre-activation error — both
-        # are only well-defined for a final fc layer
-        if self.layers and self.layers[-1].kind != "fc":
+        # the softmax-CE head consumes 2D logits and backward() hands the
+        # last layer a pre-activation error — only well-defined for a
+        # final fc layer; the MSE head accepts any output shape
+        if (self.loss == "softmax" and self.layers
+                and self.layers[-1].kind != "fc"):
             raise NotImplementedError(
-                f"the fused path requires a final fc layer (got "
+                f"the fused softmax path requires a final fc layer (got "
                 f"{self.layers[-1].kind!r}); use the unit-graph path for "
                 f"other heads")
         for layer in self.layers:
@@ -93,6 +94,8 @@ def extract_model(workflow) -> tuple[ModelSpec, list, list]:
     from ..nn import activation as act_units
     from ..nn.all2all import All2All, All2AllSoftmax
     from ..nn.conv import Conv
+    from ..nn.deconv import Deconv
+    from ..nn.depooling import Depooling
     from ..nn.dropout import DropoutForward
     from ..nn.normalization import LRNormalizerForward
     from ..nn import pooling as pool_units
@@ -120,6 +123,23 @@ def extract_model(workflow) -> tuple[ModelSpec, list, list]:
             has_params = True
             act = fwd.ACTIVATION.name
             config = {"stride": fwd.sliding, "padding": fwd.padding}
+        elif isinstance(fwd, Deconv):
+            if fwd.conv_unit is not None:
+                # tied weights are one shared Vector updated by two GD
+                # units sequentially — the fused step's parallel update
+                # would diverge from the unit graph
+                raise NotImplementedError(
+                    "fused path does not support weight-tied Deconv; "
+                    "use the unit-graph path")
+            kind = "deconv"
+            has_params = True
+            act = fwd.ACTIVATION.name
+            config = {"stride": fwd.sliding, "padding": fwd.padding}
+        elif isinstance(fwd, Depooling):
+            kind = "depooling"
+            config = {"ksize": fwd.ksize, "stride": fwd.sliding,
+                      "padding": fwd.padding,
+                      "tie": workflow.forwards.index(fwd.pool_unit)}
         elif isinstance(fwd, pool_units.Pooling):
             kind = {"MaxPooling": "max_pool",
                     "MaxAbsPooling": "maxabs_pool",
@@ -178,9 +198,12 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
     cdt = jnp.dtype(spec.compute_dtype)
     h = x
     caches = []
+    auxes = []       # per-layer residuals, kept even without caches so
+    in_shapes = []   # decoder layers can reach their tied encoder layer
     n = len(spec.layers)
     for i, (layer, (w, b)) in enumerate(zip(spec.layers, params)):
         x_in, aux = h, None
+        in_shapes.append(tuple(h.shape))
         cfg = layer.cfg
         is_last = i == n - 1
         if layer.kind == "fc":
@@ -200,6 +223,19 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
             if b is not None:
                 pre = pre + b
             h = spec.act(i).fwd(pre, jnp)
+        elif layer.kind == "deconv":
+            pre = deconv_ops.xla_deconv2d(h.astype(cdt), w.astype(cdt),
+                                          cfg["stride"], cfg["padding"],
+                                          out_dtype=jnp.float32)
+            if b is not None:
+                pre = pre + b
+            h = spec.act(i).fwd(pre, jnp)
+        elif layer.kind == "depooling":
+            off = auxes[cfg["tie"]]
+            h = pool_ops.xla_depooling(
+                h, off, in_shapes[cfg["tie"]], cfg["ksize"],
+                cfg["stride"], cfg["padding"])
+            aux = off
         elif layer.kind == "max_pool":
             h, aux = pool_ops.xla_max_pooling(h, cfg["ksize"],
                                               cfg["stride"],
@@ -240,6 +276,7 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
             h = spec.act(i).fwd(h, jnp)
         else:
             raise NotImplementedError(layer.kind)
+        auxes.append(aux)
         if want_caches:
             caches.append((x_in, aux))
     return h, caches
@@ -263,8 +300,10 @@ def _loss_and_err(spec: ModelSpec, out, target, mask):
         n_err = jnp.sum((jnp.argmax(probs, axis=1) != target) * mask)
         return (jnp.sum(loss * mask) / bs, err * mask[:, None] / bs,
                 n_err.astype(jnp.int32))
-    diff = (out - target.reshape(out.shape)) * mask[:, None]
-    loss = jnp.sum(diff * diff) / (bs * out.shape[1])
+    mask_b = mask.reshape((-1,) + (1,) * (out.ndim - 1))
+    diff = (out - target.reshape(out.shape)) * mask_b
+    feats = int(np.prod(out.shape[1:]))
+    loss = jnp.sum(diff * diff) / (bs * feats)
     # err w.r.t. the activated output, scaled 1/batch (matches
     # EvaluatorMSE); train_minibatch folds it through the last activation
     return loss, diff / bs, jnp.zeros((), jnp.int32)
@@ -299,7 +338,7 @@ def backward(spec: ModelSpec, params, caches, out, err):
                 err = jnp.dot(err2.astype(cdt), w.astype(cdt).T,
                               preferred_element_type=jnp.float32
                               ).reshape(x_in.shape)
-            else:                                         # conv
+            elif layer.kind == "conv":
                 gw = conv_ops.xla_conv2d_grad_weights(
                     x_in, err_pre, w.shape, cfg["stride"],
                     cfg["padding"])
@@ -307,6 +346,13 @@ def backward(spec: ModelSpec, params, caches, out, err):
                       if b is not None else None)
                 err = conv_ops.xla_conv2d_grad_input(
                     err_pre, w, x_in.shape, cfg["stride"], cfg["padding"])
+            else:                                         # deconv
+                gw = deconv_ops.xla_deconv2d_grad_weights(
+                    err_pre, x_in, w.shape, cfg["stride"], cfg["padding"])
+                gb = (jnp.sum(err_pre, axis=(0, 1, 2))
+                      if b is not None else None)
+                err = deconv_ops.xla_deconv2d_grad_input(
+                    err_pre, w, cfg["stride"], cfg["padding"])
             grads[i] = (gw, gb)
         elif layer.kind in ("max_pool", "maxabs_pool", "stochastic_pool",
                            "stochastic_abs_pool"):
@@ -321,6 +367,10 @@ def backward(spec: ModelSpec, params, caches, out, err):
             err = lrn_ops.xla_gd_lrn(err.reshape(y_i.shape), x_in, aux,
                                      cfg["n"], cfg["alpha"], cfg["beta"],
                                      cfg["k"])
+        elif layer.kind == "depooling":
+            err = pool_ops.xla_gd_depooling(
+                err.reshape(y_i.shape), aux, cfg["ksize"], cfg["stride"],
+                cfg["padding"])
         elif layer.kind == "dropout":
             if aux is not None:
                 err = err.reshape(x_in.shape) * aux

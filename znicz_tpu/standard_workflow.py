@@ -42,8 +42,9 @@ def _build_registries():
     try:
         from .nn import conv, gd_conv, pooling, gd_pooling  # noqa
         from .nn import normalization, dropout, activation  # noqa
+        from .nn import deconv, gd_deconv, depooling  # noqa
         modules += [conv, gd_conv, pooling, gd_pooling, normalization,
-                    dropout, activation]
+                    dropout, activation, deconv, gd_deconv, depooling]
     except ImportError:
         pass
     from .nn.nn_units import Forward, GradientDescentBase
@@ -86,7 +87,14 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             if cls is None:
                 raise ValueError(f"unknown layer type {ltype!r}; known: "
                                  f"{sorted(self.fwd_map)}")
-            unit = cls(self, name=f"fwd{i}_{ltype}", **spec.get("->", {}))
+            kwargs = dict(spec.get("->", {}))
+            # decoder units tie to an earlier forward by index: depooling
+            # needs the winner offsets of its paired pooling, deconv may
+            # share (and co-train) the encoder conv's weight Vector
+            tie_idx = kwargs.pop("tie", None)
+            unit = cls(self, name=f"fwd{i}_{ltype}", **kwargs)
+            if tie_idx is not None:
+                unit.tie(self.forwards[tie_idx])
             if prev is self.loader:
                 unit.link_attrs(self.loader, ("input", "minibatch_data"))
             else:
