@@ -1,0 +1,100 @@
+"""AlexNet sample tests (SURVEY.md §2.2 samples/AlexNet [baseline] /
+BASELINE config 3): geometry of the real 227×227 net, a learnable
+shrunken variant through the fused path, and numpy-vs-XLA parity of the
+unit graph on one minibatch."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.config import root
+from znicz_tpu.models import alexnet
+
+
+@pytest.fixture
+def small_net():
+    saved = {k: root.alexnet.get(k) for k in
+             ("minibatch_size", "size", "n_classes")}
+    saved_syn = root.alexnet.synthetic.to_dict()
+    root.alexnet.update({"minibatch_size": 32, "size": 67,
+                         "n_classes": 10})
+    root.alexnet.synthetic.update({"n_train": 160, "n_valid": 32,
+                                   "n_test": 32, "noise": 0.3})
+    yield
+    root.alexnet.update(saved)
+    root.alexnet.synthetic.update(saved_syn)
+
+
+def tanh_layers(lr=0.05):
+    """Learnable-at-test-scale variant: the strict-ReLU stack needs
+    real-data scale to leave the dead-unit regime, tanh doesn't."""
+    layers = alexnet.make_layers(10, lr=lr, wd=0.0,
+                                 widths=(8, 12, 16, 16, 12, 64, 64))
+    layers = [la for la in layers if la["type"] != "dropout"]
+    for la in layers:
+        la["type"] = {"conv_str": "conv_tanh",
+                      "all2all_str": "all2all_tanh"}.get(la["type"],
+                                                         la["type"])
+    return layers
+
+
+class TestGeometry:
+    def test_real_shapes(self, small_net):
+        """The classic 227×227 activation trace, checked symbolically via
+        each unit's output_shape_for (no full-size allocation)."""
+        root.alexnet.update({"size": 227, "n_classes": 1000,
+                             "minibatch_size": 1})
+        root.alexnet.synthetic.update({"n_train": 2, "n_valid": 0,
+                                       "n_test": 0})
+        wf = alexnet.AlexNetWorkflow()
+        wf.initialize(device=Device.create("numpy"))
+        expect = [(1, 55, 55, 96),     # conv1 11/4
+                  (1, 55, 55, 96),     # lrn
+                  (1, 27, 27, 96),     # pool 3/2
+                  (1, 27, 27, 256),    # conv2 5 pad2
+                  (1, 27, 27, 256),    # lrn
+                  (1, 13, 13, 256),    # pool
+                  (1, 13, 13, 384),    # conv3
+                  (1, 13, 13, 384),    # conv4
+                  (1, 13, 13, 256),    # conv5
+                  (1, 6, 6, 256),      # pool
+                  (1, 6, 6, 256),      # dropout
+                  (1, 4096),           # fc6
+                  (1, 4096),           # dropout
+                  (1, 4096),           # fc7
+                  (1, 1000)]           # softmax
+        got = [tuple(f.output.shape) for f in wf.forwards]
+        assert got == expect
+        # parameter count of the classic net (sanity of the layer wiring)
+        n_params = sum(int(np.prod(f.weights.shape)) + len(f.bias.mem)
+                       for f in wf.forwards if f.weights)
+        assert 60_000_000 < n_params < 63_000_000
+
+
+class TestTraining:
+    def test_fused_learns(self, small_net):
+        prng.seed_all(1234)
+        wf = alexnet.run(device=Device.create("xla"), epochs=11,
+                         layers=tanh_layers())
+        ms = wf.decision.epoch_metrics
+        assert ms[-1]["train_err_pct"] < 20.0
+        assert ms[-1]["train_loss"] < ms[0]["train_loss"] * 0.5
+
+    def test_unit_graph_numpy_vs_xla_minibatch(self, small_net):
+        """One forward+backward tick, both backends, same weights."""
+        layers = tanh_layers()
+        prng.seed_all(5)
+        wf_np = alexnet.AlexNetWorkflow(layers=layers)
+        wf_np.initialize(device=Device.create("numpy"))
+        prng.seed_all(5)
+        wf_x = alexnet.AlexNetWorkflow(layers=layers)
+        wf_x.initialize(device=Device.create("xla"))
+        for wf in (wf_np, wf_x):
+            wf.run(max_ticks=2)
+        for f_np, f_x in zip(wf_np.forwards, wf_x.forwards):
+            if not f_np.weights:
+                continue
+            np.testing.assert_allclose(
+                f_np.weights.mem, f_x.weights.mem, rtol=5e-4, atol=2e-5,
+                err_msg=f_np.name)

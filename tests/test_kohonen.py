@@ -1,0 +1,116 @@
+"""Kohonen SOM tests (reference pattern, SURVEY.md §4): op goldens,
+numpy-vs-XLA backend cross-check, the non-gradient training loop
+(SURVEY.md §3.5), and the sample workflow converging (quantization error
+drops, neuron sheet unfolds)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device, NumpyDevice
+from znicz_tpu.config import root
+from znicz_tpu.ops import kohonen as som_ops
+
+
+class TestKohonenOps:
+    def test_distances_golden(self):
+        x = np.array([[0.0, 0.0], [1.0, 1.0]], np.float32)
+        w = np.array([[0.0, 0.0], [0.0, 1.0], [3.0, 4.0]], np.float32)
+        d = som_ops.distances(x, w, np)
+        expect = np.array([[0.0, 1.0, 25.0], [2.0, 1.0, 13.0]])
+        np.testing.assert_allclose(d, expect, atol=1e-5)
+        np.testing.assert_array_equal(som_ops.winners(d, np), [0, 1])
+
+    def test_np_vs_xla_forward(self):
+        gen = prng.get("t")
+        x = gen.normal(size=(32, 8)).astype(np.float32)
+        w = gen.normal(size=(25, 8)).astype(np.float32)
+        win_np, d_np = som_ops.np_forward(x, w)
+        win_x, d_x = som_ops.xla_forward(jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_array_equal(win_np, np.asarray(win_x))
+        np.testing.assert_allclose(d_np, np.asarray(d_x), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_som_update_pulls_winner(self):
+        """With σ→0 the update reduces to pulling each winner toward its
+        sample (winner-take-all k-means-style step)."""
+        w = np.zeros((4, 2), np.float32)
+        x = np.array([[1.0, 0.0]], np.float32)
+        coords = som_ops.grid_coords(2, 2)
+        win = np.array([3], np.int32)
+        w2, diff = som_ops.som_update(w, x, win, coords, lr=1.0,
+                                      sigma=1e-3, xp=np)
+        np.testing.assert_allclose(w2[3], [1.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(w2[:3], 0.0, atol=1e-6)
+        assert diff > 0
+
+    def test_np_vs_xla_train_step(self):
+        gen = prng.get("t2")
+        x = gen.normal(size=(16, 3)).astype(np.float32)
+        w = gen.normal(size=(9, 3)).astype(np.float32)
+        coords = som_ops.grid_coords(3, 3)
+        w_np, d_np = som_ops.np_train_step(w, x, coords, 0.3, 1.5)
+        w_x, d_x = som_ops.xla_train_step(jnp.asarray(w), jnp.asarray(x),
+                                          jnp.asarray(coords), 0.3, 1.5)
+        np.testing.assert_allclose(w_np, np.asarray(w_x), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(float(d_np), float(d_x), rtol=1e-4)
+
+
+@pytest.fixture
+def small_som():
+    saved = root.kohonen.synthetic.to_dict()
+    saved_mb = root.kohonen.get("minibatch_size", 100)
+    root.kohonen.synthetic.update({"n_train": 400, "n_clusters": 4,
+                                   "noise": 0.06})
+    root.kohonen.minibatch_size = 100
+    yield
+    root.kohonen.synthetic.update(saved)
+    root.kohonen.minibatch_size = saved_mb
+
+
+class TestKohonenWorkflow:
+    def test_numpy_learns(self, small_som):
+        from znicz_tpu.models import kohonen
+        wf = kohonen.run(device=Device.create("numpy"), epochs=8)
+        assert len(wf.decision.epoch_metrics) <= 8
+        assert wf.quantization_error() < 0.25
+        # hits histogram counted every processed sample
+        assert wf.forward.hits.mem.sum() > 0
+
+    def test_numpy_vs_xla(self, small_som):
+        from znicz_tpu.models import kohonen
+        prng.seed_all(77)
+        wf_np = kohonen.run(device=Device.create("numpy"), epochs=3)
+        prng.seed_all(77)
+        wf_x = kohonen.run(device=Device.create("xla"), epochs=3)
+        np.testing.assert_allclose(wf_np.forward.weights.mem,
+                                   wf_x.forward.weights.mem,
+                                   rtol=5e-4, atol=1e-5)
+
+    def test_fused_matches_loop(self, small_som):
+        """The jitted-scan epoch (parallel.som) must track the unit-graph
+        loop: same schedules, same shuffles → same weights."""
+        from znicz_tpu.models import kohonen
+        prng.seed_all(99)
+        wf = kohonen.run(device=Device.create("xla"), epochs=4)
+        prng.seed_all(99)
+        wf2 = kohonen.KohonenWorkflow()
+        wf2.decision.max_epochs = 4
+        wf2.initialize(device=Device.create("xla"))
+        wf2.run_fused()
+        # fused truncates ragged tails; with n_train % batch == 0 the
+        # paths see identical minibatches
+        np.testing.assert_allclose(wf.forward.weights.mem,
+                                   wf2.forward.weights.mem,
+                                   rtol=5e-4, atol=1e-5)
+
+    def test_decision_epsilon_stops(self, small_som):
+        from znicz_tpu.models import kohonen
+        wf = kohonen.KohonenWorkflow(
+            decision_config={"max_epochs": 50, "epsilon": 1e30})
+        wf.initialize(device=Device.create("numpy"))
+        wf.run()
+        assert len(wf.decision.epoch_metrics) == 1   # stops on epoch 0

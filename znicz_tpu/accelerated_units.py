@@ -79,6 +79,24 @@ class AcceleratedUnit(Unit):
                 donate_argnums=donate_argnums)
         return self._jit_cache[key]
 
+    @property
+    def current_batch_size(self) -> int:
+        """Rows of the minibatch that are real (the loader pads short
+        ones); falls back to the unit's own tensors outside a workflow."""
+        wf = self.workflow
+        loader = getattr(wf, "loader", None) if wf is not None else None
+        if loader is not None:
+            return loader.minibatch_size
+        for attr in ("input", "output"):
+            try:
+                v = getattr(self, attr)
+            except AttributeError:
+                continue
+            if v:
+                return len(v.mem)
+        raise AttributeError(f"{self.name}: no loader/input/output to "
+                             "infer the batch size from")
+
     # -- Vector helpers ----------------------------------------------------
     def init_vectors(self, *vectors: Vector) -> None:
         for v in vectors:

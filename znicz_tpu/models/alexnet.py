@@ -1,0 +1,175 @@
+"""AlexNetWorkflow: the reference's ImageNet AlexNet sample.
+
+Parity target: the reference ``samples/AlexNet`` (SURVEY.md §2.2 Samples
+row [baseline: samples/AlexNet] / BASELINE.json config 3 and the headline
+metric "ImageNet AlexNet images/sec/chip").  Classic 2012 geometry over
+227×227×3 NHWC inputs: conv11/4·96 → LRN → pool3/2 → conv5·256(pad 2) →
+LRN → pool3/2 → conv3·384 → conv3·384 → conv3·256 → pool3/2 → dropout →
+fc4096 → dropout → fc4096 → softmax(1000), strict-ReLU activations
+(SURVEY.md §2.2 ConvStrictRELU), LRN normalization [baseline], dropout
+[baseline: AlexNet config].
+
+Data: ImageNet is not available in this environment (no network —
+SURVEY.md caveat); a seeded synthetic stand-in with the real tensor
+geometry serves training/benchmarking.  Shapes and class count are
+configurable so tests can shrink the net (``root.alexnet``).
+
+Run: ``python -m znicz_tpu.models.alexnet [--backend=…] [--epochs=N]``
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import prng
+from ..backends import Device
+from ..config import root
+from ..loader.fullbatch import FullBatchLoader
+from ..standard_workflow import StandardWorkflow
+
+
+def make_layers(n_classes: int = 1000, lr: float = 0.01,
+                moment: float = 0.9, wd: float = 5e-4,
+                widths=(96, 256, 384, 384, 256, 4096, 4096)) -> list:
+    """The AlexNet ``layers`` config; ``widths`` shrinks the net for
+    tests."""
+    gd = {"learning_rate": lr, "gradient_moment": moment,
+          "weights_decay": wd}
+    c1, c2, c3, c4, c5, f6, f7 = widths
+    return [
+        {"type": "conv_str",
+         "->": {"n_kernels": c1, "kx": 11, "ky": 11, "sliding": 4},
+         "<-": dict(gd)},
+        {"type": "norm", "->": {"n": 5, "alpha": 1e-4, "beta": 0.75,
+                                "k": 2.0}},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": 2}},
+        {"type": "conv_str",
+         "->": {"n_kernels": c2, "kx": 5, "ky": 5, "padding": 2},
+         "<-": dict(gd)},
+        {"type": "norm", "->": {"n": 5, "alpha": 1e-4, "beta": 0.75,
+                                "k": 2.0}},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": 2}},
+        {"type": "conv_str",
+         "->": {"n_kernels": c3, "kx": 3, "ky": 3, "padding": 1},
+         "<-": dict(gd)},
+        {"type": "conv_str",
+         "->": {"n_kernels": c4, "kx": 3, "ky": 3, "padding": 1},
+         "<-": dict(gd)},
+        {"type": "conv_str",
+         "->": {"n_kernels": c5, "kx": 3, "ky": 3, "padding": 1},
+         "<-": dict(gd)},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": 2}},
+        {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+        {"type": "all2all_str", "->": {"output_sample_shape": f6},
+         "<-": dict(gd)},
+        {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+        {"type": "all2all_str", "->": {"output_sample_shape": f7},
+         "<-": dict(gd)},
+        {"type": "softmax", "->": {"output_sample_shape": n_classes},
+         "<-": dict(gd)},
+    ]
+
+
+root.alexnet.update({
+    "minibatch_size": 128,
+    "size": 227,
+    "n_classes": 1000,
+    "layers": None,   # default: make_layers(n_classes)
+    "decision": {"max_epochs": 10, "fail_iterations": 50},
+    "synthetic": {"n_train": 512, "n_valid": 128, "n_test": 128,
+                  "noise": 0.4},
+})
+
+
+class ImagenetSyntheticLoader(FullBatchLoader):
+    """Seeded synthetic stand-in with ImageNet tensor geometry: per-class
+    prototypes + noise at (size, size, 3) NHWC."""
+
+    def __init__(self, workflow=None, name=None, size=227, n_classes=1000,
+                 synthetic_sizes=None, **kwargs):
+        kwargs.setdefault("normalization_type", "linear")
+        super().__init__(workflow, name or "imagenet_loader", **kwargs)
+        self.size = int(size)
+        self.n_classes = int(n_classes)
+        self.synthetic_sizes = synthetic_sizes
+
+    def load_data(self) -> None:
+        cfg = self.synthetic_sizes or root.alexnet.synthetic.to_dict()
+        n_test, n_valid, n_train = (cfg["n_test"], cfg["n_valid"],
+                                    cfg["n_train"])
+        noise, s = cfg.get("noise", 0.4), self.size
+        gen = prng.get("imagenet_synthetic")
+        n = n_test + n_valid + n_train
+        labels = gen.randint(0, self.n_classes, n).astype(np.int32)
+        # low-res per-class prototypes upsampled to full size keep the
+        # synthetic set learnable without storing n_classes full images;
+        # float32 throughout (a float64 prototype sheet at 1000 classes
+        # would peak at ~1.3 GB)
+        protos = gen.normal(0.0, 1.0, (self.n_classes, 8, 8, 3)).astype(
+            np.float32)
+        up = protos.repeat(s // 8 + 1, axis=1).repeat(s // 8 + 1, axis=2)
+        up = up[:, :s, :s, :]
+        data = np.empty((n, s, s, 3), np.float32)
+        for i in range(n):   # chunked: avoid a (n, s, s, 3) temp blowup
+            data[i] = up[labels[i]] + gen.normal(
+                0.0, noise, (s, s, 3)).astype(np.float32)
+        self.original_data.mem = data
+        self.original_labels.mem = labels
+        self.class_lengths = [n_test, n_valid, n_train]
+
+
+class AlexNetWorkflow(StandardWorkflow):
+    """BASELINE config 3: the ImageNet AlexNet training workflow."""
+
+    def __init__(self, workflow=None, name="AlexNetWorkflow", layers=None,
+                 decision_config=None, snapshotter_config=None, **kwargs):
+        loader = ImagenetSyntheticLoader(
+            minibatch_size=root.alexnet.get("minibatch_size", 128),
+            size=root.alexnet.get("size", 227),
+            n_classes=root.alexnet.get("n_classes", 1000),
+            synthetic_sizes=kwargs.get("synthetic_sizes"))
+        super().__init__(
+            None, name,
+            layers=layers or root.alexnet.get("layers")
+            or make_layers(root.alexnet.get("n_classes", 1000)),
+            loader=loader,
+            loss_function="softmax",
+            decision_config=decision_config
+            or root.alexnet.decision.to_dict(),
+            snapshotter_config=snapshotter_config)
+
+
+def run(device: Device | None = None, epochs: int | None = None,
+        fused: bool = True, mesh=None, **kwargs) -> AlexNetWorkflow:
+    """Build, initialize and train.  ``fused=True`` (default) uses the
+    compiled whole-step path — the per-unit tick loop at this scale only
+    serves as the correctness cross-check."""
+    wf = AlexNetWorkflow(**kwargs)
+    if epochs is not None:
+        wf.decision.max_epochs = epochs
+    wf.initialize(device=device or Device.create("auto"))
+    if fused and wf.device.is_xla:
+        wf.run_fused(mesh=mesh, max_epochs=epochs)
+    else:
+        wf.run()
+    return wf
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--backend", default="auto",
+                        choices=("auto", "numpy", "xla"))
+    parser.add_argument("--epochs", type=int, default=None)
+    parser.add_argument("--unit-graph", action="store_true",
+                        help="per-unit tick loop instead of the fused step")
+    args = parser.parse_args(argv)
+    wf = run(device=Device.create(args.backend), epochs=args.epochs,
+             fused=not args.unit_graph)
+    for m in wf.decision.epoch_metrics[-3:]:
+        print(m)
+
+
+if __name__ == "__main__":
+    main()

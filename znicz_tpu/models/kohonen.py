@@ -1,0 +1,155 @@
+"""KohonenWorkflow: the reference's self-organizing-map sample.
+
+Parity target: the reference Kohonen sample (SURVEY.md §2.2 Samples row /
+§3.5 call stack / BASELINE.json config 5): loader → KohonenForward
+(winner-take-all) → KohonenTrainer (neighborhood pull) → KohonenDecision
+(weight-change stop) in a minibatch loop — no gradient chain.
+
+Data: 2-D points from a seeded mixture of gaussian clusters (the classic
+SOM demo distribution); after training the 2-D neuron sheet unfolds over
+the clusters and quantization error drops.
+
+Run: ``python -m znicz_tpu.models.kohonen [--backend=…] [--epochs=N]``
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import prng
+from ..backends import Device
+from ..config import root
+from ..loader.fullbatch import FullBatchLoader
+from ..logger import MetricsWriter
+from ..accelerated_units import AcceleratedWorkflow
+from ..nn.kohonen import (KohonenDecision, KohonenForward, KohonenTrainer,
+                          make_train_only_gate)
+from ..ops import kohonen as som_ops
+
+root.kohonen.update({
+    "minibatch_size": 100,
+    "shape": (8, 8),
+    "learning_rate": 0.5,
+    "decision": {"max_epochs": 30, "epsilon": 1e-4},
+    "synthetic": {"n_train": 2000, "n_clusters": 5, "noise": 0.08},
+})
+
+
+class SOMLoader(FullBatchLoader):
+    """Seeded 2-D gaussian-cluster mixture; train set only."""
+
+    def load_data(self) -> None:
+        cfg = root.kohonen.synthetic.to_dict()
+        gen = prng.get("kohonen_synthetic")
+        k, n = cfg["n_clusters"], cfg["n_train"]
+        centers = gen.uniform(-1.0, 1.0, (k, 2))
+        which = gen.randint(0, k, n)
+        pts = centers[which] + gen.normal(0.0, cfg["noise"], (n, 2))
+        self.original_data.mem = pts.astype(np.float32)
+        self.original_labels.mem = which.astype(np.int32)
+        self.class_lengths = [0, 0, n]
+
+
+class KohonenWorkflow(AcceleratedWorkflow):
+    """BASELINE config 5: the SOM minibatch loop."""
+
+    def __init__(self, workflow=None, name="KohonenWorkflow", shape=None,
+                 decision_config=None, **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.metrics_writer = MetricsWriter()
+        shape = shape or root.kohonen.shape
+        self.loader = SOMLoader(
+            self, minibatch_size=root.kohonen.get("minibatch_size", 100))
+        self.add_unit(self.loader)
+        self.loader.link_from(self.start_point)
+        self.forward = KohonenForward(self, name="kohonen_forward",
+                                      shape=shape)
+        self.forward.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.forward.link_from(self.loader)
+        self.trainer = KohonenTrainer(
+            self, name="kohonen_trainer",
+            learning_rate=root.kohonen.get("learning_rate", 0.5))
+        self.trainer.setup_from_forward(self.forward)
+        self.trainer.link_from(self.forward)
+        cfg = decision_config or root.kohonen.decision.to_dict()
+        self.decision = KohonenDecision(self, name="decision", **cfg)
+        self.decision.link_loader(self.loader)
+        self.decision.link_trainer(self.trainer)
+        self.decision.link_from(self.trainer)
+        self.trainer.gate_skip = make_train_only_gate(self.loader,
+                                                      self.decision)
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+        self.loader.link_from(self.decision)   # minibatch loop back-edge
+
+    def quantization_error(self) -> float:
+        x = self.loader.original_data.mem
+        return float(som_ops.quantization_error(
+            x.reshape(len(x), -1), self.forward.weights.mem, np))
+
+    # -- fused TPU hot path ------------------------------------------------
+    def run_fused(self, max_epochs: int | None = None):
+        """Whole epochs as one jitted scan (parallel.som); Decision's
+        stop logic stays host-side between epochs."""
+        from ..parallel.som import FusedSOMTrainer
+
+        assert self.initialized, "initialize() first"
+        tr = FusedSOMTrainer(np.asarray(self.forward.weights.mem),
+                             self.forward.shape, workflow=self)
+        from ..loader.base import TRAIN
+
+        loader, decision = self.loader, self.decision
+        data = loader.original_data.devmem
+        epochs = max_epochs or decision.max_epochs or 30
+        batch = loader.max_minibatch_size
+        first = True
+        for epoch in range(loader.epoch_number, epochs):
+            loader.epoch_number = epoch
+            if not first:   # initialize() already built epoch 0's plan —
+                loader._build_epoch_plan()   # same shuffle stream as the
+            first = False                    # unit-graph loop
+            lr, sigma = self.trainer.schedules()
+            perm = loader._shuffled[TRAIN]
+            diff = tr.train_epoch(data, perm, batch, lr, sigma)
+            decision.epoch_metrics.append(
+                {"epoch": epoch, "weights_diff": diff})
+            self.metrics_writer.write(kind="epoch", epoch=epoch,
+                                      weights_diff=diff)
+            if diff < decision.epsilon:
+                break
+        decision.complete.set(True)
+        tr.write_back(self.forward)
+        return tr
+
+
+def run(device: Device | None = None, epochs: int | None = None,
+        fused: bool = False, **kwargs) -> KohonenWorkflow:
+    wf = KohonenWorkflow(**kwargs)
+    if epochs is not None:
+        wf.decision.max_epochs = epochs
+    wf.initialize(device=device or Device.create("auto"))
+    if fused:
+        wf.run_fused()
+    else:
+        wf.run()
+    return wf
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--backend", default="auto",
+                        choices=("auto", "numpy", "xla"))
+    parser.add_argument("--epochs", type=int, default=None)
+    parser.add_argument("--fused", action="store_true")
+    args = parser.parse_args(argv)
+    wf = run(device=Device.create(args.backend), epochs=args.epochs,
+             fused=args.fused)
+    for m in wf.decision.epoch_metrics[-5:]:
+        print(m)
+    print("quantization error:", wf.quantization_error())
+
+
+if __name__ == "__main__":
+    main()

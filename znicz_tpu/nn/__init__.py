@@ -18,12 +18,19 @@ from .gd_conv import (GDRELUConv, GDSigmoidConv, GDStrictRELUConv,
                       GDTanhConv, GradientDescentConv)
 from .gd_pooling import (GDAvgPooling, GDMaxAbsPooling, GDMaxPooling,
                          GDStochasticAbsPooling, GDStochasticPooling)
+from .deconv import Deconv, DeconvSigmoid, DeconvTanh
+from .gd_deconv import GDDeconv, GDDeconvSigmoid, GDDeconvTanh
+from .depooling import Depooling, GDDepooling
+from .kohonen import (KohonenDecision, KohonenForward, KohonenTrainer)
 from .nn_units import Forward, GradientDescentBase
 from .normalization import LRNormalizerBackward, LRNormalizerForward
 from .pooling import (AvgPooling, MaxAbsPooling, MaxPooling, Pooling,
                       StochasticAbsPooling, StochasticPooling)
 
 __all__ = [
+    "Deconv", "DeconvSigmoid", "DeconvTanh", "Depooling", "GDDeconv",
+    "GDDeconvSigmoid", "GDDeconvTanh", "GDDepooling", "KohonenDecision",
+    "KohonenForward", "KohonenTrainer",
     "All2All", "All2AllRELU", "All2AllSigmoid", "All2AllSoftmax",
     "All2AllStrictRELU", "All2AllTanh", "AvgPooling", "Conv", "ConvRELU",
     "ConvSigmoid", "ConvStrictRELU", "ConvTanh", "DecisionBase",

@@ -70,14 +70,6 @@ class Forward(AcceleratedUnit):
             if self.bias_filling == "uniform" and self.bias_stddev is None:
                 self.bias.mem = np.zeros(b_shape, np.float32)
 
-    @property
-    def current_batch_size(self) -> int:
-        """Rows of the minibatch that are real (loader pads short ones)."""
-        wf = self.workflow
-        loader = getattr(wf, "loader", None) if wf is not None else None
-        return loader.minibatch_size if loader is not None \
-            else len(self.input.mem)
-
 
 class GradientDescentBase(AcceleratedUnit):
     """Backprop base unit (the reference's hand-written gradient units).
@@ -135,13 +127,6 @@ class GradientDescentBase(AcceleratedUnit):
         self.init_vectors(self.err_input, self.gradient_weights,
                           self.gradient_bias, self.velocity_weights,
                           self.velocity_bias)
-
-    @property
-    def current_batch_size(self) -> int:
-        wf = self.workflow
-        loader = getattr(wf, "loader", None) if wf is not None else None
-        return loader.minibatch_size if loader is not None \
-            else len(self.output.mem)
 
     # -- distributed contract (SURVEY.md §2.4) ----------------------------
     def generate_data_for_master(self):

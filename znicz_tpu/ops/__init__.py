@@ -18,5 +18,5 @@ or the XLA formulation runs on the current backend (Pallas requires real TPU
 or interpret mode).
 """
 
-from . import (activations, conv, dropout, matmul, normalization,  # noqa
-               pooling, rngbits, softmax, update)
+from . import (activations, conv, deconv, dropout, kohonen, matmul,  # noqa
+               normalization, pooling, rngbits, softmax, update)

@@ -1,0 +1,96 @@
+"""Kohonen self-organizing-map ops: distances, winners, neighborhood pull.
+
+Parity target: the reference's Kohonen distance/argmin/neighborhood-update
+kernels (SURVEY.md §2.3 Kohonen row) behind ``KohonenForward`` /
+``KohonenTrainer`` [baseline].
+
+TPU-native design: the (B, N) squared-distance matrix is computed as
+``‖x‖² − 2·x·Wᵀ + ‖w‖²`` — one MXU matmul instead of the reference's
+per-neuron distance kernel; the winner search is a row argmin on the VPU;
+the neighborhood-decayed weight pull is two more matmuls
+(``hᵀ·x`` and a rank-1 scale of W), so a whole trainer step is
+matmul-shaped and fuses under jit.  All functions are generic over the
+numpy/jnp namespace: numpy IS the golden tier (SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def _matmul(a, b, xp):
+    """Full-f32 matmul on every backend: TPU matmuls default to bf16 MXU
+    passes, which breaks the numpy↔XLA backend-equivalence contract
+    (winner flips from 1e-3 noise compound over epochs)."""
+    if xp is np:
+        return a @ b
+    import jax
+    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
+
+def grid_coords(sy: int, sx: int, xp=np):
+    """(N, 2) float32 grid coordinates of an sy×sx SOM sheet, row-major
+    (neuron n sits at (n // sx, n % sx))."""
+    n = xp.arange(sy * sx)
+    return xp.stack([n // sx, n % sx], axis=1).astype(np.float32)
+
+
+def distances(x, w, xp=np):
+    """Squared euclidean distances (B, N): x (B, F), w (N, F)."""
+    x2 = (x * x).sum(axis=1, keepdims=True)         # (B, 1)
+    w2 = (w * w).sum(axis=1)                        # (N,)
+    return x2 - 2.0 * _matmul(x, w.T, xp) + w2
+
+
+def winners(d, xp=np):
+    """Row argmin of the distance matrix → (B,) int32 winner indices."""
+    return xp.argmin(d, axis=1).astype(np.int32)
+
+
+def neighborhood(win, coords, sigma, xp=np):
+    """Gaussian sheet-distance weights (B, N): h[b, n] =
+    exp(−‖c_n − c_win(b)‖² / (2σ²))."""
+    cw = coords[win]                                 # (B, 2)
+    d2 = ((coords[None, :, :] - cw[:, None, :]) ** 2).sum(axis=2)
+    return xp.exp(-d2 / (2.0 * sigma * sigma))
+
+
+def som_update(w, x, win, coords, lr, sigma, xp=np):
+    """One neighborhood-decayed batch pull.
+
+    Δw_n = lr/B · Σ_b h[b,n]·(x_b − w_n)  — computed as the matmul
+    ``hᵀ·x`` minus a per-neuron rescale of w (no (B, N, F) intermediate).
+    Returns (new_w, mean |Δw|) — the latter feeds KohonenDecision."""
+    b = x.shape[0]
+    h = neighborhood(win, coords, sigma, xp)         # (B, N)
+    num = _matmul(h.T, x, xp)                        # (N, F)
+    s = h.sum(axis=0)                                # (N,)
+    delta = (lr / b) * (num - s[:, None] * w)
+    return w + delta, xp.abs(delta).mean()
+
+
+def np_forward(x, w):
+    d = distances(x, w, np)
+    return winners(d, np), d
+
+
+def xla_forward(x, w):
+    d = distances(x, w, jnp)
+    return winners(d, jnp), d
+
+
+def np_train_step(w, x, coords, lr, sigma):
+    win, _ = np_forward(x, w)
+    return som_update(w, x, win, coords, lr, sigma, np)
+
+
+def xla_train_step(w, x, coords, lr, sigma):
+    win, _ = xla_forward(x, w)
+    return som_update(w, x, win, coords, lr, sigma, jnp)
+
+
+def quantization_error(x, w, xp=np):
+    """Mean distance from each sample to its winner (SOM quality metric)."""
+    d = distances(x, w, xp)
+    return xp.sqrt(xp.maximum(d.min(axis=1), 0.0)).mean()

@@ -1,0 +1,61 @@
+"""Fused SOM training: whole epochs as one jitted ``lax.scan``.
+
+The TPU hot path for the Kohonen units (same design as ``parallel.fused``
+for the gradient chain): the dataset stays HBM-resident, an epoch's
+minibatch index matrix drives a ``lax.scan`` whose body is the
+distance→argmin→neighborhood-pull step from ``ops.kohonen``, and the host
+syncs once per epoch.  σ/lr schedules are per-epoch scalars passed in, so
+recompilation never happens across epochs."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import kohonen as som_ops
+
+
+class FusedSOMTrainer:
+    """Device-resident SOM weights + a compiled epoch function."""
+
+    def __init__(self, weights: np.ndarray, grid_shape: tuple[int, int],
+                 workflow=None):
+        self.grid_shape = grid_shape
+        self.weights = jnp.asarray(weights)
+        self._coords = jnp.asarray(som_ops.grid_coords(*grid_shape))
+        self.workflow = workflow
+        self._epoch_fn = None
+
+    def _build(self):
+        coords = self._coords
+
+        def epoch(w, data, idx, lr, sigma):
+            def body(w, step_idx):
+                x = jnp.take(data, step_idx, axis=0)
+                x = x.reshape(len(x), -1)
+                win, _ = som_ops.xla_forward(x, w)
+                w, diff = som_ops.som_update(w, x, win, coords, lr,
+                                             sigma, jnp)
+                return w, diff
+            return jax.lax.scan(body, w, idx)
+
+        self._epoch_fn = jax.jit(epoch, donate_argnums=(0,))
+
+    def train_epoch(self, data, indices: np.ndarray, batch: int,
+                    lr: float, sigma: float) -> float:
+        """One epoch over ``indices`` (truncated to full batches — the
+        scan body needs one static shape); returns mean |Δw|."""
+        if self._epoch_fn is None:
+            self._build()
+        steps = len(indices) // batch
+        if steps == 0:
+            raise ValueError("fewer samples than one batch")
+        idx = np.asarray(indices[:steps * batch], np.int32).reshape(
+            steps, batch)
+        self.weights, diffs = self._epoch_fn(
+            self.weights, data, idx, jnp.float32(lr), jnp.float32(sigma))
+        return float(np.asarray(diffs).mean())
+
+    def write_back(self, forward_unit) -> None:
+        forward_unit.weights.mem = np.asarray(self.weights)
