@@ -1,0 +1,145 @@
+"""Cutter/Merger glue units + LR-adjust policies (SURVEY.md §2.2
+Cutter/Merger and LR adjust rows): numpy-vs-XLA parity, adjoint checks,
+policy math, and schedule equivalence between the unit-graph and fused
+paths."""
+
+import numpy as np
+import pytest
+
+from helpers import _x, wire, wire_gd
+
+from znicz_tpu import Vector, prng
+from znicz_tpu.backends import Device, NumpyDevice
+from znicz_tpu.config import root
+from znicz_tpu.nn.cutter import (ChannelMerger, Cutter, EltwiseSumMerger,
+                                 GDChannelMerger, GDCutter,
+                                 GDEltwiseSumMerger)
+from znicz_tpu.nn.lr_adjust import (ArbitraryPolicy, ExpPolicy, InvPolicy,
+                                    LearningRateAdjust, StepExpPolicy,
+                                    make_policy)
+
+
+class TestCutter:
+    def test_crop_and_grad_adjoint(self, xla_device):
+        x = _x((2, 8, 10, 3))
+        u = wire(Cutter, x, padding=(2, 1, 3, 2))   # l, t, r, b
+        u.run()
+        assert u.output.mem.shape == (2, 5, 5, 3)
+        np.testing.assert_allclose(u.output.mem, x[:, 1:6, 2:7, :])
+        err = _x(u.output.mem.shape, "err")
+        g = wire_gd(GDCutter, u, err)
+        g.run()
+        assert g.err_input.mem.shape == x.shape
+        # adjoint: <crop(x), err> == <x, pad(err)>
+        np.testing.assert_allclose(np.vdot(u.output.mem, err),
+                                   np.vdot(x, g.err_input.mem), rtol=1e-5)
+        # backend parity
+        u2 = wire(Cutter, x, padding=(2, 1, 3, 2), device=xla_device)
+        u2.run()
+        np.testing.assert_allclose(u2.output.mem, u.output.mem)
+
+
+class _Src:
+    """Forward-unit stand-in exposing .output."""
+
+    def __init__(self, arr):
+        self.output = Vector(np.asarray(arr, np.float32))
+        self.name = "src"
+
+
+class TestMergers:
+    def test_channel_merger_fwd_bwd(self):
+        a, b = _x((2, 4, 4, 3)), _x((2, 4, 4, 5), "b")
+        wf_unit = wire(Cutter, _x((2, 5, 5, 1)), padding=(0, 0, 1, 1))
+        m = ChannelMerger(wf_unit.workflow)
+        m.link_inputs(_Src(a), _Src(b))
+        m.initialize(NumpyDevice())
+        m.run()
+        assert m.output.mem.shape == (2, 4, 4, 8)
+        np.testing.assert_allclose(m.output.mem[..., :3], a, rtol=1e-6)
+        np.testing.assert_allclose(m.output.mem[..., 3:], b, rtol=1e-6)
+        err = _x((2, 4, 4, 8), "err")
+        g = wire_gd(GDChannelMerger, m, err)
+        g.run()
+        np.testing.assert_allclose(g.err_inputs[0].mem, err[..., :3])
+        np.testing.assert_allclose(g.err_inputs[1].mem, err[..., 3:])
+
+    def test_sum_merger(self):
+        a, b = _x((2, 6, 6, 4)), _x((2, 6, 6, 4), "b")
+        helper = wire(Cutter, _x((2, 5, 5, 1)), padding=(0, 0, 1, 1))
+        m = EltwiseSumMerger(helper.workflow)
+        m.link_inputs(_Src(a), _Src(b))
+        m.initialize(NumpyDevice())
+        m.run()
+        np.testing.assert_allclose(m.output.mem, a + b, rtol=1e-6)
+        err = _x((2, 6, 6, 4), "err")
+        g = wire_gd(GDEltwiseSumMerger, m, err)
+        g.run()
+        np.testing.assert_allclose(g.err_input.mem, err)
+
+
+class TestPolicies:
+    def test_math(self):
+        assert StepExpPolicy(0.1, 10)(1.0, 25) == pytest.approx(1e-2)
+        assert ExpPolicy(0.5)(2.0, 3) == pytest.approx(0.25)
+        assert InvPolicy(1e-2, 0.5)(1.0, 300) == pytest.approx(
+            (1 + 3.0) ** -0.5)
+        p = ArbitraryPolicy([(1.0, 10), (0.1, 20), (0.01, 30)])
+        assert p(5.0, 5) == 5.0
+        assert p(5.0, 15) == 0.5
+        assert p(5.0, 99) == pytest.approx(0.05)
+        assert make_policy(("exp", {"gamma": 0.9})).scale(2) == \
+            pytest.approx(0.81)
+
+    def test_unit_rewrites_gd_lr(self):
+        class FakeGD:
+            learning_rate = 0.5
+            learning_rate_bias = 0.25
+
+        class FakeLoader:
+            epoch_number = 0
+
+        from znicz_tpu.workflow import Workflow
+        wf = Workflow(name="w")
+        wf.loader = FakeLoader()
+        adj = LearningRateAdjust(wf, policy=("exp", {"gamma": 0.1}))
+        gd = FakeGD()
+        adj.link_gds([gd])
+        adj.run()
+        assert gd.learning_rate == pytest.approx(0.5)
+        wf.loader.epoch_number = 2
+        adj.run()
+        assert gd.learning_rate == pytest.approx(0.005)
+        assert gd.learning_rate_bias == pytest.approx(0.0025)
+
+
+@pytest.fixture
+def small_mnist():
+    saved = root.mnist.synthetic.to_dict()
+    root.mnist.synthetic.update({"n_train": 400, "n_valid": 100,
+                                 "n_test": 100})
+    yield
+    root.mnist.synthetic.update(saved)
+
+
+class TestScheduleEquivalence:
+    def test_unit_graph_vs_fused_with_schedule(self, small_mnist):
+        """Epoch-granular exp schedule: the unit-graph loop (lr mutated
+        per epoch) and the fused path (traced lr_scale) must produce the
+        same weights."""
+        from znicz_tpu.models.mnist import MnistWorkflow
+        cfg = {"policy": ("exp", {"gamma": 0.5})}
+        prng.seed_all(321)
+        wf = MnistWorkflow(lr_adjuster_config=cfg)
+        wf.decision.max_epochs = 3
+        wf.initialize(device=Device.create("xla"))
+        wf.run()
+        prng.seed_all(321)
+        wf2 = MnistWorkflow(lr_adjuster_config=cfg)
+        wf2.decision.max_epochs = 3
+        wf2.initialize(device=Device.create("xla"))
+        wf2.run_fused(max_epochs=3)
+        for f1, f2 in zip(wf.forwards, wf2.forwards):
+            np.testing.assert_allclose(f1.weights.mem, f2.weights.mem,
+                                       rtol=5e-4, atol=1e-5,
+                                       err_msg=f1.name)

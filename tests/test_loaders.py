@@ -1,0 +1,109 @@
+"""Normalizer family + image loader tests (SURVEY.md §2.1 loader row,
+§2.2 znicz loaders row)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from znicz_tpu import prng
+from znicz_tpu.backends import NumpyDevice
+from znicz_tpu.loader.image import FullBatchImageLoader, decode_image
+from znicz_tpu.normalization import (LinearNormalizer,
+                                     MeanDispersionNormalizer,
+                                     NORMALIZERS, PointwiseNormalizer,
+                                     create_normalizer)
+from znicz_tpu.workflow import Workflow
+
+
+class TestNormalizers:
+    def test_registry(self):
+        assert set(NORMALIZERS) == {"none", "linear", "mean_disp",
+                                    "external_mean", "pointwise"}
+        with pytest.raises(ValueError):
+            create_normalizer("bogus")
+
+    def test_linear(self):
+        d = np.array([[0.0, 5.0], [10.0, 2.5]], np.float32)
+        n = LinearNormalizer().fit(d)
+        out = n.apply(d)
+        assert out.min() == -1.0 and out.max() == 1.0
+        # state round-trips (snapshot contract)
+        n2 = LinearNormalizer().restore(n.state())
+        np.testing.assert_allclose(n2.apply(d), out)
+
+    def test_mean_disp(self):
+        d = prng.get("n").normal(3.0, 2.0, (100, 4)).astype(np.float32)
+        out = MeanDispersionNormalizer().fit(d).apply(d)
+        np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.std(axis=0), 1.0, atol=1e-2)
+
+    def test_pointwise(self):
+        d = np.array([[0.0, 100.0], [1.0, 200.0]], np.float32)
+        out = PointwiseNormalizer().fit(d).apply(d)
+        np.testing.assert_allclose(out, [[-1, -1], [1, 1]], atol=1e-6)
+
+    def test_external_mean(self):
+        mean = np.full((2, 2, 1), 7.0, np.float32)
+        n = create_normalizer("external_mean", mean_source=mean)
+        out = n.apply(np.full((3, 2, 2, 1), 10.0, np.float32))
+        np.testing.assert_allclose(out, 3.0)
+
+
+@pytest.fixture
+def image_tree(tmp_path):
+    """Tiny directory-per-class PNG dataset."""
+    from PIL import Image
+
+    gen = prng.get("imgs")
+    for split, n_per in (("train", 4), ("valid", 2)):
+        for cls in ("cats", "dogs"):
+            d = tmp_path / split / cls
+            d.mkdir(parents=True)
+            base = 40 if cls == "cats" else 200
+            for i in range(n_per):
+                arr = np.clip(base + gen.normal(0, 20, (8, 8, 3)), 0,
+                              255).astype(np.uint8)
+                Image.fromarray(arr).save(d / f"im{i}.png")
+    return tmp_path
+
+
+class TestImageLoader:
+    def test_decode(self, image_tree):
+        path = os.path.join(image_tree, "train", "cats", "im0.png")
+        arr = decode_image(path)
+        assert arr.shape == (8, 8, 3) and arr.dtype == np.float32
+        gray = decode_image(path, grayscale=True, size=(4, 4))
+        assert gray.shape == (4, 4, 1)
+        cropped = decode_image(path, crop=(1, 2, 1, 2))
+        assert cropped.shape == (4, 6, 3)
+
+    def test_fullbatch_image_loader(self, image_tree):
+        wf = Workflow(name="w")
+        loader = FullBatchImageLoader(
+            wf, train_paths=[str(image_tree / "train")],
+            validation_paths=[str(image_tree / "valid")],
+            minibatch_size=4, normalization_type="linear")
+        loader.initialize(NumpyDevice())
+        assert loader.label_map == {"cats": 0, "dogs": 1}
+        assert loader.class_lengths == [0, 4, 8]   # 2/class valid, 4 train
+        assert loader.original_data.mem.shape == (12, 8, 8, 3)
+        assert loader.original_data.mem.min() >= -1.0
+        assert loader.original_data.mem.max() <= 1.0
+        # serve one epoch: 1 valid batch + 2 train batches
+        seen = []
+        for _ in range(3):
+            loader.run()
+            seen.append((loader.minibatch_class, loader.minibatch_size))
+        assert seen == [(1, 4), (2, 4), (2, 4)]
+        assert bool(loader.last_minibatch)
+
+    def test_mixed_shapes_rejected(self, image_tree):
+        from PIL import Image
+        odd = image_tree / "train" / "cats" / "odd.png"
+        Image.fromarray(np.zeros((5, 5, 3), np.uint8)).save(odd)
+        wf = Workflow(name="w")
+        loader = FullBatchImageLoader(
+            wf, train_paths=[str(image_tree / "train")], minibatch_size=4)
+        with pytest.raises(ValueError, match="mixed image shapes"):
+            loader.initialize(NumpyDevice())

@@ -1,0 +1,110 @@
+"""RBM unit tests (SURVEY.md §2.2 RBM row): CD-1 math goldens, identical
+counter-RNG sampling across backends, and learning on the classic bars
+dataset (reconstruction error drops)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from helpers import _x, wire
+
+from znicz_tpu import Vector, prng
+from znicz_tpu.backends import NumpyDevice
+from znicz_tpu.nn.rbm_units import RBM, Binarization, RBMTrainer
+from znicz_tpu.ops import rbm as rbm_ops
+
+
+def bars(n, size=4, stream="bars"):
+    """Horizontal/vertical bar images, flattened (the classic RBM toy)."""
+    gen = prng.get(stream)
+    data = np.zeros((n, size, size), np.float32)
+    for i in range(n):
+        if gen.randint(0, 2):
+            data[i, gen.randint(0, size), :] = 1.0
+        else:
+            data[i, :, gen.randint(0, size)] = 1.0
+    return data.reshape(n, size * size)
+
+
+class TestOps:
+    def test_probs_golden(self):
+        v = np.array([[0.0, 1.0]], np.float32)
+        w = np.array([[1.0, -1.0], [2.0, 0.5]], np.float32)
+        hb = np.array([0.5, -0.5], np.float32)
+        hp = rbm_ops.hidden_probs(v, w, hb, np)
+        expect = 1 / (1 + np.exp(-(v @ w + hb)))
+        np.testing.assert_allclose(hp, expect, rtol=1e-6)
+        vp = rbm_ops.visible_probs(hp, w, np.zeros(2, np.float32), np)
+        np.testing.assert_allclose(
+            vp, 1 / (1 + np.exp(-(hp @ w.T))), rtol=1e-6)
+
+    def test_sampling_identical_across_backends(self):
+        p = np.asarray(_x((8, 16)), np.float32) * 0.2 + 0.5
+        s_np = rbm_ops.sample_bernoulli(p, 1234, (1, 2, 3), np)
+        s_x = rbm_ops.sample_bernoulli(jnp.asarray(p), 1234, (1, 2, 3),
+                                       jnp)
+        np.testing.assert_array_equal(s_np, np.asarray(s_x))
+        assert set(np.unique(s_np)) <= {0.0, 1.0}
+
+    def test_cd1_np_vs_xla(self):
+        v0 = bars(16)
+        gen = prng.get("w")
+        w = gen.normal(0, 0.01, (16, 8)).astype(np.float32)
+        vb = np.zeros(16, np.float32)
+        hb = np.zeros(8, np.float32)
+        out_np = rbm_ops.np_cd1_step(w, vb, hb, v0, 0.1, 99, (0, 1, 2))
+        out_x = rbm_ops.xla_cd1_step(jnp.asarray(w), jnp.asarray(vb),
+                                     jnp.asarray(hb), jnp.asarray(v0),
+                                     0.1, 99, (0, 1, 2))
+        for a, b, name in zip(out_np, out_x, "w vb hb recon".split()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6, err_msg=name)
+
+
+class TestUnits:
+    def test_binarization(self, xla_device):
+        p = np.clip(np.asarray(_x((6, 10)), np.float32) * 0.2 + 0.5, 0, 1)
+        prng.seed_all(7)
+        u_np = wire(Binarization, p)
+        prng.seed_all(7)
+        u_x = wire(Binarization, p, device=xla_device)
+        u_np.run()
+        u_x.run()
+        np.testing.assert_array_equal(u_np.output.mem, u_x.output.mem)
+
+    def test_rbm_forward_numpy_vs_xla(self, xla_device):
+        v = bars(12)
+        prng.seed_all(3)
+        f_np = wire(RBM, v, n_hidden=8)
+        prng.seed_all(3)
+        f_x = wire(RBM, v, n_hidden=8, device=xla_device)
+        f_np.run()
+        f_x.run()
+        np.testing.assert_allclose(f_np.output.mem, f_x.output.mem,
+                                   rtol=1e-5, atol=1e-6)
+
+    def _train(self, device, epochs=100, n=64, lr=2.0):
+        prng.seed_all(11)
+        v = bars(n)
+        fwd = wire(RBM, v, n_hidden=12, device=device)
+        tr = RBMTrainer(fwd.workflow, learning_rate=lr)
+        tr.setup_from_forward(fwd)
+        tr.initialize(device)
+        errs = []
+        for _ in range(epochs):
+            fwd.run()
+            tr.run()
+            errs.append(tr.recon_err)
+        return errs, fwd
+
+    def test_cd1_learns_bars(self):
+        errs, _ = self._train(NumpyDevice())
+        assert errs[-1] < errs[0] * 0.1, (errs[0], errs[-1])
+
+    def test_trainer_numpy_vs_xla(self, xla_device):
+        errs_np, f_np = self._train(NumpyDevice(), epochs=5)
+        errs_x, f_x = self._train(xla_device, epochs=5)
+        np.testing.assert_allclose(errs_np, errs_x, rtol=1e-4)
+        np.testing.assert_allclose(f_np.weights.mem, f_x.weights.mem,
+                                   rtol=1e-4, atol=1e-6)

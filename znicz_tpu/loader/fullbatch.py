@@ -25,11 +25,13 @@ class FullBatchLoader(Loader):
     (N,) and ``class_lengths`` in ``load_data``."""
 
     def __init__(self, workflow=None, name=None, normalization_type="none",
-                 **kwargs):
+                 normalization_parameters=None, **kwargs):
         super().__init__(workflow, name, **kwargs)
         self.original_data = Vector()
         self.original_labels = Vector()
         self.normalization_type = normalization_type
+        self.normalization_parameters = normalization_parameters or {}
+        self.normalizer = None
 
     def load_data(self) -> None:
         raise NotImplementedError
@@ -48,19 +50,19 @@ class FullBatchLoader(Loader):
             (self.max_minibatch_size,), self.original_labels.dtype)
 
     def _normalize(self) -> None:
-        """Reference normalizer family (linear/mean-disp/none)."""
-        if self.normalization_type == "none":
-            return
-        data = self.original_data.mem.astype(np.float32)
-        if self.normalization_type == "linear":      # to [-1, 1]
-            lo, hi = data.min(), data.max()
-            scale = 2.0 / max(hi - lo, 1e-8)
-            self.original_data.mem = (data - lo) * scale - 1.0
-        elif self.normalization_type == "mean_disp":  # zero mean, unit std
-            mu, sd = data.mean(axis=0), data.std(axis=0) + 1e-8
-            self.original_data.mem = (data - mu) / sd
-        else:
-            raise ValueError(self.normalization_type)
+        """Apply the reference normalizer family (znicz_tpu.normalization);
+        statistics are fitted on the whole resident dataset once and kept
+        on the loader for snapshots / external reuse."""
+        from ..normalization import create_normalizer
+        if self.normalizer is None:
+            self.normalizer = create_normalizer(
+                self.normalization_type, **self.normalization_parameters)
+            self.normalizer.fit(self.original_data.mem)
+        elif getattr(self, "_normalized", False):
+            return   # re-initialize (device rebind): data already mapped
+        self.original_data.mem = self.normalizer.apply(
+            self.original_data.mem)
+        self._normalized = True
 
     def fill_minibatch(self, indices: np.ndarray, klass: int) -> None:
         size = len(indices)

@@ -101,17 +101,17 @@ class ImagenetSyntheticLoader(FullBatchLoader):
         gen = prng.get("imagenet_synthetic")
         n = n_test + n_valid + n_train
         labels = gen.randint(0, self.n_classes, n).astype(np.int32)
-        # low-res per-class prototypes upsampled to full size keep the
-        # synthetic set learnable without storing n_classes full images;
-        # float32 throughout (a float64 prototype sheet at 1000 classes
-        # would peak at ~1.3 GB)
+        # low-res per-class prototypes upsampled per sample keep the
+        # synthetic set learnable without storing n_classes full images —
+        # upsampling inside the loop avoids a ~646 MB full prototype
+        # sheet at the default (1000, 227) config; float32 throughout
         protos = gen.normal(0.0, 1.0, (self.n_classes, 8, 8, 3)).astype(
             np.float32)
-        up = protos.repeat(s // 8 + 1, axis=1).repeat(s // 8 + 1, axis=2)
-        up = up[:, :s, :s, :]
+        rep = s // 8 + 1
         data = np.empty((n, s, s, 3), np.float32)
-        for i in range(n):   # chunked: avoid a (n, s, s, 3) temp blowup
-            data[i] = up[labels[i]] + gen.normal(
+        for i in range(n):
+            up = protos[labels[i]].repeat(rep, axis=0).repeat(rep, axis=1)
+            data[i] = up[:s, :s, :] + gen.normal(
                 0.0, noise, (s, s, 3)).astype(np.float32)
         self.original_data.mem = data
         self.original_labels.mem = labels

@@ -18,16 +18,23 @@ from .gd_conv import (GDRELUConv, GDSigmoidConv, GDStrictRELUConv,
                       GDTanhConv, GradientDescentConv)
 from .gd_pooling import (GDAvgPooling, GDMaxAbsPooling, GDMaxPooling,
                          GDStochasticAbsPooling, GDStochasticPooling)
+from .cutter import (ChannelMerger, Cutter, EltwiseSumMerger,
+                     GDChannelMerger, GDCutter, GDEltwiseSumMerger)
 from .deconv import Deconv, DeconvSigmoid, DeconvTanh
 from .gd_deconv import GDDeconv, GDDeconvSigmoid, GDDeconvTanh
 from .depooling import Depooling, GDDepooling
 from .kohonen import (KohonenDecision, KohonenForward, KohonenTrainer)
+from .lr_adjust import LearningRateAdjust, make_policy
+from .rbm_units import RBM, Binarization, RBMTrainer
 from .nn_units import Forward, GradientDescentBase
 from .normalization import LRNormalizerBackward, LRNormalizerForward
 from .pooling import (AvgPooling, MaxAbsPooling, MaxPooling, Pooling,
                       StochasticAbsPooling, StochasticPooling)
 
 __all__ = [
+    "ChannelMerger", "Cutter", "EltwiseSumMerger", "GDChannelMerger",
+    "GDCutter", "GDEltwiseSumMerger", "LearningRateAdjust", "make_policy", "RBM", "Binarization",
+    "RBMTrainer",
     "Deconv", "DeconvSigmoid", "DeconvTanh", "Depooling", "GDDeconv",
     "GDDeconvSigmoid", "GDDeconvTanh", "GDDepooling", "KohonenDecision",
     "KohonenForward", "KohonenTrainer",

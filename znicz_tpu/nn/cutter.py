@@ -1,0 +1,220 @@
+"""Tensor slicing / concat glue units for branched nets.
+
+Parity target: the reference ``veles/znicz/cutter.py`` and merger glue
+(mount empty — surveyed contract, SURVEY.md §2.2 Cutter/Merger row):
+``Cutter`` crops a spatial window out of NHWC activations (``GDCutter``
+zero-pads the error back), mergers join branch outputs (channel concat /
+elementwise sum) with error-splitting gradients.
+
+TPU-first: all four are pure static-slice/pad/concat ops — XLA folds them
+into neighboring kernels, so they cost one fused copy at most."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..memory import Vector
+from .nn_units import Forward, GradientDescentBase
+
+
+class Cutter(Forward):
+    """output = input[:, y:y+h, x:x+w, :] (reference Cutter contract)."""
+
+    MAPPING = ("cutter",)
+
+    def __init__(self, workflow=None, name=None, padding=None, **kwargs):
+        """``padding`` = (left, top, right, bottom) crop margins — the
+        reference's 4-tuple convention."""
+        kwargs["include_bias"] = False
+        super().__init__(workflow, name, **kwargs)
+        if padding is None:
+            raise ValueError("padding=(left, top, right, bottom) required")
+        self.padding = tuple(int(p) for p in padding)
+
+    def output_shape_for(self, x_shape) -> tuple[int, ...]:
+        b, h, w, c = x_shape
+        le, to, ri, bo = self.padding
+        return (b, h - to - bo, w - le - ri, c)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if len(self.input.shape) != 4:
+            raise ValueError(f"{self.name}: Cutter expects NHWC input")
+        oshape = self.output_shape_for(self.input.shape)
+        if oshape[1] <= 0 or oshape[2] <= 0:
+            raise ValueError(f"{self.name}: crop {self.padding} leaves "
+                             f"no pixels of {tuple(self.input.shape)}")
+        if not self.output:
+            self.output.mem = np.zeros(oshape, np.float32)
+        self.init_vectors(self.output)
+
+    def _slice(self, x):
+        le, to, ri, bo = self.padding
+        _, h, w, _ = self.input.shape
+        return x[:, to:h - bo, le:w - ri, :]
+
+    def numpy_run(self) -> None:
+        self.output.mem = np.ascontiguousarray(self._slice(self.input.mem))
+
+    def xla_run(self) -> None:
+        self.output.devmem = self._slice(self.input.devmem)
+
+
+class GDCutter(GradientDescentBase):
+    """Zero-pad err_output back to the input extent."""
+
+    MAPPING = ("cutter",)
+
+    def setup_from_forward(self, fwd) -> "GDCutter":
+        super().setup_from_forward(fwd)
+        self.padding = fwd.padding
+        self.include_bias = False
+        return self
+
+    def _pad_spec(self):
+        le, to, ri, bo = self.padding
+        return ((0, 0), (to, bo), (le, ri), (0, 0))
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        err = self.err_output.mem.reshape(self.output.shape)
+        self.err_input.mem = np.pad(err, self._pad_spec())
+
+    def xla_run(self) -> None:
+        if not self.need_err_input:
+            return
+        err = self.err_output.devmem.reshape(tuple(self.output.shape))
+        self.err_input.devmem = jnp.pad(err, self._pad_spec())
+
+
+class ChannelMerger(Forward):
+    """Concatenate branch outputs on the channel (minor) axis.
+
+    Inputs are linked via ``link_inputs(unit_a, unit_b, ...)``; the unit's
+    own ``input`` stays the first branch (chain compatibility)."""
+
+    MAPPING = ("channel_merger",)
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        kwargs["include_bias"] = False
+        super().__init__(workflow, name, **kwargs)
+        self.branches: list = []
+
+    def link_inputs(self, *units) -> "ChannelMerger":
+        self.branches = list(units)
+        self.link_attrs(units[0], ("input", "output"))
+        return self
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if not self.branches:
+            raise ValueError(f"{self.name}: link_inputs(...) first")
+        shapes = [tuple(u.output.shape) for u in self.branches]
+        lead = shapes[0][:-1]
+        if any(s[:-1] != lead for s in shapes):
+            raise ValueError(f"{self.name}: branch shapes {shapes} differ "
+                             "outside the channel axis")
+        self.split_sizes = [s[-1] for s in shapes]
+        if not self.output:
+            self.output.mem = np.zeros((*lead, sum(self.split_sizes)),
+                                       np.float32)
+        self.init_vectors(self.output)
+
+    def numpy_run(self) -> None:
+        self.output.mem = np.concatenate(
+            [u.output.mem for u in self.branches], axis=-1)
+
+    def xla_run(self) -> None:
+        self.output.devmem = jnp.concatenate(
+            [u.output.devmem for u in self.branches], axis=-1)
+
+
+class GDChannelMerger(GradientDescentBase):
+    """Split err_output back into per-branch slices (``err_inputs[i]``)."""
+
+    MAPPING = ("channel_merger",)
+
+    def setup_from_forward(self, fwd) -> "GDChannelMerger":
+        super().setup_from_forward(fwd)
+        self.split_sizes = fwd.split_sizes
+        self.include_bias = False
+        self.err_inputs = [Vector() for _ in self.split_sizes]
+        return self
+
+    def _split(self, err, xp):
+        bounds = np.cumsum(self.split_sizes)[:-1]
+        return xp.split(err, bounds, axis=-1)
+
+    def numpy_run(self) -> None:
+        err = self.err_output.mem.reshape(self.output.shape)
+        for v, part in zip(self.err_inputs, self._split(err, np)):
+            v.mem = np.ascontiguousarray(part)
+        self.err_input.mem = self.err_inputs[0].mem
+
+    def xla_run(self) -> None:
+        err = self.err_output.devmem.reshape(tuple(self.output.shape))
+        for v, part in zip(self.err_inputs, self._split(err, jnp)):
+            v.devmem = part
+        self.err_input.devmem = self.err_inputs[0].devmem
+
+
+class EltwiseSumMerger(Forward):
+    """Elementwise sum of branch outputs (residual-style joins); the
+    gradient broadcasts err_output to every branch unchanged."""
+
+    MAPPING = ("sum_merger",)
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        kwargs["include_bias"] = False
+        super().__init__(workflow, name, **kwargs)
+        self.branches: list = []
+
+    def link_inputs(self, *units) -> "EltwiseSumMerger":
+        self.branches = list(units)
+        self.link_attrs(units[0], ("input", "output"))
+        return self
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if not self.branches:
+            raise ValueError(f"{self.name}: link_inputs(...) first")
+        shapes = {tuple(u.output.shape) for u in self.branches}
+        if len(shapes) != 1:
+            raise ValueError(f"{self.name}: branch shapes differ: {shapes}")
+        if not self.output:
+            self.output.mem = np.zeros(next(iter(shapes)), np.float32)
+        self.init_vectors(self.output)
+
+    def numpy_run(self) -> None:
+        acc = self.branches[0].output.mem.copy()
+        for u in self.branches[1:]:
+            acc += u.output.mem
+        self.output.mem = acc
+
+    def xla_run(self) -> None:
+        acc = self.branches[0].output.devmem
+        for u in self.branches[1:]:
+            acc = acc + u.output.devmem
+        self.output.devmem = acc
+
+
+class GDEltwiseSumMerger(GradientDescentBase):
+    MAPPING = ("sum_merger",)
+
+    def setup_from_forward(self, fwd) -> "GDEltwiseSumMerger":
+        super().setup_from_forward(fwd)
+        self.include_bias = False
+        return self
+
+    def numpy_run(self) -> None:
+        if self.need_err_input:
+            self.err_input.mem = self.err_output.mem.reshape(
+                self.output.shape).copy()
+
+    def xla_run(self) -> None:
+        if self.need_err_input:
+            self.err_input.devmem = self.err_output.devmem.reshape(
+                tuple(self.output.shape))

@@ -1,0 +1,130 @@
+"""Learning-rate schedule policies applied to GD units.
+
+Parity target: the reference ``veles/znicz/lr_adjust.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 LR adjust row): iteration/epoch
+policies — step, exponential, inverse, arbitrary — applied to the
+``learning_rate`` (and ``learning_rate_bias``) of the GD chain.
+
+TPU-first: the unit-graph path mutates each GD unit's hyperparameter
+between ticks (policies are host-side Python, SURVEY.md §7 hard part
+(b)); the fused path multiplies a *traced* per-epoch ``lr_scale`` scalar
+into the compiled update (``parallel.fused``) so a schedule never forces
+a recompile."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..units import Unit
+
+
+class LRPolicy:
+    """lr(iteration) — base; ``base_lr`` is bound at attach time."""
+
+    def __call__(self, base_lr: float, it: int) -> float:
+        raise NotImplementedError
+
+    def scale(self, it: int) -> float:
+        """lr(it)/lr(0) — the multiplier the fused path traces in."""
+        return self(1.0, it)
+
+
+class FixedPolicy(LRPolicy):
+    def __call__(self, base_lr, it):
+        return base_lr
+
+
+class StepExpPolicy(LRPolicy):
+    """lr · γ^⌊it/step⌋ (caffe "step")."""
+
+    def __init__(self, gamma: float = 0.1, step: int = 1):
+        self.gamma, self.step = gamma, int(step)
+
+    def __call__(self, base_lr, it):
+        return base_lr * self.gamma ** (it // self.step)
+
+
+class ExpPolicy(LRPolicy):
+    """lr · γ^it."""
+
+    def __init__(self, gamma: float = 0.95):
+        self.gamma = gamma
+
+    def __call__(self, base_lr, it):
+        return base_lr * self.gamma ** it
+
+
+class InvPolicy(LRPolicy):
+    """lr · (1 + γ·it)^−p (caffe "inv")."""
+
+    def __init__(self, gamma: float = 1e-4, power: float = 0.75):
+        self.gamma, self.power = gamma, power
+
+    def __call__(self, base_lr, it):
+        return base_lr * (1.0 + self.gamma * it) ** (-self.power)
+
+
+class ArbitraryPolicy(LRPolicy):
+    """Piecewise-constant (lr_scale, until_iteration) table; the last
+    entry's scale holds forever (reference "arbitrary" policy)."""
+
+    def __init__(self, schedule):
+        self.schedule = [(float(s), int(u)) for s, u in schedule]
+
+    def __call__(self, base_lr, it):
+        for scale, until in self.schedule:
+            if it < until:
+                return base_lr * scale
+        return base_lr * self.schedule[-1][0]
+
+
+POLICIES = {"fixed": FixedPolicy, "step_exp": StepExpPolicy,
+            "exp": ExpPolicy, "inv": InvPolicy,
+            "arbitrary": ArbitraryPolicy}
+
+
+def make_policy(spec) -> LRPolicy:
+    """'exp' | ('exp', {...kwargs}) | LRPolicy instance."""
+    if isinstance(spec, LRPolicy):
+        return spec
+    if isinstance(spec, str):
+        return POLICIES[spec]()
+    name, kwargs = spec
+    return POLICIES[name](**kwargs)
+
+
+class LearningRateAdjust(Unit):
+    """Re-writes each attached GD unit's learning_rate before its tick.
+
+    ``by_epoch``: the iteration counter is the loader epoch (default) or
+    the running minibatch count."""
+
+    def __init__(self, workflow=None, name=None, policy="fixed",
+                 bias_policy=None, by_epoch=True, **kwargs):
+        super().__init__(workflow, name or "lr_adjust", **kwargs)
+        self.policy = make_policy(policy)
+        self.bias_policy = make_policy(bias_policy) if bias_policy \
+            else self.policy
+        self.by_epoch = by_epoch
+        self._gds: list = []
+        self._base: list = []
+        self._minibatches = 0
+
+    def link_gds(self, gds) -> "LearningRateAdjust":
+        self._gds = list(gds)
+        self._base = [(g.learning_rate, g.learning_rate_bias)
+                      for g in self._gds]
+        return self
+
+    def iteration(self) -> int:
+        if self.by_epoch:
+            loader = getattr(self.workflow, "loader", None)
+            return loader.epoch_number if loader is not None else 0
+        return self._minibatches
+
+    def run(self) -> None:
+        it = self.iteration()
+        for g, (lr0, lrb0) in zip(self._gds, self._base):
+            g.learning_rate = self.policy(lr0, it)
+            g.learning_rate_bias = self.bias_policy(lrb0, it)
+        self._minibatches += 1

@@ -1,0 +1,175 @@
+"""Restricted Boltzmann machine units (CD-1 training).
+
+Parity target: the reference ``veles/znicz/rbm_units.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 RBM row): the RBM building blocks —
+stochastic binarization of inputs, the hidden-probability forward, and
+the contrastive-divergence trainer (no gradient chain; like Kohonen, a
+self-contained non-backprop training path, SURVEY.md §3.5 pattern).
+
+TPU-first: all phases are matmul-shaped (``ops.rbm``); Bernoulli draws
+come from the counter RNG keyed by (unit, epoch, minibatch) so numpy and
+XLA paths sample identical states."""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from .. import prng
+from ..accelerated_units import AcceleratedUnit
+from ..memory import Vector
+from ..ops import rbm as rbm_ops
+from .nn_units import Forward
+
+
+class Binarization(Forward):
+    """Stochastic 0/1 binarization of input probabilities (the reference
+    unit feeding binary RBMs)."""
+
+    MAPPING = ("binarization",)
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        kwargs["include_bias"] = False
+        super().__init__(workflow, name, **kwargs)
+        self.rng = prng.get("rbm")
+        self.unit_id = zlib.crc32((self.name or "bin").encode())
+
+    def _counters(self):
+        loader = getattr(self.workflow, "loader", None) \
+            if self.workflow is not None else None
+        if loader is None:
+            return (self.unit_id, 0, 0)
+        return (self.unit_id, loader.epoch_number, loader.minibatch_offset)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if not self.output:
+            self.output.mem = np.zeros(self.input.shape, np.float32)
+        self.init_vectors(self.output)
+
+    def numpy_run(self) -> None:
+        self.output.mem = rbm_ops.sample_bernoulli(
+            self.input.mem, self.rng.stream_seed, self._counters(), np)
+
+    def xla_run(self) -> None:
+        import jax.numpy as jnp
+        seed = self.rng.stream_seed
+        if not hasattr(self, "_fn"):
+            self._fn = self.jit(
+                lambda x, c0, c1, c2: rbm_ops.sample_bernoulli(
+                    x, seed, (c0, c1, c2), jnp))
+        self.output.devmem = self._fn(self.input.devmem,
+                                      *map(np.uint32, self._counters()))
+
+
+class RBM(Forward):
+    """Hidden-probability forward: output = σ(input·W + hbias).
+
+    Owns the full RBM parameter set (W, vbias, hbias); the trainer links
+    to the same Vectors."""
+
+    MAPPING = ("rbm",)
+
+    def __init__(self, workflow=None, name=None, n_hidden=None, **kwargs):
+        kwargs["include_bias"] = False
+        kwargs.setdefault("weights_filling", "gaussian")
+        kwargs.setdefault("weights_stddev", 0.01)
+        super().__init__(workflow, name, **kwargs)
+        if n_hidden is None:
+            raise ValueError("n_hidden is required")
+        self.n_hidden = int(n_hidden)
+        self.vbias = Vector()
+        self.hbias = Vector()
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        n_visible = int(np.prod(self.input.shape[1:]))
+        self.n_visible = n_visible
+        self.create_weights((n_visible, self.n_hidden), ())
+        if not self.vbias:
+            self.vbias.mem = np.zeros(n_visible, np.float32)
+        if not self.hbias:
+            self.hbias.mem = np.zeros(self.n_hidden, np.float32)
+        if not self.output:
+            self.output.mem = np.zeros((self.input.shape[0],
+                                        self.n_hidden), np.float32)
+        self.init_vectors(self.weights, self.vbias, self.hbias,
+                          self.output)
+
+    def _v2d(self, mem):
+        return mem.reshape(len(mem), -1)
+
+    def numpy_run(self) -> None:
+        self.output.mem = rbm_ops.hidden_probs(
+            self._v2d(self.input.mem), self.weights.mem, self.hbias.mem,
+            np)
+
+    def xla_run(self) -> None:
+        import jax.numpy as jnp
+        if not hasattr(self, "_fn"):
+            self._fn = self.jit(
+                lambda v, w, c: rbm_ops.hidden_probs(
+                    v.reshape(len(v), -1), w, c, jnp))
+        self.output.devmem = self._fn(self.input.devmem,
+                                      self.weights.devmem,
+                                      self.hbias.devmem)
+
+
+class RBMTrainer(AcceleratedUnit):
+    """CD-1 contrastive-divergence update on the linked RBM's parameters;
+    publishes ``recon_err`` (mean reconstruction mse) per minibatch."""
+
+    def __init__(self, workflow=None, name=None, learning_rate=0.1,
+                 **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.learning_rate = learning_rate
+        self.recon_err = np.inf
+        self.rng = prng.get("rbm")
+        self.unit_id = zlib.crc32((self.name or "rbm_tr").encode())
+        self._step = 0
+
+    def setup_from_forward(self, fwd: RBM) -> "RBMTrainer":
+        self.forward_unit = fwd
+        self.link_attrs(fwd, "weights", "vbias", "hbias", "input")
+        return self
+
+    def _counters(self):
+        loader = getattr(self.workflow, "loader", None) \
+            if self.workflow is not None else None
+        self._step += 1
+        if loader is None:
+            # standalone (unit-test) use: an internal step counter keeps
+            # successive Gibbs samples decorrelated
+            return (self.unit_id, 0, self._step)
+        return (self.unit_id, loader.epoch_number, loader.minibatch_offset)
+
+    def numpy_run(self) -> None:
+        bs = self.current_batch_size
+        v0 = self.input.mem.reshape(len(self.input.mem), -1)[:bs]
+        w, vb, hb, recon = rbm_ops.np_cd1_step(
+            self.weights.mem, self.vbias.mem, self.hbias.mem, v0,
+            self.learning_rate, self.rng.stream_seed, self._counters())
+        self.weights.mem, self.vbias.mem, self.hbias.mem = \
+            w.astype(np.float32), vb.astype(np.float32), \
+            hb.astype(np.float32)
+        self.recon_err = float(recon)
+
+    def xla_run(self) -> None:
+        import jax.numpy as jnp
+        seed = self.rng.stream_seed
+        if not hasattr(self, "_fn"):
+            # lr is a traced argument — mutating self.learning_rate (LR
+            # schedules) must not be frozen into the compiled closure
+            self._fn = self.jit(
+                lambda w, vb, hb, v, lr, c0, c1, c2: rbm_ops.cd1_step(
+                    w, vb, hb, v.reshape(len(v), -1), lr, seed,
+                    (c0, c1, c2), jnp))
+        bs = self.current_batch_size
+        w, vb, hb, recon = self._fn(
+            self.weights.devmem, self.vbias.devmem, self.hbias.devmem,
+            self.input.devmem[:bs], jnp.float32(self.learning_rate),
+            *map(np.uint32, self._counters()))
+        self.weights.devmem, self.vbias.devmem, self.hbias.devmem = \
+            w, vb, hb
+        self.recon_err = float(recon)

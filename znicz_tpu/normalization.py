@@ -1,0 +1,134 @@
+"""Dataset normalizer family (loader preprocessing).
+
+Parity target: the reference ``veles/normalization.py`` (mount empty —
+surveyed contract, SURVEY.md §2.1 Loader base row: "``veles/
+normalization.py`` normalizer family"): named, stateful normalizers the
+loaders apply to the whole dataset — fit statistics once on the data
+(reference: on the training portion), then transform any tensor with the
+same state; state survives snapshots (plain-attribute dataclass-style).
+
+Registry use: ``create_normalizer("linear")`` — the loader's
+``normalization_type`` / ``normalization_parameters`` config pair."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NormalizerBase:
+    """fit(data) once → apply(tensor) anywhere; state in plain attrs."""
+
+    NAME: str = ""
+
+    def fit(self, data: np.ndarray) -> "NormalizerBase":
+        return self
+
+    def apply(self, data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def state(self) -> dict:
+        """Snapshot payload (reference normalizers pickled whole)."""
+        return dict(self.__dict__)
+
+    def restore(self, state: dict) -> "NormalizerBase":
+        self.__dict__.update(state)
+        return self
+
+
+class NoneNormalizer(NormalizerBase):
+    NAME = "none"
+
+    def apply(self, data):
+        return np.asarray(data, np.float32)
+
+
+class LinearNormalizer(NormalizerBase):
+    """Scale to [-1, 1] from the fitted min/max (reference "linear")."""
+
+    NAME = "linear"
+
+    def __init__(self, interval=(-1.0, 1.0)):
+        self.lo_out, self.hi_out = interval
+        self.lo = self.hi = None
+
+    def fit(self, data):
+        self.lo = float(np.min(data))
+        self.hi = float(np.max(data))
+        return self
+
+    def apply(self, data):
+        scale = (self.hi_out - self.lo_out) / max(self.hi - self.lo, 1e-8)
+        return ((np.asarray(data, np.float32) - self.lo) * scale
+                + self.lo_out).astype(np.float32)
+
+
+class MeanDispersionNormalizer(NormalizerBase):
+    """Per-feature zero mean / unit dispersion (reference "mean_disp")."""
+
+    NAME = "mean_disp"
+
+    def __init__(self):
+        self.mean = self.disp = None
+
+    def fit(self, data):
+        data = np.asarray(data, np.float32)
+        self.mean = data.mean(axis=0)
+        self.disp = data.std(axis=0) + 1e-8
+        return self
+
+    def apply(self, data):
+        return ((np.asarray(data, np.float32) - self.mean)
+                / self.disp).astype(np.float32)
+
+
+class ExternalMeanNormalizer(NormalizerBase):
+    """Subtract a supplied mean image (reference "external_mean" — the
+    AlexNet ImageNet mean-pixel file)."""
+
+    NAME = "external_mean"
+
+    def __init__(self, mean_source=None):
+        if mean_source is None:
+            raise ValueError("mean_source (array or .npy path) required")
+        self.mean = (np.load(mean_source) if isinstance(mean_source, str)
+                     else np.asarray(mean_source)).astype(np.float32)
+
+    def apply(self, data):
+        return (np.asarray(data, np.float32) - self.mean).astype(
+            np.float32)
+
+
+class PointwiseNormalizer(NormalizerBase):
+    """Per-feature linear map fitted to [-1, 1] (reference "pointwise":
+    each input coordinate rescaled independently)."""
+
+    NAME = "pointwise"
+
+    def __init__(self):
+        self.lo = self.hi = None
+
+    def fit(self, data):
+        data = np.asarray(data, np.float32)
+        self.lo = data.min(axis=0)
+        self.hi = data.max(axis=0)
+        return self
+
+    def apply(self, data):
+        scale = 2.0 / np.maximum(self.hi - self.lo, 1e-8)
+        return ((np.asarray(data, np.float32) - self.lo) * scale
+                - 1.0).astype(np.float32)
+
+
+NORMALIZERS = {cls.NAME: cls for cls in
+               (NoneNormalizer, LinearNormalizer,
+                MeanDispersionNormalizer, ExternalMeanNormalizer,
+                PointwiseNormalizer)}
+
+
+def create_normalizer(name: str, **kwargs) -> NormalizerBase:
+    try:
+        cls = NORMALIZERS[name]
+    except KeyError:
+        raise ValueError(f"unknown normalizer {name!r}; known: "
+                         f"{sorted(NORMALIZERS)}") from None
+    return cls(**kwargs)

@@ -19,4 +19,4 @@ or interpret mode).
 """
 
 from . import (activations, conv, deconv, dropout, kohonen, matmul,  # noqa
-               normalization, pooling, rngbits, softmax, update)
+               normalization, pooling, rbm, rngbits, softmax, update)

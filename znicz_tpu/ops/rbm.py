@@ -1,0 +1,79 @@
+"""Restricted Boltzmann machine ops: CD-1 contrastive divergence.
+
+Parity target: the reference ``veles/znicz/rbm_units.py`` (mount empty —
+surveyed contract, SURVEY.md §2.2 RBM row: CD training units).
+
+TPU-native design: one CD-1 step is three matmuls (v₀→h₀, h₀→v₁, v₁→h₁)
+plus two outer-product gradient matmuls — all MXU work — with Bernoulli
+sampling drawn from the counter-based RNG (``ops.rngbits``), so the numpy
+golden path and the XLA path sample identical hidden states (SURVEY.md §7
+hard part (c)).  Mean-field reconstruction (probabilities, not samples)
+for the negative phase — the standard Hinton recipe."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import rngbits
+
+
+def _sigmoid(x, xp):
+    return 1.0 / (1.0 + xp.exp(-x))
+
+
+def _matmul(a, b, xp):
+    """Full-f32 matmul on every backend: TPU matmuls default to bf16 MXU
+    passes, which would flip marginal Bernoulli draws vs the numpy golden
+    path and break backend equivalence (same fix as ops.kohonen)."""
+    if xp is np:
+        return a @ b
+    import jax
+    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
+
+def sample_bernoulli(p, seed: int, counters, xp=np):
+    """0/1 sample of probabilities ``p`` from the counter RNG — identical
+    draws on every backend for the same (seed, counters)."""
+    key = rngbits.fold(seed, *counters, xp=xp)
+    n = int(np.prod(p.shape))
+    u = rngbits.uniform01(key, n, xp=xp).reshape(p.shape)
+    return (u < p).astype(np.float32)
+
+
+def hidden_probs(v, w, hbias, xp=np):
+    """P(h=1|v) = σ(vW + c); v (B, V), w (V, H)."""
+    return _sigmoid(_matmul(v, w, xp) + hbias, xp)
+
+
+def visible_probs(h, w, vbias, xp=np):
+    """P(v=1|h) = σ(hWᵀ + b)."""
+    return _sigmoid(_matmul(h, w.T, xp) + vbias, xp)
+
+
+def cd1_step(w, vbias, hbias, v0, lr: float, seed: int, counters,
+             xp=np):
+    """One CD-1 update over minibatch ``v0``.
+
+    Positive phase uses h₀ *probabilities* for statistics but a sampled
+    h₀ to drive the reconstruction; negative phase is mean-field.
+    Returns (w', vbias', hbias', reconstruction mse)."""
+    b = v0.shape[0]
+    h0p = hidden_probs(v0, w, hbias, xp)
+    h0s = sample_bernoulli(h0p, seed, counters, xp)
+    v1 = visible_probs(h0s, w, vbias, xp)
+    h1p = hidden_probs(v1, w, hbias, xp)
+    gw = (_matmul(v0.T, h0p, xp) - _matmul(v1.T, h1p, xp)) / b
+    gvb = (v0 - v1).mean(axis=0)
+    ghb = (h0p - h1p).mean(axis=0)
+    recon = ((v0 - v1) ** 2).mean()
+    return (w + lr * gw, vbias + lr * gvb, hbias + lr * ghb, recon)
+
+
+def np_cd1_step(w, vbias, hbias, v0, lr, seed, counters):
+    return cd1_step(w, vbias, hbias, v0, lr, seed, counters, np)
+
+
+def xla_cd1_step(w, vbias, hbias, v0, lr, seed, counters):
+    return cd1_step(w, vbias, hbias, v0, lr, seed, counters, jnp)

@@ -381,11 +381,12 @@ def backward(spec: ModelSpec, params, caches, out, err):
     return grads
 
 
-def apply_updates(spec: ModelSpec, params, vels, grads):
+def apply_updates(spec: ModelSpec, params, vels, grads, lr_scale=1.0):
     # Inline update math (not the Pallas update kernel): inside the fused
     # step XLA fuses these elementwise ops into the surrounding graph; the
     # Pallas kernel serves the unit-graph path where each op dispatches
     # separately (the reference's kernel-per-op model).
+    # ``lr_scale`` may be traced — LR schedules never force a recompile.
     new_p, new_v = [], []
     for layer, (w, b), (vw, vb), grad in zip(spec.layers, params, vels,
                                              grads):
@@ -396,12 +397,12 @@ def apply_updates(spec: ModelSpec, params, vels, grads):
         gw, gb = grad
         lr, wd, l1, mom = layer.hypers
         reg = wd * ((1.0 - l1) * w + 0.5 * l1 * jnp.sign(w))
-        vw2 = mom * vw - lr * (gw + reg)
+        vw2 = mom * vw - lr * lr_scale * (gw + reg)
         w2 = w + vw2
         if b is not None:
             lrb, wdb, l1b, momb = layer.hypers_bias
             regb = wdb * ((1.0 - l1b) * b + 0.5 * l1b * jnp.sign(b))
-            vb2 = momb * vb - lrb * (gb + regb)
+            vb2 = momb * vb - lrb * lr_scale * (gb + regb)
             b2 = b + vb2
         else:
             b2, vb2 = None, None
@@ -411,7 +412,7 @@ def apply_updates(spec: ModelSpec, params, vels, grads):
 
 
 def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None,
-                    epoch=0, ctr=0):
+                    epoch=0, ctr=0, lr_scale=1.0):
     if mask is None:
         mask = jnp.ones((x.shape[0],), jnp.float32)
     out, caches = forward(spec, params, x, want_caches=True, train=True,
@@ -423,7 +424,7 @@ def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None,
         # last-layer kinds fold their own activation in backward()
         err = spec.act(last).bwd(err, out, None, jnp)
     grads = backward(spec, params, caches, out, err)
-    params, vels = apply_updates(spec, params, vels, grads)
+    params, vels = apply_updates(spec, params, vels, grads, lr_scale)
     metrics = {"loss": loss, "n_err": n_err}
     return params, vels, metrics
 
@@ -486,7 +487,7 @@ class FusedTrainer:
         spec = self.spec
 
         def train_epoch(params, vels, data, target, idx, mask, ctrs,
-                        epoch):
+                        epoch, lr_scale):
             def body(carry, step):
                 params, vels = carry
                 step_idx, step_mask, step_ctr = step
@@ -497,7 +498,7 @@ class FusedTrainer:
                         x, self._batch_sharding)
                 params, vels, m = train_minibatch(
                     spec, params, vels, x, t, step_mask, epoch=epoch,
-                    ctr=step_ctr)
+                    ctr=step_ctr, lr_scale=lr_scale)
                 return (params, vels), m
             (params, vels), ms = jax.lax.scan(body, (params, vels),
                                               (idx, mask, ctrs))
@@ -518,43 +519,48 @@ class FusedTrainer:
         self._train_epoch_fn = jax.jit(train_epoch, donate_argnums=(0, 1))
         self._eval_epoch_fn = jax.jit(eval_epoch)
 
-    def _idx_matrix(self, indices: np.ndarray,
-                    batch: int) -> tuple[np.ndarray, np.ndarray,
-                                         np.ndarray]:
+    def _idx_matrix(self, indices: np.ndarray, batch: int,
+                    ctr_base: int = 0) -> tuple[np.ndarray, np.ndarray,
+                                                np.ndarray]:
         """(steps, batch) int32 indices + 0/1 mask + per-step counter.
         The final short batch wraps around for a static shape; the mask
         zeroes the padded tail so metrics and gradients count each sample
         exactly once.  The counter equals the loader's
-        ``minibatch_offset`` after the corresponding unit-graph step, so
-        stochastic layers reproduce the unit path's RNG draws."""
+        ``minibatch_offset`` after the corresponding unit-graph step
+        (``ctr_base`` = samples already consumed this epoch by earlier
+        calls), so stochastic layers reproduce the unit path's RNG
+        draws."""
         n = len(indices)
         steps = max(1, -(-n // batch))
         padded = np.resize(indices, steps * batch)
         mask = np.zeros(steps * batch, np.float32)
         mask[:n] = 1.0
-        ctrs = np.minimum((np.arange(steps) + 1) * batch, n).astype(
-            np.uint32)
+        ctrs = (ctr_base + np.minimum((np.arange(steps) + 1) * batch, n)
+                ).astype(np.uint32)
         return (padded.reshape(steps, batch).astype(np.int32),
                 mask.reshape(steps, batch), ctrs)
 
     def train_epoch(self, data, target, indices, batch: int,
-                    sync: bool = True, epoch: int | None = None) -> dict:
+                    sync: bool = True, epoch: int | None = None,
+                    lr_scale: float = 1.0, ctr_base: int = 0) -> dict:
         """One epoch on device.  ``sync=False`` returns device arrays
         without a host readback — on tunneled TPUs a device→host fetch
         costs ~100× a step, so throughput loops should defer syncing.
 
         ``epoch`` keys the stochastic layers' counter RNG; when omitted
         an internal counter advances per call, so repeated calls never
-        silently reuse dropout masks."""
+        silently reuse dropout masks.  ``lr_scale`` multiplies every
+        layer's learning rate (traced — LR schedules don't recompile)."""
         if epoch is None:
             epoch = self._auto_epoch
         self._auto_epoch = epoch + 1
         if self._train_epoch_fn is None:
             self._build()
-        idx, mask, ctrs = self._idx_matrix(np.asarray(indices), batch)
+        idx, mask, ctrs = self._idx_matrix(np.asarray(indices), batch,
+                                           ctr_base)
         self.params, self.vels, ms = self._train_epoch_fn(
             self.params, self.vels, data, target, idx, mask, ctrs,
-            jnp.uint32(epoch))
+            jnp.uint32(epoch), jnp.float32(lr_scale))
         return {k: np.asarray(v) for k, v in ms.items()} if sync else ms
 
     def eval_epoch(self, data, target, indices, batch: int,

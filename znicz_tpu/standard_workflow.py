@@ -42,9 +42,10 @@ def _build_registries():
     try:
         from .nn import conv, gd_conv, pooling, gd_pooling  # noqa
         from .nn import normalization, dropout, activation  # noqa
-        from .nn import deconv, gd_deconv, depooling  # noqa
+        from .nn import cutter, deconv, gd_deconv, depooling  # noqa
         modules += [conv, gd_conv, pooling, gd_pooling, normalization,
-                    dropout, activation, deconv, gd_deconv, depooling]
+                    dropout, activation, deconv, gd_deconv, depooling,
+                    cutter]
     except ImportError:
         pass
     from .nn.nn_units import Forward, GradientDescentBase
@@ -70,6 +71,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         self.loss_function = loss_function
         self.forwards = []
         self.gds = []
+        self.lr_adjuster = None
         self.metrics_writer = MetricsWriter()
         self.fwd_map, self.gd_map = _build_registries()
 
@@ -128,9 +130,19 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
 
+    def link_lr_adjuster(self, **config) -> None:
+        """Insert a LearningRateAdjust between decision and the GD chain
+        (call before link_gds; the reference's lr_adjust wiring)."""
+        from .nn.lr_adjust import LearningRateAdjust
+        self.lr_adjuster = LearningRateAdjust(self, **config)
+        self.lr_adjuster.link_from(self.decision)
+        self.lr_adjuster.gate_skip = DerivedBool(
+            lambda: bool(self.decision.complete), ())
+
     def link_gds(self, **defaults) -> None:
         """Mirrored gradient chain, last layer first (reference link_gds)."""
-        prev = self.decision
+        prev = self.lr_adjuster if self.lr_adjuster is not None \
+            else self.decision
         loader = self.loader
         decision = self.decision
         # skip backprop on valid/test minibatches and once training is
@@ -138,6 +150,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         train_only = DerivedBool(
             lambda: loader.minibatch_class != TRAIN
             or bool(decision.complete), ())
+        first = True
         for i in reversed(range(len(self.forwards))):
             spec = self.layers_config[i]
             cls = self.gd_map.get(spec["type"])
@@ -148,14 +161,17 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             unit = cls(self, name=f"gd{i}_{spec['type']}",
                        need_err_input=(i > 0), **kwargs)
             unit.setup_from_forward(self.forwards[i])
-            if prev is self.decision:
+            if first:
                 unit.link_attrs(self.evaluator, "err_output")
+                first = False
             else:
                 unit.link_attrs(prev, ("err_output", "err_input"))
             unit.link_from(prev)
             unit.gate_skip = train_only
             self.gds.insert(0, unit)
             prev = unit
+        if self.lr_adjuster is not None:
+            self.lr_adjuster.link_gds(self.gds)
         # close the minibatch loop
         self.loader.link_from(self.gds[0])
 
@@ -193,15 +209,59 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         batch = loader.max_minibatch_size
         epochs = max_epochs or decision.max_epochs or 10
         from .loader.base import CLASS_NAMES
+        lr_policy = (self.lr_adjuster.policy
+                     if self.lr_adjuster is not None else None)
+        if self.lr_adjuster is not None:
+            adj = self.lr_adjuster
+            if adj.bias_policy is not adj.policy or not adj.by_epoch:
+                # the fused step traces ONE per-epoch scale into both
+                # weight and bias updates — refuse configurations it
+                # cannot reproduce rather than silently diverging
+                raise NotImplementedError(
+                    "run_fused supports a single by-epoch LR policy; "
+                    "separate bias_policy or by_epoch=False schedules "
+                    "need the unit-graph path (wf.run())")
+        first = True
+        # Unit-graph parity for the stop tick: in the tick where Decision
+        # sets ``complete`` the GD units are gate-skipped, so the LAST
+        # train minibatch of the final epoch never updates weights.  The
+        # fused loop reproduces this by deferring each epoch's last
+        # minibatch update until it knows training continues.
+        pending = None   # (tail_indices, epoch, lr_scale, ctr_base)
         for epoch in range(loader.epoch_number, epochs):
+            loader.epoch_number = epoch
+            if not first:   # initialize() already built epoch 0's plan —
+                loader._build_epoch_plan()   # reuse the loader's shuffle
+            first = False                    # stream (unit-graph parity)
             metrics = {"epoch": epoch}
-            perm = cls_idx[TRAIN].copy()
-            loader.prng.shuffle(perm)
-            tm = trainer.train_epoch(data, target, perm, batch,
-                                     epoch=epoch)
-            metrics["train_loss"] = float(tm["loss"].mean())
+            perm = loader._shuffled[TRAIN]
+            scale = lr_policy.scale(epoch) if lr_policy is not None \
+                else 1.0
+            if pending is not None:
+                trainer.train_epoch(data, target, pending[0], batch,
+                                    epoch=pending[1], lr_scale=pending[2],
+                                    ctr_base=pending[3], sync=False)
             n_train = len(cls_idx[TRAIN])
-            metrics["train_n_err"] = int(tm["n_err"].sum())
+            split = ((n_train - 1) // batch) * batch
+            head, tail = perm[:split], perm[split:]
+            if len(head):
+                tm = trainer.train_epoch(data, target, head, batch,
+                                         epoch=epoch, lr_scale=scale)
+            else:
+                tm = {"loss": np.zeros((0,)), "n_err": np.zeros((0,))}
+            # the tail minibatch's metrics come from a forward pass over
+            # the post-head weights — same weights the unit graph's
+            # evaluator saw before the (skipped-or-deferred) update.
+            # Caveat: this forward runs in eval mode, so for nets with
+            # stochastic layers (dropout) the tail step's train metrics
+            # differ slightly from the unit graph's dropout-active ones;
+            # weights stay exactly equal either way
+            em_tail = trainer.eval_epoch(data, target, tail, batch)
+            pending = (tail, epoch, scale, split)
+            metrics["train_loss"] = float(
+                np.concatenate([tm["loss"], em_tail["loss"]]).mean())
+            metrics["train_n_err"] = int(tm["n_err"].sum()
+                                         + em_tail["n_err"].sum())
             metrics["train_err_pct"] = 100.0 * metrics["train_n_err"] \
                 / max(n_train, 1)
             for k in (VALID, TEST):
@@ -238,19 +298,23 @@ class StandardWorkflow(StandardWorkflowBase):
 
     def __init__(self, workflow=None, name=None, layers=None,
                  loader=None, loss_function="softmax", decision_config=None,
-                 snapshotter_config=None, **kwargs):
+                 snapshotter_config=None, lr_adjuster_config=None,
+                 **kwargs):
         super().__init__(workflow, name, layers=layers,
                          loss_function=loss_function, **kwargs)
         if loader is not None:
             self.create_workflow(loader, decision_config or {},
-                                 snapshotter_config)
+                                 snapshotter_config, lr_adjuster_config)
 
     def create_workflow(self, loader, decision_config: dict,
-                        snapshotter_config: dict | None) -> None:
+                        snapshotter_config: dict | None,
+                        lr_adjuster_config: dict | None = None) -> None:
         self.link_loader(loader)
         self.link_forwards()
         self.link_evaluator()
         self.link_decision(**decision_config)
+        if lr_adjuster_config is not None:
+            self.link_lr_adjuster(**lr_adjuster_config)
         self.link_gds()
         if snapshotter_config is not None:
             self.link_snapshotter(**snapshotter_config)
