@@ -1,0 +1,314 @@
+// znicz-tpu native inference engine.
+//
+// Parity target: the reference's libVeles/libZnicz C++ snapshot-inference
+// engines (SURVEY.md §2.3 last row: "load trained snapshot, CPU inference").
+// TPU-native redesign: instead of parsing Python pickles, this consumes the
+// framework's portable .znn binary export (znicz_tpu/export.py) — a flat
+// layer list with raw float32 parameter blobs — and runs the forward chain
+// on the host CPU.  Layout is NHWC throughout, matching the framework.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+//
+// Build: make -C native      (produces libznicz_infer.so)
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---- model format ---------------------------------------------------------
+// header: magic "ZNN1", uint32 n_layers
+// per layer: uint32 kind, uint32 activation, int32 p[8] geometry,
+//            uint64 w_size, float32[w_size], uint64 b_size, float32[b_size]
+// geometry p[] meaning by kind:
+//   fc:       p0=in_features, p1=out_features
+//   conv:     p0=kh, p1=kw, p2=cin, p3=cout, p4=sh, p5=sw, p6=ph, p7=pw
+//   pool:     p0=kh, p1=kw, p4=sh, p5=sw, p6=ph, p7=pw
+//   lrn:      p0=n; alpha/beta/k packed in the weight blob (3 floats)
+//   activation/dropout/softmax: none
+
+enum Kind : uint32_t {
+  kFC = 0,
+  kConv = 1,
+  kMaxPool = 2,
+  kAvgPool = 3,
+  kLRN = 4,
+  kActivation = 5,
+  kDropout = 6,     // inference identity (inverted dropout)
+  kSoftmax = 7,
+};
+
+enum Act : uint32_t {
+  aLinear = 0,
+  aTanh = 1,      // 1.7159 * tanh(0.6666 x)  (reference scaled tanh)
+  aRelu = 2,      // log(1 + e^x)             (reference smooth relu)
+  aStrictRelu = 3,
+  aSigmoid = 4,
+};
+
+struct Layer {
+  uint32_t kind = 0;
+  uint32_t act = 0;
+  int32_t p[8] = {0};
+  std::vector<float> w;
+  std::vector<float> b;
+};
+
+struct Model {
+  std::vector<Layer> layers;
+};
+
+// ---- shape tracking -------------------------------------------------------
+struct Shape {  // NHWC; fc activations use h=w=1, c=features
+  int64_t n = 0, h = 0, w = 0, c = 0;
+  int64_t size() const { return n * h * w * c; }
+};
+
+float apply_act(uint32_t a, float x) {
+  switch (a) {
+    case aTanh: return 1.7159f * std::tanh(0.6666f * x);
+    case aRelu: return std::log1p(std::exp(x));
+    case aStrictRelu: return x > 0.0f ? x : 0.0f;
+    case aSigmoid: return 1.0f / (1.0f + std::exp(-x));
+    default: return x;
+  }
+}
+
+void act_inplace(uint32_t a, std::vector<float>& v) {
+  if (a == aLinear) return;
+  for (auto& x : v) x = apply_act(a, x);
+}
+
+// ---- layer forward kernels (plain CPU; NHWC) ------------------------------
+void fc_forward(const Layer& L, const std::vector<float>& in, Shape& s,
+                std::vector<float>& out) {
+  const int64_t fin = L.p[0], fout = L.p[1], batch = s.n;
+  out.assign(batch * fout, 0.0f);
+  for (int64_t b = 0; b < batch; ++b) {
+    const float* x = in.data() + b * fin;
+    float* y = out.data() + b * fout;
+    if (!L.b.empty()) std::memcpy(y, L.b.data(), fout * sizeof(float));
+    for (int64_t i = 0; i < fin; ++i) {
+      const float xi = x[i];
+      if (xi == 0.0f) continue;
+      const float* wrow = L.w.data() + i * fout;  // (in, out) layout
+      for (int64_t j = 0; j < fout; ++j) y[j] += xi * wrow[j];
+    }
+  }
+  s = {batch, 1, 1, fout};
+}
+
+void conv_forward(const Layer& L, const std::vector<float>& in, Shape& s,
+                  std::vector<float>& out) {
+  const int kh = L.p[0], kw = L.p[1], cin = L.p[2], cout = L.p[3];
+  const int sh = L.p[4], sw = L.p[5], ph = L.p[6], pw = L.p[7];
+  const int64_t oh = (s.h + 2 * ph - kh) / sh + 1;
+  const int64_t ow = (s.w + 2 * pw - kw) / sw + 1;
+  out.assign(s.n * oh * ow * cout, 0.0f);
+  for (int64_t b = 0; b < s.n; ++b)
+    for (int64_t oy = 0; oy < oh; ++oy)
+      for (int64_t ox = 0; ox < ow; ++ox) {
+        float* y = out.data() + ((b * oh + oy) * ow + ox) * cout;
+        if (!L.b.empty())
+          std::memcpy(y, L.b.data(), cout * sizeof(float));
+        for (int ky = 0; ky < kh; ++ky) {
+          const int64_t iy = oy * sh + ky - ph;
+          if (iy < 0 || iy >= s.h) continue;
+          for (int kx = 0; kx < kw; ++kx) {
+            const int64_t ix = ox * sw + kx - pw;
+            if (ix < 0 || ix >= s.w) continue;
+            const float* x =
+                in.data() + ((b * s.h + iy) * s.w + ix) * cin;
+            // w layout HWIO: ((ky*kw + kx)*cin + ci)*cout + co
+            const float* wp = L.w.data() + (ky * kw + kx) * cin * cout;
+            for (int ci = 0; ci < cin; ++ci) {
+              const float xi = x[ci];
+              if (xi == 0.0f) continue;
+              const float* wrow = wp + ci * cout;
+              for (int co = 0; co < cout; ++co) y[co] += xi * wrow[co];
+            }
+          }
+        }
+      }
+  s = {s.n, oh, ow, cout};
+}
+
+void pool_forward(const Layer& L, bool avg, const std::vector<float>& in,
+                  Shape& s, std::vector<float>& out) {
+  const int kh = L.p[0], kw = L.p[1];
+  const int sh = L.p[4], sw = L.p[5], ph = L.p[6], pw = L.p[7];
+  const int64_t oh = (s.h + 2 * ph - kh) / sh + 1;
+  const int64_t ow = (s.w + 2 * pw - kw) / sw + 1;
+  out.assign(s.n * oh * ow * s.c, 0.0f);
+  const float inv_area = 1.0f / (kh * kw);
+  for (int64_t b = 0; b < s.n; ++b)
+    for (int64_t oy = 0; oy < oh; ++oy)
+      for (int64_t ox = 0; ox < ow; ++ox)
+        for (int64_t c = 0; c < s.c; ++c) {
+          float best = avg ? 0.0f : -1e30f;
+          for (int ky = 0; ky < kh; ++ky) {
+            const int64_t iy = oy * sh + ky - ph;
+            for (int kx = 0; kx < kw; ++kx) {
+              const int64_t ix = ox * sw + kx - pw;
+              float v = 0.0f;  // zero padding (matches avg; max pads -inf
+              if (iy >= 0 && iy < s.h && ix >= 0 && ix < s.w)
+                v = in[((b * s.h + iy) * s.w + ix) * s.c + c];
+              else if (!avg)
+                v = -1e30f;   // outside: never wins the max
+              if (avg)
+                best += v;
+              else if (v > best)
+                best = v;
+            }
+          }
+          out[((b * oh + oy) * ow + ox) * s.c + c] =
+              avg ? best * inv_area : best;
+        }
+  s = {s.n, oh, ow, s.c};
+}
+
+void lrn_forward(const Layer& L, const std::vector<float>& in, Shape& s,
+                 std::vector<float>& out) {
+  const int n = L.p[0];
+  const float alpha = L.w[0], beta = L.w[1], k = L.w[2];
+  const int half_lo = (n - 1) / 2, half_hi = n / 2;
+  out.assign(in.size(), 0.0f);
+  const int64_t rows = s.n * s.h * s.w;
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* x = in.data() + r * s.c;
+    float* y = out.data() + r * s.c;
+    for (int64_t c = 0; c < s.c; ++c) {
+      float acc = 0.0f;
+      const int64_t lo = c - half_lo < 0 ? 0 : c - half_lo;
+      const int64_t hi = c + half_hi >= s.c ? s.c - 1 : c + half_hi;
+      for (int64_t j = lo; j <= hi; ++j) acc += x[j] * x[j];
+      y[c] = x[c] * std::pow(k + alpha * acc, -beta);
+    }
+  }
+}
+
+void softmax_forward(std::vector<float>& v, const Shape& s) {
+  const int64_t classes = s.c;
+  for (int64_t b = 0; b < s.n; ++b) {
+    float* y = v.data() + b * classes;
+    float m = y[0];
+    for (int64_t j = 1; j < classes; ++j)
+      if (y[j] > m) m = y[j];
+    float sum = 0.0f;
+    for (int64_t j = 0; j < classes; ++j) {
+      y[j] = std::exp(y[j] - m);
+      sum += y[j];
+    }
+    for (int64_t j = 0; j < classes; ++j) y[j] /= sum;
+  }
+}
+
+}  // namespace
+
+// ---- C ABI ----------------------------------------------------------------
+extern "C" {
+
+void* zn_load(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  char magic[4];
+  if (std::fread(magic, 1, 4, f) != 4 ||
+      std::memcmp(magic, "ZNN1", 4) != 0) {
+    std::fclose(f);
+    return nullptr;
+  }
+  uint32_t n_layers = 0;
+  if (std::fread(&n_layers, 4, 1, f) != 1 || n_layers > 4096) {
+    std::fclose(f);
+    return nullptr;
+  }
+  auto* m = new Model();
+  m->layers.resize(n_layers);
+  for (auto& L : m->layers) {
+    uint64_t wn = 0, bn = 0;
+    bool ok = std::fread(&L.kind, 4, 1, f) == 1 &&
+              std::fread(&L.act, 4, 1, f) == 1 &&
+              std::fread(L.p, 4, 8, f) == 8 &&
+              std::fread(&wn, 8, 1, f) == 1;
+    if (ok) {
+      L.w.resize(wn);
+      ok = wn == 0 || std::fread(L.w.data(), 4, wn, f) == wn;
+    }
+    if (ok) ok = std::fread(&bn, 8, 1, f) == 1;
+    if (ok) {
+      L.b.resize(bn);
+      ok = bn == 0 || std::fread(L.b.data(), 4, bn, f) == bn;
+    }
+    if (!ok) {
+      std::fclose(f);
+      delete m;
+      return nullptr;
+    }
+  }
+  std::fclose(f);
+  return m;
+}
+
+void zn_free(void* handle) { delete static_cast<Model*>(handle); }
+
+int zn_n_layers(void* handle) {
+  return static_cast<int>(static_cast<Model*>(handle)->layers.size());
+}
+
+// Forward: input NHWC float32 (batch, h, w, c); returns the flat output
+// size written, or -1 on error.  out_cap = capacity of out in floats.
+int64_t zn_infer(void* handle, const float* input, int64_t batch,
+                 int64_t h, int64_t w, int64_t c, float* out,
+                 int64_t out_cap) {
+  auto* m = static_cast<Model*>(handle);
+  Shape s{batch, h, w, c};
+  std::vector<float> cur(input, input + s.size());
+  std::vector<float> next;
+  for (const auto& L : m->layers) {
+    switch (L.kind) {
+      case kFC: {
+        // flatten whatever is upstream
+        Shape flat{s.n, 1, 1, s.h * s.w * s.c};
+        s = flat;
+        fc_forward(L, cur, s, next);
+        act_inplace(L.act, next);
+        cur.swap(next);
+        break;
+      }
+      case kConv:
+        conv_forward(L, cur, s, next);
+        act_inplace(L.act, next);
+        cur.swap(next);
+        break;
+      case kMaxPool:
+      case kAvgPool:
+        pool_forward(L, L.kind == kAvgPool, cur, s, next);
+        cur.swap(next);
+        break;
+      case kLRN:
+        lrn_forward(L, cur, s, next);
+        cur.swap(next);
+        break;
+      case kActivation:
+        act_inplace(L.act, cur);
+        break;
+      case kDropout:
+        break;  // inverted dropout: inference identity
+      case kSoftmax:
+        softmax_forward(cur, s);
+        break;
+      default:
+        return -1;
+    }
+  }
+  const int64_t n = static_cast<int64_t>(cur.size());
+  if (n > out_cap) return -1;
+  std::memcpy(out, cur.data(), n * sizeof(float));
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,121 @@
+"""Aux subsystem tests (SURVEY.md §2.1 genetics/plotting/web-status rows,
+§2.2 weight-viz/image-saver rows)."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.config import Config, root
+from znicz_tpu.genetics import Gene, GeneticOptimizer
+
+
+class TestGenetics:
+    def test_optimizes_quadratic(self):
+        """GA finds the sweet spot of a smooth 2-param objective."""
+        tree = Config("t")
+        tree.update({"a": {"x": 0.0}, "b": 0.0})
+        genes = [Gene("a.x", -4.0, 4.0), Gene("b", -4.0, 4.0)]
+
+        def fitness(t):
+            return -((t.a.x - 1.5) ** 2 + (t.b + 2.0) ** 2)
+
+        opt = GeneticOptimizer(genes, fitness, population_size=16,
+                               generations=12, tree=tree)
+        best = opt.run()
+        assert best.fitness > -0.1
+        assert abs(tree.a.x - 1.5) < 0.3       # winner installed
+        assert abs(tree.b + 2.0) < 0.3
+        # monotone-ish improvement recorded
+        assert opt.history[-1]["best_fitness"] >= \
+            opt.history[0]["best_fitness"]
+
+    def test_int_gene(self):
+        tree = Config("t")
+        tree.update({"n": 0})
+        opt = GeneticOptimizer(
+            [Gene("n", 1, 9, is_int=True)],
+            lambda t: -abs(t.n - 4), population_size=8, generations=6,
+            tree=tree)
+        best = opt.run()
+        assert isinstance(best.values[0], int)
+        assert tree.n == 4
+
+
+@pytest.fixture
+def trained_wf(tmp_path):
+    from znicz_tpu.models.mnist import MnistWorkflow
+    saved = root.mnist.synthetic.to_dict()
+    root.mnist.synthetic.update({"n_train": 200, "n_valid": 60,
+                                 "n_test": 60})
+    prng.seed_all(3)
+    wf = MnistWorkflow()
+    wf.decision.max_epochs = 2
+    wf.initialize(device=Device.create("numpy"))
+    wf.run()
+    yield wf
+    root.mnist.synthetic.update(saved)
+
+
+class TestPlotters:
+    def test_curve_and_weights_emit_metrics(self, trained_wf, tmp_path):
+        from znicz_tpu.plotting_units import (AccumulatingPlotter,
+                                              ConfusionMatrixPlotter,
+                                              Weights2D)
+        wf = trained_wf
+        curve = AccumulatingPlotter(wf, metric="validation_n_err",
+                                    render=True,
+                                    directory=str(tmp_path))
+        w2d = Weights2D(wf, unit=wf.forwards[0], render=True,
+                        directory=str(tmp_path), sample_shape=(28, 28))
+        cm = ConfusionMatrixPlotter(wf, name="cmplot",
+                                    directory=str(tmp_path))
+        wf.loader.last_minibatch.set(True)
+        curve.run()
+        w2d.run()
+        cm.run()
+        kinds = {r.get("kind") for r in wf.metrics_writer.records}
+        assert {"curve", "weights", "confusion"} <= kinds
+        pngs = [f for f in os.listdir(tmp_path) if f.endswith(".png")]
+        assert len(pngs) >= 2   # curve + weight tiles rendered
+
+    def test_image_saver(self, trained_wf, tmp_path):
+        from znicz_tpu.loader.base import VALID
+        from znicz_tpu.plotting_units import ImageSaver
+        wf = trained_wf
+        saver = ImageSaver(wf, directory=str(tmp_path / "bad"), limit=5)
+        # serve one validation minibatch, then dump mistakes
+        ld = wf.loader
+        idx = np.arange(ld.class_lengths[0],
+                        ld.class_lengths[0] + ld.max_minibatch_size)
+        ld.minibatch_class = VALID
+        ld.minibatch_size = len(idx)
+        ld.fill_minibatch(idx, VALID)
+        for f in wf.forwards:
+            f.run()
+        wf.evaluator.run()
+        saver.run()
+        assert len(saver.saved_paths) > 0
+        assert all(os.path.exists(p) for p in saver.saved_paths)
+
+
+class TestWebStatus:
+    def test_status_page_and_json(self, trained_wf):
+        from znicz_tpu.web_status import StatusServer
+        srv = StatusServer(trained_wf).start()
+        try:
+            with urllib.request.urlopen(srv.url + "status.json",
+                                        timeout=10) as resp:
+                data = json.loads(resp.read())
+            assert data["workflow"] == "MnistWorkflow"
+            assert data["complete"] is True
+            assert len(data["metrics"]) == 2
+            with urllib.request.urlopen(srv.url, timeout=10) as resp:
+                page = resp.read().decode()
+            assert "znicz-tpu" in page
+        finally:
+            srv.stop()

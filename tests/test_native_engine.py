@@ -1,0 +1,104 @@
+"""Native C++ inference engine tests (SURVEY.md §2.3 libVeles/libZnicz
+row): build the .so, export trained workflows, and check the C++ forward
+matches the framework's numpy golden path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.config import root
+from znicz_tpu.export import NativeEngine, build_native, export_workflow
+
+
+@pytest.fixture(scope="module")
+def engine():
+    build_native()
+    return NativeEngine()
+
+
+@pytest.fixture
+def small_mnist():
+    saved = root.mnist.synthetic.to_dict()
+    root.mnist.synthetic.update({"n_train": 300, "n_valid": 60,
+                                 "n_test": 60})
+    yield
+    root.mnist.synthetic.update(saved)
+
+
+def _numpy_forward(wf, x):
+    """Drive the unit-graph forwards on numpy over a fixed batch."""
+    ld = wf.loader
+    ld.minibatch_class = 0      # eval: dropout must be identity
+    ld.minibatch_size = len(x)
+    ld.minibatch_data.mem = np.asarray(x, np.float32)
+    for f in wf.forwards:
+        f.run()
+    return np.asarray(wf.forwards[-1].output.mem)
+
+
+class TestNativeEngine:
+    def test_mlp_matches_golden(self, engine, small_mnist, tmp_path):
+        from znicz_tpu.models.mnist import MnistWorkflow
+        prng.seed_all(5)
+        wf = MnistWorkflow()
+        wf.decision.max_epochs = 2
+        wf.initialize(device=Device.create("numpy"))
+        wf.run()
+        path = export_workflow(wf, str(tmp_path / "mlp.znn"))
+        model = engine.load(path)
+        assert model.n_layers == 3   # fc + fc + softmax head
+        x = wf.loader.original_data.mem[:16]
+        ref = _numpy_forward(wf, x)
+        got = model.infer(x, ref.shape[1])
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_conv_net_matches_golden(self, engine, tmp_path):
+        """Conv + maxpool + LRN + avgpool + dropout + fc stack."""
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+        from znicz_tpu.standard_workflow import StandardWorkflow
+
+        class Loader(FullBatchLoader):
+            def load_data(self):
+                gen = prng.get("nat")
+                n = 40
+                self.original_data.mem = np.asarray(
+                    gen.normal(size=(n, 12, 12, 3)), np.float32)
+                self.original_labels.mem = gen.randint(
+                    0, 5, n).astype(np.int32)
+                self.class_lengths = [0, 0, n]
+
+        layers = [
+            {"type": "conv_tanh",
+             "->": {"n_kernels": 6, "kx": 3, "padding": 1},
+             "<-": {"learning_rate": 0.05}},
+            {"type": "max_pooling", "->": {"kx": 2}},
+            {"type": "norm", "->": {"n": 5}},
+            {"type": "avg_pooling", "->": {"kx": 2}},
+            {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+            {"type": "all2all_str", "->": {"output_sample_shape": 16},
+             "<-": {"learning_rate": 0.05}},
+            {"type": "softmax", "->": {"output_sample_shape": 5},
+             "<-": {"learning_rate": 0.05}},
+        ]
+        prng.seed_all(7)
+        wf = StandardWorkflow(
+            None, "natwf", layers=layers, loader=Loader(minibatch_size=20),
+            decision_config={"max_epochs": 2, "fail_iterations": 10})
+        wf.initialize(device=Device.create("numpy"))
+        wf.run()
+        path = export_workflow(wf, str(tmp_path / "conv.znn"))
+        model = engine.load(path)
+        x = wf.loader.original_data.mem[:8]
+        ref = _numpy_forward(wf, x)
+        got = model.infer(x, 5)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_bad_file_rejected(self, engine, tmp_path):
+        bad = tmp_path / "bad.znn"
+        bad.write_bytes(b"NOPE" + b"\0" * 64)
+        with pytest.raises(IOError):
+            engine.load(str(bad))

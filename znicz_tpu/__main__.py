@@ -1,0 +1,68 @@
+"""CLI: ``python -m znicz_tpu <workflow> [<config.py>] [options]``.
+
+Parity target: the reference ``veles/__main__.py`` (SURVEY.md §2.1 L7):
+two-file workflow+config UX, snapshot resume, backend selection, config
+overrides, distributed bootstrap flags.
+
+Examples::
+
+    python -m znicz_tpu znicz_tpu.models.mnist
+    python -m znicz_tpu my_workflow.py my_config.py --backend=xla
+    python -m znicz_tpu znicz_tpu.models.mnist --set mnist.minibatch_size=50
+    python -m znicz_tpu znicz_tpu.models.mnist --snapshot snapshots/s_best.npz
+    python -m znicz_tpu wf.py cfg.py --coordinator=host:1234 \
+        --num-processes=4 --process-id=0        # multi-host SPMD
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .launcher import Launcher
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="znicz_tpu",
+        description="TPU-native unit/workflow training engine")
+    p.add_argument("workflow",
+                   help="workflow module: a .py path or dotted name")
+    p.add_argument("config", nargs="?", default=None,
+                   help="config file (python executed against `root`)")
+    p.add_argument("--backend", default="auto",
+                   choices=("auto", "numpy", "xla"))
+    p.add_argument("--snapshot", default=None,
+                   help="resume from a snapshot .npz")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--fused", action="store_true",
+                   help="train via the fused whole-step path")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="PATH=VALUE",
+                   help="config override, e.g. --set mnist.layers=[...]")
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 (multi-host SPMD)")
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--process-id", type=int, default=0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    launcher = Launcher(
+        workflow=args.workflow, config=args.config, backend=args.backend,
+        snapshot=args.snapshot, epochs=args.epochs, fused=args.fused,
+        seed=args.seed, overrides=args.overrides,
+        coordinator=args.coordinator, num_processes=args.num_processes,
+        process_id=args.process_id)
+    wf = launcher.run()
+    decision = getattr(wf, "decision", None)
+    if decision is not None and decision.epoch_metrics:
+        for m in decision.epoch_metrics[-3:]:
+            print(m)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

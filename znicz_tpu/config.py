@@ -64,6 +64,24 @@ class Config:
                 self.__dict__[k] = v
         return self
 
+    def setdefaults(self, values: dict) -> "Config":
+        """Recursively fill only MISSING keys (module-level sample
+        defaults): a config file executed before the module import — the
+        launcher's two-file order — keeps its values."""
+        for k, v in values.items():
+            existing = self.__dict__.get(k, _MISSING)
+            if isinstance(v, dict):
+                node = existing
+                if not isinstance(node, Config):
+                    if existing is not _MISSING:
+                        continue   # leaf already set by the user
+                    node = Config(f"{self._path}.{k}")
+                    self.__dict__[k] = node
+                node.setdefaults(v)
+            elif existing is _MISSING:
+                self.__dict__[k] = v
+        return self
+
     # -- access helpers ----------------------------------------------------
     def get(self, name: str, default=None):
         """Read a leaf without creating intermediate nodes."""

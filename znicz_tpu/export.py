@@ -1,0 +1,181 @@
+"""Portable model export (.znn) + the native-engine binding.
+
+Parity target: the reference's libVeles/libZnicz C++ snapshot-inference
+path (SURVEY.md §2.3 last row: load a trained snapshot, run CPU
+inference).  The reference engines parsed its Python pickles; here the
+boundary is a purpose-built flat binary (magic ``ZNN1``; per layer: kind,
+activation, 8-int geometry, raw float32 weight/bias blobs — see
+``native/znicz_infer.cpp`` for the authoritative format comment) written
+from a trained workflow, consumed by ``native/libznicz_infer.so`` through
+ctypes (no pybind11 in this environment)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+KIND = {"fc": 0, "conv": 1, "max_pool": 2, "avg_pool": 3, "lrn": 4,
+        "activation": 5, "dropout": 6, "softmax": 7}
+ACT = {"linear": 0, "tanh": 1, "relu": 2, "strict_relu": 3, "sigmoid": 4}
+
+
+def _pack_layer(fh, kind: int, act: int, p, w=None, b=None) -> None:
+    p = (list(p) + [0] * 8)[:8]
+    fh.write(struct.pack("<II8i", kind, act, *p))
+    for blob in (w, b):
+        if blob is None:
+            fh.write(struct.pack("<Q", 0))
+        else:
+            arr = np.ascontiguousarray(blob, np.float32)
+            fh.write(struct.pack("<Q", arr.size))
+            fh.write(arr.tobytes())
+
+
+def export_workflow(workflow, path: str) -> str:
+    """Serialize a trained StandardWorkflow's forward chain to .znn.
+
+    Covers the inference-relevant unit zoo (fc/conv/pool/LRN/activation/
+    dropout/softmax); decoder (Deconv/Depooling) and non-gradient paths
+    (Kohonen/RBM) are training-side constructs the reference engines did
+    not serve either."""
+    from .nn.all2all import All2All, All2AllSoftmax
+    from .nn.conv import Conv
+    from .nn.dropout import DropoutForward
+    from .nn.normalization import LRNormalizerForward
+    from .nn import activation as act_units
+    from .nn import pooling as pool_units
+
+    with open(path, "wb") as fh:
+        fh.write(b"ZNN1")
+        fh.write(struct.pack("<I", _count_layers(workflow)))
+        for fwd in workflow.forwards:
+            if isinstance(fwd, All2All):
+                w = np.asarray(fwd.weights.mem, np.float32)
+                b = (np.asarray(fwd.bias.mem, np.float32)
+                     if fwd.include_bias else None)
+                act = ("linear" if isinstance(fwd, All2AllSoftmax)
+                       else fwd.ACTIVATION.name)
+                _pack_layer(fh, KIND["fc"], ACT[act],
+                            [w.shape[0], w.shape[1]], w, b)
+                if isinstance(fwd, All2AllSoftmax):
+                    _pack_layer(fh, KIND["softmax"], 0, [])
+            elif isinstance(fwd, Conv):
+                w = np.asarray(fwd.weights.mem, np.float32)
+                b = (np.asarray(fwd.bias.mem, np.float32)
+                     if fwd.include_bias else None)
+                kh, kw, cin, cout = w.shape
+                (sh, sw), (ph, pw) = fwd.sliding, fwd.padding
+                _pack_layer(fh, KIND["conv"], ACT[fwd.ACTIVATION.name],
+                            [kh, kw, cin, cout, sh, sw, ph, pw], w, b)
+            elif isinstance(fwd, pool_units.Pooling):
+                avg = isinstance(fwd, pool_units.AvgPooling)
+                (kh, kw) = fwd.ksize
+                (sh, sw), (ph, pw) = fwd.sliding, fwd.padding
+                _pack_layer(fh, KIND["avg_pool" if avg else "max_pool"],
+                            0, [kh, kw, 0, 0, sh, sw, ph, pw])
+            elif isinstance(fwd, LRNormalizerForward):
+                _pack_layer(fh, KIND["lrn"], 0, [fwd.n],
+                            np.asarray([fwd.alpha, fwd.beta, fwd.k],
+                                       np.float32))
+            elif isinstance(fwd, DropoutForward):
+                _pack_layer(fh, KIND["dropout"], 0, [])
+            elif isinstance(fwd, act_units.ActivationForward):
+                name = fwd.ACTIVATION.name
+                if name not in ACT:
+                    raise NotImplementedError(
+                        f"native engine has no activation {name!r}")
+                _pack_layer(fh, KIND["activation"], ACT[name], [])
+            else:
+                raise NotImplementedError(
+                    f"export does not cover {type(fwd).__name__}")
+    return path
+
+
+def _count_layers(workflow) -> int:
+    from .nn.all2all import All2AllSoftmax
+    n = len(workflow.forwards)
+    n += sum(1 for f in workflow.forwards
+             if isinstance(f, All2AllSoftmax))   # fused softmax head
+    return n
+
+
+class NativeEngine:
+    """ctypes wrapper over libznicz_infer.so (builds it on first use)."""
+
+    def __init__(self, lib_path: str | None = None):
+        self.lib = ctypes.CDLL(lib_path or build_native())
+        self.lib.zn_load.restype = ctypes.c_void_p
+        self.lib.zn_load.argtypes = [ctypes.c_char_p]
+        self.lib.zn_free.argtypes = [ctypes.c_void_p]
+        self.lib.zn_n_layers.argtypes = [ctypes.c_void_p]
+        self.lib.zn_infer.restype = ctypes.c_int64
+        self.lib.zn_infer.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64]
+
+    def load(self, path: str) -> "NativeModel":
+        handle = self.lib.zn_load(path.encode())
+        if not handle:
+            raise IOError(f"native engine failed to load {path!r}")
+        return NativeModel(self, handle)
+
+
+class NativeModel:
+    def __init__(self, engine: NativeEngine, handle):
+        self.engine = engine
+        self.handle = handle
+
+    @property
+    def n_layers(self) -> int:
+        return self.engine.lib.zn_n_layers(self.handle)
+
+    def infer(self, x: np.ndarray, out_features: int) -> np.ndarray:
+        """x: (B, H, W, C) or (B, F) float32 → (B, out_features)."""
+        x = np.ascontiguousarray(x, np.float32)
+        if x.ndim == 2:
+            b, f = x.shape
+            shape = (b, 1, 1, f)
+        elif x.ndim == 4:
+            shape = x.shape
+        else:
+            raise ValueError(f"expected 2-D or 4-D input, got {x.shape}")
+        out = np.empty(shape[0] * out_features, np.float32)
+        n = self.engine.lib.zn_infer(
+            self.handle,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            *[ctypes.c_int64(int(d)) for d in shape],
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(out.size))
+        if n < 0:
+            raise RuntimeError("native inference failed")
+        if n != out.size:
+            raise RuntimeError(
+                f"native engine produced {n} floats, expected {out.size} "
+                "(wrong out_features?)")
+        return out.reshape(shape[0], out_features)
+
+    def __del__(self):
+        try:
+            self.engine.lib.zn_free(self.handle)
+        except Exception:
+            pass
+
+
+def build_native(force: bool = False) -> str:
+    """make -C native; returns the .so path."""
+    so = os.path.join(_NATIVE_DIR, "libznicz_infer.so")
+    src = os.path.join(_NATIVE_DIR, "znicz_infer.cpp")
+    if force or not os.path.exists(so) \
+            or os.path.getmtime(so) < os.path.getmtime(src):
+        subprocess.run(["make", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True)
+    return so

@@ -1,0 +1,137 @@
+"""Genetic hyperparameter search over the config tree.
+
+Parity target: the reference ``veles/genetics/`` (mount empty — surveyed
+contract, SURVEY.md §2.1 Genetics row: chromosome = config values,
+fitness = workflow result; the genetics module mutated config leaves and
+relaunched workflows).
+
+TPU-first simplification: the reference forked whole launcher processes
+per individual; here an evaluation is a plain callable (build + train a
+workflow, return fitness), so populations can also be scored in-process
+— on TPU the expensive part is the jitted training itself, and config
+changes that keep shapes static reuse the compile cache across
+individuals.  All randomness draws from the seeded PRNG streams."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from . import prng
+from .config import Config, root
+
+
+@dataclasses.dataclass
+class Gene:
+    """One evolvable config leaf."""
+
+    path: str                    # dotted path under the tree root
+    lo: float
+    hi: float
+    is_int: bool = False
+
+    def clip(self, v: float):
+        v = float(np.clip(v, self.lo, self.hi))
+        return int(round(v)) if self.is_int else v
+
+    def sample(self, gen) -> float:
+        return self.clip(gen.uniform(self.lo, self.hi))
+
+
+@dataclasses.dataclass
+class Individual:
+    values: list
+    fitness: float | None = None
+
+
+class GeneticOptimizer:
+    """Tournament-selection GA with blend crossover + gaussian mutation.
+
+    ``evaluate(tree)`` receives a cloned config tree with the
+    chromosome's values applied and returns a fitness (HIGHER is better —
+    negate a loss).  The best tree is re-applied to the live ``root`` at
+    the end (the reference applied the winning config the same way)."""
+
+    def __init__(self, genes, evaluate, population_size=12,
+                 generations=8, tournament=3, crossover_rate=0.7,
+                 mutation_rate=0.15, mutation_sigma=0.2, elite=1,
+                 tree: Config = root, stream="genetics"):
+        self.genes = list(genes)
+        self.evaluate = evaluate
+        self.population_size = population_size
+        self.generations = generations
+        self.tournament = tournament
+        self.crossover_rate = crossover_rate
+        self.mutation_rate = mutation_rate
+        self.mutation_sigma = mutation_sigma
+        self.elite = elite
+        self.tree = tree
+        self.gen = prng.get(stream)
+        self.history: list[dict] = []
+        self.best: Individual | None = None
+
+    # -- chromosome ↔ config ------------------------------------------------
+    def apply(self, values, tree: Config) -> Config:
+        for gene, v in zip(self.genes, values):
+            tree.set_path(gene.path, v)
+        return tree
+
+    def _fitness(self, ind: Individual) -> float:
+        if ind.fitness is None:
+            tree = self.tree.clone()
+            self.apply(ind.values, tree)
+            ind.fitness = float(self.evaluate(tree))
+        return ind.fitness
+
+    # -- GA operators --------------------------------------------------------
+    def _select(self, population) -> Individual:
+        picks = [population[self.gen.randint(0, len(population))]
+                 for _ in range(self.tournament)]
+        return max(picks, key=lambda i: i.fitness)
+
+    def _crossover(self, a: Individual, b: Individual) -> list:
+        if self.gen.uniform(0, 1) > self.crossover_rate:
+            return list(a.values)
+        mix = self.gen.uniform(0, 1, len(self.genes))
+        return [g.clip(m * va + (1 - m) * vb)
+                for g, va, vb, m in zip(self.genes, a.values, b.values,
+                                        mix)]
+
+    def _mutate(self, values) -> list:
+        out = []
+        for g, v in zip(self.genes, values):
+            if self.gen.uniform(0, 1) < self.mutation_rate:
+                span = g.hi - g.lo
+                v = g.clip(v + self.gen.normal(0.0,
+                                               self.mutation_sigma * span))
+            out.append(v)
+        return out
+
+    # -- main loop -----------------------------------------------------------
+    def run(self) -> Individual:
+        population = [Individual([g.sample(self.gen)
+                                  for g in self.genes])
+                      for _ in range(self.population_size)]
+        for generation in range(self.generations):
+            for ind in population:
+                self._fitness(ind)
+            population.sort(key=lambda i: -i.fitness)
+            self.best = population[0]
+            self.history.append({
+                "generation": generation,
+                "best_fitness": population[0].fitness,
+                "best_values": list(population[0].values),
+                "mean_fitness": float(np.mean(
+                    [i.fitness for i in population]))})
+            if generation == self.generations - 1:
+                break
+            nxt = [Individual(list(i.values), i.fitness)
+                   for i in population[:self.elite]]
+            while len(nxt) < self.population_size:
+                child = self._crossover(self._select(population),
+                                        self._select(population))
+                nxt.append(Individual(self._mutate(child)))
+            population = nxt
+        self.apply(self.best.values, self.tree)   # install the winner
+        return self.best

@@ -1,0 +1,138 @@
+"""Launcher: the two-file workflow+config entry point.
+
+Parity target: the reference ``veles/launcher.py`` + ``veles/__main__.py``
+(mount empty — surveyed contract, SURVEY.md §2.1 Launcher/CLI row, §3.1
+call stack): ``python -m veles <workflow.py> <config.py>`` with
+standalone / master / slave modes, ``--snapshot`` resume, backend choice,
+and CLI config-path overrides.
+
+TPU-first redesign (SURVEY.md §2.4): the master/slave star (Twisted +
+ZeroMQ job protocol) collapses into **multi-process SPMD** — every
+process runs the same program over a global device mesh, coordinated by
+``jax.distributed.initialize`` (DCN); gradient aggregation is the mesh
+all-reduce inside the fused step, not a job queue.  So the launcher's
+"distributed mode" is a coordinator address + process count/index, not a
+role split."""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import inspect
+import os
+import runpy
+
+from .backends import Device
+from .config import apply_overrides, root
+from . import prng
+
+
+def load_workflow_module(spec: str):
+    """Import a workflow module from a file path or dotted module name."""
+    if spec.endswith(".py") or os.path.sep in spec:
+        name = os.path.splitext(os.path.basename(spec))[0]
+        mod_spec = importlib.util.spec_from_file_location(name, spec)
+        if mod_spec is None:
+            raise ImportError(f"cannot load workflow file {spec!r}")
+        module = importlib.util.module_from_spec(mod_spec)
+        mod_spec.loader.exec_module(module)
+        return module
+    return importlib.import_module(spec)
+
+
+def exec_config_file(path: str) -> None:
+    """Run a config file: plain Python mutating the global ``root``
+    (reference config-file UX)."""
+    runpy.run_path(path, init_globals={"root": root})
+
+
+class Launcher:
+    """Builds and runs one workflow according to CLI-ish options."""
+
+    def __init__(self, workflow: str, config: str | None = None,
+                 backend: str = "auto", snapshot: str | None = None,
+                 epochs: int | None = None, fused: bool = False,
+                 seed: int | None = None, overrides=(),
+                 coordinator: str | None = None, num_processes: int = 1,
+                 process_id: int = 0):
+        self.workflow_spec = workflow
+        self.config_path = config
+        self.backend = backend
+        self.snapshot = snapshot
+        self.epochs = epochs
+        self.fused = fused
+        self.seed = seed
+        self.overrides = list(overrides)
+        self.coordinator = coordinator
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self.workflow = None
+
+    # -- distributed bootstrap (replaces Server/Client) --------------------
+    def init_distributed(self) -> None:
+        if self.coordinator is None:
+            return
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator,
+            num_processes=self.num_processes,
+            process_id=self.process_id)
+
+    def build(self):
+        """Import module + config, seed, construct the workflow."""
+        self.init_distributed()
+        if self.config_path:
+            exec_config_file(self.config_path)
+        apply_overrides(self.overrides)
+        prng.seed_all(self.seed if self.seed is not None
+                      else root.common.get("seed", 1234))
+        module = load_workflow_module(self.workflow_spec)
+        self.module = module
+        if not hasattr(module, "run"):
+            raise AttributeError(
+                f"workflow module {self.workflow_spec!r} defines no "
+                "run() entry point")
+        return module
+
+    def run(self):
+        """Execute end-to-end; returns the finished workflow."""
+        module = self.build()
+        device = Device.create(self.backend)
+        sig = inspect.signature(module.run)
+        kwargs = {}
+        if "device" in sig.parameters:
+            kwargs["device"] = device
+        if "epochs" in sig.parameters and self.epochs is not None:
+            kwargs["epochs"] = self.epochs
+        if "fused" in sig.parameters:
+            kwargs["fused"] = self.fused
+        if self.snapshot:
+            # resume: build + initialize without training, load arrays,
+            # then continue — run(load, main) style split
+            wf = self._build_workflow_only(module, device)
+            from .snapshotter import SnapshotterToFile
+            SnapshotterToFile.load(wf, self.snapshot)
+            if self.epochs is not None:
+                wf.decision.max_epochs = self.epochs
+            if self.fused and hasattr(wf, "run_fused"):
+                wf.run_fused()
+            else:
+                wf.run()
+            self.workflow = wf
+            return wf
+        self.workflow = module.run(**kwargs)
+        return self.workflow
+
+    def _build_workflow_only(self, module, device):
+        """Construct + initialize the module's workflow class without
+        running it (the resume path needs state loaded in between)."""
+        for name in dir(module):
+            obj = getattr(module, name)
+            if (isinstance(obj, type) and name.endswith("Workflow")
+                    and getattr(obj, "__module__", "") == module.__name__):
+                wf = obj()
+                wf.initialize(device=device)
+                return wf
+        raise AttributeError(
+            f"workflow module {self.workflow_spec!r} has no *Workflow "
+            "class to resume into")

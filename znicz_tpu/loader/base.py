@@ -90,6 +90,17 @@ class Loader(Unit):
             for off in range(0, n, self.max_minibatch_size):
                 self._order.append((klass, off))
         self._pos = 0
+        self._plan_epoch = self.epoch_number
+
+    def train_permutation(self, epoch: int) -> np.ndarray:
+        """Shuffled global train indices for ``epoch`` — the PUBLIC hook
+        the fused paths use to consume the exact shuffle stream the tick
+        loop would (unit-graph RNG parity); rebuilds the plan when asked
+        for an epoch the current plan doesn't cover."""
+        if epoch != getattr(self, "_plan_epoch", None):
+            self.epoch_number = epoch
+            self._build_epoch_plan()
+        return self._shuffled[TRAIN]
 
     def run(self) -> None:
         if self._pos >= len(self._order):          # new epoch

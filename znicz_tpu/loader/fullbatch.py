@@ -58,11 +58,16 @@ class FullBatchLoader(Loader):
             self.normalizer = create_normalizer(
                 self.normalization_type, **self.normalization_parameters)
             self.normalizer.fit(self.original_data.mem)
-        elif getattr(self, "_normalized", False):
-            return   # re-initialize (device rebind): data already mapped
+        elif getattr(self, "_normalized_id", None) \
+                == id(self.original_data.mem):
+            # re-initialize with load_data() keeping the same array →
+            # already transformed; a reload installs a fresh raw array
+            # (different id) and must be re-normalized with the fitted
+            # statistics
+            return
         self.original_data.mem = self.normalizer.apply(
             self.original_data.mem)
-        self._normalized = True
+        self._normalized_id = id(self.original_data.mem)
 
     def fill_minibatch(self, indices: np.ndarray, klass: int) -> None:
         size = len(indices)

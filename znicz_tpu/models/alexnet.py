@@ -70,7 +70,7 @@ def make_layers(n_classes: int = 1000, lr: float = 0.01,
     ]
 
 
-root.alexnet.update({
+root.alexnet.setdefaults({
     "minibatch_size": 128,
     "size": 227,
     "n_classes": 1000,

@@ -23,7 +23,7 @@ from ..loader.fullbatch import FullBatchLoaderMSE
 from ..standard_workflow import StandardWorkflow
 from .mnist import MnistLoader
 
-root.mnist_ae.update({
+root.mnist_ae.setdefaults({
     "minibatch_size": 100,
     "layers": [
         # conv-MSE gradients sum over all 28×28 output positions, so the

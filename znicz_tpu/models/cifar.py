@@ -29,7 +29,7 @@ from ..config import root
 from ..loader.fullbatch import FullBatchLoader
 from ..standard_workflow import StandardWorkflow
 
-root.cifar.update({
+root.cifar.setdefaults({
     "minibatch_size": 100,
     "layers": [
         {"type": "conv_tanh",

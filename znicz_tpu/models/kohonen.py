@@ -26,7 +26,7 @@ from ..nn.kohonen import (KohonenDecision, KohonenForward, KohonenTrainer,
                           make_train_only_gate)
 from ..ops import kohonen as som_ops
 
-root.kohonen.update({
+root.kohonen.setdefaults({
     "minibatch_size": 100,
     "shape": (8, 8),
     "learning_rate": 0.5,

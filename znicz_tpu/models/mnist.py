@@ -27,7 +27,7 @@ from ..config import root
 from ..loader.fullbatch import FullBatchLoader
 from ..standard_workflow import StandardWorkflow
 
-root.mnist.update({
+root.mnist.setdefaults({
     "minibatch_size": 100,
     "layers": [
         {"type": "all2all_tanh", "->": {"output_sample_shape": 100},

@@ -34,7 +34,7 @@ class ActivationForward(Forward):
             self.output.mem = np.zeros(self.input.shape, np.float32)
         self.init_vectors(self.output)
         act = self.ACTIVATION
-        self._fwd_fn = lambda x: act.fwd(x, jnp)
+        self._fwd_fn = lambda x: activations.act_fwd(act.name, x)
 
     def numpy_run(self) -> None:
         self.output.mem = self.ACTIVATION.fwd(self.input.mem, np)
@@ -68,7 +68,7 @@ class ActivationBackward(GradientDescentBase):
         if not hasattr(self, "_bwd_fn"):
             act = self.ACTIVATION
             self._bwd_fn = self.jit(
-                lambda e, y, x: act.bwd(e, y, x, jnp))
+                lambda e, y, x: activations.act_bwd(act.name, e, y, x))
         self.err_input.devmem = self._bwd_fn(
             self.err_output.devmem, self.output.devmem,
             self.input.devmem if self.ACTIVATION.needs_input else None)

@@ -76,13 +76,22 @@ class DropoutForward(Forward):
             self.output.devmem = self.input.devmem
             return
         if not hasattr(self, "_fwd_fn"):
+            from ..ops import tuning
             seed, ratio = self.rng.stream_seed, self.dropout_ratio
             shape = tuple(self.input.shape)
+            use_pallas = tuning.use_pallas()
 
             def fwd(x, counters):
                 mask = drop_ops.make_mask(seed, counters, shape, ratio,
                                           jnp)
-                return drop_ops.xla_dropout(x, mask), mask
+                if use_pallas:
+                    # fused mask-gen+apply kernel; the hash inside is
+                    # bit-identical to make_mask, so mask stays the
+                    # published contract for DropoutBackward
+                    y = drop_ops.dropout_apply(x, seed, counters, ratio)
+                else:
+                    y = drop_ops.xla_dropout(x, mask)
+                return y, mask
 
             self._fwd_fn = fwd
         y, mask = self.jit(self._fwd_fn)(

@@ -127,4 +127,8 @@ class LearningRateAdjust(Unit):
         for g, (lr0, lrb0) in zip(self._gds, self._base):
             g.learning_rate = self.policy(lr0, it)
             g.learning_rate_bias = self.bias_policy(lrb0, it)
-        self._minibatches += 1
+        loader = getattr(self.workflow, "loader", None)
+        from ..loader.base import TRAIN
+        if loader is None or loader.minibatch_class == TRAIN:
+            # count only the ticks the gated GD units actually train on
+            self._minibatches += 1

@@ -36,7 +36,7 @@ class LRNormalizerForward(Forward):
             self.denom.mem = np.zeros(self.input.shape, np.float32)
         self.init_vectors(self.output, self.denom)
         n, a, b, k = self.n, self.alpha, self.beta, self.k
-        self._fwd_fn = lambda x: lrn_ops.xla_lrn(x, n, a, b, k)
+        self._fwd_fn = lambda x: lrn_ops.lrn(x, n, a, b, k)
 
     def numpy_run(self) -> None:
         y, d = lrn_ops.np_lrn(self.input.mem, self.n, self.alpha,
@@ -74,6 +74,6 @@ class LRNormalizerBackward(GradientDescentBase):
         if not hasattr(self, "_bwd_fn"):
             n, a, b, k = self.n, self.alpha, self.beta, self.k
             self._bwd_fn = self.jit(
-                lambda e, x, d: lrn_ops.xla_gd_lrn(e, x, d, n, a, b, k))
+                lambda e, x, d: lrn_ops.gd_lrn(e, x, d, n, a, b, k))
         self.err_input.devmem = self._bwd_fn(
             self.err_output.devmem, self.input.devmem, self.denom.devmem)

@@ -79,7 +79,7 @@ class _OffsetPooling(Pooling):
 class MaxPooling(_OffsetPooling):
     MAPPING = ("max_pooling",)
     _np_fn = staticmethod(pool_ops.np_max_pooling)
-    _xla_fn = staticmethod(pool_ops.xla_max_pooling)
+    _xla_fn = staticmethod(pool_ops.max_pooling)
 
     def numpy_run(self) -> None:
         y, idx = self._np_fn(self.input.mem, self.ksize, self.sliding,
@@ -100,7 +100,7 @@ class MaxAbsPooling(MaxPooling):
 
     MAPPING = ("maxabs_pooling",)
     _np_fn = staticmethod(pool_ops.np_maxabs_pooling)
-    _xla_fn = staticmethod(pool_ops.xla_maxabs_pooling)
+    _xla_fn = staticmethod(pool_ops.maxabs_pooling)
 
 
 class AvgPooling(Pooling):

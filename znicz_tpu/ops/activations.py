@@ -28,6 +28,27 @@ _TANH_D1 = TANH_A * TANH_B            # 1.14381894
 _TANH_D2 = TANH_B / TANH_A            # 0.388484177 = d1 / a²
 
 
+def act_fwd(name: str, x):
+    """Dispatching elementwise forward for device arrays: the Pallas
+    tiled kernel on TPU (reference elementwise-kernel parity, SURVEY.md
+    §2.3 row 6), plain jnp (XLA-fused) elsewhere."""
+    from . import tuning
+    if tuning.use_pallas():
+        from . import elementwise
+        return elementwise.pallas_act_fwd(name, x)
+    import jax.numpy as jnp
+    return BY_NAME[name].fwd(x, jnp)
+
+
+def act_bwd(name: str, err_y, y, x=None):
+    from . import tuning
+    if tuning.use_pallas():
+        from . import elementwise
+        return elementwise.pallas_act_bwd(name, err_y, y, x)
+    import jax.numpy as jnp
+    return BY_NAME[name].bwd(err_y, y, x, jnp)
+
+
 class Activation:
     """Namespace-style activation definition."""
 

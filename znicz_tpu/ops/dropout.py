@@ -43,3 +43,17 @@ def np_gd_dropout(err, mask):
 
 def xla_gd_dropout(err, mask):
     return err * mask
+
+
+def dropout_apply(x, stream_seed: int, counters, ratio: float):
+    """Dispatching fused mask-gen + apply: one Pallas HBM pass on TPU
+    (the in-kernel hash is bit-identical to :func:`make_mask`), the
+    mask-multiply formulation elsewhere.  Works for the backward pass
+    too — ``err ⊙ mask`` is just this op applied to ``err``."""
+    from . import tuning
+    if tuning.use_pallas():
+        from . import elementwise
+        return elementwise.pallas_dropout(x, stream_seed,
+                                          tuple(counters), ratio)
+    return x * make_mask(stream_seed, counters, tuple(x.shape), ratio,
+                         jnp)

@@ -1,0 +1,315 @@
+"""Pallas elementwise kernels: activations, dropout, LRN, pool-select.
+
+Parity target: the remaining hand-written kernel rows of SURVEY.md §2.3 —
+activation elementwise kernels (row 6), ``dropout.cl/.cu`` + device RNG
+(row 7), ``normalization.cl/.cu`` LRN (row 4), and the select/argmax core
+of ``pooling.cl/.cu`` (row 3).  The matmul/conv/softmax/update rows live
+in their own modules.
+
+Design: one shared flatten-to-(rows, 128) tiling for rank-free
+elementwise work (VPU lanes on the minor dim); LRN keeps channels on the
+lane axis and does its n-tap window sum on the loaded block; dropout
+evaluates the counter-RNG hash (``ops.rngbits`` murmur3 finalizer —
+bit-identical to the numpy golden path) *inside* the kernel from the
+block's global element offset, so mask generation + scale + apply is one
+HBM pass; pooling's winner select consumes XLA-stacked window taps
+(T, rows, C) and emits value + dense slot index in one pass (the
+strided tap gather/scatter stays in XLA — data movement the compiler
+pipelines well, SURVEY.md §7 hard part (a))."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import activations, rngbits, tuning
+
+_LANES = 128
+
+
+def _flatten_blocks(n: int, block_rows: int = 256):
+    """(rows, padded_rows, block_rows) for an n-element flat tensor laid
+    out (rows, 128)."""
+    npad = tuning.round_up(max(n, _LANES), _LANES)
+    rows = npad // _LANES
+    br = min(block_rows, tuning.round_up(rows, 8))
+    rows_pad = tuning.round_up(rows, br)
+    return rows, rows_pad, br, npad
+
+
+def _to_rows(a, npad, rows_pad):
+    flat = jnp.ravel(a)
+    flat = jnp.pad(flat, (0, npad - flat.size))
+    a2 = flat.reshape(-1, _LANES)
+    if rows_pad != a2.shape[0]:
+        a2 = jnp.pad(a2, ((0, rows_pad - a2.shape[0]), (0, 0)))
+    return a2
+
+
+# -- activations -----------------------------------------------------------
+def _act_fwd_kernel(x_ref, o_ref, *, name):
+    act = activations.BY_NAME[name]
+    o_ref[:] = act.fwd(x_ref[:].astype(jnp.float32), jnp).astype(
+        o_ref.dtype)
+
+
+def _act_bwd_kernel(e_ref, y_ref, x_ref, o_ref, *, name):
+    act = activations.BY_NAME[name]
+    x = x_ref[:].astype(jnp.float32) if x_ref is not None else None
+    o_ref[:] = act.bwd(e_ref[:].astype(jnp.float32),
+                       y_ref[:].astype(jnp.float32), x, jnp).astype(
+        o_ref.dtype)
+
+
+def _lastaxis_blocks(x):
+    """(x2, rows, rows_pad, br, c): last axis preserved as the lane dim —
+    required by position-dependent activations (sincos's even/odd lanes);
+    used whenever the activation's math references the last-axis index."""
+    c = x.shape[-1]
+    rows = int(x.size // c)
+    x2 = x.reshape(rows, c)
+    br = min(256, tuning.round_up(rows, 8))
+    rows_pad = tuning.round_up(rows, br)
+    if rows_pad != rows:
+        x2 = jnp.pad(x2, ((0, rows_pad - rows), (0, 0)))
+    return x2, rows, rows_pad, br, c
+
+
+#: Activations whose math depends on the last-axis position.
+_POSITIONAL = ("sincos",)
+
+
+@functools.partial(jax.jit, static_argnames=("name",))
+def pallas_act_fwd(name: str, x):
+    """y = act(x) as one tiled VPU pass (reference elementwise kernels)."""
+    if name in _POSITIONAL:
+        x2, rows, rows_pad, br, c = _lastaxis_blocks(x)
+        y = pl.pallas_call(
+            functools.partial(_act_fwd_kernel, name=name),
+            grid=(rows_pad // br,),
+            in_specs=[pl.BlockSpec((br, c), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((br, c), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows_pad, c), x.dtype),
+            interpret=tuning.interpret_mode(),
+        )(x2)
+        return y[:rows].reshape(x.shape)
+    n = x.size
+    rows, rows_pad, br, npad = _flatten_blocks(n)
+    x2 = _to_rows(x, npad, rows_pad)
+    y = pl.pallas_call(
+        functools.partial(_act_fwd_kernel, name=name),
+        grid=(rows_pad // br,),
+        in_specs=[pl.BlockSpec((br, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((br, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, _LANES), x.dtype),
+        interpret=tuning.interpret_mode(),
+    )(x2)
+    return y.reshape(-1)[:n].reshape(x.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("name",))
+def pallas_act_bwd(name: str, err_y, y, x=None):
+    """err_x from (err_y, y[, x]) — the unit-zoo derivative convention."""
+    act = activations.BY_NAME[name]
+    if name in _POSITIONAL:
+        e2, rows, rows_pad, br, c = _lastaxis_blocks(err_y)
+        y2 = _lastaxis_blocks(y)[0]
+        x2 = _lastaxis_blocks(x)[0]
+        spec = pl.BlockSpec((br, c), lambda i: (i, 0))
+        out = pl.pallas_call(
+            functools.partial(_act_bwd_kernel, name=name),
+            grid=(rows_pad // br,),
+            in_specs=[spec, spec, spec], out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((rows_pad, c), err_y.dtype),
+            interpret=tuning.interpret_mode(),
+        )(e2, y2, x2)
+        return out[:rows].reshape(err_y.shape)
+    n = err_y.size
+    rows, rows_pad, br, npad = _flatten_blocks(n)
+    e2 = _to_rows(err_y, npad, rows_pad)
+    y2 = _to_rows(y, npad, rows_pad)
+    spec = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
+    if act.needs_input:
+        if x is None:
+            raise ValueError(f"{name} backward needs the forward input")
+        x2 = _to_rows(x, npad, rows_pad)
+        kernel = functools.partial(_act_bwd_kernel, name=name)
+        out = pl.pallas_call(
+            kernel, grid=(rows_pad // br,),
+            in_specs=[spec, spec, spec], out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((rows_pad, _LANES),
+                                           err_y.dtype),
+            interpret=tuning.interpret_mode(),
+        )(e2, y2, x2)
+    else:
+        def kernel(e_ref, y_ref, o_ref):
+            _act_bwd_kernel(e_ref, y_ref, None, o_ref, name=name)
+        out = pl.pallas_call(
+            kernel, grid=(rows_pad // br,),
+            in_specs=[spec, spec], out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((rows_pad, _LANES),
+                                           err_y.dtype),
+            interpret=tuning.interpret_mode(),
+        )(e2, y2)
+    return out.reshape(-1)[:n].reshape(err_y.shape)
+
+
+# -- dropout ---------------------------------------------------------------
+def _dropout_kernel(key_ref, x_ref, o_ref, *, ratio, br):
+    i = pl.program_id(0)
+    key = key_ref[0]
+    base = (i * br * _LANES)
+    idx = (jax.lax.broadcasted_iota(jnp.uint32, x_ref.shape, 0) * _LANES
+           + jax.lax.broadcasted_iota(jnp.uint32, x_ref.shape, 1)
+           + jnp.uint32(base))
+    # identical math to rngbits.uniform01 → bit-identical masks
+    h = rngbits._mix(idx * jnp.uint32(rngbits._C2) ^ key, jnp)
+    u = (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(
+        1.0 / (1 << 24))
+    keep = (u >= jnp.float32(ratio)).astype(jnp.float32)
+    scale = jnp.float32(1.0 / (1.0 - ratio))
+    o_ref[:] = (x_ref[:].astype(jnp.float32) * keep * scale).astype(
+        o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("ratio", "seed"))
+def pallas_dropout(x, seed: int, counters, ratio: float):
+    """Fused mask-gen + scale + apply in one HBM pass (reference
+    dropout kernel + device RNG, with the counter-RNG determinism fix)."""
+    key = rngbits.fold(seed, *counters, xp=jnp).reshape(1)
+    n = x.size
+    rows, rows_pad, br, npad = _flatten_blocks(n)
+    x2 = _to_rows(x, npad, rows_pad)
+    from jax.experimental.pallas import tpu as pltpu
+    out = pl.pallas_call(
+        functools.partial(_dropout_kernel, ratio=ratio, br=br),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(rows_pad // br,),
+            in_specs=[pl.BlockSpec((br, _LANES), lambda i, k: (i, 0))],
+            out_specs=pl.BlockSpec((br, _LANES), lambda i, k: (i, 0))),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, _LANES), x.dtype),
+        interpret=tuning.interpret_mode(),
+    )(key, x2)
+    return out.reshape(-1)[:n].reshape(x.shape)
+
+
+# -- LRN -------------------------------------------------------------------
+def _lrn_fwd_kernel(x_ref, y_ref, d_ref, *, n, alpha, beta, k):
+    x = x_ref[:].astype(jnp.float32)
+    c = x.shape[-1]
+    half_lo, half_hi = (n - 1) // 2, n // 2
+    sq = x * x
+    pad = jnp.pad(sq, ((0, 0), (half_lo, half_hi)))
+    acc = pad[:, 0:c]
+    for i in range(1, n):
+        acc = acc + pad[:, i:i + c]
+    d = k + alpha * acc
+    d_ref[:] = d
+    y_ref[:] = (x * d ** (-beta)).astype(y_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "alpha", "beta", "k"))
+def pallas_lrn(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    """Cross-channel LRN fwd: rows = every spatial position, channels on
+    the lane axis; window sum + powers in one VMEM pass → (y, denom)."""
+    c = x.shape[-1]
+    lead = x.shape[:-1]
+    rows = int(x.size // c)
+    x2 = x.reshape(rows, c)
+    br = min(256, tuning.round_up(rows, 8))
+    rows_pad = tuning.round_up(rows, br)
+    if rows_pad != rows:
+        x2 = jnp.pad(x2, ((0, rows_pad - rows), (0, 0)))
+    y, d = pl.pallas_call(
+        functools.partial(_lrn_fwd_kernel, n=n, alpha=alpha, beta=beta,
+                          k=k),
+        grid=(rows_pad // br,),
+        in_specs=[pl.BlockSpec((br, c), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((br, c), lambda i: (i, 0)),
+                   pl.BlockSpec((br, c), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows_pad, c), x.dtype),
+                   jax.ShapeDtypeStruct((rows_pad, c), jnp.float32)],
+        interpret=tuning.interpret_mode(),
+    )(x2)
+    return (y[:rows].reshape(*lead, c), d[:rows].reshape(*lead, c))
+
+
+def _lrn_bwd_kernel(e_ref, x_ref, d_ref, o_ref, *, n, alpha, beta):
+    e = e_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.float32)
+    d = d_ref[:].astype(jnp.float32)
+    c = x.shape[-1]
+    half_lo, half_hi = (n - 1) // 2, n // 2
+    q = e * x * d ** (-beta - 1.0)
+    pad = jnp.pad(q, ((0, 0), (half_lo, half_hi)))
+    acc = pad[:, 0:c]
+    for i in range(1, n):
+        acc = acc + pad[:, i:i + c]
+    o_ref[:] = (e * d ** (-beta) - 2.0 * alpha * beta * x * acc).astype(
+        o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "alpha", "beta", "k"))
+def pallas_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    c = x.shape[-1]
+    lead = x.shape[:-1]
+    rows = int(x.size // c)
+    br = min(256, tuning.round_up(rows, 8))
+    rows_pad = tuning.round_up(rows, br)
+
+    def to2(a):
+        a2 = a.reshape(rows, c)
+        return jnp.pad(a2, ((0, rows_pad - rows), (0, 0))) \
+            if rows_pad != rows else a2
+    spec = pl.BlockSpec((br, c), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_lrn_bwd_kernel, n=n, alpha=alpha, beta=beta),
+        grid=(rows_pad // br,),
+        in_specs=[spec, spec, spec], out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((rows_pad, c), jnp.float32),
+        interpret=tuning.interpret_mode(),
+    )(to2(err), to2(x), to2(d))
+    return out[:rows].reshape(*lead, c)
+
+
+# -- pooling winner select -------------------------------------------------
+def _pool_select_kernel(taps_ref, y_ref, idx_ref, *, n_taps, use_abs):
+    best_val = taps_ref[0]
+    best = jnp.abs(best_val) if use_abs else best_val
+    idx = jnp.zeros(best.shape, jnp.int32)
+    for t in range(1, n_taps):
+        sl = taps_ref[t]
+        score = jnp.abs(sl) if use_abs else sl
+        take = score > best
+        best = jnp.where(take, score, best)
+        best_val = jnp.where(take, sl, best_val)
+        idx = jnp.where(take, jnp.int32(t), idx)
+    y_ref[:] = best_val.astype(y_ref.dtype)
+    idx_ref[:] = idx
+
+
+@functools.partial(jax.jit, static_argnames=("use_abs",))
+def pallas_pool_select(taps, use_abs: bool = False):
+    """(value, window-slot index) over stacked window taps (T, rows, C) —
+    the select/argmax core of the reference pooling kernel; tap stacking
+    and the backward scatter stay in XLA (SURVEY.md §7 hard part (a))."""
+    t, rows, c = taps.shape
+    br = min(256, tuning.round_up(rows, 8))
+    rows_pad = tuning.round_up(rows, br)
+    if rows_pad != rows:
+        taps = jnp.pad(taps, ((0, 0), (0, rows_pad - rows), (0, 0)))
+    y, idx = pl.pallas_call(
+        functools.partial(_pool_select_kernel, n_taps=t, use_abs=use_abs),
+        grid=(rows_pad // br,),
+        in_specs=[pl.BlockSpec((t, br, c), lambda i: (0, i, 0))],
+        out_specs=[pl.BlockSpec((br, c), lambda i: (i, 0)),
+                   pl.BlockSpec((br, c), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows_pad, c), taps.dtype),
+                   jax.ShapeDtypeStruct((rows_pad, c), jnp.int32)],
+        interpret=tuning.interpret_mode(),
+    )(taps)
+    return y[:rows], idx[:rows]

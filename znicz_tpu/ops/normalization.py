@@ -74,3 +74,20 @@ def np_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
 
 def xla_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
     return _bwd(err, x, d, n, alpha, beta, jnp)
+
+
+# -- dispatchers (Pallas kernel on TPU, XLA formulation elsewhere) ---------
+def lrn(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    from . import tuning
+    if tuning.use_pallas():
+        from . import elementwise
+        return elementwise.pallas_lrn(x, n, alpha, beta, k)
+    return xla_lrn(x, n, alpha, beta, k)
+
+
+def gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    from . import tuning
+    if tuning.use_pallas():
+        from . import elementwise
+        return elementwise.pallas_gd_lrn(err, x, d, n, alpha, beta, k)
+    return xla_gd_lrn(err, x, d, n, alpha, beta, k)

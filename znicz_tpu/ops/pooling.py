@@ -99,6 +99,36 @@ def xla_maxabs_pooling(x, ksize, stride=None, padding=0):
     return _max_pool(x, ksize, stride or ksize, padding, jnp, True)
 
 
+def _pallas_max_pool(x, ksize, stride, padding, use_abs):
+    """Stack the window taps in XLA, run the winner select in the Pallas
+    kernel (SURVEY.md §2.3 pooling row; §7 hard part (a) split)."""
+    from . import elementwise
+    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), _norm2(stride), \
+        _norm2(padding)
+    b, h, w, c = x.shape
+    oh, ow = out_size(h, kh, sh, ph), out_size(w, kw, sw, pw)
+    xpad = _pad(x, ph, pw, -np.inf if not use_abs else 0.0, jnp)
+    taps = jnp.stack(_slices(xpad, kh, kw, sh, sw, oh, ow))
+    y, idx = elementwise.pallas_pool_select(
+        taps.reshape(kh * kw, -1, c), use_abs=use_abs)
+    return y.reshape(b, oh, ow, c), idx.reshape(b, oh, ow, c)
+
+
+def max_pooling(x, ksize, stride=None, padding=0):
+    """Dispatcher: Pallas winner-select kernel on TPU, XLA otherwise."""
+    from . import tuning
+    if tuning.use_pallas():
+        return _pallas_max_pool(x, ksize, stride or ksize, padding, False)
+    return xla_max_pooling(x, ksize, stride, padding)
+
+
+def maxabs_pooling(x, ksize, stride=None, padding=0):
+    from . import tuning
+    if tuning.use_pallas():
+        return _pallas_max_pool(x, ksize, stride or ksize, padding, True)
+    return xla_maxabs_pooling(x, ksize, stride, padding)
+
+
 def _avg_pool(x, ksize, stride, padding, xp):
     (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), _norm2(stride), \
         _norm2(padding)

@@ -1,0 +1,115 @@
+"""Multi-host SPMD bootstrap + data distribution + failure recovery.
+
+Parity target: the reference's distributed runtime (SURVEY.md §2.1
+Master server / Slave client rows; §2.4; §3.2 job-loop call stack;
+§5 failure detection): a Twisted TCP + ZeroMQ master–slave star shipping
+pickled minibatches and gradients, with disconnect-requeue recovery.
+
+TPU-first redesign (the north star): every host runs the SAME program;
+``jax.distributed`` (DCN coordination service) replaces the Twisted
+control plane; the data plane is XLA collectives over ICI/DCN inside the
+compiled step — no pickled tensors, no job queue.  This module holds the
+glue the reference put in server.py/client.py:
+
+* :func:`initialize` — process bootstrap (the master/slave handshake).
+* :func:`global_mesh` — a ("data", "model") mesh over ALL processes'
+  devices (the slave roster).
+* :func:`shard_dataset` — per-process dataset slice → one global sharded
+  array (the reference's ``generate_data_for_slave`` minibatch split,
+  done once per dataset instead of per job).
+* :class:`CheckpointRecovery` — crash/preemption recovery: periodic
+  snapshots + resume (the reference's requeue becomes restart-from-
+  checkpoint, SURVEY.md §5 failure row).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from . import mesh as mesh_lib
+
+
+def initialize(coordinator: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Bootstrap multi-host JAX (idempotent).  Arguments may come from
+    the environment (JAX_COORDINATOR_ADDRESS / NUM_PROCESSES /
+    PROCESS_ID) — the launcher passes CLI flags through here."""
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator is None:
+        return   # single-process: nothing to negotiate
+    kwargs = dict(coordinator_address=coordinator)
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    jax.distributed.initialize(**kwargs)
+
+
+def global_mesh(n_model: int = 1) -> "jax.sharding.Mesh":
+    """("data", "model") mesh over every device of every process."""
+    devices = jax.devices()
+    return mesh_lib.make_mesh(n_data=len(devices) // n_model,
+                              n_model=n_model, devices=devices)
+
+
+def process_shard(n: int) -> slice:
+    """This process's contiguous row range of an n-sample dataset."""
+    p, np_ = jax.process_index(), jax.process_count()
+    per = -(-n // np_)
+    return slice(p * per, min((p + 1) * per, n))
+
+
+def shard_dataset(local_rows: np.ndarray, mesh, total_rows: int
+                  ) -> jax.Array:
+    """Assemble one global batch-sharded array from per-process rows.
+
+    ``local_rows`` are THIS process's samples (``process_shard`` of the
+    global set); the result is a global jax.Array sharded over the mesh's
+    ``data`` axis — the TPU equivalent of the master shipping each slave
+    its minibatch slice, paid once per dataset."""
+    sharding = mesh_lib.shard_batch(mesh)
+    global_shape = (total_rows,) + tuple(local_rows.shape[1:])
+    if jax.process_count() == 1:
+        return jax.device_put(local_rows, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_rows), global_shape)
+
+
+class CheckpointRecovery:
+    """Failure recovery loop: snapshot every N epochs, resume after a
+    crash (reference: master requeued a lost slave's job; with SPMD the
+    whole program restarts from the last snapshot — SURVEY.md §5)."""
+
+    def __init__(self, workflow, directory="snapshots",
+                 prefix="recovery", interval=1):
+        from ..snapshotter import SnapshotterToFile
+        self.workflow = workflow
+        self.snap = SnapshotterToFile(workflow, prefix=prefix,
+                                      directory=directory,
+                                      interval=interval)
+        # standalone use: not linked into the control graph
+        workflow.units.remove(self.snap) \
+            if self.snap in workflow.units else None
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.snap.directory,
+                            f"{self.snap.prefix}_current.npz")
+
+    def save(self) -> str:
+        """Checkpoint now (call between epochs; process 0 writes)."""
+        if jax.process_index() != 0:
+            return self.path
+        return self.snap.save("current")
+
+    def resume_if_found(self) -> dict | None:
+        """Restore the latest checkpoint into the (initialized) workflow;
+        returns its meta or None when starting fresh."""
+        from ..snapshotter import SnapshotterToFile
+        if not os.path.exists(self.path):
+            return None
+        return SnapshotterToFile.load(self.workflow, self.path)

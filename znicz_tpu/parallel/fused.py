@@ -237,13 +237,12 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
                 cfg["stride"], cfg["padding"])
             aux = off
         elif layer.kind == "max_pool":
-            h, aux = pool_ops.xla_max_pooling(h, cfg["ksize"],
-                                              cfg["stride"],
-                                              cfg["padding"])
+            h, aux = pool_ops.max_pooling(h, cfg["ksize"],
+                                          cfg["stride"], cfg["padding"])
         elif layer.kind == "maxabs_pool":
-            h, aux = pool_ops.xla_maxabs_pooling(h, cfg["ksize"],
-                                                 cfg["stride"],
-                                                 cfg["padding"])
+            h, aux = pool_ops.maxabs_pooling(h, cfg["ksize"],
+                                             cfg["stride"],
+                                             cfg["padding"])
         elif layer.kind == "avg_pool":
             h = pool_ops.xla_avg_pooling(h, cfg["ksize"], cfg["stride"],
                                          cfg["padding"])
@@ -263,8 +262,8 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
                     h, cfg["ksize"], cfg["stride"], cfg["padding"], None,
                     use_abs=use_abs, deterministic=True)
         elif layer.kind == "lrn":
-            h, aux = lrn_ops.xla_lrn(h, cfg["n"], cfg["alpha"],
-                                     cfg["beta"], cfg["k"])
+            h, aux = lrn_ops.lrn(h, cfg["n"], cfg["alpha"],
+                                 cfg["beta"], cfg["k"])
         elif layer.kind == "dropout":
             if train:
                 aux = drop_ops.make_mask(
@@ -364,9 +363,9 @@ def backward(spec: ModelSpec, params, caches, out, err):
                 err.reshape(y_i.shape), x_in.shape, cfg["ksize"],
                 cfg["stride"], cfg["padding"])
         elif layer.kind == "lrn":
-            err = lrn_ops.xla_gd_lrn(err.reshape(y_i.shape), x_in, aux,
-                                     cfg["n"], cfg["alpha"], cfg["beta"],
-                                     cfg["k"])
+            err = lrn_ops.gd_lrn(err.reshape(y_i.shape), x_in, aux,
+                                 cfg["n"], cfg["alpha"], cfg["beta"],
+                                 cfg["k"])
         elif layer.kind == "depooling":
             err = pool_ops.xla_gd_depooling(
                 err.reshape(y_i.shape), aux, cfg["ksize"], cfg["stride"],

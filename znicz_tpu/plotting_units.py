@@ -1,0 +1,243 @@
+"""Plotting / visualization units.
+
+Parity target: the reference plotting stack (mount empty — surveyed
+contract, SURVEY.md §2.1 Plotting row + §2.2 Weight/image viz row): the
+``plotting_units``/``nn_plotting_units`` families — error curves
+(``AccumulatingPlotter``), weight matrices as images (``Weights2D``),
+confusion matrices, Kohonen hit maps — plus ``image_saver`` dumping
+misclassified samples.
+
+TPU-first redesign (SURVEY.md §5): the reference pickled live matplotlib
+state over zmq to a separate graphics process; here every plotter is a
+*metric-emitting unit* — it appends structured records to the workflow's
+``MetricsWriter`` (JSONL) and renders PNGs through matplotlib's Agg
+backend only when asked (``render=True``), so headless training pays
+nothing for observability."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .loader.base import CLASS_NAMES, TRAIN
+from .units import Unit
+
+
+def _writer(workflow):
+    return getattr(workflow, "metrics_writer", None)
+
+
+class PlotterBase(Unit):
+    """Shared epoch gating + optional matplotlib rendering."""
+
+    def __init__(self, workflow=None, name=None, render=False,
+                 directory="plots", **kwargs):
+        super().__init__(workflow, name, **kwargs)
+        self.render = render
+        self.directory = directory
+
+    def should_fire(self) -> bool:
+        loader = getattr(self.workflow, "loader", None)
+        return loader is None or bool(loader.last_minibatch)
+
+    def _savefig(self, fig, tag: str) -> str:
+        os.makedirs(self.directory, exist_ok=True)
+        epoch = getattr(getattr(self.workflow, "loader", None),
+                        "epoch_number", 0)
+        path = os.path.join(self.directory,
+                            f"{self.name}_{tag}_e{epoch}.png")
+        fig.savefig(path, dpi=80)
+        import matplotlib.pyplot as plt
+        plt.close(fig)
+        return path
+
+
+class AccumulatingPlotter(PlotterBase):
+    """Error/loss curve across epochs (reference error plotters): pulls a
+    named attribute off the decision's last epoch metrics."""
+
+    def __init__(self, workflow=None, name=None, metric="validation_n_err",
+                 **kwargs):
+        super().__init__(workflow, name or f"plot_{metric}", **kwargs)
+        self.metric = metric
+        self.values: list = []
+
+    def run(self) -> None:
+        if not self.should_fire():
+            return
+        metrics = self.workflow.decision.epoch_metrics
+        if not metrics or self.metric not in metrics[-1]:
+            return
+        self.values.append(metrics[-1][self.metric])
+        w = _writer(self.workflow)
+        if w is not None:
+            w.write(kind="curve", plot=self.name, metric=self.metric,
+                    value=metrics[-1][self.metric],
+                    epoch=metrics[-1].get("epoch"))
+        if self.render:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+            fig, ax = plt.subplots(figsize=(5, 3))
+            ax.plot(self.values)
+            ax.set_xlabel("epoch")
+            ax.set_ylabel(self.metric)
+            self._savefig(fig, self.metric)
+
+
+class Weights2D(PlotterBase):
+    """First-layer weights as image tiles (reference Weights2D): emits
+    per-epoch weight statistics, renders a tile grid on demand."""
+
+    def __init__(self, workflow=None, name=None, unit=None, limit=16,
+                 sample_shape=None, **kwargs):
+        super().__init__(workflow, name or "weights2d", **kwargs)
+        self.unit = unit
+        self.limit = limit
+        self.sample_shape = sample_shape
+
+    def run(self) -> None:
+        if not self.should_fire() or self.unit is None:
+            return
+        w = np.asarray(self.unit.weights.mem)
+        writer = _writer(self.workflow)
+        if writer is not None:
+            writer.write(kind="weights", plot=self.name,
+                         unit=self.unit.name, mean=float(w.mean()),
+                         std=float(w.std()),
+                         min=float(w.min()), max=float(w.max()))
+        if self.render:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+            tiles = self._tiles(w)
+            n = len(tiles)
+            cols = int(np.ceil(np.sqrt(n)))
+            rows = int(np.ceil(n / cols))
+            fig, axes = plt.subplots(rows, cols,
+                                     figsize=(cols * 1.4, rows * 1.4))
+            for ax, tile in zip(np.atleast_1d(axes).ravel(), tiles):
+                ax.imshow(tile, cmap="gray")
+                ax.axis("off")
+            for ax in np.atleast_1d(axes).ravel()[n:]:
+                ax.axis("off")
+            self._savefig(fig, "tiles")
+
+    def _tiles(self, w: np.ndarray) -> list:
+        if w.ndim == 4:            # conv HWIO → per-output-channel tiles
+            tiles = [w[..., 0, i] for i in
+                     range(min(w.shape[-1], self.limit))]
+        else:                      # fc (in, out) → per-neuron input maps
+            shape = self.sample_shape
+            if shape is None:
+                side = int(np.sqrt(w.shape[0]))
+                if side * side != w.shape[0]:
+                    return [w[:, :min(w.shape[1], self.limit)]]
+                shape = (side, side)
+            tiles = [w[:, i].reshape(shape)
+                     for i in range(min(w.shape[1], self.limit))]
+        return tiles
+
+
+class ConfusionMatrixPlotter(PlotterBase):
+    """Emits the evaluator's confusion matrix per epoch (reference
+    confusion-matrix plotter)."""
+
+    def run(self) -> None:
+        if not self.should_fire():
+            return
+        ev = getattr(self.workflow, "evaluator", None)
+        cm = getattr(ev, "confusion_matrix", None)
+        if cm is None or not cm:
+            return
+        w = _writer(self.workflow)
+        if w is not None:
+            w.write(kind="confusion", plot=self.name,
+                    matrix=np.asarray(cm.mem).tolist())
+        if self.render:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+            fig, ax = plt.subplots(figsize=(4, 4))
+            ax.imshow(np.asarray(cm.mem), cmap="viridis")
+            ax.set_xlabel("predicted")
+            ax.set_ylabel("label")
+            self._savefig(fig, "confusion")
+
+
+class KohonenHitsPlotter(PlotterBase):
+    """SOM neuron hit histogram over the sheet (reference KohonenHits)."""
+
+    def __init__(self, workflow=None, name=None, forward=None, **kwargs):
+        super().__init__(workflow, name or "kohonen_hits", **kwargs)
+        self.forward = forward
+
+    def run(self) -> None:
+        if not self.should_fire() or self.forward is None:
+            return
+        hits = np.asarray(self.forward.hits.mem).reshape(
+            self.forward.shape)
+        w = _writer(self.workflow)
+        if w is not None:
+            w.write(kind="kohonen_hits", plot=self.name,
+                    hits=hits.tolist())
+        if self.render:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+            fig, ax = plt.subplots(figsize=(4, 4))
+            ax.imshow(hits, cmap="hot")
+            self._savefig(fig, "hits")
+
+
+class ImageSaver(Unit):
+    """Dump misclassified samples to disk (reference image_saver): one
+    PNG per wrong prediction, named label_pred_index, capped per epoch."""
+
+    def __init__(self, workflow=None, name=None, directory="misclassified",
+                 limit=32, **kwargs):
+        super().__init__(workflow, name or "image_saver", **kwargs)
+        self.directory = directory
+        self.limit = limit
+        self._saved_epoch = -1
+        self._count = 0
+        self.saved_paths: list[str] = []
+
+    def run(self) -> None:
+        wf = self.workflow
+        loader, ev = wf.loader, getattr(wf, "evaluator", None)
+        if ev is None or loader.minibatch_class == TRAIN:
+            return
+        epoch = loader.epoch_number
+        if epoch != self._saved_epoch:
+            self._saved_epoch = epoch
+            self._count = 0
+        if self._count >= self.limit:
+            return
+        labels = np.asarray(loader.minibatch_labels.mem)
+        pred = np.asarray(ev.max_idx.mem)
+        data = np.asarray(loader.minibatch_data.mem)
+        bs = loader.minibatch_size
+        wrong = np.nonzero(pred[:bs] != labels[:bs])[0]
+        if len(wrong) == 0:
+            return
+        os.makedirs(self.directory, exist_ok=True)
+        from PIL import Image
+        for i in wrong:
+            if self._count >= self.limit:
+                break
+            img = data[i]
+            if img.ndim == 1:
+                side = int(np.sqrt(img.size))
+                img = img[:side * side].reshape(side, side)
+            elif img.ndim == 3 and img.shape[-1] == 1:
+                img = img[..., 0]
+            lo, hi = float(img.min()), float(img.max())
+            u8 = ((img - lo) / max(hi - lo, 1e-8) * 255).astype(np.uint8)
+            name = (f"e{epoch}_{CLASS_NAMES[loader.minibatch_class]}"
+                    f"_l{labels[i]}_p{pred[i]}_{self._count}.png")
+            path = os.path.join(self.directory, name)
+            Image.fromarray(u8).save(path)
+            self.saved_paths.append(path)
+            self._count += 1

@@ -53,10 +53,12 @@ class RandomGenerator:
 
     # -- numpy side (golden path) -----------------------------------------
     def normal(self, loc=0.0, scale=1.0, size=None, dtype=np.float32):
-        return self.numpy.normal(loc, scale, size).astype(dtype)
+        v = self.numpy.normal(loc, scale, size)
+        return dtype(v) if size is None else v.astype(dtype)
 
     def uniform(self, low=-1.0, high=1.0, size=None, dtype=np.float32):
-        return self.numpy.uniform(low, high, size).astype(dtype)
+        v = self.numpy.uniform(low, high, size)
+        return dtype(v) if size is None else v.astype(dtype)
 
     def fill(self, arr: np.ndarray, vmin=-1.0, vmax=1.0) -> None:
         """In-place uniform fill (reference ``prng.fill`` contract)."""

@@ -1,0 +1,96 @@
+"""Web status page: live training progress over HTTP.
+
+Parity target: the reference ``veles/web_status.py`` (mount empty —
+surveyed contract, SURVEY.md §2.1 Web status row: master HTTP page with
+progress and connected slaves).
+
+TPU-first: a stdlib ``http.server`` thread serving ``/status.json``
+(workflow name, epoch, metrics history, per-unit time table, device) and
+a self-refreshing minimal HTML page at ``/`` — no tornado/twisted, no
+separate graphics process; multi-host SPMD replaces the slave roster
+with the JAX process/device inventory."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PAGE = """<!doctype html><html><head><title>znicz-tpu status</title>
+<meta http-equiv="refresh" content="3"><style>
+body{font-family:monospace;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:2px 8px;text-align:right}
+th{background:#eee}</style></head><body>
+<h2 id="t">znicz-tpu</h2><div id="s">loading…</div>
+<script>
+fetch('status.json').then(r=>r.json()).then(d=>{
+ document.getElementById('t').textContent=d.workflow+' — epoch '+d.epoch;
+ let h='<p>device: '+d.device+' | units: '+d.n_units+'</p>';
+ if(d.metrics.length){
+  h+='<table><tr>'+Object.keys(d.metrics[0]).map(k=>'<th>'+k+'</th>')
+    .join('')+'</tr>';
+  for(const m of d.metrics.slice(-12))
+   h+='<tr>'+Object.values(m).map(v=>'<td>'+(typeof v==='number'?
+     v.toPrecision(5):v)+'</td>').join('')+'</tr>';
+  h+='</table>';}
+ document.getElementById('s').innerHTML=h;});
+</script></body></html>"""
+
+
+class StatusServer:
+    """Background HTTP server over a live workflow (read-only)."""
+
+    def __init__(self, workflow, host: str = "127.0.0.1", port: int = 0):
+        self.workflow = workflow
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):   # keep training logs clean
+                pass
+
+            def do_GET(self):
+                if self.path.endswith("status.json"):
+                    body = json.dumps(outer.snapshot(),
+                                      default=float).encode()
+                    ctype = "application/json"
+                else:
+                    body = _PAGE.encode()
+                    ctype = "text/html"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+
+    def snapshot(self) -> dict:
+        wf = self.workflow
+        loader = getattr(wf, "loader", None)
+        decision = getattr(wf, "decision", None)
+        device = getattr(wf, "device", None)
+        return {
+            "workflow": wf.name,
+            "epoch": getattr(loader, "epoch_number", None),
+            "complete": bool(getattr(decision, "complete", False)),
+            "metrics": list(getattr(decision, "epoch_metrics", []))[-50:],
+            "n_units": len(wf.units),
+            "device": type(device).__name__ if device else None,
+            "time_table": wf.time_table()[:10],
+        }
+
+    def start(self) -> "StatusServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}/"
