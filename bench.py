@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Benchmark harness — prints ONE JSON line.
+"""Benchmark harness — prints ONE JSON line on stdout, always.
 
 Headline metric (BASELINE.json `metric`): **ImageNet AlexNet
 images/sec/chip** — the real 227×227×3 geometry (seeded synthetic data;
@@ -11,8 +11,25 @@ jitted, dataset HBM-resident).
 on the same device* — the reference's execution model (one kernel enqueue
 per unit per minibatch, Python between ops; SURVEY.md §3.1 hot-loop
 note), which is the only reference-equivalent baseline measurable here
-(the reference's own CUDA numbers are unrecoverable — BASELINE.md)."""
+(the reference's own CUDA numbers are unrecoverable — BASELINE.md).
 
+Resilience contract (VERDICT round 1, item 1): the tunneled TPU backend
+can refuse to initialize transiently, so the harness (a) retries backend
+bring-up with backoff, (b) falls back to a reduced-size CPU measurement
+if the TPU never appears (clearly labeled via "device"/"error" fields),
+and (c) traps every failure into a parseable ``{"error": ...}`` JSON line
+with exit code 0 — rc=1 with a raw traceback must never happen again.
+
+Extra modes (not used by the driver):
+
+* ``--kernels`` — run every Pallas kernel on the current device against
+  its XLA twin, assert allclose, and time both (the per-kernel table
+  VERDICT item 3 asks for; results land in BASELINE.md).
+* ``--config NAME`` — bench a non-flagship BASELINE config
+  (cifar/autoencoder/kohonen/mnist) instead of AlexNet.
+"""
+
+import argparse
 import json
 import sys
 import time
@@ -20,45 +37,142 @@ import time
 import numpy as np
 
 
-def _build(minibatch=128, n_train=512):
+def _emit(obj) -> int:
+    print(json.dumps(obj))
+    sys.stdout.flush()
+    return 0
+
+
+_PROBE = """
+import json, sys, time
+t0 = time.monotonic()
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+jnp.zeros((8, 128)).block_until_ready()
+print(json.dumps({"platform": d.platform,
+                  "kind": getattr(d, "device_kind", d.platform),
+                  "secs": round(time.monotonic() - t0, 1)}))
+"""
+
+
+def _await_backend(total_wait: float):
+    """Bring up the default JAX backend, retrying with backoff.
+
+    Returns (platform, device_kind).  The tunneled TPU plugin doesn't
+    just *fail* during warm-up — ``jax.devices()`` can **hang** inside
+    ``make_c_api_client`` indefinitely (observed: >400 s; this is what
+    produced round 1's rc=1 BENCH capture).  A hung in-process PJRT init
+    can't be interrupted, so each probe runs in a subprocess that can be
+    killed on timeout; this process only touches JAX once a probe has
+    confirmed the backend is healthy (by then the tunnel is warm and the
+    in-process init is fast)."""
+    import subprocess
+
+    deadline = time.monotonic() + total_wait
+    delay, last = 5.0, "no probe ran"
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise RuntimeError(f"backend not up after {total_wait:.0f}s: "
+                               f"{last}")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE], capture_output=True,
+                text=True, timeout=min(max(left, 10.0), 180.0))
+            if proc.returncode == 0 and proc.stdout.strip():
+                # scan for the probe's JSON among any plugin noise
+                for line in reversed(proc.stdout.strip().splitlines()):
+                    try:
+                        json.loads(line)
+                        break
+                    except ValueError:
+                        continue
+                else:
+                    raise ValueError("no JSON line in probe stdout")
+                import jax  # safe now: tunnel verified healthy
+                dev = jax.devices()[0]
+                return dev.platform, getattr(dev, "device_kind",
+                                             dev.platform)
+            last = (proc.stderr or "").strip().splitlines()[-1:] or ["?"]
+            last = last[0][-300:]
+        except subprocess.TimeoutExpired:
+            last = "probe hung (PJRT client init timeout)"
+        except Exception as e:   # malformed stdout / transient init error:
+            last = f"probe postprocessing failed: {e}"[:300]   # retry
+        time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+        delay = min(delay * 2.0, 60.0)
+
+
+def _force_cpu():
+    """Point this (not-yet-backend-initialized) process at CPU."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass
+
+
+#: config name → (module, workflow class, config-tree attr).
+_CONFIGS = {
+    "alexnet": ("alexnet", "AlexNetWorkflow", "alexnet"),
+    "cifar": ("cifar", "CifarWorkflow", "cifar"),
+    "mnist": ("mnist", "MnistWorkflow", "mnist"),
+    "autoencoder": ("autoencoder", "MnistAEWorkflow", "mnist_ae"),
+    "kohonen": ("kohonen", "KohonenWorkflow", "kohonen"),
+}
+
+
+def _build(config: str, minibatch, n_train):
     from znicz_tpu import prng
     prng.seed_all(1234)
+    import importlib
+
     from znicz_tpu.backends import Device
     from znicz_tpu.config import root
-    from znicz_tpu.models import alexnet
 
-    root.alexnet.update({"minibatch_size": minibatch})
-    root.alexnet.synthetic.update({"n_train": n_train, "n_valid": 0,
-                                   "n_test": 0})
-    wf = alexnet.AlexNetWorkflow()
+    mod_name, cls, tree_name = _CONFIGS[config]
+    mod = importlib.import_module(f"znicz_tpu.models.{mod_name}")
+    tree = getattr(root, tree_name)
+    if minibatch:
+        tree.update({"minibatch_size": minibatch})
+    if n_train:
+        tree.synthetic.update({"n_train": n_train, "n_valid": 0,
+                               "n_test": 0})
+    wf = getattr(mod, cls)()
     wf.initialize(device=Device.create("xla"))
     return wf
 
 
-def measure_fused(wf, epochs: int = 4) -> float:
-    """Images/sec of the fused whole-step path."""
-    from znicz_tpu.parallel import FusedTrainer
+def measure_fused(wf, epochs: int, warm: int = 2):
+    """(images/sec, spec, params) of the fused whole-step path."""
+    from znicz_tpu.parallel import fused, FusedTrainer
 
+    spec, params, _ = fused.extract_model(wf)
     tr = FusedTrainer(wf)
     ld = wf.loader
-    data, target = ld.original_data.devmem, ld.original_labels.devmem
+    data = ld.original_data.devmem
+    # MSE heads (autoencoder) regress on target tensors, not labels
+    target = (ld.original_targets.devmem
+              if getattr(wf, "loss_function", "softmax") == "mse"
+              else ld.original_labels.devmem)
     n = ld.class_lengths[2]
     idx = np.arange(ld.total_samples - n, ld.total_samples)
     batch = ld.max_minibatch_size
     # two warm epochs: the first compiles, the second recompiles once
     # more when the donated params come back with device-chosen layouts
-    tr.train_epoch(data, target, idx, batch, sync=True)
-    tr.train_epoch(data, target, idx, batch, sync=True)
+    for _ in range(warm):
+        tr.train_epoch(data, target, idx, batch, sync=True)
     t0 = time.perf_counter()
     last = None
     for _ in range(epochs):
         last = tr.train_epoch(data, target, idx, batch, sync=False)
     np.asarray(last["loss"])                     # one sync at the end
     dt = time.perf_counter() - t0
-    return epochs * n / dt
+    return epochs * n / dt, spec, params
 
 
-def measure_unit_graph(wf, ticks: int = 4) -> float:
+def measure_unit_graph(wf, ticks: int) -> float:
     """Images/sec of the per-unit dispatch path (reference execution
     model) on the same device and weights."""
     wf.run(max_ticks=1)                          # compile+warm all units
@@ -68,16 +182,280 @@ def measure_unit_graph(wf, ticks: int = 4) -> float:
     return ticks * wf.loader.max_minibatch_size / dt
 
 
-def main() -> None:
-    wf = _build()
-    fused = measure_fused(wf)
-    unit_graph = measure_unit_graph(wf)
-    print(json.dumps({
-        "metric": "alexnet_train_images_per_sec_per_chip",
-        "value": round(fused, 1),
-        "unit": "images/sec",
-        "vs_baseline": round(fused / unit_graph, 2),
-    }))
+def measure_som_fused(wf, epochs: int):
+    """(samples/sec, flops/sample) of the fused SOM epoch scan."""
+    from znicz_tpu.loader.base import TRAIN
+    from znicz_tpu.parallel.som import FusedSOMTrainer
+
+    ld = wf.loader
+    tr = FusedSOMTrainer(np.asarray(wf.forward.weights.mem),
+                         wf.forward.shape, workflow=wf)
+    data = ld.original_data.devmem
+    perm = ld.train_permutation(ld.epoch_number)
+    batch = ld.max_minibatch_size
+    n = ld.class_lengths[TRAIN]
+    lr, sigma = wf.trainer.schedules()
+    tr.train_epoch(data, perm, batch, lr, sigma)       # compile
+    tr.train_epoch(data, perm, batch, lr, sigma)
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        tr.train_epoch(data, perm, batch, lr, sigma)
+    dt = time.perf_counter() - t0
+    n_neurons = int(np.prod(wf.forward.shape))
+    dim = int(np.prod(ld.original_data.shape[1:]))
+    return epochs * n / dt, 6.0 * n_neurons * dim
+
+
+def _reduce_for_cpu(args):
+    """Shrink to 'prove the path compiles and emit a labeled number':
+    ticks=0 skips the per-unit dispatch pass entirely (compiling every
+    unit's kernels on CPU costs minutes and the CPU ratio is meaningless
+    for the TPU headline)."""
+    args.minibatch, args.n_train = 4, 4
+    args.epochs, args.ticks, args.warm = 1, 0, 1
+
+
+def bench_training(args) -> int:
+    result = {"metric": f"{args.config}_train_images_per_sec_per_chip",
+              "value": None, "unit": "images/sec", "vs_baseline": None}
+    try:
+        platform, kind = _await_backend(args.backend_wait)
+        result["device"] = kind
+        if platform == "cpu":
+            # jax silently defaulted to host CPU (no TPU registered at
+            # all): keep the run small and say so — a full-size AlexNet
+            # epoch on CPU takes hours and isn't the headline metric.
+            result["note"] = "no TPU registered; reduced-size CPU run"
+            _reduce_for_cpu(args)
+    except Exception as e:
+        # TPU never came up: emit a labeled reduced-size CPU number so
+        # the line still parses, and carry the init error for the record.
+        result["error"] = f"tpu backend init failed: {e}"[:400]
+        try:
+            _force_cpu()
+            import jax
+            dev = jax.devices()[0]   # in-process: axon never registered
+            if dev.platform != "cpu":
+                raise RuntimeError(f"got {dev.platform}, wanted cpu")
+            kind = getattr(dev, "device_kind", "cpu")
+            result["device"] = f"cpu-fallback ({kind})"
+            _reduce_for_cpu(args)
+        except Exception as e2:
+            result["error"] += f"; cpu fallback failed: {e2}"[:200]
+            return _emit(result)
+    try:
+        from znicz_tpu.ops import flops as flops_mod
+
+        wf = _build(args.config, args.minibatch, args.n_train)
+        if args.config == "kohonen":
+            # the SOM has no gradient chain; its fused path is the
+            # dedicated epoch scan in parallel.som
+            ips, flops_img = measure_som_fused(wf, args.epochs)
+            result["value"] = round(ips, 1)
+            result["flops_per_image"] = flops_img
+            result["tflops_per_sec"] = round(ips * flops_img / 1e12, 4)
+            if args.ticks > 0:
+                unit_graph = measure_unit_graph(wf, args.ticks)
+                result["vs_baseline"] = round(ips / unit_graph, 2)
+            return _emit(result)
+        try:
+            fused_ips, spec, params = measure_fused(
+                wf, args.epochs, getattr(args, "warm", 2))
+            result["path"] = "fused"
+        except NotImplementedError as e:
+            # e.g. weight-tied Deconv: fall back to the unit-graph path
+            # so the config still gets a measured number
+            result["path"] = "unit_graph"
+            note = f"fused path unavailable: {e}"[:200]
+            result["note"] = (result["note"] + "; " + note
+                              if "note" in result else note)
+            fused_ips = measure_unit_graph(wf, max(args.ticks, 1))
+            spec = params = None
+        result["value"] = round(fused_ips, 1)
+        if spec is not None:
+            fl = flops_mod.model_flops(
+                spec, params, wf.loader.original_data.shape[1:])
+            achieved = fused_ips * fl["train_step"] / 1e12
+            result["tflops_per_sec"] = round(achieved, 2)
+            result["flops_per_image"] = fl["train_step"]
+            peak = flops_mod.peak_tflops(kind, spec.compute_dtype)
+            if peak:
+                result["mfu"] = round(achieved / peak, 4)
+                result["peak_tflops"] = peak
+            if args.ticks > 0:
+                unit_graph = measure_unit_graph(wf, args.ticks)
+                result["vs_baseline"] = round(fused_ips / unit_graph, 2)
+    except Exception as e:
+        result.setdefault("error", "")
+        result["error"] = (result["error"]
+                           + f" measure failed: {e!r}").strip()[:600]
+    return _emit(result)
+
+
+# -- per-kernel Pallas-vs-XLA validation (VERDICT item 3) ------------------
+def _kernel_cases():
+    """[(name, pallas_thunk, xla_thunk, compare)] on bench-scale shapes."""
+    import jax.numpy as jnp
+    from znicz_tpu.ops import (activations, dropout as drop_ops,
+                               elementwise, matmul,
+                               normalization as lrn_ops,
+                               softmax, update)
+
+    rng = np.random.default_rng(1234)
+
+    def f32(*s):
+        return jnp.asarray(rng.standard_normal(s), jnp.float32)
+
+    a, b = f32(512, 1024), f32(1024, 768)
+    logits = f32(1024, 1000)
+    labels = jnp.asarray(rng.integers(0, 1000, size=1024), jnp.int32)
+    x4 = f32(32, 28, 28, 64)
+    err4 = f32(32, 28, 28, 64)
+    xact = f32(1024, 4096)
+    yact, eact = f32(1024, 4096), f32(1024, 4096)
+    w = f32(4096, 1024)
+    grad, vel = f32(4096, 1024), f32(4096, 1024)
+    seed, ctrs = 1234, (7, 3, 11)
+    taps = f32(9, 32 * 14 * 14, 64)          # (window taps, rows, C)
+    hypers = jnp.asarray([0.01, 1e-4, 0.0, 0.9], jnp.float32)
+    _, d_lrn = lrn_ops.xla_lrn(x4)
+
+    cases = [
+        ("matmul", lambda: matmul.pallas_matmul(a, b),
+         lambda: matmul.xla_matmul(a, b), "close"),
+        ("softmax", lambda: softmax.pallas_softmax(logits),
+         lambda: softmax.xla_softmax(logits), "close"),
+        ("softmax_ce",
+         lambda: softmax.pallas_softmax_ce_from_logits(logits, labels),
+         lambda: softmax.xla_softmax_ce_from_logits(logits, labels),
+         "close"),
+        ("act_bwd_tanh",
+         lambda: elementwise.pallas_act_bwd("tanh", eact, yact),
+         lambda: activations.BY_NAME["tanh"].bwd(eact, yact, None, jnp),
+         "close"),
+        ("dropout",
+         lambda: elementwise.pallas_dropout(xact, seed, ctrs, 0.4),
+         lambda: xact * drop_ops.make_mask(seed, ctrs, xact.shape, 0.4,
+                                           jnp), "exact"),
+        ("lrn", lambda: elementwise.pallas_lrn(x4)[0],
+         lambda: lrn_ops.xla_lrn(x4)[0], "close"),
+        ("gd_lrn",
+         lambda: elementwise.pallas_gd_lrn(err4, x4, d_lrn),
+         lambda: lrn_ops.xla_gd_lrn(err4, x4, d_lrn), "close"),
+        ("pool_select",
+         lambda: elementwise.pallas_pool_select(taps)[0],
+         lambda: jnp.max(taps, axis=0), "close"),
+        ("sgd_update",
+         lambda: update.pallas_sgd_update(w, grad, vel, hypers),
+         lambda: update.xla_sgd_update(w, grad, vel, 0.01, 1e-4, 0.0,
+                                       0.9), "close"),
+    ]
+    for act in ("tanh", "relu", "sigmoid"):
+        cases.append((
+            f"act_fwd_{act}",
+            lambda act=act: elementwise.pallas_act_fwd(act, xact),
+            lambda act=act: activations.BY_NAME[act].fwd(xact, jnp),
+            "close"))
+    return cases
+
+
+def _time_thunk(thunk, iters=20):
+    from znicz_tpu.ops import tuning
+    if tuning.interpret_mode():
+        iters = 2                   # interpret mode: only timing shape
+    import jax
+    out = thunk()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = thunk()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e6    # µs
+
+
+def bench_kernels(args) -> int:
+    import jax
+
+    result = {"metric": "pallas_kernel_validation", "value": None,
+              "unit": "kernels_passed", "vs_baseline": None}
+    try:
+        platform, kind = _await_backend(args.backend_wait)
+        result["device"] = kind
+    except Exception as e:
+        result["error"] = f"tpu backend init failed: {e}"[:400]
+        try:
+            _force_cpu()
+            dev = jax.devices()[0]
+            if dev.platform != "cpu":
+                raise RuntimeError(f"got {dev.platform}, wanted cpu")
+            platform = "cpu"
+            result["device"] = "cpu-fallback"
+        except Exception as e2:
+            result["error"] += f"; cpu fallback failed: {e2}"[:200]
+            return _emit(result)
+    from znicz_tpu.ops import tuning
+    if not tuning.use_pallas():
+        result["error"] = (f"platform {platform!r}: Pallas disabled and "
+                           f"not in interpret mode")
+        return _emit(result)
+    rows, passed = [], 0
+    for name, pallas_t, xla_t, mode in _kernel_cases():
+        row = {"kernel": name}
+        try:
+            got = [np.asarray(g)
+                   for g in jax.tree_util.tree_leaves(pallas_t())]
+            ref = [np.asarray(r)
+                   for r in jax.tree_util.tree_leaves(xla_t())]
+            ok = len(got) == len(ref)
+            err = 0.0
+            for g, r in zip(got, ref):       # every output must match
+                if mode == "exact":
+                    ok = ok and bool(np.array_equal(g, r))
+                else:
+                    ok = ok and bool(np.allclose(g, r, rtol=2e-3,
+                                                 atol=2e-3))
+                err = max(err, float(np.max(np.abs(
+                    g.astype(np.float64) - r.astype(np.float64)))))
+            row["pass"] = ok
+            row["max_abs_err"] = err
+            row["pallas_us"] = round(_time_thunk(pallas_t), 1)
+            row["xla_us"] = round(_time_thunk(xla_t), 1)
+            passed += ok
+        except Exception as e:
+            row["pass"] = False
+            row["error"] = str(e)[:300]
+        rows.append(row)
+        print(f"  {name:16s} pass={row.get('pass')} "
+              f"pallas={row.get('pallas_us', '-')}us "
+              f"xla={row.get('xla_us', '-')}us "
+              f"err={row.get('max_abs_err', row.get('error', '-'))}",
+              file=sys.stderr)
+    result["value"] = passed
+    result["total"] = len(rows)
+    result["rows"] = rows
+    return _emit(result)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="alexnet")
+    p.add_argument("--minibatch", type=int, default=128)
+    p.add_argument("--n-train", type=int, dest="n_train", default=512)
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--ticks", type=int, default=4)
+    p.add_argument("--backend-wait", type=float, default=420.0)
+    p.add_argument("--kernels", action="store_true")
+    args = p.parse_args(argv)
+    try:
+        if args.kernels:
+            return bench_kernels(args)
+        return bench_training(args)
+    except SystemExit:
+        raise
+    except BaseException as e:          # last-ditch: line must parse
+        return _emit({"metric": "bench_error", "value": None,
+                      "unit": "images/sec", "vs_baseline": None,
+                      "error": repr(e)[:600]})
 
 
 if __name__ == "__main__":

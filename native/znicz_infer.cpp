@@ -215,39 +215,55 @@ extern "C" {
 void* zn_load(const char* path) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return nullptr;
-  char magic[4];
-  if (std::fread(magic, 1, 4, f) != 4 ||
-      std::memcmp(magic, "ZNN1", 4) != 0) {
-    std::fclose(f);
-    return nullptr;
-  }
-  uint32_t n_layers = 0;
-  if (std::fread(&n_layers, 4, 1, f) != 1 || n_layers > 4096) {
-    std::fclose(f);
-    return nullptr;
-  }
-  auto* m = new Model();
-  m->layers.resize(n_layers);
-  for (auto& L : m->layers) {
-    uint64_t wn = 0, bn = 0;
-    bool ok = std::fread(&L.kind, 4, 1, f) == 1 &&
-              std::fread(&L.act, 4, 1, f) == 1 &&
-              std::fread(L.p, 4, 8, f) == 8 &&
-              std::fread(&wn, 8, 1, f) == 1;
-    if (ok) {
-      L.w.resize(wn);
-      ok = wn == 0 || std::fread(L.w.data(), 4, wn, f) == wn;
-    }
-    if (ok) ok = std::fread(&bn, 8, 1, f) == 1;
-    if (ok) {
-      L.b.resize(bn);
-      ok = bn == 0 || std::fread(L.b.data(), 4, bn, f) == bn;
-    }
-    if (!ok) {
+  // A corrupt .znn must yield nullptr, never an exception escaping the C
+  // ABI: bound every blob length against the file size before resize()
+  // (a hostile uint64 would otherwise throw bad_alloc/length_error) and
+  // catch anything the allocator still throws.
+  std::fseek(f, 0, SEEK_END);
+  const int64_t fsize = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  const uint64_t max_floats =
+      fsize > 0 ? static_cast<uint64_t>(fsize) / 4 : 0;
+  Model* m = nullptr;
+  try {
+    char magic[4];
+    if (std::fread(magic, 1, 4, f) != 4 ||
+        std::memcmp(magic, "ZNN1", 4) != 0) {
       std::fclose(f);
-      delete m;
       return nullptr;
     }
+    uint32_t n_layers = 0;
+    if (std::fread(&n_layers, 4, 1, f) != 1 || n_layers > 4096) {
+      std::fclose(f);
+      return nullptr;
+    }
+    m = new Model();
+    m->layers.resize(n_layers);
+    for (auto& L : m->layers) {
+      uint64_t wn = 0, bn = 0;
+      bool ok = std::fread(&L.kind, 4, 1, f) == 1 &&
+                std::fread(&L.act, 4, 1, f) == 1 &&
+                std::fread(L.p, 4, 8, f) == 8 &&
+                std::fread(&wn, 8, 1, f) == 1 && wn <= max_floats;
+      if (ok) {
+        L.w.resize(wn);
+        ok = wn == 0 || std::fread(L.w.data(), 4, wn, f) == wn;
+      }
+      if (ok) ok = std::fread(&bn, 8, 1, f) == 1 && bn <= max_floats;
+      if (ok) {
+        L.b.resize(bn);
+        ok = bn == 0 || std::fread(L.b.data(), 4, bn, f) == bn;
+      }
+      if (!ok) {
+        std::fclose(f);
+        delete m;
+        return nullptr;
+      }
+    }
+  } catch (...) {
+    std::fclose(f);
+    delete m;
+    return nullptr;
   }
   std::fclose(f);
   return m;
@@ -265,31 +281,60 @@ int64_t zn_infer(void* handle, const float* input, int64_t batch,
                  int64_t h, int64_t w, int64_t c, float* out,
                  int64_t out_cap) {
   auto* m = static_cast<Model*>(handle);
+  if (batch <= 0 || h <= 0 || w <= 0 || c <= 0) return -1;
   Shape s{batch, h, w, c};
   std::vector<float> cur(input, input + s.size());
   std::vector<float> next;
+  // Every layer validates its declared geometry against the running
+  // activation shape before touching memory — a model whose fc
+  // in_features (or conv cin / window extents) disagree with the actual
+  // tensor must fail with -1, not read past the buffer.
   for (const auto& L : m->layers) {
     switch (L.kind) {
       case kFC: {
         // flatten whatever is upstream
         Shape flat{s.n, 1, 1, s.h * s.w * s.c};
+        const int64_t fin = L.p[0], fout = L.p[1];
+        if (fin != flat.c || fout <= 0 ||
+            static_cast<int64_t>(L.w.size()) != fin * fout ||
+            (!L.b.empty() && static_cast<int64_t>(L.b.size()) != fout))
+          return -1;
         s = flat;
         fc_forward(L, cur, s, next);
         act_inplace(L.act, next);
         cur.swap(next);
         break;
       }
-      case kConv:
+      case kConv: {
+        const int64_t kh = L.p[0], kw = L.p[1], cin = L.p[2],
+                      cout = L.p[3], sh = L.p[4], sw = L.p[5],
+                      ph = L.p[6], pw = L.p[7];
+        if (kh <= 0 || kw <= 0 || sh <= 0 || sw <= 0 || ph < 0 ||
+            pw < 0 || cin != s.c || cout <= 0 ||
+            (s.h + 2 * ph - kh) / sh + 1 <= 0 ||
+            (s.w + 2 * pw - kw) / sw + 1 <= 0 ||
+            static_cast<int64_t>(L.w.size()) != kh * kw * cin * cout ||
+            (!L.b.empty() && static_cast<int64_t>(L.b.size()) != cout))
+          return -1;
         conv_forward(L, cur, s, next);
         act_inplace(L.act, next);
         cur.swap(next);
         break;
+      }
       case kMaxPool:
-      case kAvgPool:
+      case kAvgPool: {
+        const int64_t kh = L.p[0], kw = L.p[1], sh = L.p[4],
+                      sw = L.p[5], ph = L.p[6], pw = L.p[7];
+        if (kh <= 0 || kw <= 0 || sh <= 0 || sw <= 0 || ph < 0 ||
+            pw < 0 || (s.h + 2 * ph - kh) / sh + 1 <= 0 ||
+            (s.w + 2 * pw - kw) / sw + 1 <= 0)
+          return -1;
         pool_forward(L, L.kind == kAvgPool, cur, s, next);
         cur.swap(next);
         break;
+      }
       case kLRN:
+        if (L.p[0] <= 0 || L.w.size() < 3) return -1;
         lrn_forward(L, cur, s, next);
         cur.swap(next);
         break;

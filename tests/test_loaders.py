@@ -107,3 +107,35 @@ class TestImageLoader:
             wf, train_paths=[str(image_tree / "train")], minibatch_size=4)
         with pytest.raises(ValueError, match="mixed image shapes"):
             loader.initialize(NumpyDevice())
+
+
+class TestNormalizeReloadContract:
+    def test_inplace_refill_renormalized(self):
+        """A load_data that refills the SAME array in place must still be
+        re-normalized on re-initialize (ADVICE r1: id() identity does not
+        imply normalized contents)."""
+        from znicz_tpu.backends import NumpyDevice
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+        from znicz_tpu.workflow import Workflow
+
+        raw = (np.arange(24, dtype=np.float32).reshape(6, 4) + 100.0)
+
+        class InPlaceLoader(FullBatchLoader):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, normalization_type="linear", **kw)
+
+            def load_data(self):
+                if not self.original_data:
+                    self.original_data.mem = raw.copy()
+                    self.original_labels.mem = np.zeros(6, np.int32)
+                else:       # re-init: refill the existing array in place
+                    self.original_data.mem[:] = raw
+                self.class_lengths = [0, 0, 6]
+
+        wf = Workflow(name="w")
+        ld = InPlaceLoader(wf, minibatch_size=3)
+        ld.initialize(NumpyDevice())
+        first = ld.original_data.mem.copy()
+        assert first.max() <= 1.0 + 1e-6          # linear → [-1, 1]
+        ld.initialize(NumpyDevice())              # resume/re-init path
+        np.testing.assert_allclose(ld.original_data.mem, first)

@@ -102,3 +102,33 @@ class TestNativeEngine:
         bad.write_bytes(b"NOPE" + b"\0" * 64)
         with pytest.raises(IOError):
             engine.load(str(bad))
+
+    def test_oversized_blob_rejected(self, engine, tmp_path):
+        """A hostile uint64 w_size larger than the file must yield a load
+        error, not bad_alloc aborting the process (ADVICE r1 medium)."""
+        import struct
+        bad = tmp_path / "huge.znn"
+        bad.write_bytes(b"ZNN1" + struct.pack("<I", 1)
+                        + struct.pack("<II", 0, 0)       # kind=fc, act
+                        + struct.pack("<8i", 4, 4, 0, 0, 0, 0, 0, 0)
+                        + struct.pack("<Q", 1 << 60))    # absurd w_size
+        with pytest.raises(IOError):
+            engine.load(str(bad))
+
+    def test_geometry_mismatch_rejected(self, engine, tmp_path):
+        """fc in_features disagreeing with the fed tensor must fail with
+        -1 (heap over-read guard), not read past the activation buffer."""
+        import struct
+        w = np.zeros((4, 3), np.float32)
+        blob = (b"ZNN1" + struct.pack("<I", 1)
+                + struct.pack("<II", 0, 0)
+                + struct.pack("<8i", 4, 3, 0, 0, 0, 0, 0, 0)
+                + struct.pack("<Q", w.size) + w.tobytes()
+                + struct.pack("<Q", 0))
+        path = tmp_path / "geom.znn"
+        path.write_bytes(blob)
+        model = engine.load(str(path))
+        ok = model.infer(np.zeros((2, 4), np.float32), 3)
+        assert ok.shape == (2, 3)
+        with pytest.raises(RuntimeError):        # 7 features != fc fin=4
+            model.infer(np.zeros((2, 7), np.float32), 3)

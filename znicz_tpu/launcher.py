@@ -125,14 +125,30 @@ class Launcher:
 
     def _build_workflow_only(self, module, device):
         """Construct + initialize the module's workflow class without
-        running it (the resume path needs state loaded in between)."""
-        for name in dir(module):
-            obj = getattr(module, name)
-            if (isinstance(obj, type) and name.endswith("Workflow")
-                    and getattr(obj, "__module__", "") == module.__name__):
-                wf = obj()
-                wf.initialize(device=device)
-                return wf
-        raise AttributeError(
-            f"workflow module {self.workflow_spec!r} has no *Workflow "
-            "class to resume into")
+        running it (the resume path needs state loaded in between).
+
+        Resolution order (ADVICE r1: dir() picking an arbitrary class was
+        unsafe for multi-workflow modules):
+        1. an explicit ``WORKFLOW`` attribute (class or zero-arg factory);
+        2. the module's sole ``*Workflow`` class — more than one is an
+           error directing the author to convention 1."""
+        target = getattr(module, "WORKFLOW", None)
+        if target is None:
+            found = [getattr(module, name) for name in dir(module)
+                     if isinstance(getattr(module, name), type)
+                     and name.endswith("Workflow")
+                     and getattr(getattr(module, name), "__module__", "")
+                     == module.__name__]
+            if len(found) > 1:
+                raise AttributeError(
+                    f"workflow module {self.workflow_spec!r} defines "
+                    f"{len(found)} *Workflow classes; set WORKFLOW = "
+                    f"<class or factory> to pick the resume target")
+            if not found:
+                raise AttributeError(
+                    f"workflow module {self.workflow_spec!r} has no "
+                    "*Workflow class to resume into")
+            target = found[0]
+        wf = target()
+        wf.initialize(device=device)
+        return wf

@@ -63,6 +63,10 @@ class Loader(Unit):
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device, **kwargs)
         self.load_data()
+        # load_data() (re)produced RAW data — FullBatchLoader._normalize
+        # keys off this, not array identity (an in-place refill keeps the
+        # same id but raw contents; ADVICE r1)
+        self._data_reloaded = True
         self.total_samples = int(sum(self.class_lengths))
         if self.class_lengths[TRAIN] <= 0:
             raise ValueError("loader has no training samples")

@@ -54,20 +54,18 @@ class FullBatchLoader(Loader):
         statistics are fitted on the whole resident dataset once and kept
         on the loader for snapshots / external reuse."""
         from ..normalization import create_normalizer
+        if not getattr(self, "_data_reloaded", True):
+            return          # nothing reloaded since the last normalize
         if self.normalizer is None:
             self.normalizer = create_normalizer(
                 self.normalization_type, **self.normalization_parameters)
             self.normalizer.fit(self.original_data.mem)
-        elif getattr(self, "_normalized_id", None) \
-                == id(self.original_data.mem):
-            # re-initialize with load_data() keeping the same array →
-            # already transformed; a reload installs a fresh raw array
-            # (different id) and must be re-normalized with the fitted
-            # statistics
-            return
+        # load_data() always yields raw contents (even when it refills an
+        # existing array in place — the reload flag, not id(), is the
+        # contract), so apply the fitted statistics unconditionally
         self.original_data.mem = self.normalizer.apply(
             self.original_data.mem)
-        self._normalized_id = id(self.original_data.mem)
+        self._data_reloaded = False
 
     def fill_minibatch(self, indices: np.ndarray, klass: int) -> None:
         size = len(indices)

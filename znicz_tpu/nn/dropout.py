@@ -43,6 +43,15 @@ class DropoutForward(Forward):
         super().initialize(device, **kwargs)
         if not self.output:
             self.output.mem = np.zeros(self.input.shape, np.float32)
+        from ..ops import tuning
+        if tuning.use_pallas() and device is not None and device.is_xla:
+            # Pallas contract: the fused kernel never materializes the
+            # mask — leave the Vector EMPTY (falsy) rather than uploading
+            # an input-sized all-ones buffer a reader could mistake for
+            # the real thing; DropoutBackward regenerates the stream from
+            # (seed, counters) instead
+            self.init_vectors(self.output)
+            return
         if not self.mask:
             self.mask.mem = np.ones(self.input.shape, np.float32)
         self.init_vectors(self.output, self.mask)
@@ -72,32 +81,40 @@ class DropoutForward(Forward):
 
     def xla_run(self) -> None:
         if not self._is_training():
-            self.mask.devmem = jnp.ones(self.input.shape, jnp.float32)
+            from ..ops import tuning
+            if not tuning.use_pallas():    # pallas mode: mask stays empty
+                self.mask.devmem = jnp.ones(self.input.shape, jnp.float32)
             self.output.devmem = self.input.devmem
             return
         if not hasattr(self, "_fwd_fn"):
             from ..ops import tuning
             seed, ratio = self.rng.stream_seed, self.dropout_ratio
             shape = tuple(self.input.shape)
-            use_pallas = tuning.use_pallas()
+            self._use_pallas = tuning.use_pallas()
 
-            def fwd(x, counters):
-                mask = drop_ops.make_mask(seed, counters, shape, ratio,
-                                          jnp)
-                if use_pallas:
-                    # fused mask-gen+apply kernel; the hash inside is
-                    # bit-identical to make_mask, so mask stays the
-                    # published contract for DropoutBackward
-                    y = drop_ops.dropout_apply(x, seed, counters, ratio)
-                else:
-                    y = drop_ops.xla_dropout(x, mask)
-                return y, mask
+            if self._use_pallas:
+                # fused mask-gen+apply kernel, ONE HBM pass: the mask is
+                # NOT materialized here — DropoutBackward regenerates the
+                # identical stream from (seed, counters) (ADVICE r1: the
+                # old path paid a second full mask pass)
+                def fwd(x, counters):
+                    return drop_ops.dropout_apply(x, seed, counters,
+                                                  ratio)
+            else:
+                def fwd(x, counters):
+                    mask = drop_ops.make_mask(seed, counters, shape,
+                                              ratio, jnp)
+                    return drop_ops.xla_dropout(x, mask), mask
 
             self._fwd_fn = fwd
-        y, mask = self.jit(self._fwd_fn)(
-            self.input.devmem,
-            jnp.asarray(self._counters(), jnp.uint32))
-        self.output.devmem, self.mask.devmem = y, mask
+        ctrs = tuple(int(c) for c in self._counters())
+        out = self.jit(self._fwd_fn)(self.input.devmem,
+                                     jnp.asarray(ctrs, jnp.uint32))
+        if self._use_pallas:
+            self.output.devmem = out
+            self._last_counters = ctrs     # mask contract for backward
+        else:
+            self.output.devmem, self.mask.devmem = out
 
 
 class DropoutBackward(GradientDescentBase):
@@ -108,6 +125,7 @@ class DropoutBackward(GradientDescentBase):
     def setup_from_forward(self, fwd) -> "DropoutBackward":
         super().setup_from_forward(fwd)
         self.link_attrs(fwd, "mask")
+        self._fwd_unit = fwd
         self.include_bias = False
         return self
 
@@ -119,6 +137,20 @@ class DropoutBackward(GradientDescentBase):
 
     def xla_run(self) -> None:
         if not self.need_err_input:
+            return
+        ctrs = getattr(self._fwd_unit, "_last_counters", None) \
+            if getattr(self._fwd_unit, "_use_pallas", False) else None
+        if ctrs is not None:
+            # Pallas contract: the forward published no mask; regenerate
+            # the identical (seed, counters) stream fused with the apply
+            if not hasattr(self, "_bwd_pallas_fn"):
+                seed = self._fwd_unit.rng.stream_seed
+                ratio = self._fwd_unit.dropout_ratio
+                self._bwd_pallas_fn = self.jit(
+                    lambda e, c: drop_ops.dropout_apply(e, seed, c,
+                                                        ratio))
+            self.err_input.devmem = self._bwd_pallas_fn(
+                self.err_output.devmem, jnp.asarray(ctrs, jnp.uint32))
             return
         if not hasattr(self, "_bwd_fn"):
             self._bwd_fn = self.jit(drop_ops.xla_gd_dropout)

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from ..accelerated_units import AcceleratedUnit
 from ..loader.base import TRAIN
 from ..memory import Vector
@@ -66,14 +68,22 @@ class KohonenForward(Forward):
 
     def xla_run(self) -> None:
         if not hasattr(self, "_fwd_fn"):
-            self._fwd_fn = self.jit(
-                lambda x, w: som_ops.xla_forward(
-                    x.reshape(len(x), -1), w))
-        win, d = self._fwd_fn(self.input.devmem, self.weights.devmem)
+            def fwd(x, w, hits, bs):
+                win, d = som_ops.xla_forward(x.reshape(len(x), -1), w)
+                # hits accumulate on device: a host np.add.at here would
+                # force a device→host fetch EVERY minibatch (~100× a
+                # step over the tunnel; ADVICE r1) — readers map_read
+                # once per epoch instead
+                live = (jnp.arange(win.shape[0]) < bs).astype(hits.dtype)
+                return win, d, hits.at[win].add(live)
+
+            self._fwd_fn = self.jit(fwd)
+        win, d, hits = self._fwd_fn(self.input.devmem,
+                                    self.weights.devmem,
+                                    self.hits.devmem,
+                                    self.current_batch_size)
         self.output.devmem, self.distances.devmem = win, d
-        bs = self.current_batch_size
-        self.hits.map_write()
-        np.add.at(self.hits.mem, np.asarray(win)[:bs], 1)
+        self.hits.devmem = hits
 
 
 class KohonenTrainer(AcceleratedUnit):

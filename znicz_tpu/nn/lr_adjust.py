@@ -129,6 +129,7 @@ class LearningRateAdjust(Unit):
             g.learning_rate_bias = self.bias_policy(lrb0, it)
         loader = getattr(self.workflow, "loader", None)
         from ..loader.base import TRAIN
-        if loader is None or loader.minibatch_class == TRAIN:
+        if loader is None or \
+                getattr(loader, "minibatch_class", TRAIN) == TRAIN:
             # count only the ticks the gated GD units actually train on
             self._minibatches += 1
