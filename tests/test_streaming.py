@@ -1,0 +1,314 @@
+"""Streaming loader family (SURVEY.md §2.2 "Znicz loaders" row — the
+on-the-fly/LMDB pipelines, VERDICT r1 item 4): record format round-trip,
+loader-contract behavior, and the load-bearing claim — the streaming
+trainer reproduces the resident fused trainer bit-for-bit."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device, NumpyDevice
+from znicz_tpu.loader import (RecordFile, RecordLoader, RecordWriter,
+                              TRAIN, write_records)
+from znicz_tpu.loader.streaming import BatchPrefetcher, StreamingLoader
+from znicz_tpu.workflow import Workflow
+
+
+def _dataset(n=60, shape=(6, 6, 1), classes=5, seed="rec"):
+    gen = prng.get(seed)
+    data = np.asarray(gen.normal(size=(n, *shape)), np.float32)
+    labels = gen.randint(0, classes, n).astype(np.int32)
+    return data, labels
+
+
+class TestRecordFormat:
+    def test_round_trip(self, tmp_path):
+        data, labels = _dataset()
+        p = str(tmp_path / "d.znr")
+        write_records(p, data, labels)
+        rf = RecordFile(p)
+        assert len(rf) == 60
+        assert rf.data_shape == (6, 6, 1)
+        d, l = rf.read_batch([3, 0, 59])
+        np.testing.assert_array_equal(d, data[[3, 0, 59]])
+        np.testing.assert_array_equal(l, labels[[3, 0, 59]])
+
+    def test_sharded(self, tmp_path):
+        data, labels = _dataset()
+        paths = write_records(str(tmp_path / "d.znr"), data, labels,
+                              shard_size=25)
+        assert len(paths) == 3
+        assert [len(RecordFile(p)) for p in paths] == [25, 25, 10]
+
+    def test_streamed_writer(self, tmp_path):
+        data, labels = _dataset(n=10)
+        p = str(tmp_path / "s.znr")
+        with RecordWriter(p, data.shape[1:], data.dtype,
+                          (), labels.dtype) as w:
+            for i in range(10):
+                w.write(data[i], labels[i])
+        rf = RecordFile(p)
+        d, l = rf.read_batch(np.arange(10))
+        np.testing.assert_array_equal(d, data)
+        np.testing.assert_array_equal(l, labels)
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = tmp_path / "bad.znr"
+        p.write_bytes(b"NOPE" + b"\0" * 100)
+        with pytest.raises(ValueError, match="not a .znr"):
+            RecordFile(str(p))
+
+    def test_truncated_rejected(self, tmp_path):
+        data, labels = _dataset(n=10)
+        p = str(tmp_path / "t.znr")
+        write_records(p, data, labels)
+        blob = open(p, "rb").read()
+        open(p, "wb").write(blob[:len(blob) - 8])
+        with pytest.raises(ValueError, match="truncated"):
+            RecordFile(str(p))
+
+
+class TestRecordLoader:
+    def _loader(self, tmp_path, data, labels, batch=16, **kw):
+        n_test, n_valid = 10, 10
+        tr = write_records(str(tmp_path / "train.znr"),
+                           data[n_test + n_valid:],
+                           labels[n_test + n_valid:], shard_size=20)
+        va = write_records(str(tmp_path / "valid.znr"),
+                           data[n_test:n_test + n_valid],
+                           labels[n_test:n_test + n_valid])
+        te = write_records(str(tmp_path / "test.znr"), data[:n_test],
+                           labels[:n_test])
+        wf = Workflow(name="w")
+        return RecordLoader(wf, train_paths=tr, validation_paths=va,
+                            test_paths=te, minibatch_size=batch, **kw)
+
+    def test_contract(self, tmp_path):
+        data, labels = _dataset()
+        ld = self._loader(tmp_path, data, labels)
+        ld.initialize(NumpyDevice())
+        assert ld.class_lengths == [10, 10, 40]
+        assert ld.sample_shape == (6, 6, 1)
+        # global index space: rows must come back exactly
+        d, l = ld.read_batch([0, 10, 25, 59])
+        np.testing.assert_array_equal(d, data[[0, 10, 25, 59]])
+        np.testing.assert_array_equal(l, labels[[0, 10, 25, 59]])
+
+    def test_unit_graph_serving_matches_fullbatch(self, tmp_path):
+        """Same seed → the streaming loader serves byte-identical
+        minibatches to a FullBatchLoader over the same arrays."""
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+        data, labels = _dataset()
+
+        class Resident(FullBatchLoader):
+            def __init__(self, *a, **kw):
+                kw.setdefault("normalization_type", "none")
+                super().__init__(*a, **kw)
+
+            def load_data(self):
+                self.original_data.mem = data.copy()
+                self.original_labels.mem = labels.copy()
+                self.class_lengths = [10, 10, 40]
+
+        prng.seed_all(77)
+        ld_s = self._loader(tmp_path, data, labels)
+        ld_s.initialize(NumpyDevice())
+        prng.seed_all(77)
+        ld_r = Resident(Workflow(name="w2"), minibatch_size=16)
+        ld_r.initialize(NumpyDevice())
+        # exactly one epoch (1 test + 1 valid + 3 train batches): beyond
+        # it the two loaders would interleave draws from the SHARED
+        # "loader" prng stream and legitimately shuffle differently
+        for _ in range(5):
+            ld_s.run()
+            ld_r.run()
+            assert ld_s.minibatch_class == ld_r.minibatch_class
+            assert ld_s.minibatch_size == ld_r.minibatch_size
+            n = ld_s.minibatch_size
+            np.testing.assert_array_equal(
+                ld_s.minibatch_data.mem[:n], ld_r.minibatch_data.mem[:n])
+            np.testing.assert_array_equal(
+                ld_s.minibatch_labels.mem[:n],
+                ld_r.minibatch_labels.mem[:n])
+
+
+class TestPrefetcher:
+    def test_yields_all_rows_in_order(self, tmp_path):
+        data, labels = _dataset(n=32)
+        p = write_records(str(tmp_path / "d.znr"), data, labels)
+        wf = Workflow(name="w")
+        ld = RecordLoader(wf, train_paths=p, minibatch_size=8)
+        ld.initialize(NumpyDevice())
+        rows = np.arange(32).reshape(4, 8)
+        got = list(BatchPrefetcher(ld, rows, depth=2))
+        assert len(got) == 4
+        for i, (x, t) in enumerate(got):
+            np.testing.assert_array_equal(np.asarray(x), data[rows[i]])
+            np.testing.assert_array_equal(np.asarray(t), labels[rows[i]])
+
+    def test_producer_error_surfaces(self, tmp_path):
+        class Exploding(StreamingLoader):
+            def load_meta(self):
+                self.class_lengths = [0, 0, 8]
+                self.sample_shape = (2,)
+
+            def read_batch(self, indices):
+                raise RuntimeError("disk on fire")
+
+        ld = Exploding(Workflow(name="w"))
+        ld.load_meta()
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            list(BatchPrefetcher(ld, np.zeros((1, 4), np.int32)))
+
+
+class TestStreamTrainerEquivalence:
+    def test_bitwise_vs_resident_fused(self, tmp_path):
+        """A dataset that fits in HBM must train IDENTICALLY through
+        FusedTrainer (resident scan) and StreamTrainer (prefetched
+        minibatch loop) — same step math, same RNG counters."""
+        from znicz_tpu.config import root
+        from znicz_tpu.models import mnist
+        from znicz_tpu.parallel import FusedTrainer, fused
+        from znicz_tpu.parallel.stream import StreamTrainer
+
+        saved = root.mnist.to_dict()
+        root.mnist.update({"minibatch_size": 20})
+        root.mnist.synthetic.update({"n_train": 50, "n_valid": 10,
+                                     "n_test": 0})
+        try:
+            prng.seed_all(42)
+            wf = mnist.MnistWorkflow()
+            wf.initialize(device=Device.create("xla"))
+        finally:
+            root.mnist.update(saved)
+        spec, params, vels = fused.extract_model(wf)
+        ld = wf.loader
+        data = ld.original_data.devmem
+        target = ld.original_labels.devmem
+        idx = np.arange(10, 60)     # train rows (global index space)
+
+        res = FusedTrainer(spec=spec, params=params, vels=vels)
+        for ep in range(2):
+            rm = res.train_epoch(data, target, idx, 20, epoch=ep)
+
+        # stream the same (already normalized) arrays from shards
+        paths = write_records(
+            str(tmp_path / "m.znr"), np.asarray(ld.original_data.mem),
+            np.asarray(ld.original_labels.mem), shard_size=24)
+        wf2 = Workflow(name="w2")
+        sld = RecordLoader(wf2, train_paths=paths, minibatch_size=20)
+        sld.initialize(NumpyDevice())
+        st = StreamTrainer(spec=spec, params=params, vels=vels,
+                           loader=sld)
+        for ep in range(2):
+            sm = st.train_epoch(None, None, idx, 20, epoch=ep)
+
+        np.testing.assert_array_equal(rm["loss"], sm["loss"])
+        np.testing.assert_array_equal(rm["n_err"], sm["n_err"])
+        for (rw, rb), (sw, sb) in zip(res.params, st.params):
+            np.testing.assert_array_equal(np.asarray(rw),
+                                          np.asarray(sw))
+            if rb is not None:
+                np.testing.assert_array_equal(np.asarray(rb),
+                                              np.asarray(sb))
+
+    def test_run_fused_end_to_end(self, tmp_path):
+        """StandardWorkflow.run_fused over a RecordLoader: trains, logs
+        metrics, writes weights back."""
+        from znicz_tpu.standard_workflow import StandardWorkflow
+
+        data, labels = _dataset(n=80, shape=(5, 5, 1), classes=4)
+        tr = write_records(str(tmp_path / "tr.znr"), data[20:],
+                           labels[20:], shard_size=32)
+        va = write_records(str(tmp_path / "va.znr"), data[:20],
+                           labels[:20])
+        prng.seed_all(9)
+        wf = StandardWorkflow(
+            None, "swf",
+            layers=[{"type": "all2all_tanh",
+                     "->": {"output_sample_shape": 12},
+                     "<-": {"learning_rate": 0.05}},
+                    {"type": "softmax", "->": {"output_sample_shape": 4},
+                     "<-": {"learning_rate": 0.05}}],
+            loader=RecordLoader(None, train_paths=tr,
+                                validation_paths=va, minibatch_size=16),
+            decision_config={"max_epochs": 3, "fail_iterations": 10})
+        wf.initialize(device=Device.create("xla"))
+        tr_obj = wf.run_fused()
+        assert type(tr_obj).__name__ == "StreamTrainer"
+        ms = wf.decision.epoch_metrics
+        assert len(ms) == 3
+        assert ms[-1]["train_loss"] < ms[0]["train_loss"]
+        # weights were written back into the unit graph
+        assert np.isfinite(wf.forwards[0].weights.mem).all()
+
+
+class TestOnTheFlyImages:
+    @pytest.fixture
+    def image_tree(self, tmp_path):
+        from PIL import Image
+        gen = prng.get("imgs")
+        for split, n in (("train", 8), ("valid", 4)):
+            for cname in ("cats", "dogs"):
+                d = tmp_path / split / cname
+                d.mkdir(parents=True)
+                for i in range(n // 2):
+                    arr = gen.randint(0, 255, (8, 8, 3)).astype(np.uint8)
+                    Image.fromarray(arr).save(d / f"{i}.png")
+        return tmp_path
+
+    def test_matches_fullbatch_loader(self, image_tree):
+        from znicz_tpu.loader.image import FullBatchImageLoader
+        from znicz_tpu.loader.streaming import OnTheFlyImageLoader
+
+        wf = Workflow(name="w")
+        otf = OnTheFlyImageLoader(
+            wf, train_paths=[str(image_tree / "train")],
+            validation_paths=[str(image_tree / "valid")],
+            minibatch_size=4)
+        otf.initialize(NumpyDevice())
+        wf2 = Workflow(name="w2")
+        full = FullBatchImageLoader(
+            wf2, train_paths=[str(image_tree / "train")],
+            validation_paths=[str(image_tree / "valid")],
+            minibatch_size=4)
+        full.initialize(NumpyDevice())
+        assert otf.class_lengths == full.class_lengths
+        assert otf.label_map == full.label_map
+        idx = np.asarray([0, 3, 7, 11])
+        d, l = otf.read_batch(idx)
+        np.testing.assert_allclose(
+            d, np.asarray(full.original_data.mem)[idx], rtol=1e-6)
+        np.testing.assert_array_equal(
+            l, np.asarray(full.original_labels.mem)[idx])
+
+    def test_abandoned_iteration_releases_producer(self, tmp_path):
+        """Consumer raising mid-epoch must not leave the producer thread
+        blocked on a full queue pinning device batches."""
+        data, labels = _dataset(n=64)
+        p = write_records(str(tmp_path / "d.znr"), data, labels)
+        ld = RecordLoader(Workflow(name="w"), train_paths=p,
+                          minibatch_size=8)
+        ld.initialize(NumpyDevice())
+        pf = BatchPrefetcher(ld, np.arange(64).reshape(8, 8), depth=2)
+        it = iter(pf)
+        next(it)
+        it.close()                 # GeneratorExit → finally → pf.close()
+        pf._thread.join(timeout=5.0)
+        assert not pf._thread.is_alive()
+
+    def test_vector_labels_round_trip(self, tmp_path):
+        """Non-scalar label_shape shards (e.g. one-hot) serve correctly."""
+        gen = prng.get("vec")
+        data = np.asarray(gen.normal(size=(20, 3, 3, 1)), np.float32)
+        labels = np.asarray(gen.normal(size=(20, 4)), np.float32)
+        paths = write_records(str(tmp_path / "v.znr"), data, labels)
+        ld = RecordLoader(Workflow(name="w"), train_paths=paths,
+                          minibatch_size=5)
+        ld.initialize(NumpyDevice())
+        assert ld.label_shape == (4,)
+        d, l = ld.read_batch([2, 7, 19])
+        np.testing.assert_array_equal(l, labels[[2, 7, 19]])
+        ld.run()
+        assert ld.minibatch_labels.mem.shape == (5, 4)

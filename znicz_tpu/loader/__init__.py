@@ -7,6 +7,11 @@ Parity target: the reference loader layer (SURVEY.md §2.1 Loader base row:
 
 from .base import TEST, TRAIN, VALID, Loader
 from .fullbatch import FullBatchLoader, FullBatchLoaderMSE
+from .records import RecordFile, RecordWriter, write_records
+from .streaming import (BatchPrefetcher, OnTheFlyImageLoader,
+                        RecordLoader, StreamingLoader)
 
 __all__ = ["TEST", "TRAIN", "VALID", "Loader", "FullBatchLoader",
-           "FullBatchLoaderMSE"]
+           "FullBatchLoaderMSE", "RecordFile", "RecordWriter",
+           "write_records", "BatchPrefetcher", "OnTheFlyImageLoader",
+           "RecordLoader", "StreamingLoader"]

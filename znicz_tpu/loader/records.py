@@ -1,0 +1,183 @@
+"""``.znr`` record shards — the disk format behind the streaming loaders.
+
+Parity target: the reference's LMDB-backed loader row (SURVEY.md §2.2
+"Znicz loaders": ``loader/loader_lmdb.py`` and the ImageNet pipeline —
+mount empty, surveyed contract).  The reference used LMDB because its
+on-the-fly pipelines decoded arbitrary blobs per key; the TPU rebuild
+stores **fixed-shape preprocessed tensors** instead, because static shapes
+are what XLA wants and a fixed record size makes random access a single
+``mmap`` slice — no key/value store, no per-record header walk, no decode
+on the hot path.
+
+Layout (little-endian):
+
+    magic  b"ZNR1"
+    u32    header_json_len
+    bytes  header json: {"n", "data_shape", "data_dtype",
+                         "label_shape", "label_dtype"}
+    pad    to 64-byte alignment
+    data   n × prod(data_shape) × itemsize   (C-order, contiguous)
+    labels n × prod(label_shape) × itemsize
+
+Data and labels are separate contiguous blocks so a minibatch gather is
+two fancy-index reads on two mmaps (rows of the data block are page-
+aligned for the common 4-KiB-multiple record sizes).  Shards are plain
+files: a dataset larger than HBM (or RAM — reads are lazy page faults)
+is just a list of shards.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+_MAGIC = b"ZNR1"
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return ((n + _ALIGN - 1) // _ALIGN) * _ALIGN
+
+
+class RecordWriter:
+    """Streams records into one ``.znr`` shard.
+
+    >>> w = RecordWriter(path, (227, 227, 3), np.float32)
+    >>> w.write(img, label)      # or w.write_batch(imgs, labels)
+    >>> w.close()                # finalizes the header
+    """
+
+    def __init__(self, path: str, data_shape, data_dtype=np.float32,
+                 label_shape=(), label_dtype=np.int32):
+        self.path = path
+        self.data_shape = tuple(int(d) for d in data_shape)
+        self.data_dtype = np.dtype(data_dtype)
+        self.label_shape = tuple(int(d) for d in label_shape)
+        self.label_dtype = np.dtype(label_dtype)
+        self.n = 0
+        # labels buffer in memory (small); data streams straight to disk
+        self._labels: list[np.ndarray] = []
+        self._f = open(path, "wb")
+        self._header_at = None
+        self._write_header(placeholder=True)
+
+    def _write_header(self, placeholder: bool) -> None:
+        head = json.dumps({
+            "n": 0 if placeholder else self.n,
+            "data_shape": self.data_shape,
+            "data_dtype": self.data_dtype.name,
+            "label_shape": self.label_shape,
+            "label_dtype": self.label_dtype.name,
+        }).encode()
+        if placeholder:
+            # reserve a fixed-size header slot: the final n is patched in
+            # on close, so pad the json out to a stable length
+            head = head + b" " * 24
+            self._header_at = len(_MAGIC) + 4
+            self._head_len = len(head)
+        else:
+            head = head.ljust(self._head_len)
+        self._f.write(_MAGIC)
+        self._f.write(np.dtype("<u4").type(len(head)).tobytes())
+        self._f.write(head)
+        pad = _align(self._f.tell()) - self._f.tell()
+        self._f.write(b"\0" * pad)
+        self._data_at = self._f.tell()
+
+    def write(self, data: np.ndarray, label) -> None:
+        self.write_batch(np.asarray(data)[None],
+                         np.asarray(label, self.label_dtype)[None])
+
+    def write_batch(self, data: np.ndarray, labels: np.ndarray) -> None:
+        data = np.ascontiguousarray(data, self.data_dtype)
+        if data.shape[1:] != self.data_shape:
+            raise ValueError(f"record shape {data.shape[1:]} != declared "
+                             f"{self.data_shape}")
+        labels = np.ascontiguousarray(labels, self.label_dtype)
+        if len(labels) != len(data):
+            raise ValueError("data/label count mismatch")
+        self._f.write(data.tobytes())
+        self._labels.append(labels.reshape(len(labels),
+                                           *self.label_shape).copy())
+        self.n += len(data)
+
+    def close(self) -> None:
+        if self._f is None:
+            return
+        if self._labels:
+            self._f.write(np.concatenate(self._labels).tobytes())
+        self._f.seek(0)
+        self._write_header(placeholder=False)
+        self._f.close()
+        self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordFile:
+    """Random access over one ``.znr`` shard via mmap (zero-copy rows)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            if f.read(4) != _MAGIC:
+                raise ValueError(f"{path}: not a .znr record file")
+            head_len = int(np.frombuffer(f.read(4), "<u4")[0])
+            head = json.loads(f.read(head_len))
+        self.n = int(head["n"])
+        self.data_shape = tuple(head["data_shape"])
+        self.data_dtype = np.dtype(head["data_dtype"])
+        self.label_shape = tuple(head["label_shape"])
+        self.label_dtype = np.dtype(head["label_dtype"])
+        data_at = _align(4 + 4 + head_len)
+        row = int(np.prod(self.data_shape))
+        labels_at = data_at + self.n * row * self.data_dtype.itemsize
+        lrow = int(np.prod(self.label_shape)) if self.label_shape else 1
+        expect = labels_at + self.n * lrow * self.label_dtype.itemsize
+        if os.path.getsize(path) < expect:
+            raise ValueError(f"{path}: truncated record file")
+        self.data = np.memmap(path, self.data_dtype, "r",
+                              offset=data_at, shape=(self.n, row)
+                              ).reshape(self.n, *self.data_shape)
+        self.labels = np.memmap(path, self.label_dtype, "r",
+                                offset=labels_at, shape=(self.n, lrow))
+        if not self.label_shape:
+            self.labels = self.labels.reshape(self.n)
+        else:
+            self.labels = self.labels.reshape(self.n, *self.label_shape)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def read_batch(self, indices) -> tuple[np.ndarray, np.ndarray]:
+        """Materialized (copied) rows — safe to mutate / device_put."""
+        idx = np.asarray(indices)
+        return np.asarray(self.data[idx]), np.asarray(self.labels[idx])
+
+
+def write_records(path: str, data: np.ndarray, labels: np.ndarray,
+                  shard_size: int | None = None) -> list[str]:
+    """Convenience: dump arrays into one shard (or ``shard_size``-row
+    shards, ``path`` gaining ``-00000`` suffixes).  Returns the paths."""
+    data = np.asarray(data)
+    labels = np.asarray(labels)
+    if shard_size is None:
+        shards = [(path, slice(0, len(data)))]
+    else:
+        base, ext = os.path.splitext(path)
+        shards = [(f"{base}-{i // shard_size:05d}{ext}",
+                   slice(i, min(i + shard_size, len(data))))
+                  for i in range(0, len(data), shard_size)]
+    out = []
+    for p, sl in shards:
+        with RecordWriter(p, data.shape[1:], data.dtype,
+                          labels.shape[1:], labels.dtype) as w:
+            w.write_batch(data[sl], labels[sl])
+        out.append(p)
+    return out

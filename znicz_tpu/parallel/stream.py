@@ -1,0 +1,116 @@
+"""Streaming fused trainer: disk-backed datasets at fused-path speed.
+
+Counterpart of :class:`parallel.fused.FusedTrainer` for datasets that do
+NOT fit in HBM (SURVEY.md §2.2 "Znicz loaders" row — the reference's
+on-the-fly/LMDB pipelines).  The resident trainer scans a whole epoch on
+device; here the epoch is a host loop over a jitted per-minibatch step,
+with :class:`loader.streaming.BatchPrefetcher` double-buffering the
+host read/decode + host→HBM transfer under the previous step's compute
+(JAX async dispatch keeps the device queue full as long as the host
+keeps up).
+
+RNG/math contract: identical to the resident path — the same
+``train_minibatch`` body, the same (epoch, samples-consumed) counters —
+so a dataset that *does* fit in HBM trains bit-for-bit identically
+through either trainer (asserted in tests/test_streaming.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..loader.streaming import BatchPrefetcher, StreamingLoader
+from . import mesh as mesh_lib
+from .fused import FusedTrainer, eval_minibatch, train_minibatch
+
+
+class StreamTrainer(FusedTrainer):
+    """FusedTrainer drop-in whose epoch drivers stream minibatches from
+    a :class:`StreamingLoader` instead of indexing a resident tensor.
+
+    ``train_epoch(data, target, ...)`` keeps the resident signature so
+    ``StandardWorkflow.run_fused`` treats both trainers uniformly;
+    ``data``/``target`` are ignored (pass ``None``)."""
+
+    def __init__(self, workflow=None, spec=None, params=None, vels=None,
+                 mesh=None, loader: StreamingLoader | None = None,
+                 prefetch_depth: int = 2):
+        super().__init__(workflow, spec=spec, params=params, vels=vels,
+                         mesh=mesh)
+        self.loader = loader if loader is not None \
+            else getattr(workflow, "loader", None)
+        if not isinstance(self.loader, StreamingLoader):
+            raise TypeError("StreamTrainer needs a StreamingLoader")
+        self.prefetch_depth = prefetch_depth
+        self._step_fn = None
+        self._eval_fn = None
+
+    # -- per-minibatch compiled steps -------------------------------------
+    def _build_steps(self):
+        spec = self.spec
+
+        def step(params, vels, x, t, mask, epoch, ctr, lr_scale):
+            if self._batch_sharding is not None:
+                x = jax.lax.with_sharding_constraint(
+                    x, self._batch_sharding)
+            return train_minibatch(spec, params, vels, x, t, mask,
+                                   epoch=epoch, ctr=ctr,
+                                   lr_scale=lr_scale)
+
+        def estep(params, x, t, mask):
+            if self._batch_sharding is not None:
+                x = jax.lax.with_sharding_constraint(
+                    x, self._batch_sharding)
+            return eval_minibatch(spec, params, x, t, mask)
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+        self._eval_fn = jax.jit(estep)
+
+    def _device_put(self, a):
+        if self._batch_sharding is not None:
+            return jax.device_put(a, self._batch_sharding)
+        return jax.device_put(a)
+
+    # -- epoch drivers -----------------------------------------------------
+    def train_epoch(self, data, target, indices, batch: int,
+                    sync: bool = True, epoch: int | None = None,
+                    lr_scale: float = 1.0, ctr_base: int = 0) -> dict:
+        if epoch is None:
+            epoch = self._auto_epoch
+        self._auto_epoch = epoch + 1
+        if self._step_fn is None:
+            self._build_steps()
+        idx, mask, ctrs = self._idx_matrix(np.asarray(indices), batch,
+                                           ctr_base)
+        pf = BatchPrefetcher(self.loader, idx, depth=self.prefetch_depth,
+                             device_put=self._device_put)
+        losses, n_errs = [], []
+        ep = jnp.uint32(epoch)
+        ls = jnp.float32(lr_scale)
+        for step_i, (x, t) in enumerate(pf):
+            self.params, self.vels, m = self._step_fn(
+                self.params, self.vels, x, t,
+                jnp.asarray(mask[step_i]), ep,
+                jnp.uint32(ctrs[step_i]), ls)
+            losses.append(m["loss"])
+            n_errs.append(m["n_err"])
+        ms = {"loss": jnp.stack(losses), "n_err": jnp.stack(n_errs)}
+        return {k: np.asarray(v) for k, v in ms.items()} if sync else ms
+
+    def eval_epoch(self, data, target, indices, batch: int,
+                   sync: bool = True) -> dict:
+        if self._eval_fn is None:
+            self._build_steps()
+        idx, mask, _ = self._idx_matrix(np.asarray(indices), batch)
+        pf = BatchPrefetcher(self.loader, idx, depth=self.prefetch_depth,
+                             device_put=self._device_put)
+        losses, n_errs = [], []
+        for step_i, (x, t) in enumerate(pf):
+            m = self._eval_fn(self.params, x, t,
+                              jnp.asarray(mask[step_i]))
+            losses.append(m["loss"])
+            n_errs.append(m["n_err"])
+        ms = {"loss": jnp.stack(losses), "n_err": jnp.stack(n_errs)}
+        return {k: np.asarray(v) for k, v in ms.items()} if sync else ms

@@ -195,14 +195,30 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         spec, params, vels = fused.extract_model(self)
         if compute_dtype is not None:
             spec = fused.ModelSpec(spec.layers, spec.loss, compute_dtype)
-        trainer = FusedTrainer(spec=spec, params=params, vels=vels,
-                               mesh=mesh)
+        from .loader.streaming import StreamingLoader
+        if isinstance(self.loader, StreamingLoader):
+            # disk-backed dataset: stream minibatches through the
+            # double-buffered prefetcher instead of scanning a resident
+            # tensor (same step math/RNG — parallel/stream.py)
+            if self.loss_function == "mse":
+                raise NotImplementedError(
+                    "streaming loaders serve (data, labels); MSE target "
+                    "tensors need the resident path")
+            from .parallel.stream import StreamTrainer
+            trainer = StreamTrainer(spec=spec, params=params, vels=vels,
+                                    mesh=mesh, loader=self.loader)
+        else:
+            trainer = FusedTrainer(spec=spec, params=params, vels=vels,
+                                   mesh=mesh)
         trainer.workflow = self
         loader, decision = self.loader, self.decision
-        data = loader.original_data.devmem
-        target = (loader.original_targets.devmem
-                  if self.loss_function == "mse"
-                  else loader.original_labels.devmem)
+        if isinstance(loader, StreamingLoader):
+            data = target = None       # StreamTrainer reads the loader
+        else:
+            data = loader.original_data.devmem
+            target = (loader.original_targets.devmem
+                      if self.loss_function == "mse"
+                      else loader.original_labels.devmem)
         bounds = np.cumsum([0] + list(loader.class_lengths))
         cls_idx = {k: np.arange(bounds[k], bounds[k + 1])
                    for k in (TEST, VALID, TRAIN)}
