@@ -167,8 +167,9 @@ def _dropout_kernel(key_ref, x_ref, o_ref, *, ratio, br):
            + jnp.uint32(base))
     # identical math to rngbits.uniform01 → bit-identical masks
     h = rngbits._mix(idx * jnp.uint32(rngbits._C2) ^ key, jnp)
-    u = (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(
-        1.0 / (1 << 24))
+    # Mosaic can't lower uint32→f32; values are < 2²⁴ so int32 is exact.
+    u = (h >> jnp.uint32(8)).astype(jnp.int32).astype(jnp.float32) \
+        * jnp.float32(1.0 / (1 << 24))
     keep = (u >= jnp.float32(ratio)).astype(jnp.float32)
     scale = jnp.float32(1.0 / (1.0 - ratio))
     o_ref[:] = (x_ref[:].astype(jnp.float32) * keep * scale).astype(

@@ -120,7 +120,7 @@ _PEAK_TFLOPS = (
     ("v6", 918.0, 459.0),
     ("v5p", 459.0, 229.5),
     ("v5e", 197.0, 98.5),
-    ("v5litepod", 197.0, 98.5),
+    ("v5lite", 197.0, 98.5),      # device_kind "TPU v5 lite" (v5e)
     ("v4", 275.0, 137.5),
     ("v3", 123.0, 61.5),
     ("v2", 45.0, 22.5),
