@@ -347,8 +347,10 @@ def bench_training(args) -> int:
 def _kernel_cases():
     """[(name, pallas_thunk, xla_thunk, compare)] on bench-scale shapes."""
     import jax.numpy as jnp
-    from znicz_tpu.ops import (activations, dropout as drop_ops,
-                               elementwise, matmul,
+    from znicz_tpu.ops import (activations, conv as conv_ops,
+                               deconv as deconv_ops,
+                               dropout as drop_ops,
+                               elementwise, kohonen as som_ops, matmul,
                                normalization as lrn_ops,
                                softmax, update)
 
@@ -368,6 +370,13 @@ def _kernel_cases():
     grad, vel = f32(4096, 1024), f32(4096, 1024)
     seed, ctrs = 1234, (7, 3, 11)
     taps = f32(9, 32 * 14 * 14, 64)          # (window taps, rows, C)
+    xsom, wsom = f32(256, 784), f32(400, 784)   # 20x20 SOM on MNIST dims
+    perr = f32(32 * 14 * 14, 64)
+    poff = jnp.asarray(rng.integers(0, 9, size=(32 * 14 * 14, 64)),
+                       jnp.int32)
+    ximg, cerr = f32(16, 28, 28, 64), f32(16, 28, 28, 64)
+    cw = f32(3, 3, 64, 64)
+    xdec, wdec = f32(16, 14, 14, 32), f32(4, 4, 16, 32)
     hypers = jnp.asarray([0.01, 1e-4, 0.0, 0.9], jnp.float32)
     _, d_lrn = lrn_ops.xla_lrn(x4)
 
@@ -396,6 +405,29 @@ def _kernel_cases():
         ("pool_select",
          lambda: elementwise.pallas_pool_select(taps)[0],
          lambda: jnp.max(taps, axis=0), "close"),
+        ("pool_scatter",
+         lambda: elementwise.pallas_pool_scatter(perr, poff, 9),
+         lambda: jnp.stack([perr * (poff == t) for t in range(9)]),
+         "exact"),
+        ("pool_gather",
+         lambda: elementwise.pallas_pool_gather(taps, poff),
+         lambda: sum(taps[t] * (poff == t) for t in range(9)), "close"),
+        ("conv_grad_w",
+         lambda: conv_ops.pallas_conv2d_grad_weights(
+             ximg, cerr, (3, 3, 64, 64), 1, 1),
+         lambda: conv_ops.xla_conv2d_grad_weights(
+             ximg, cerr, (3, 3, 64, 64), 1, 1), "close"),
+        ("conv_grad_x",
+         lambda: conv_ops.pallas_conv2d_grad_input(
+             cerr, cw, ximg.shape, 1, 1),
+         lambda: conv_ops.xla_conv2d_grad_input(
+             cerr, cw, ximg.shape, 1, 1), "close"),
+        ("deconv",
+         lambda: deconv_ops.pallas_deconv2d(xdec, wdec, 2, 1),
+         lambda: deconv_ops.xla_deconv2d(xdec, wdec, 2, 1), "close"),
+        ("kohonen_argmin",
+         lambda: som_ops.pallas_distance_argmin(xsom, wsom)[0],
+         lambda: som_ops.xla_forward(xsom, wsom)[0], "exact"),
         ("sgd_update",
          lambda: update.pallas_sgd_update(w, grad, vel, hypers),
          lambda: update.xla_sgd_update(w, grad, vel, 0.01, 1e-4, 0.0,

@@ -78,6 +78,77 @@ class TestLRNKernel:
                                    atol=1e-6)
 
 
+class TestConvGradKernels:
+    """Implicit-GEMM Pallas tiers for conv gradients and the deconv
+    family (SURVEY.md §2.3 conv-grad + deconv rows)."""
+
+    @pytest.mark.parametrize("stride,padding", [(1, 0), (2, 1)])
+    def test_conv_grads_vs_golden(self, stride, padding):
+        from znicz_tpu.ops import conv as conv_ops
+        x = _x((2, 9, 9, 5))
+        w = _x((3, 3, 5, 7), "w")
+        y = conv_ops.np_conv2d(x, w, stride, padding)
+        err = _x(y.shape, "err")
+        dw_ref = conv_ops.np_conv2d_grad_weights(x, err, w.shape, stride,
+                                                 padding)
+        dw = conv_ops.pallas_conv2d_grad_weights(
+            jnp.asarray(x), jnp.asarray(err), w.shape, stride, padding)
+        np.testing.assert_allclose(np.asarray(dw), dw_ref, rtol=1e-4,
+                                   atol=1e-4)
+        dx_ref = conv_ops.np_conv2d_grad_input(err, w, x.shape, stride,
+                                               padding)
+        dx = conv_ops.pallas_conv2d_grad_input(
+            jnp.asarray(err), jnp.asarray(w), x.shape, stride, padding)
+        np.testing.assert_allclose(np.asarray(dx), dx_ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    @pytest.mark.parametrize("stride,padding", [(1, 0), (2, 1)])
+    def test_deconv_all_directions_vs_golden(self, stride, padding):
+        from znicz_tpu.ops import deconv as deconv_ops
+        x = _x((2, 5, 5, 7))
+        w = _x((3, 3, 4, 7), "w")         # (KH, KW, C_out, C_in)
+        y_ref = deconv_ops.np_deconv2d(x, w, stride, padding)
+        y = deconv_ops.pallas_deconv2d(jnp.asarray(x), jnp.asarray(w),
+                                       stride, padding)
+        np.testing.assert_allclose(np.asarray(y), y_ref, rtol=1e-4,
+                                   atol=1e-4)
+        err = _x(y_ref.shape, "err")
+        dx_ref = deconv_ops.np_deconv2d_grad_input(err, w, stride,
+                                                   padding)
+        dx = deconv_ops.pallas_deconv2d_grad_input(
+            jnp.asarray(err), jnp.asarray(w), stride, padding)
+        np.testing.assert_allclose(np.asarray(dx), dx_ref, rtol=1e-4,
+                                   atol=1e-4)
+        dw_ref = deconv_ops.np_deconv2d_grad_weights(err, x, w.shape,
+                                                     stride, padding)
+        dw = deconv_ops.pallas_deconv2d_grad_weights(
+            jnp.asarray(err), jnp.asarray(x), w.shape, stride, padding)
+        np.testing.assert_allclose(np.asarray(dw), dw_ref, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestKohonenKernel:
+    def test_distance_argmin_vs_golden(self):
+        from znicz_tpu.ops import kohonen as som_ops
+        x = _x((13, 37))                 # odd sizes exercise padding
+        w = _x((150, 37), "w")           # >128 neurons: two neuron tiles
+        win_ref, d_ref = som_ops.np_forward(x, w)
+        win, dmin = som_ops.pallas_distance_argmin(jnp.asarray(x),
+                                                   jnp.asarray(w))
+        np.testing.assert_array_equal(np.asarray(win), win_ref)
+        np.testing.assert_allclose(np.asarray(dmin), d_ref.min(axis=1),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_single_tile(self):
+        from znicz_tpu.ops import kohonen as som_ops
+        x = _x((4, 8))
+        w = _x((9, 8), "w")              # 3x3 SOM, one padded tile
+        win_ref, _ = som_ops.np_forward(x, w)
+        win, _ = som_ops.pallas_distance_argmin(jnp.asarray(x),
+                                                jnp.asarray(w))
+        np.testing.assert_array_equal(np.asarray(win), win_ref)
+
+
 class TestPoolSelectKernel:
     @pytest.mark.parametrize("use_abs", [False, True])
     def test_vs_golden(self, use_abs):
@@ -89,6 +160,41 @@ class TestPoolSelectKernel:
                                            (0, 0), use_abs)
         np.testing.assert_allclose(np.asarray(y), y_ref, rtol=1e-6)
         np.testing.assert_array_equal(np.asarray(idx), idx_ref)
+
+    def test_scatter_backward_vs_golden(self):
+        x = _x((2, 6, 6, 5))
+        _, idx = pool_ops.np_max_pooling(x, (2, 2), (2, 2), (0, 0))
+        err = _x((2, 3, 3, 5), "err")
+        ref = pool_ops.np_gd_max_pooling(err, idx, x.shape, (2, 2),
+                                         (2, 2), (0, 0))
+        dx = pool_ops.gd_max_pooling(jnp.asarray(err), jnp.asarray(idx),
+                                     x.shape, (2, 2), (2, 2), (0, 0))
+        np.testing.assert_allclose(np.asarray(dx), ref, rtol=1e-6)
+
+    def test_scatter_backward_overlapping(self):
+        x = _x((2, 7, 7, 3))
+        _, idx = pool_ops.np_max_pooling(x, (3, 3), (2, 2), (1, 1))
+        err = _x(idx.shape, "err")
+        ref = pool_ops.np_gd_max_pooling(err, idx, x.shape, (3, 3),
+                                         (2, 2), (1, 1))
+        dx = pool_ops.gd_max_pooling(jnp.asarray(err), jnp.asarray(idx),
+                                     x.shape, (3, 3), (2, 2), (1, 1))
+        np.testing.assert_allclose(np.asarray(dx), ref, rtol=1e-6,
+                                   atol=1e-6)
+
+    def test_depool_roundtrip(self):
+        x = _x((2, 6, 6, 5))
+        y, idx = pool_ops.np_max_pooling(x, (2, 2), (2, 2), (0, 0))
+        up_ref = pool_ops.np_depooling(y, idx, x.shape, (2, 2), (2, 2),
+                                       (0, 0))
+        up = pool_ops.depooling(jnp.asarray(y), jnp.asarray(idx), x.shape,
+                                (2, 2), (2, 2), (0, 0))
+        np.testing.assert_allclose(np.asarray(up), up_ref, rtol=1e-6)
+        err = _x(x.shape, "err")
+        g_ref = pool_ops.np_gd_depooling(err, idx, (2, 2), (2, 2), (0, 0))
+        g = pool_ops.gd_depooling(jnp.asarray(err), jnp.asarray(idx),
+                                  (2, 2), (2, 2), (0, 0))
+        np.testing.assert_allclose(np.asarray(g), g_ref, rtol=1e-6)
 
     def test_overlapping_padded(self):
         x = _x((2, 7, 7, 3))

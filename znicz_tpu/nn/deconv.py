@@ -113,7 +113,7 @@ class Deconv(Forward):
         act, sliding, padding = self.ACTIVATION, self.sliding, self.padding
 
         def fwd(x, w, b):
-            y = deconv_ops.xla_deconv2d(x, w, sliding, padding)
+            y = deconv_ops.deconv2d(x, w, sliding, padding)
             if b is not None:
                 y = y + b
             return act.fwd(y, jnp)

@@ -68,7 +68,7 @@ class Depooling(Forward):
             ks, sl, pad = self.ksize, self.sliding, self.padding
             out_shape = tuple(self.output.shape)
             self._fwd_fn = self.jit(
-                lambda x, off: pool_ops.xla_depooling(
+                lambda x, off: pool_ops.depooling(
                     x, off, out_shape, ks, sl, pad))
         self.output.devmem = self._fwd_fn(self.input.devmem,
                                           self.input_offset.devmem)
@@ -103,7 +103,7 @@ class GDDepooling(GradientDescentBase):
             ks, sl, pad = self.ksize, self.sliding, self.padding
             out_shape = tuple(self.output.shape)
             self._bwd_fn = self.jit(
-                lambda e, off: pool_ops.xla_gd_depooling(
+                lambda e, off: pool_ops.gd_depooling(
                     e.reshape(out_shape), off, ks, sl, pad))
         self.err_input.devmem = self._bwd_fn(self.err_output.devmem,
                                              self.input_offset.devmem)

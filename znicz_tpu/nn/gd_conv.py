@@ -79,10 +79,10 @@ class GradientDescentConv(GradientDescentBase):
         def bwd(x, w, err_out, y):
             err_y = act.bwd(err_out.reshape(y.shape), y,
                             x if act.needs_input else None, jnp)
-            gw = conv_ops.xla_conv2d_grad_weights(x, err_y, w_shape,
+            gw = conv_ops.conv2d_grad_weights(x, err_y, w_shape,
                                                   sliding, padding)
             gb = jnp.sum(err_y, axis=(0, 1, 2)) if include_bias else None
-            err_in = (conv_ops.xla_conv2d_grad_input(
+            err_in = (conv_ops.conv2d_grad_input(
                 err_y, w, x.shape, sliding, padding) if need_err else None)
             return gw, gb, err_in
 

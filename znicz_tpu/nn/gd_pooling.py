@@ -51,7 +51,7 @@ class GDMaxPooling(GDPoolingBase):
             ks, sl, pad = self.ksize, self.sliding, self.padding
             x_shape = tuple(self.input.shape)
             self._bwd_fn = self.jit(
-                lambda e, off: pool_ops.xla_gd_max_pooling(
+                lambda e, off: pool_ops.gd_max_pooling(
                     e, off, x_shape, ks, sl, pad))
         self.err_input.devmem = self._bwd_fn(self.err_output.devmem,
                                              self.input_offset.devmem)

@@ -159,11 +159,65 @@ def pallas_conv2d(x, w, stride=1, padding=0, out_dtype=None):
     return y.reshape(b, oh, ow, oc)
 
 
+def pallas_conv2d_grad_input(err, w, x_shape, stride=1, padding=0):
+    """Implicit-GEMM transposed conv (SURVEY.md §2.3 conv-grad row): the
+    interior-dilate + edge-pad of err is pure data movement (XLA pad),
+    the FLOPs run in the Pallas MXU matmul against the spatially-flipped
+    IO-swapped kernel."""
+    kh, kw, c, oc = w.shape
+    (sh, sw), (ph, pw) = _norm2(stride), _norm2(padding)
+    _, h, w_in, _ = x_shape
+    _, oh, ow, _ = err.shape
+    w_flip = jnp.transpose(w[::-1, ::-1, :, :], (0, 1, 3, 2))
+    lo_h, lo_w = kh - 1 - ph, kw - 1 - pw
+    hi_h = h + ph - ((oh - 1) * sh + 1)
+    hi_w = w_in + pw - ((ow - 1) * sw + 1)
+    ed = lax.pad(err, jnp.zeros((), err.dtype),
+                 ((0, 0, 0), (lo_h, hi_h, sh - 1),
+                  (lo_w, hi_w, sw - 1), (0, 0, 0)))
+    cols = lax.conv_general_dilated_patches(
+        ed, (kh, kw), (1, 1), ((0, 0), (0, 0)),
+        dimension_numbers=_DIMNUMS)          # (B, H, W, OC*KH*KW)
+    b, hh, ww, k = cols.shape
+    w2 = jnp.transpose(w_flip, (2, 0, 1, 3)).reshape(k, c)
+    dx = matmul.pallas_matmul(cols.reshape(-1, k), w2,
+                              out_dtype=jnp.float32)
+    return dx.reshape(b, hh, ww, c)
+
+
+def pallas_conv2d_grad_weights(x, err, w_shape, stride=1, padding=0):
+    """Implicit-GEMM weight grad: colsᵀ·err on the MXU — cols is the
+    same patch matrix as the forward, so dw = (B·OH·OW, C·KH·KW)ᵀ @
+    (B·OH·OW, OC), reshaped to (KH, KW, C, OC)."""
+    kh, kw, c, oc = w_shape
+    (sh, sw), (ph, pw) = _norm2(stride), _norm2(padding)
+    cols = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), ((ph, ph), (pw, pw)),
+        dimension_numbers=_DIMNUMS)          # (B, OH, OW, C*KH*KW)
+    k = cols.shape[-1]
+    dw = matmul.pallas_matmul(cols.reshape(-1, k).T,
+                              err.reshape(-1, oc),
+                              out_dtype=jnp.float32)
+    return jnp.transpose(dw.reshape(c, kh, kw, oc), (1, 2, 0, 3))
+
+
 def conv2d(x, w, stride=1, padding=0, out_dtype=None):
     """Dispatcher: XLA conv is the default production path on TPU (the
     compiler's conv→MXU lowering beats implicit GEMM for most shapes);
     set ZNICZ_TPU_CONV=pallas to force the Pallas GEMM tier."""
-    import os
-    if os.environ.get("ZNICZ_TPU_CONV") == "pallas" and tuning.use_pallas():
+    if tuning.force_pallas_conv():
         return pallas_conv2d(x, w, stride, padding, out_dtype)
     return xla_conv2d(x, w, stride, padding, out_dtype)
+
+
+def conv2d_grad_input(err, w, x_shape, stride=1, padding=0):
+    if tuning.force_pallas_conv():
+        return pallas_conv2d_grad_input(err, w, x_shape, stride, padding)
+    return xla_conv2d_grad_input(err, w, x_shape, stride, padding)
+
+
+def conv2d_grad_weights(x, err, w_shape, stride=1, padding=0):
+    if tuning.force_pallas_conv():
+        return pallas_conv2d_grad_weights(x, err, w_shape, stride,
+                                          padding)
+    return xla_conv2d_grad_weights(x, err, w_shape, stride, padding)

@@ -314,3 +314,71 @@ def pallas_pool_select(taps, use_abs: bool = False):
         interpret=tuning.interpret_mode(),
     )(taps)
     return y[:rows], idx[:rows]
+
+
+def _pool_scatter_kernel(e_ref, i_ref, o_ref, *, n_taps):
+    err = e_ref[:].astype(jnp.float32)
+    idx = i_ref[:]
+    for t in range(n_taps):
+        o_ref[t] = jnp.where(idx == jnp.int32(t), err,
+                             jnp.float32(0.0)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("n_taps",))
+def pallas_pool_scatter(err, offsets, n_taps: int):
+    """GD-pooling backward core (SURVEY.md §2.3 gd_pooling row, §7 hard
+    part (a)): expand (err, winner-slot offsets) into the per-tap
+    contribution stack ``out[t] = err·(offsets == t)`` in ONE read of
+    err+offsets (the XLA formulation re-reads both once per tap).  The
+    regular strided placement of the taps into dx stays in XLA, mirroring
+    the forward's stack-in-XLA / select-in-Pallas split."""
+    rows, c = err.shape
+    br = min(256, tuning.round_up(rows, 8))
+    rows_pad = tuning.round_up(rows, br)
+    if rows_pad != rows:
+        err = jnp.pad(err, ((0, rows_pad - rows), (0, 0)))
+        offsets = jnp.pad(offsets, ((0, rows_pad - rows), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_pool_scatter_kernel, n_taps=n_taps),
+        grid=(rows_pad // br,),
+        in_specs=[pl.BlockSpec((br, c), lambda i: (i, 0)),
+                  pl.BlockSpec((br, c), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((n_taps, br, c), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_taps, rows_pad, c), err.dtype),
+        interpret=tuning.interpret_mode(),
+    )(err, offsets)
+    return out[:, :rows]
+
+
+def _pool_gather_kernel(taps_ref, i_ref, o_ref, *, n_taps):
+    idx = i_ref[:]
+    acc = jnp.where(idx == 0, taps_ref[0].astype(jnp.float32),
+                    jnp.float32(0.0))
+    for t in range(1, n_taps):
+        acc = acc + jnp.where(idx == jnp.int32(t),
+                              taps_ref[t].astype(jnp.float32),
+                              jnp.float32(0.0))
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+@jax.jit
+def pallas_pool_gather(taps, offsets):
+    """Depooling backward core (adjoint of the offset scatter): select
+    each window's recorded winner tap and sum — ``out = Σ_t
+    taps[t]·(offsets == t)`` in one pass over the (T, rows, C) stack."""
+    t, rows, c = taps.shape
+    br = min(256, tuning.round_up(rows, 8))
+    rows_pad = tuning.round_up(rows, br)
+    if rows_pad != rows:
+        taps = jnp.pad(taps, ((0, 0), (0, rows_pad - rows), (0, 0)))
+        offsets = jnp.pad(offsets, ((0, rows_pad - rows), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_pool_gather_kernel, n_taps=t),
+        grid=(rows_pad // br,),
+        in_specs=[pl.BlockSpec((t, br, c), lambda i: (0, i, 0)),
+                  pl.BlockSpec((br, c), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((br, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, c), taps.dtype),
+        interpret=tuning.interpret_mode(),
+    )(taps, offsets)
+    return out[:rows]

@@ -14,9 +14,13 @@ numpy/jnp namespace: numpy IS the golden tier (SURVEY.md §4)."""
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
+import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
 
 def _matmul(a, b, xp):
@@ -94,3 +98,91 @@ def quantization_error(x, w, xp=np):
     """Mean distance from each sample to its winner (SOM quality metric)."""
     d = distances(x, w, xp)
     return xp.sqrt(xp.maximum(d.min(axis=1), 0.0)).mean()
+
+
+# -- Pallas tier -----------------------------------------------------------
+# Parity row SURVEY.md §2.3 "Kohonen distance/argmin/neighborhood kernels":
+# the reference computed a (B, N) distance matrix kernel then an argmin
+# kernel over it.  The TPU kernel fuses both: neuron tiles stream through
+# VMEM, each contributes one MXU matmul to a running (min, argmin) pair,
+# and the (B, N) matrix never exists in HBM.
+
+def _dist_argmin_kernel(x_ref, w_ref, min_ref, arg_ref, *, bn, n_valid):
+    j = pl.program_id(1)
+    x = x_ref[:].astype(jnp.float32)                      # (bb, F)
+    w = w_ref[:].astype(jnp.float32)                      # (bn, F)
+    x2 = (x * x).sum(axis=1, keepdims=True)               # (bb, 1)
+    w2 = (w * w).sum(axis=1)                              # (bn,)
+    # HIGHEST precision matches _matmul's backend-equivalence contract:
+    # default MXU f32 (bf16 passes) flips near-tie winners vs the golden.
+    cross = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=jax.lax.Precision.HIGHEST)
+    d = x2 - 2.0 * cross + w2[None, :]                    # (bb, bn)
+    col = (jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+           + jnp.int32(bn) * j)
+    d = jnp.where(col < n_valid, d, jnp.float32(np.inf))  # mask N padding
+    blk_min = jnp.min(d, axis=1, keepdims=True)           # (bb, 1)
+    blk_arg = jnp.argmin(d, axis=1).astype(jnp.int32)[:, None] \
+        + jnp.int32(bn) * j
+    blk_min = jnp.broadcast_to(blk_min, min_ref.shape)
+    blk_arg = jnp.broadcast_to(blk_arg, arg_ref.shape)
+
+    @pl.when(j == 0)
+    def _init():
+        min_ref[:] = blk_min
+        arg_ref[:] = blk_arg
+
+    @pl.when(j > 0)
+    def _merge():
+        cur = min_ref[:]
+        better = blk_min < cur                 # strict: ties keep the
+        min_ref[:] = jnp.where(better, blk_min, cur)      # first neuron,
+        arg_ref[:] = jnp.where(better, blk_arg, arg_ref[:])  # = argmin
+
+
+@jax.jit
+def pallas_distance_argmin(x, w):
+    """Fused winner search: (B, F) samples × (N, F) codebook →
+    ``(win int32 (B,), dmin f32 (B,))`` without materializing (B, N)."""
+    from . import tuning
+    b, f = x.shape
+    n, f2 = w.shape
+    assert f == f2, (x.shape, w.shape)
+    bb = min(256, tuning.round_up(b, 8))
+    bn = 128
+    bp, np_, fp = (tuning.round_up(b, bb), tuning.round_up(n, bn),
+                   tuning.round_up(f, 128))
+    if (bp, fp) != (b, f):
+        x = jnp.pad(x, ((0, bp - b), (0, fp - f)))
+    if (np_, fp) != (n, f):
+        w = jnp.pad(w, ((0, np_ - n), (0, fp - f)))
+    grid = (bp // bb, np_ // bn)               # neuron tiles innermost:
+    dmin, win = pl.pallas_call(                # sequential merge per row
+        functools.partial(_dist_argmin_kernel, bn=bn, n_valid=n),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bb, fp), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, fp), lambda i, j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, 128), lambda i, j: (i, 0)),
+            pl.BlockSpec((bb, 128), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bp, 128), jnp.int32),
+        ],
+        interpret=tuning.interpret_mode(),
+    )(x, w)
+    return win[:b, 0], dmin[:b, 0]
+
+
+def forward_winners(x, w):
+    """Dispatching winner search for jax arrays: the fused Pallas kernel
+    on TPU, the XLA distance matrix elsewhere.  Returns (win, dmin)."""
+    from . import tuning
+    if tuning.use_pallas():
+        return pallas_distance_argmin(x, w)
+    d = distances(x, w, jnp)
+    return winners(d, jnp), d.min(axis=1)

@@ -232,6 +232,34 @@ def xla_gd_max_pooling(err, offsets, x_shape, ksize, stride=None,
     return dx[:, ph:ph + h, pw:pw + w, :]
 
 
+def _pallas_gd_max_pool(err, offsets, x_shape, ksize, stride, padding):
+    """Pallas offset-scatter backward: the per-tap equality select runs
+    in one kernel pass (elementwise.pallas_pool_scatter); the regular
+    strided placement of each tap into dx stays in XLA."""
+    from . import elementwise
+    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), \
+        _norm2(stride or ksize), _norm2(padding)
+    b, h, w, c = x_shape
+    _, oh, ow, _ = err.shape
+    taps = elementwise.pallas_pool_scatter(
+        err.reshape(-1, c), offsets.reshape(-1, c), kh * kw)
+    taps = taps.reshape(kh * kw, b, oh, ow, c)
+    dx = jnp.zeros((b, h + 2 * ph, w + 2 * pw, c), jnp.float32)
+    for t, i, j in _taps(kh, kw):
+        dx = dx.at[:, i:i + oh * sh:sh, j:j + ow * sw:sw, :].add(taps[t])
+    return dx[:, ph:ph + h, pw:pw + w, :]
+
+
+def gd_max_pooling(err, offsets, x_shape, ksize, stride=None, padding=0):
+    """Dispatcher: Pallas scatter kernel on TPU, XLA otherwise."""
+    from . import tuning
+    if tuning.use_pallas():
+        return _pallas_gd_max_pool(err, offsets, x_shape, ksize, stride,
+                                   padding)
+    return xla_gd_max_pooling(err, offsets, x_shape, ksize, stride,
+                              padding)
+
+
 def np_depooling(x, offsets, out_shape, ksize, stride=None, padding=0):
     """Unpooling (decoder path): scatter each pooled value back to its
     recorded winner slot — the same dense compare+add scatter as the
@@ -264,6 +292,30 @@ def np_gd_depooling(err, offsets, ksize, stride=None, padding=0):
 
 def xla_gd_depooling(err, offsets, ksize, stride=None, padding=0):
     return _depool_gather(err, offsets, ksize, stride, padding, jnp)
+
+
+def depooling(x, offsets, out_shape, ksize, stride=None, padding=0):
+    """Dispatcher for the decoder-path scatter (same core as gd_max)."""
+    from . import tuning
+    if tuning.use_pallas():
+        return _pallas_gd_max_pool(x, offsets, out_shape, ksize, stride,
+                                   padding)
+    return xla_depooling(x, offsets, out_shape, ksize, stride, padding)
+
+
+def gd_depooling(err, offsets, ksize, stride=None, padding=0):
+    """Dispatcher: winner-tap gather kernel on TPU, XLA otherwise."""
+    from . import elementwise, tuning
+    if not tuning.use_pallas():
+        return xla_gd_depooling(err, offsets, ksize, stride, padding)
+    (kh, kw), (ph, pw) = _norm2(ksize), _norm2(padding)
+    (sh, sw) = _norm2(stride if stride is not None else ksize)
+    b, oh, ow, c = offsets.shape
+    epad = _pad(err, ph, pw, 0.0, jnp)
+    taps = jnp.stack(_slices(epad, kh, kw, sh, sw, oh, ow))
+    out = elementwise.pallas_pool_gather(
+        taps.reshape(kh * kw, -1, c), offsets.reshape(-1, c))
+    return out.reshape(b, oh, ow, c)
 
 
 def np_gd_avg_pooling(err, x_shape, ksize, stride=None, padding=0):

@@ -35,6 +35,13 @@ def interpret_mode() -> bool:
     return _INTERPRET and not on_tpu()
 
 
+def force_pallas_conv() -> bool:
+    """Whether ZNICZ_TPU_CONV=pallas routes the conv/deconv family to
+    the implicit-GEMM Pallas tier (default: XLA's native conv lowering,
+    which beats implicit GEMM on TPU — BASELINE.md kernel table)."""
+    return os.environ.get("ZNICZ_TPU_CONV") == "pallas" and use_pallas()
+
+
 # dtype → (sublane, lane) minimum tile (pallas_guide.md tiling table)
 _MIN_TILE = {
     jnp.float32: (8, 128),

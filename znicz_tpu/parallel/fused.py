@@ -224,7 +224,7 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
                 pre = pre + b
             h = spec.act(i).fwd(pre, jnp)
         elif layer.kind == "deconv":
-            pre = deconv_ops.xla_deconv2d(h.astype(cdt), w.astype(cdt),
+            pre = deconv_ops.deconv2d(h.astype(cdt), w.astype(cdt),
                                           cfg["stride"], cfg["padding"],
                                           out_dtype=jnp.float32)
             if b is not None:
@@ -232,7 +232,7 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
             h = spec.act(i).fwd(pre, jnp)
         elif layer.kind == "depooling":
             off = auxes[cfg["tie"]]
-            h = pool_ops.xla_depooling(
+            h = pool_ops.depooling(
                 h, off, in_shapes[cfg["tie"]], cfg["ksize"],
                 cfg["stride"], cfg["padding"])
             aux = off
@@ -338,24 +338,24 @@ def backward(spec: ModelSpec, params, caches, out, err):
                               preferred_element_type=jnp.float32
                               ).reshape(x_in.shape)
             elif layer.kind == "conv":
-                gw = conv_ops.xla_conv2d_grad_weights(
+                gw = conv_ops.conv2d_grad_weights(
                     x_in, err_pre, w.shape, cfg["stride"],
                     cfg["padding"])
                 gb = (jnp.sum(err_pre, axis=(0, 1, 2))
                       if b is not None else None)
-                err = conv_ops.xla_conv2d_grad_input(
+                err = conv_ops.conv2d_grad_input(
                     err_pre, w, x_in.shape, cfg["stride"], cfg["padding"])
             else:                                         # deconv
-                gw = deconv_ops.xla_deconv2d_grad_weights(
+                gw = deconv_ops.deconv2d_grad_weights(
                     err_pre, x_in, w.shape, cfg["stride"], cfg["padding"])
                 gb = (jnp.sum(err_pre, axis=(0, 1, 2))
                       if b is not None else None)
-                err = deconv_ops.xla_deconv2d_grad_input(
+                err = deconv_ops.deconv2d_grad_input(
                     err_pre, w, cfg["stride"], cfg["padding"])
             grads[i] = (gw, gb)
         elif layer.kind in ("max_pool", "maxabs_pool", "stochastic_pool",
                            "stochastic_abs_pool"):
-            err = pool_ops.xla_gd_max_pooling(
+            err = pool_ops.gd_max_pooling(
                 err.reshape(y_i.shape), aux, x_in.shape, cfg["ksize"],
                 cfg["stride"], cfg["padding"])
         elif layer.kind == "avg_pool":
@@ -367,7 +367,7 @@ def backward(spec: ModelSpec, params, caches, out, err):
                                  cfg["n"], cfg["alpha"], cfg["beta"],
                                  cfg["k"])
         elif layer.kind == "depooling":
-            err = pool_ops.xla_gd_depooling(
+            err = pool_ops.gd_depooling(
                 err.reshape(y_i.shape), aux, cfg["ksize"], cfg["stride"],
                 cfg["padding"])
         elif layer.kind == "dropout":

@@ -34,7 +34,7 @@ class FusedSOMTrainer:
             def body(w, step_idx):
                 x = jnp.take(data, step_idx, axis=0)
                 x = x.reshape(len(x), -1)
-                win, _ = som_ops.xla_forward(x, w)
+                win, _ = som_ops.forward_winners(x, w)
                 w, diff = som_ops.som_update(w, x, win, coords, lr,
                                              sigma, jnp)
                 return w, diff
