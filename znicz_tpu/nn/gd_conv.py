@@ -80,7 +80,7 @@ class GradientDescentConv(GradientDescentBase):
             err_y = act.bwd(err_out.reshape(y.shape), y,
                             x if act.needs_input else None, jnp)
             gw = conv_ops.conv2d_grad_weights(x, err_y, w_shape,
-                                                  sliding, padding)
+                                              sliding, padding)
             gb = jnp.sum(err_y, axis=(0, 1, 2)) if include_bias else None
             err_in = (conv_ops.conv2d_grad_input(
                 err_y, w, x.shape, sliding, padding) if need_err else None)

@@ -79,7 +79,7 @@ class GDDeconv(GradientDescentBase):
             err_y = act.bwd(err_out.reshape(y.shape), y,
                             x if act.needs_input else None, jnp)
             gw = deconv_ops.deconv2d_grad_weights(err_y, x, w_shape,
-                                                      sliding, padding)
+                                                  sliding, padding)
             gb = jnp.sum(err_y, axis=(0, 1, 2)) if include_bias else None
             err_in = (deconv_ops.deconv2d_grad_input(
                 err_y, w, sliding, padding) if need_err else None)

@@ -52,8 +52,11 @@ def model_flops(spec, params, input_shape) -> dict:
             train += 3.0 * f
             shape = (n_out,)
         elif layer.kind in ("conv", "deconv"):
-            kh, kw = w.shape[0], w.shape[1]
-            c_in, c_out = w.shape[2], w.shape[3]
+            # weight-tied deconv: shared W lives at the encoder's index
+            # (counted once in n_params, at the conv's own row)
+            wt = w if w is not None else params[cfg["tie"]][0]
+            kh, kw = wt.shape[0], wt.shape[1]
+            c_in, c_out = wt.shape[2], wt.shape[3]
             if layer.kind == "conv":
                 oh, ow = _conv_out_hw(shape[0], shape[1], kh, kw,
                                       cfg["stride"], cfg["padding"])
@@ -63,11 +66,14 @@ def model_flops(spec, params, input_shape) -> dict:
                 py, px = norm2(cfg["padding"])
                 oh = (shape[0] - 1) * sy + kh - 2 * py
                 ow = (shape[1] - 1) * sx + kw - 2 * px
+            # deconv weights are (KH, KW, C_out, C_in) — its output
+            # channel count is axis 2, not 3 (conv: axis 3)
+            out_c = c_out if layer.kind == "conv" else c_in
             f = 2.0 * kh * kw * c_in * c_out * oh * ow \
-                + (oh * ow * c_out if b is not None else 0)
+                + (oh * ow * out_c if b is not None else 0)
             fwd += f
             train += 3.0 * f
-            shape = (oh, ow, c_out)
+            shape = (oh, ow, out_c)
         elif layer.kind in ("max_pool", "maxabs_pool", "avg_pool",
                             "stochastic_pool", "stochastic_abs_pool"):
             kh, kw = norm2(cfg["ksize"])

@@ -103,14 +103,12 @@ def _pallas_max_pool(x, ksize, stride, padding, use_abs):
     """Stack the window taps in XLA, run the winner select in the Pallas
     kernel (SURVEY.md §2.3 pooling row; §7 hard part (a) split)."""
     from . import elementwise
-    (kh, kw), (sh, sw), (ph, pw) = _norm2(ksize), _norm2(stride), \
-        _norm2(padding)
     b, h, w, c = x.shape
-    oh, ow = out_size(h, kh, sh, ph), out_size(w, kw, sw, pw)
-    xpad = _pad(x, ph, pw, -np.inf if not use_abs else 0.0, jnp)
-    taps = jnp.stack(_slices(xpad, kh, kw, sh, sw, oh, ow))
+    _, oh, ow, _ = pool_out_shape(x.shape, ksize, stride, padding)
+    taps = _tap_stack(x, (oh, ow), ksize, stride, padding,
+                      -np.inf if not use_abs else 0.0, jnp)
     y, idx = elementwise.pallas_pool_select(
-        taps.reshape(kh * kw, -1, c), use_abs=use_abs)
+        taps.reshape(taps.shape[0], -1, c), use_abs=use_abs)
     return y.reshape(b, oh, ow, c), idx.reshape(b, oh, ow, c)
 
 
@@ -303,18 +301,27 @@ def depooling(x, offsets, out_shape, ksize, stride=None, padding=0):
     return xla_depooling(x, offsets, out_shape, ksize, stride, padding)
 
 
+def _tap_stack(x, out_hw, ksize, stride, padding, pad_value, xp):
+    """Pad + stack the strided window taps: (T, B, OH, OW, C) — the
+    shared extraction behind the forward select, the depooling-backward
+    gather, and the stochastic tiers (one place owns the slicing math)."""
+    (kh, kw), (ph, pw) = _norm2(ksize), _norm2(padding)
+    (sh, sw) = _norm2(stride if stride is not None else ksize)
+    oh, ow = out_hw
+    xpad = _pad(x, ph, pw, pad_value, xp)
+    stack = np.stack if xp is np else jnp.stack
+    return stack(_slices(xpad, kh, kw, sh, sw, oh, ow))
+
+
 def gd_depooling(err, offsets, ksize, stride=None, padding=0):
     """Dispatcher: winner-tap gather kernel on TPU, XLA otherwise."""
     from . import elementwise, tuning
     if not tuning.use_pallas():
         return xla_gd_depooling(err, offsets, ksize, stride, padding)
-    (kh, kw), (ph, pw) = _norm2(ksize), _norm2(padding)
-    (sh, sw) = _norm2(stride if stride is not None else ksize)
     b, oh, ow, c = offsets.shape
-    epad = _pad(err, ph, pw, 0.0, jnp)
-    taps = jnp.stack(_slices(epad, kh, kw, sh, sw, oh, ow))
+    taps = _tap_stack(err, (oh, ow), ksize, stride, padding, 0.0, jnp)
     out = elementwise.pallas_pool_gather(
-        taps.reshape(kh * kw, -1, c), offsets.reshape(-1, c))
+        taps.reshape(taps.shape[0], -1, c), offsets.reshape(-1, c))
     return out.reshape(b, oh, ow, c)
 
 

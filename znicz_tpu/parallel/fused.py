@@ -124,17 +124,24 @@ def extract_model(workflow) -> tuple[ModelSpec, list, list]:
             act = fwd.ACTIVATION.name
             config = {"stride": fwd.sliding, "padding": fwd.padding}
         elif isinstance(fwd, Deconv):
-            if fwd.conv_unit is not None:
-                # tied weights are one shared Vector updated by two GD
-                # units sequentially — the fused step's parallel update
-                # would diverge from the unit graph
-                raise NotImplementedError(
-                    "fused path does not support weight-tied Deconv; "
-                    "use the unit-graph path")
             kind = "deconv"
-            has_params = True
             act = fwd.ACTIVATION.name
             config = {"stride": fwd.sliding, "padding": fwd.padding}
+            if fwd.conv_unit is not None:
+                # tied weights: one shared Vector, updated by both GD
+                # units.  The fused step stores the array once (at the
+                # encoder conv's index) and replays the unit graph's
+                # SEQUENTIAL update order (apply_updates walks layers in
+                # reverse, so the deconv's update lands before the conv's
+                # reads W for its decay term — exactly the GD chain's
+                # execution order).  The deconv keeps its own velocity.
+                if fwd.include_bias:
+                    raise NotImplementedError(
+                        "weight-tied Deconv with include_bias=True is "
+                        "not supported by the fused path")
+                config["tie"] = workflow.forwards.index(fwd.conv_unit)
+            else:
+                has_params = True
         elif isinstance(fwd, Depooling):
             kind = "depooling"
             config = {"ksize": fwd.ksize, "stride": fwd.sliding,
@@ -178,6 +185,9 @@ def extract_model(workflow) -> tuple[ModelSpec, list, list]:
             vels.append((np.asarray(gdu.velocity_weights.mem),
                          np.asarray(gdu.velocity_bias.mem)
                          if fwd.include_bias else None))
+        elif kind == "deconv":          # tied: own velocity, shared W
+            params.append((None, None))
+            vels.append((np.asarray(gdu.velocity_weights.mem), None))
         else:
             params.append((None, None))
             vels.append((None, None))
@@ -224,9 +234,10 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
                 pre = pre + b
             h = spec.act(i).fwd(pre, jnp)
         elif layer.kind == "deconv":
-            pre = deconv_ops.deconv2d(h.astype(cdt), w.astype(cdt),
-                                          cfg["stride"], cfg["padding"],
-                                          out_dtype=jnp.float32)
+            wt = w if w is not None else params[cfg["tie"]][0]
+            pre = deconv_ops.deconv2d(h.astype(cdt), wt.astype(cdt),
+                                      cfg["stride"], cfg["padding"],
+                                      out_dtype=jnp.float32)
             if b is not None:
                 pre = pre + b
             h = spec.act(i).fwd(pre, jnp)
@@ -322,7 +333,10 @@ def backward(spec: ModelSpec, params, caches, out, err):
         x_in, aux = caches[i]
         y_i = caches[i + 1][0] if i < n - 1 else out
         cfg = layer.cfg
-        if layer.kind in PARAM_KINDS:
+        if layer.kind in PARAM_KINDS and (w is not None
+                                          or layer.kind == "deconv"):
+            if layer.kind == "deconv" and w is None:
+                w = params[cfg["tie"]][0]        # tied encoder weights
             # fold through the fused activation (last layer already is
             # pre-activation — see docstring)
             err_pre = err if i == n - 1 \
@@ -386,28 +400,40 @@ def apply_updates(spec: ModelSpec, params, vels, grads, lr_scale=1.0):
     # Pallas kernel serves the unit-graph path where each op dispatches
     # separately (the reference's kernel-per-op model).
     # ``lr_scale`` may be traced — LR schedules never force a recompile.
-    new_p, new_v = [], []
-    for layer, (w, b), (vw, vb), grad in zip(spec.layers, params, vels,
-                                             grads):
+    #
+    # Layers apply in REVERSE order — the GD chain's execution order
+    # (last forward's GD runs first).  For independent parameters the
+    # order is irrelevant; for weight-tied Deconv it makes the shared
+    # Vector's two sequential updates land exactly as the unit graph's:
+    # the deconv's update first, then the conv's decay term reads the
+    # already-updated W.
+    n = len(spec.layers)
+    cur_w = [p[0] for p in params]
+    cur_b = [p[1] for p in params]
+    new_v = [list(v) for v in vels]
+    for i in reversed(range(n)):
+        layer, grad = spec.layers[i], grads[i]
+        if grad is None:
+            continue
+        tgt = layer.cfg.get("tie", i) if layer.kind == "deconv" else i
+        w, b = cur_w[tgt], cur_b[i]
         if w is None:
-            new_p.append((None, None))
-            new_v.append((None, None))
             continue
         gw, gb = grad
+        vw, vb = vels[i]
         lr, wd, l1, mom = layer.hypers
         reg = wd * ((1.0 - l1) * w + 0.5 * l1 * jnp.sign(w))
         vw2 = mom * vw - lr * lr_scale * (gw + reg)
-        w2 = w + vw2
+        cur_w[tgt] = w + vw2
+        new_v[i][0] = vw2
         if b is not None:
             lrb, wdb, l1b, momb = layer.hypers_bias
             regb = wdb * ((1.0 - l1b) * b + 0.5 * l1b * jnp.sign(b))
             vb2 = momb * vb - lrb * lr_scale * (gb + regb)
-            b2 = b + vb2
-        else:
-            b2, vb2 = None, None
-        new_p.append((w2, b2))
-        new_v.append((vw2, vb2))
-    return new_p, new_v
+            cur_b[i] = b + vb2
+            new_v[i][1] = vb2
+    return ([(w, b) for w, b in zip(cur_w, cur_b)],
+            [tuple(v) for v in new_v])
 
 
 def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None,
@@ -462,6 +488,11 @@ class FusedTrainer:
                         (mesh_lib.shard_params(mesh, pidx, w.ndim),
                          mesh_lib.replicated(mesh)))
                     pidx += 1
+            for j, layer in enumerate(spec.layers):
+                # tied deconv: its velocity must shard like the shared W
+                if layer.kind == "deconv" and "tie" in layer.cfg:
+                    self._param_shardings[j] = \
+                        self._param_shardings[layer.cfg["tie"]]
             put = lambda a, s: jax.device_put(a, s)      # noqa: E731
             self.params = [
                 (put(w, sh[0]) if w is not None else None,
@@ -578,11 +609,11 @@ class FusedTrainer:
         for fwd, gdu, (w, b), (vw, vb) in zip(
                 self.workflow.forwards, self.workflow.gds, self.params,
                 self.vels):
-            if w is None:
-                continue
-            fwd.weights.mem = np.asarray(w)
-            if b is not None:
-                fwd.bias.mem = np.asarray(b)
-            gdu.velocity_weights.mem = np.asarray(vw)
+            if w is not None:
+                fwd.weights.mem = np.asarray(w)
+                if b is not None:
+                    fwd.bias.mem = np.asarray(b)
+            if vw is not None:   # tied deconv: own velocity, shared W
+                gdu.velocity_weights.mem = np.asarray(vw)
             if vb is not None:
                 gdu.velocity_bias.mem = np.asarray(vb)
