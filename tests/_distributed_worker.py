@@ -1,0 +1,70 @@
+"""Worker for the true multi-process distributed test (run via
+``subprocess`` from tests/test_distributed.py, 2 processes on CPU).
+
+Each process bootstraps through ``parallel.distributed`` exactly the way
+a real multi-host deployment would (SURVEY.md §3.2 job-loop redesign):
+``initialize`` → ``global_mesh`` over both processes' devices →
+``process_shard``/``shard_dataset`` to assemble the global batch from
+process-local rows → fused train steps whose gradient all-reduce rides
+XLA collectives.  Process 0 saves the final weights for the parent test
+to compare against a single-process run of the identical math.
+
+Usage: python _distributed_worker.py PORT PROC_ID NUM_PROCS OUT.npy
+"""
+
+import sys
+
+import numpy as np
+
+import jax
+
+
+def main() -> None:
+    port, pid, nproc, out = (sys.argv[1], int(sys.argv[2]),
+                             int(sys.argv[3]), sys.argv[4])
+    # a sitecustomize imports jax before this script runs, so the
+    # JAX_PLATFORMS env var is already consumed — force CPU the way
+    # tests/conftest.py does, before any backend is instantiated
+    jax.config.update("jax_platforms", "cpu")
+    from znicz_tpu.parallel import distributed
+    distributed.initialize(f"127.0.0.1:{port}", num_processes=nproc,
+                           process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+
+    from znicz_tpu.parallel import fused, mesh as mesh_lib
+    from znicz_tpu.parallel.fused import LayerSpec, ModelSpec
+
+    n, feats, classes = 64, 32, 5
+    rng = np.random.default_rng(0)           # all processes draw the
+    data = rng.standard_normal((n, feats)).astype(np.float32)  # same set
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    w0 = (rng.standard_normal((feats, classes)) * 0.1).astype(np.float32)
+
+    mesh = distributed.global_mesh()
+    sl = distributed.process_shard(n)
+    gx = distributed.shard_dataset(data[sl], mesh, n)
+    gy = distributed.shard_dataset(labels[sl], mesh, n)
+
+    spec = ModelSpec((LayerSpec(
+        kind="fc", activation="linear", include_bias=True,
+        hypers=(0.05, 0.0, 0.0, 0.9),
+        hypers_bias=(0.05, 0.0, 0.0, 0.9)),), "softmax")
+    repl = mesh_lib.replicated(mesh)
+    put = lambda a: jax.device_put(a, repl)            # noqa: E731
+    params = [(put(w0), put(np.zeros(classes, np.float32)))]
+    vels = [(put(np.zeros_like(w0)),
+             put(np.zeros(classes, np.float32)))]
+
+    step = jax.jit(
+        lambda p, v, x, t: fused.train_minibatch(spec, p, v, x, t)[:2])
+    for _ in range(5):
+        params, vels = step(params, vels, gx, gy)
+
+    final = np.asarray(params[0][0])     # replicated → locally readable
+    if pid == 0:
+        np.save(out, final)
+    jax.effects_barrier()
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,33 @@ class TestLauncher:
         wf = ln.run()
         assert wf.loader.max_minibatch_size == 30
 
+    def test_profile_trace_produced(self, small_mnist, config_file,
+                                    tmp_path):
+        """--profile DIR wraps the run in jax.profiler.trace and leaves
+        a trace artifact behind (VERDICT round 1, item 9)."""
+        trace_dir = str(tmp_path / "trace")
+        ln = Launcher("znicz_tpu.models.mnist", config=config_file,
+                      backend="xla", epochs=1, profile=trace_dir)
+        ln.run()
+        found = [os.path.join(dp, f)
+                 for dp, _, fs in os.walk(trace_dir) for f in fs]
+        assert any(f.endswith((".xplane.pb", ".trace.json.gz"))
+                   for f in found), found
+
+    def test_run_fused_profile_dir(self, small_mnist, config_file,
+                                   tmp_path):
+        from znicz_tpu.backends import Device
+        from znicz_tpu.models.mnist import MnistWorkflow
+        exec_config_file(config_file)
+        prng.seed_all(99)
+        wf = MnistWorkflow()
+        wf.decision.max_epochs = 1
+        wf.initialize(device=Device.create("xla"))
+        trace_dir = str(tmp_path / "fused_trace")
+        wf.run_fused(max_epochs=1, profile_dir=trace_dir)
+        found = [f for _, _, fs in os.walk(trace_dir) for f in fs]
+        assert found, "no trace artifacts written"
+
     def test_config_exec_sees_root(self, tmp_path):
         cfg = tmp_path / "c.py"
         cfg.write_text("root.testing.value = 41 + 1\n")

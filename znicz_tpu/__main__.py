@@ -41,6 +41,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="PATH=VALUE",
                    help="config override, e.g. --set mnist.layers=[...]")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="dump a jax.profiler trace of the run into DIR "
+                        "(view with TensorBoard / xprof)")
     p.add_argument("--coordinator", default=None,
                    help="host:port of process 0 (multi-host SPMD)")
     p.add_argument("--num-processes", type=int, default=1)
@@ -55,7 +58,7 @@ def main(argv=None) -> int:
         snapshot=args.snapshot, epochs=args.epochs, fused=args.fused,
         seed=args.seed, overrides=args.overrides,
         coordinator=args.coordinator, num_processes=args.num_processes,
-        process_id=args.process_id)
+        process_id=args.process_id, profile=args.profile)
     wf = launcher.run()
     decision = getattr(wf, "decision", None)
     if decision is not None and decision.epoch_metrics:

@@ -83,27 +83,51 @@ class Config:
         return self
 
     # -- access helpers ----------------------------------------------------
+    @staticmethod
+    def _descend(node, part):
+        """One path step: Config attribute, list index, or dict key —
+        paths may continue into container leaves (``layers.0.<-.lr``),
+        which the genetics module needs to evolve per-layer hypers."""
+        if isinstance(node, Config):
+            return node.__dict__.get(part, _MISSING)
+        if isinstance(node, list):
+            try:
+                return node[int(part)]
+            except (ValueError, IndexError):
+                return _MISSING
+        if isinstance(node, dict):
+            return node.get(part, _MISSING)
+        return _MISSING
+
     def get(self, name: str, default=None):
         """Read a leaf without creating intermediate nodes."""
-        parts = name.split(".")
         node = self
-        for i, part in enumerate(parts):
-            value = node.__dict__.get(part, _MISSING)
-            is_last = i == len(parts) - 1
-            if value is _MISSING or (not is_last
-                                     and not isinstance(value, Config)):
+        for part in name.split("."):
+            node = self._descend(node, part)
+            if node is _MISSING:
                 return default
-            node = value
         return default if isinstance(node, Config) and not node.to_dict() \
             else node
 
     def set_path(self, dotted: str, value):
-        """CLI-style override: ``set_path("mnist.lr", 0.01)``."""
+        """CLI-style override: ``set_path("mnist.lr", 0.01)``; paths may
+        index into list/dict leaves (``mnist.layers.0.<-.learning_rate``)."""
         parts = dotted.split(".")
         node = self
         for part in parts[:-1]:
-            node = getattr(node, part)
-        setattr(node, parts[-1], value)
+            if isinstance(node, Config):
+                node = getattr(node, part)
+            elif isinstance(node, list):
+                node = node[int(part)]
+            else:
+                node = node[part]
+        last = parts[-1]
+        if isinstance(node, Config):
+            setattr(node, last, value)
+        elif isinstance(node, list):
+            node[int(last)] = value
+        else:
+            node[last] = value
 
     def to_dict(self) -> dict:
         out = {}

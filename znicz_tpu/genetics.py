@@ -108,14 +108,31 @@ class GeneticOptimizer:
             out.append(v)
         return out
 
+    def _score_population(self, population) -> None:
+        """Fill in missing fitnesses — batched through the evaluator's
+        ``evaluate_population`` when it has one (LauncherEvaluator runs
+        candidates through parallel launcher processes, the reference
+        genetics execution model), else serially."""
+        pending = [i for i in population if i.fitness is None]
+        if pending and hasattr(self.evaluate, "evaluate_population"):
+            trees = []
+            for ind in pending:
+                tree = self.tree.clone()
+                self.apply(ind.values, tree)
+                trees.append(tree)
+            fits = self.evaluate.evaluate_population(trees)
+            for ind, f in zip(pending, fits):
+                ind.fitness = float(f)
+        for ind in population:
+            self._fitness(ind)
+
     # -- main loop -----------------------------------------------------------
     def run(self) -> Individual:
         population = [Individual([g.sample(self.gen)
                                   for g in self.genes])
                       for _ in range(self.population_size)]
         for generation in range(self.generations):
-            for ind in population:
-                self._fitness(ind)
+            self._score_population(population)
             population.sort(key=lambda i: -i.fitness)
             self.best = population[0]
             self.history.append({
@@ -135,3 +152,141 @@ class GeneticOptimizer:
             population = nxt
         self.apply(self.best.values, self.tree)   # install the winner
         return self.best
+
+
+# -- launcher-driven evaluation (reference: fitness = workflow result) -----
+def _eval_main() -> None:
+    """Subprocess entry: evaluate ONE candidate via the Launcher and
+    print its fitness as JSON (spawned by LauncherEvaluator)."""
+    import json
+    import sys
+
+    cfg = json.loads(sys.argv[1])
+    if cfg.get("force_cpu"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from .launcher import Launcher
+    wf = Launcher(cfg["workflow"], epochs=cfg.get("epochs"),
+                  backend=cfg.get("backend", "auto"),
+                  seed=cfg.get("seed"),
+                  overrides=cfg.get("overrides", ())).run()
+    value = wf.decision.epoch_metrics[-1][cfg["metric"]]
+    fitness = value if cfg.get("maximize") else -value
+    print(json.dumps({"fitness": float(fitness)}))
+
+
+class LauncherEvaluator:
+    """Fitness = a workflow trained through the :class:`Launcher`
+    (SURVEY.md §2.1 genetics row: the reference ran every candidate
+    through the launcher; chromosome = config leaves).
+
+    ``processes > 1`` evaluates candidates in parallel OS processes
+    (each a fresh interpreter running :func:`_eval_main` with the
+    chromosome as ``--set``-style overrides — the population-parallel
+    execution the reference got from forked launchers).  ``processes=1``
+    evaluates in-process: the candidate tree's values are applied to the
+    global ``root``, the workflow is built and trained, and ``root`` is
+    restored — same contract, no interpreter spin-up, shared jit cache."""
+
+    def __init__(self, workflow: str, genes, metric="validation_n_err",
+                 maximize=False, epochs=1, backend="xla",
+                 seed: int | None = 4321, processes=1, force_cpu=False,
+                 extra_overrides=()):
+        self.workflow = workflow
+        self.genes = list(genes)
+        self.metric = metric
+        self.maximize = maximize
+        self.epochs = epochs
+        self.backend = backend
+        self.seed = seed
+        self.processes = int(processes)
+        self.force_cpu = force_cpu
+        #: fixed ``path=value`` overrides shipped to every candidate —
+        #: subprocesses start from module defaults, so experiment-level
+        #: settings (dataset sizes, minibatch) must ride along
+        self.extra_overrides = list(extra_overrides)
+        # import the workflow module NOW so its setdefaults populate the
+        # root tree — gene paths must resolve into real config (cloning
+        # before the defaults exist would auto-create empty nodes and
+        # corrupt the layers list)
+        from .launcher import load_workflow_module
+        load_workflow_module(workflow)
+
+    def _overrides(self, tree) -> list[str]:
+        return self.extra_overrides \
+            + [f"{g.path}={tree.get(g.path)!r}" for g in self.genes]
+
+    def _eval_inprocess(self, tree) -> float:
+        import copy
+
+        from .launcher import Launcher
+        saved = copy.deepcopy(root.to_dict())
+        try:
+            root.update(tree.to_dict())
+            wf = Launcher(self.workflow, epochs=self.epochs,
+                          backend=self.backend, seed=self.seed).run()
+            value = wf.decision.epoch_metrics[-1][self.metric]
+            return float(value if self.maximize else -value)
+        finally:
+            root.update(saved)
+
+    def __call__(self, tree) -> float:
+        return self.evaluate_population([tree])[0]
+
+    def evaluate_population(self, trees) -> list:
+        if self.processes <= 1:
+            return [self._eval_inprocess(t) for t in trees]
+        import json
+        import subprocess
+        import sys
+
+        def job(tree):
+            cfg = {"workflow": self.workflow, "metric": self.metric,
+                   "maximize": self.maximize, "epochs": self.epochs,
+                   "backend": self.backend, "seed": self.seed,
+                   "force_cpu": self.force_cpu,
+                   "overrides": self._overrides(tree)}
+            return subprocess.Popen(
+                [sys.executable, "-c",
+                 "from znicz_tpu.genetics import _eval_main; _eval_main()",
+                 json.dumps(cfg)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+        import time
+
+        results: list[float | None] = [None] * len(trees)
+        queue = list(enumerate(trees))
+        active: list[tuple[int, object]] = []
+        try:
+            while queue or active:
+                while queue and len(active) < self.processes:
+                    i, tree = queue.pop(0)
+                    active.append((i, job(tree)))
+                # reap whichever candidate finishes first — a slow
+                # oldest process must not hold the slot (as-completed,
+                # not FIFO)
+                done = next(((k, p) for k, p in active
+                             if p.poll() is not None), None)
+                if done is None:
+                    time.sleep(0.2)
+                    continue
+                active.remove(done)
+                i, proc = done
+                out, err = proc.communicate()
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"candidate evaluation failed "
+                        f"(rc={proc.returncode}):\n{err[-2000:]}")
+                for line in reversed(out.strip().splitlines()):
+                    try:
+                        results[i] = json.loads(line)["fitness"]
+                        break
+                    except ValueError:
+                        continue
+                else:
+                    raise RuntimeError(
+                        f"no fitness JSON in output:\n{out}")
+        finally:
+            for _, proc in active:       # no orphans on failure paths
+                proc.kill()
+        return results

@@ -54,7 +54,7 @@ class Launcher:
                  epochs: int | None = None, fused: bool = False,
                  seed: int | None = None, overrides=(),
                  coordinator: str | None = None, num_processes: int = 1,
-                 process_id: int = 0):
+                 process_id: int = 0, profile: str | None = None):
         self.workflow_spec = workflow
         self.config_path = config
         self.backend = backend
@@ -66,7 +66,18 @@ class Launcher:
         self.coordinator = coordinator
         self.num_processes = num_processes
         self.process_id = process_id
+        self.profile = profile
         self.workflow = None
+
+    def _trace_ctx(self):
+        """``jax.profiler.trace`` around the whole run when --profile DIR
+        is set (SURVEY.md §5 tracing row: the TPU-level complement to the
+        per-unit wall-clock time table, which is kept)."""
+        if not self.profile:
+            import contextlib
+            return contextlib.nullcontext()
+        import jax
+        return jax.profiler.trace(self.profile)
 
     # -- distributed bootstrap (replaces Server/Client) --------------------
     def init_distributed(self) -> None:
@@ -79,15 +90,21 @@ class Launcher:
             process_id=self.process_id)
 
     def build(self):
-        """Import module + config, seed, construct the workflow."""
+        """Import module + config, seed, construct the workflow.
+
+        Order matters: config file first (its values beat the module's
+        ``setdefaults``), then the module import (defaults fill the
+        gaps), then ``--set`` overrides LAST — they must win over both,
+        and deep paths (``mnist.layers.0.<-.learning_rate``) can only
+        resolve once the module's default structures exist."""
         self.init_distributed()
         if self.config_path:
             exec_config_file(self.config_path)
+        module = load_workflow_module(self.workflow_spec)
+        self.module = module
         apply_overrides(self.overrides)
         prng.seed_all(self.seed if self.seed is not None
                       else root.common.get("seed", 1234))
-        module = load_workflow_module(self.workflow_spec)
-        self.module = module
         if not hasattr(module, "run"):
             raise AttributeError(
                 f"workflow module {self.workflow_spec!r} defines no "
@@ -114,13 +131,15 @@ class Launcher:
             SnapshotterToFile.load(wf, self.snapshot)
             if self.epochs is not None:
                 wf.decision.max_epochs = self.epochs
-            if self.fused and hasattr(wf, "run_fused"):
-                wf.run_fused()
-            else:
-                wf.run()
+            with self._trace_ctx():
+                if self.fused and hasattr(wf, "run_fused"):
+                    wf.run_fused()
+                else:
+                    wf.run()
             self.workflow = wf
             return wf
-        self.workflow = module.run(**kwargs)
+        with self._trace_ctx():
+            self.workflow = module.run(**kwargs)
         return self.workflow
 
     def _build_workflow_only(self, module, device):

@@ -139,7 +139,20 @@ def extract_model(workflow) -> tuple[ModelSpec, list, list]:
                     raise NotImplementedError(
                         "weight-tied Deconv with include_bias=True is "
                         "not supported by the fused path")
-                config["tie"] = workflow.forwards.index(fwd.conv_unit)
+                tie = workflow.forwards.index(fwd.conv_unit)
+                if any(la.kind in PARAM_KINDS for la in layers[:tie]):
+                    # the unit graph propagates err below the tied conv
+                    # through the DECONV-UPDATED shared W (gd_deconv ran
+                    # first); the fused backward computes all grads from
+                    # pre-update params, so those nets would silently
+                    # diverge — refuse instead
+                    raise NotImplementedError(
+                        "fused path supports weight-tied Deconv only "
+                        "when no trainable layer sits below the tied "
+                        "encoder conv (err_input there would need the "
+                        "mid-backward updated W); use the unit-graph "
+                        "path")
+                config["tie"] = tie
             else:
                 has_params = True
         elif isinstance(fwd, Depooling):

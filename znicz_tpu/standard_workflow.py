@@ -181,13 +181,27 @@ class StandardWorkflowBase(AcceleratedWorkflow):
 
     # -- fused execution (the TPU hot path) -------------------------------
     def run_fused(self, mesh=None, max_epochs: int | None = None,
-                  compute_dtype: str | None = None):
+                  compute_dtype: str | None = None,
+                  profile_dir: str | None = None):
         """Train via the compiled fused step instead of the unit-graph
         tick loop: whole epochs run as one device-side ``lax.scan``
         (optionally mesh-sharded), with Decision's improvement/stop logic
         applied between epochs on host.  Weights are written back into
         the unit Vectors afterwards, so snapshotting/inspection work
-        unchanged.  Returns the FusedTrainer (kept for further use)."""
+        unchanged.  ``profile_dir`` wraps the run in a ``jax.profiler``
+        trace (SURVEY.md §5 tracing row — the device-level complement to
+        ``time_table()``), landing next to the JSONL metrics.  Returns
+        the FusedTrainer (kept for further use)."""
+        import contextlib
+        if profile_dir is not None:
+            import jax
+            ctx = jax.profiler.trace(profile_dir)
+        else:
+            ctx = contextlib.nullcontext()
+        with ctx:
+            return self._run_fused_body(mesh, max_epochs, compute_dtype)
+
+    def _run_fused_body(self, mesh, max_epochs, compute_dtype):
         from .loader.base import TEST, TRAIN, VALID
         from .parallel import FusedTrainer, fused
 
