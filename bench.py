@@ -144,12 +144,14 @@ def _build(config: str, minibatch, n_train):
     return wf
 
 
-def measure_fused(wf, epochs: int, warm: int = 2):
+def measure_fused(wf, epochs: int, warm: int = 2, dtype: str | None = None):
     """(images/sec, spec, params) of the fused whole-step path."""
     from znicz_tpu.parallel import fused, FusedTrainer
 
-    spec, params, _ = fused.extract_model(wf)
-    tr = FusedTrainer(wf)
+    spec, params, vels = fused.extract_model(wf)
+    if dtype and dtype != spec.compute_dtype:
+        spec = fused.ModelSpec(spec.layers, spec.loss, dtype)
+    tr = FusedTrainer(spec=spec, params=params, vels=vels)
     ld = wf.loader
     data = ld.original_data.devmem
     # MSE heads (autoencoder) regress on target tensors, not labels
@@ -172,7 +174,8 @@ def measure_fused(wf, epochs: int, warm: int = 2):
     return epochs * n / dt, spec, params
 
 
-def measure_stream(wf, epochs: int, warm: int = 2):
+def measure_stream(wf, epochs: int, warm: int = 2,
+                   dtype: str | None = None):
     """Images/sec of the streaming fused path: the SAME model/arrays as
     measure_fused, but served from .znr shards on disk through the
     double-buffered prefetcher (VERDICT item 4 done-criterion: disk-backed
@@ -186,6 +189,8 @@ def measure_stream(wf, epochs: int, warm: int = 2):
     from znicz_tpu.workflow import Workflow
 
     spec, params, vels = fused.extract_model(wf)
+    if dtype and dtype != spec.compute_dtype:
+        spec = fused.ModelSpec(spec.layers, spec.loss, dtype)
     ld = wf.loader
     n = ld.class_lengths[2]
     tmp = tempfile.mkdtemp(prefix="znicz_bench_znr_")
@@ -304,8 +309,10 @@ def bench_training(args) -> int:
             return _emit(result)
         try:
             fused_ips, spec, params = measure_fused(
-                wf, args.epochs, getattr(args, "warm", 2))
+                wf, args.epochs, getattr(args, "warm", 2),
+                dtype=args.dtype)
             result["path"] = "fused"
+            result["compute_dtype"] = (args.dtype or "float32")
         except NotImplementedError as e:
             # e.g. weight-tied Deconv: fall back to the unit-graph path
             # so the config still gets a measured number
@@ -329,7 +336,8 @@ def bench_training(args) -> int:
             if args.stream and \
                     getattr(wf, "loss_function", "softmax") != "mse":
                 stream_ips = measure_stream(wf, args.epochs,
-                                            getattr(args, "warm", 2))
+                                            getattr(args, "warm", 2),
+                                            dtype=args.dtype)
                 result["stream_value"] = round(stream_ips, 1)
                 result["stream_vs_resident"] = round(
                     stream_ips / fused_ips, 3)
@@ -527,6 +535,10 @@ def main(argv=None) -> int:
     p.add_argument("--epochs", type=int, default=4)
     p.add_argument("--ticks", type=int, default=4)
     p.add_argument("--backend-wait", type=float, default=420.0)
+    p.add_argument("--dtype", default=None,
+                   choices=(None, "float32", "bfloat16"),
+                   help="compute dtype for the fused path's MXU operands"
+                        " (params/accumulation stay f32)")
     p.add_argument("--kernels", action="store_true")
     p.add_argument("--stream", action="store_true",
                    help="also measure the disk-backed streaming path")

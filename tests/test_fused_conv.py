@@ -107,6 +107,17 @@ class TestFusedConvEquivalence:
         _drive_graph(wf, idx)
         _assert_params_match(wf, tr)
 
+    def test_run_fused_bfloat16_converges(self):
+        """compute_dtype='bfloat16': MXU operands in bf16, params and
+        accumulation f32 — training must still converge (mixed-precision
+        contract of the fused path)."""
+        wf = _workflow()
+        wf.run_fused(max_epochs=4, compute_dtype="bfloat16")
+        last = wf.decision.epoch_metrics[-1]
+        assert last["validation_err_pct"] < 25.0, wf.decision.epoch_metrics
+        assert np.isfinite(wf.forwards[0].weights.mem).all()
+        assert wf.forwards[0].weights.mem.dtype == np.float32  # master f32
+
     def test_run_fused_converges_conv(self):
         wf = _workflow()
         trainer = wf.run_fused(max_epochs=4)

@@ -67,7 +67,12 @@ class GeneticOptimizer:
         self.mutation_sigma = mutation_sigma
         self.elite = elite
         self.tree = tree
-        self.gen = prng.get(stream)
+        # a PRIVATE generator, not the registry's: candidate evaluation
+        # through the Launcher reseeds every registered stream
+        # (prng.seed_all), which would reset the GA's own draws each
+        # generation and degenerate the search
+        self.gen = prng.RandomGenerator(
+            f"{stream}(private)", prng.get(stream).stream_seed)
         self.history: list[dict] = []
         self.best: Individual | None = None
 
@@ -219,16 +224,23 @@ class LauncherEvaluator:
     def _eval_inprocess(self, tree) -> float:
         import copy
 
+        from .config import apply_overrides
         from .launcher import Launcher
         saved = copy.deepcopy(root.to_dict())
+        saved_seed = prng._global_seed
         try:
             root.update(tree.to_dict())
-            wf = Launcher(self.workflow, epochs=self.epochs,
+            apply_overrides(self.extra_overrides)   # parity with the
+            wf = Launcher(self.workflow, epochs=self.epochs,  # subprocess
                           backend=self.backend, seed=self.seed).run()
             value = wf.decision.epoch_metrics[-1][self.metric]
             return float(value if self.maximize else -value)
         finally:
             root.update(saved)
+            # the Launcher reseeded the global streams for reproducible
+            # candidate runs; restore the caller's seed (stream
+            # *positions* are not restorable — documented caveat)
+            prng.seed_all(saved_seed)
 
     def __call__(self, tree) -> float:
         return self.evaluate_population([tree])[0]
@@ -239,6 +251,8 @@ class LauncherEvaluator:
         import json
         import subprocess
         import sys
+        import tempfile
+        import time
 
         def job(tree):
             cfg = {"workflow": self.workflow, "metric": self.metric,
@@ -246,47 +260,57 @@ class LauncherEvaluator:
                    "backend": self.backend, "seed": self.seed,
                    "force_cpu": self.force_cpu,
                    "overrides": self._overrides(tree)}
-            return subprocess.Popen(
+            # temp files, not PIPEs: a chatty child must never block on
+            # a full pipe buffer while the parent waits on poll()
+            fout = tempfile.TemporaryFile(mode="w+t")
+            ferr = tempfile.TemporaryFile(mode="w+t")
+            proc = subprocess.Popen(
                 [sys.executable, "-c",
                  "from znicz_tpu.genetics import _eval_main; _eval_main()",
                  json.dumps(cfg)],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-
-        import time
+                stdout=fout, stderr=ferr, text=True)
+            return proc, fout, ferr
 
         results: list[float | None] = [None] * len(trees)
         queue = list(enumerate(trees))
-        active: list[tuple[int, object]] = []
+        active: list[tuple] = []
         try:
             while queue or active:
                 while queue and len(active) < self.processes:
                     i, tree = queue.pop(0)
-                    active.append((i, job(tree)))
+                    active.append((i, *job(tree)))
                 # reap whichever candidate finishes first — a slow
                 # oldest process must not hold the slot (as-completed,
                 # not FIFO)
-                done = next(((k, p) for k, p in active
-                             if p.poll() is not None), None)
+                done = next((entry for entry in active
+                             if entry[1].poll() is not None), None)
                 if done is None:
                     time.sleep(0.2)
                     continue
                 active.remove(done)
-                i, proc = done
-                out, err = proc.communicate()
+                i, proc, fout, ferr = done
+                fout.seek(0)
+                out = fout.read()
+                ferr.seek(0)
+                err = ferr.read()
+                fout.close()
+                ferr.close()
                 if proc.returncode != 0:
                     raise RuntimeError(
                         f"candidate evaluation failed "
                         f"(rc={proc.returncode}):\n{err[-2000:]}")
                 for line in reversed(out.strip().splitlines()):
                     try:
-                        results[i] = json.loads(line)["fitness"]
+                        results[i] = float(json.loads(line)["fitness"])
                         break
-                    except ValueError:
-                        continue
+                    except (ValueError, KeyError, TypeError):
+                        continue   # non-fitness JSON / stray output line
                 else:
                     raise RuntimeError(
                         f"no fitness JSON in output:\n{out}")
         finally:
-            for _, proc in active:       # no orphans on failure paths
-                proc.kill()
+            for entry in active:         # no orphans on failure paths
+                entry[1].kill()
+                entry[2].close()
+                entry[3].close()
         return results

@@ -365,20 +365,25 @@ def backward(spec: ModelSpec, params, caches, out, err):
                               preferred_element_type=jnp.float32
                               ).reshape(x_in.shape)
             elif layer.kind == "conv":
+                # grads accumulate in f32 (preferred_element_type inside
+                # the conv ops); cdt only feeds the MXU operands
                 gw = conv_ops.conv2d_grad_weights(
-                    x_in, err_pre, w.shape, cfg["stride"],
-                    cfg["padding"])
+                    x_in.astype(cdt), err_pre.astype(cdt), w.shape,
+                    cfg["stride"], cfg["padding"])
                 gb = (jnp.sum(err_pre, axis=(0, 1, 2))
                       if b is not None else None)
                 err = conv_ops.conv2d_grad_input(
-                    err_pre, w, x_in.shape, cfg["stride"], cfg["padding"])
+                    err_pre.astype(cdt), w.astype(cdt), x_in.shape,
+                    cfg["stride"], cfg["padding"])
             else:                                         # deconv
                 gw = deconv_ops.deconv2d_grad_weights(
-                    err_pre, x_in, w.shape, cfg["stride"], cfg["padding"])
+                    err_pre.astype(cdt), x_in.astype(cdt), w.shape,
+                    cfg["stride"], cfg["padding"])
                 gb = (jnp.sum(err_pre, axis=(0, 1, 2))
                       if b is not None else None)
                 err = deconv_ops.deconv2d_grad_input(
-                    err_pre, w, cfg["stride"], cfg["padding"])
+                    err_pre.astype(cdt), w.astype(cdt), cfg["stride"],
+                    cfg["padding"])
             grads[i] = (gw, gb)
         elif layer.kind in ("max_pool", "maxabs_pool", "stochastic_pool",
                            "stochastic_abs_pool"):
