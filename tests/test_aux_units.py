@@ -175,5 +175,31 @@ class TestWebStatus:
             with urllib.request.urlopen(srv.url, timeout=10) as resp:
                 page = resp.read().decode()
             assert "znicz-tpu" in page
+            # live plot endpoint (graphics-server equivalent): error
+            # curves rendered server-side as SVG polylines
+            with urllib.request.urlopen(srv.url + "plot.svg",
+                                        timeout=10) as resp:
+                svg = resp.read().decode()
+            assert svg.startswith("<svg") and "polyline" in svg
+            assert "validation_err_pct" in svg
         finally:
             srv.stop()
+
+
+class TestThreadPool:
+    def test_pool_and_shared(self):
+        from znicz_tpu import thread_pool
+        pool = thread_pool.ThreadPool(2, name="t")
+        assert sorted(pool.map(lambda x: x * x, range(5))) == \
+            [0, 1, 4, 9, 16]
+        assert pool.submit(sum, (1, 2, 3)).result() == 6
+        pool.shutdown()
+        pool.shutdown()            # idempotent
+        assert pool.map(str, [1])  # transparently restarts
+        pool.shutdown()
+        shared = thread_pool.get()
+        assert thread_pool.get() is shared
+
+
+# Wine sample functional tests live in tests/test_wine_functional.py
+# (repo convention: one functional module per sample).

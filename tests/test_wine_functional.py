@@ -1,0 +1,39 @@
+"""Wine sample functional tests (SURVEY.md §2.2 secondary samples row:
+the reference's samples/Wine tabular "hello world"): convergence on the
+13-feature/3-class geometry, and fused-vs-unit-graph parity — the
+mean/dispersion normalizer meets wildly-scaled features here."""
+
+import numpy as np
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.models import wine
+
+
+class TestWineSample:
+    def test_wine_converges(self):
+        prng.seed_all(1234)
+        wf = wine.run(device=Device.create("xla"), epochs=15)
+        last = wf.decision.epoch_metrics[-1]
+        assert last["validation_err_pct"] < 25.0, \
+            wf.decision.epoch_metrics[-3:]
+
+    def test_wine_fused_matches_unit_graph(self):
+        """run() and run_fused() train to the same weights over 5
+        epochs (the repo-wide fused-parity convention)."""
+        prng.seed_all(1234)
+        wf = wine.WineWorkflow()
+        wf.decision.max_epochs = 5
+        wf.initialize(device=Device.create("xla"))
+        wf.run()
+        prng.seed_all(1234)
+        wf2 = wine.WineWorkflow()
+        wf2.decision.max_epochs = 5
+        wf2.initialize(device=Device.create("xla"))
+        wf2.run_fused(max_epochs=5)
+        for f1, f2 in zip(wf.forwards, wf2.forwards):
+            np.testing.assert_allclose(f1.weights.mem, f2.weights.mem,
+                                       rtol=5e-4, atol=1e-5,
+                                       err_msg=f1.name)
+        assert np.isfinite(
+            wf2.decision.epoch_metrics[-1]["validation_loss"])

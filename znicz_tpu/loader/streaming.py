@@ -28,7 +28,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from ..thread_pool import ThreadPool
 
 import numpy as np
 
@@ -159,7 +159,7 @@ class OnTheFlyImageLoader(StreamingLoader):
         self.scale = scale
         self.decode_workers = decode_workers
         self.label_map: dict[str, int] = {}
-        self._pool: ThreadPoolExecutor | None = None
+        self._pool: ThreadPool | None = None
 
     def _scan_split(self, paths) -> list[tuple[str, str]]:
         found = []
@@ -198,7 +198,8 @@ class OnTheFlyImageLoader(StreamingLoader):
     def read_batch(self, indices) -> tuple[np.ndarray, np.ndarray]:
         idx = np.asarray(indices)
         if self._pool is None:
-            self._pool = ThreadPoolExecutor(self.decode_workers)
+            self._pool = ThreadPool(self.decode_workers,
+                                    name=self.name)
         imgs = list(self._pool.map(self._decode,
                                    [self._paths[i] for i in idx]))
         shapes = {a.shape for a in imgs}

@@ -1,14 +1,17 @@
 """Web status page: live training progress over HTTP.
 
-Parity target: the reference ``veles/web_status.py`` (mount empty —
-surveyed contract, SURVEY.md §2.1 Web status row: master HTTP page with
-progress and connected slaves).
+Parity target: the reference ``veles/web_status.py`` + the live-plot
+graphics server/client pair (mount empty — surveyed contract, SURVEY.md
+§2.1 Web status + Plotting rows: master HTTP page with progress; a
+separate process rendering live error curves from a zmq plot stream).
 
 TPU-first: a stdlib ``http.server`` thread serving ``/status.json``
-(workflow name, epoch, metrics history, per-unit time table, device) and
-a self-refreshing minimal HTML page at ``/`` — no tornado/twisted, no
-separate graphics process; multi-host SPMD replaces the slave roster
-with the JAX process/device inventory."""
+(workflow name, epoch, metrics history, per-unit time table, device),
+``/plot.svg`` (live error/loss curves rendered server-side — the
+graphics-*client* process becomes the viewer's browser; no zmq, no
+pickled matplotlib state), and a self-refreshing HTML page at ``/`` —
+no tornado/twisted; multi-host SPMD replaces the slave roster with the
+JAX process/device inventory."""
 
 from __future__ import annotations
 
@@ -21,7 +24,8 @@ _PAGE = """<!doctype html><html><head><title>znicz-tpu status</title>
 body{font-family:monospace;margin:2em}table{border-collapse:collapse}
 td,th{border:1px solid #999;padding:2px 8px;text-align:right}
 th{background:#eee}</style></head><body>
-<h2 id="t">znicz-tpu</h2><div id="s">loading…</div>
+<h2 id="t">znicz-tpu</h2><img src="plot.svg" alt=""><div id="s">loading…
+</div>
 <script>
 fetch('status.json').then(r=>r.json()).then(d=>{
  document.getElementById('t').textContent=d.workflow+' — epoch '+d.epoch;
@@ -35,6 +39,64 @@ fetch('status.json').then(r=>r.json()).then(d=>{
   h+='</table>';}
  document.getElementById('s').innerHTML=h;});
 </script></body></html>"""
+
+#: metric-name suffixes plotted (one polyline each), with fixed colors.
+_PLOT_KEYS = (("train_err_pct", "#c33"), ("validation_err_pct", "#36c"),
+              ("test_err_pct", "#393"), ("train_loss", "#c93"),
+              ("validation_loss", "#66c"), ("train_mse", "#c3c"))
+
+
+def render_plot_svg(metrics: list, width=640, height=240) -> str:
+    """Live error/loss curves as a standalone SVG (the reference's
+    AccumulatingPlotter error-curve view, rendered server-side with no
+    matplotlib/zmq dependency).
+
+    Each series is normalized to its own [min, max] — percentages
+    (0–100) and losses (~0–2) stay readable on one canvas; the legend
+    carries each curve's own range.  Non-finite points (a diverged
+    loss going NaN is exactly when someone opens this page) are
+    dropped per-series instead of poisoning the scale."""
+    import math
+    pad = 34
+    series = []
+    for k, c in _PLOT_KEYS:
+        v = [float(m[k]) for m in metrics
+             if k in m and math.isfinite(float(m[k]))]
+        if len(v) >= 2:
+            series.append((k, c, v))
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+             f'height="{height}" style="background:#fff;font:10px '
+             f'monospace">']
+    if not series:
+        parts.append(f'<text x="{width // 2}" y="{height // 2}" '
+                     f'text-anchor="middle">waiting for ≥2 finite '
+                     f'epochs…</text></svg>')
+        return "".join(parts)
+    n = max(len(v) for _, _, v in series)
+
+    def sx(i):
+        return pad + i * (width - 2 * pad) / max(n - 1, 1)
+
+    parts.append(f'<rect x="{pad}" y="{pad - 10}" '
+                 f'width="{width - 2 * pad}" '
+                 f'height="{height - 2 * pad + 10}" fill="none" '
+                 f'stroke="#ccc"/>')
+    for pos, (k, color, v) in enumerate(series):
+        lo, hi = min(v), max(v)
+        span = (hi - lo) or 1.0
+
+        def sy(val, lo=lo, span=span):
+            return height - pad - (val - lo) * (height - 2 * pad) / span
+
+        pts = " ".join(f"{sx(i):.1f},{sy(val):.1f}"
+                       for i, val in enumerate(v))
+        parts.append(f'<polyline points="{pts}" fill="none" '
+                     f'stroke="{color}" stroke-width="1.5"/>')
+        parts.append(f'<text x="{pad + 4 + 210 * (pos % 3)}" '
+                     f'y="{12 + 11 * (pos // 3)}" fill="{color}">'
+                     f'{k} [{lo:.3g}…{hi:.3g}]</text>')
+    parts.append("</svg>")
+    return "".join(parts)
 
 
 class StatusServer:
@@ -53,6 +115,10 @@ class StatusServer:
                     body = json.dumps(outer.snapshot(),
                                       default=float).encode()
                     ctype = "application/json"
+                elif self.path.endswith("plot.svg"):
+                    body = render_plot_svg(
+                        outer.snapshot()["metrics"]).encode()
+                    ctype = "image/svg+xml"
                 else:
                     body = _PAGE.encode()
                     ctype = "text/html"
