@@ -195,8 +195,8 @@ class TestThreadPool:
         assert pool.submit(sum, (1, 2, 3)).result() == 6
         pool.shutdown()
         pool.shutdown()            # idempotent
-        assert pool.map(str, [1])  # transparently restarts
-        pool.shutdown()
+        assert list(pool.map(str, [1])) == ["1"]   # restarts after
+        pool.shutdown()                            # shutdown
         shared = thread_pool.get()
         assert thread_pool.get() is shared
 

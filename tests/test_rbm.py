@@ -108,3 +108,90 @@ class TestUnits:
         np.testing.assert_allclose(errs_np, errs_x, rtol=1e-4)
         np.testing.assert_allclose(f_np.weights.mem, f_x.weights.mem,
                                    rtol=1e-4, atol=1e-6)
+
+    def test_momentum_decay_speed_up_bars(self):
+        """Momentum + decay (the reference trainer's full hyper set)
+        still learns the bars distribution."""
+        prng.seed_all(11)
+        v = bars(64)
+        fwd = wire(RBM, v, n_hidden=12)
+        tr = RBMTrainer(fwd.workflow, learning_rate=1.0, momentum=0.5,
+                        weights_decay=1e-4)
+        tr.setup_from_forward(fwd)
+        tr.initialize(NumpyDevice())
+        errs = []
+        for _ in range(60):
+            fwd.run()
+            tr.run()
+            errs.append(tr.recon_err)
+        assert errs[-1] < errs[0] * 0.2, (errs[0], errs[-1])
+        assert np.abs(tr.velocity_weights.mem).max() > 0
+
+
+class TestFusedRBM:
+    def test_fused_epoch_matches_unit_graph(self, xla_device):
+        """FusedRBMTrainer's scan over minibatches reproduces the
+        unit-graph trainer bit-level: same counters → same Bernoulli
+        draws → same CD-1 trajectory (SURVEY §3.5 fused parity)."""
+        import jax.numpy as jnp
+        from znicz_tpu.parallel.rbm import FusedRBMTrainer
+
+        prng.seed_all(21)
+        v = bars(64)
+        batch = 16
+        fwd = wire(RBM, v, n_hidden=12, device=xla_device)
+        tr = RBMTrainer(fwd.workflow, learning_rate=0.5, momentum=0.6,
+                        weights_decay=1e-4)
+        tr.setup_from_forward(fwd)
+        tr.initialize(xla_device)
+        w0 = np.array(fwd.weights.mem)
+
+        class _Ld:   # the unit path reads (epoch, offset) counters
+            epoch_number = 0
+            minibatch_offset = 0
+            minibatch_size = batch
+        fwd.workflow.loader = _Ld()
+
+        ftr = FusedRBMTrainer(
+            w0, np.zeros(v.shape[1], np.float32),
+            np.zeros(12, np.float32), seed=tr.rng.stream_seed,
+            unit_id=tr.unit_id, learning_rate=0.5, momentum=0.6,
+            weights_decay=1e-4)
+        for epoch in range(2):
+            _Ld.epoch_number = epoch
+            for off in range(0, len(v), batch):
+                mb = v[off:off + batch]
+                fwd.input.mem = mb          # serve the minibatch
+                fwd.initialize(xla_device)  # rebind input vector
+                _Ld.minibatch_offset = off + batch
+                tr.run()
+            ftr.train_epoch(jnp.asarray(v), np.arange(len(v)), batch,
+                            epoch)
+        np.testing.assert_allclose(np.asarray(ftr.params[0]),
+                                   tr.weights.mem, rtol=1e-4,
+                                   atol=1e-6)
+
+
+class TestPretrainSample:
+    def test_stack_pretrain_and_finetune(self):
+        """models/mnist_rbm: greedy stacked CD-1 pretraining feeds a
+        sigmoid MLP that fine-tunes to a working classifier — and the
+        pretrained features pay off early (the DBN selling point):
+        validation error collapses within the first few epochs, faster
+        than this net converges from random init."""
+        from znicz_tpu.config import root
+        from znicz_tpu.models import mnist_rbm
+        prng.seed_all(1234)
+        saved = root.mnist_rbm.to_dict()
+        try:
+            root.mnist_rbm.synthetic.update(
+                {"n_train": 600, "n_valid": 150, "n_test": 0})
+            root.mnist_rbm.update({"hidden": [64, 32],
+                                   "minibatch_size": 50})
+            from znicz_tpu.backends import Device
+            wf = mnist_rbm.run(device=Device.create("xla"), epochs=6)
+            traj = [m["validation_err_pct"]
+                    for m in wf.decision.epoch_metrics]
+            assert traj[3] < 10.0 and traj[-1] < 10.0, traj
+        finally:
+            root.mnist_rbm.update(saved)

@@ -1,0 +1,185 @@
+"""MnistRBMWorkflow: greedy stacked-RBM pretraining + MLP fine-tune.
+
+Parity target: the reference's RBM pretraining recipe (SURVEY.md §2.2
+RBM row — ``rbm_units`` existed to pretrain sigmoid MLPs layer-by-layer
+before backprop, the classic Hinton deep-belief-net workflow the
+reference's MnistRBM sample exercised).
+
+TPU-first: each RBM in the stack trains through
+``parallel.rbm.FusedRBMTrainer`` (whole CD-1 epochs as one device-side
+scan), hidden probabilities feed the next level, and the resulting
+(W, hbias) pairs initialize an ``all2all_sigmoid`` MLP fine-tuned by the
+ordinary ``StandardWorkflow`` gradient chain — pretraining and
+fine-tuning share Vectors, so the hand-off is a plain array install.
+
+Run: ``python -m znicz_tpu.models.mnist_rbm [--backend=…] [--epochs=N]``
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from .. import prng
+from ..backends import Device
+from ..config import root
+from ..standard_workflow import StandardWorkflow
+from .mnist import MnistLoader
+
+root.mnist_rbm.setdefaults({
+    "minibatch_size": 100,
+    "hidden": [256, 64],            # stacked RBM sizes (784→256→64)
+    # CD needs enough epochs to learn real features — an undertrained
+    # RBM hands the MLP a smaller-than-random init and slows it down,
+    # and an overcooked lr collapses hidden biases (dead features)
+    "pretrain": {"epochs": 10, "learning_rate": 0.1, "momentum": 0.5,
+                 "weights_decay": 2e-4},
+    "layers": None,                 # derived from `hidden` when None
+    "decision": {"max_epochs": 6, "fail_iterations": 20},
+    "synthetic": {"n_train": 5000, "n_valid": 1000, "n_test": 1000,
+                  "noise": 0.35},
+})
+
+
+def _mlp_layers(hidden) -> list:
+    # sigmoid derivative tops out at 0.25 per layer (vs tanh's 1.0), so
+    # the working lr is well above the tanh sample's 0.03
+    layers = [{"type": "all2all_sigmoid",
+               "->": {"output_sample_shape": h},
+               "<-": {"learning_rate": 0.5, "gradient_moment": 0.9}}
+              for h in hidden]
+    layers.append({"type": "softmax", "->": {"output_sample_shape": 10},
+                   "<-": {"learning_rate": 0.5,
+                          "gradient_moment": 0.9}})
+    return layers
+
+
+def pretrain_stack(data: np.ndarray, hidden, *, epochs=3,
+                   learning_rate=0.1, momentum=0.5, weights_decay=2e-4,
+                   batch=100) -> list:
+    """Greedy layer-wise CD-1 pretraining; returns [(W, hbias), …].
+
+    ``data`` rows are visible probabilities in [0, 1]-ish range; each
+    level trains on the previous level's hidden probabilities (the
+    mean-field stacking recipe)."""
+    from ..ops import rbm as rbm_ops
+    from ..parallel.rbm import FusedRBMTrainer
+    import jax.numpy as jnp
+
+    gen = prng.get("rbm")
+    v = np.asarray(data, np.float32).reshape(len(data), -1)
+    # binary RBMs model visible PROBABILITIES: the loader's normalized
+    # data (linear → [-1, 1]) must be min-max scaled into [0, 1] or CD's
+    # (v0 − v1) statistics drift the weights into sigmoid saturation.
+    # The affine map is folded back into the returned level-0 weights
+    # below, so the installed layer reproduces the pretrained hidden
+    # probabilities on the UNSCALED inputs the fine-tune MLP serves.
+    lo, hi = v.min(), v.max()
+    a, b = 1.0 / ((hi - lo) or 1.0), -lo / ((hi - lo) or 1.0)
+    v = a * v + b
+    out = []
+    for level, n_hidden in enumerate(hidden):
+        n_visible = v.shape[1]
+        w0 = gen.normal(0.0, 0.01, (n_visible, n_hidden))
+        tr = FusedRBMTrainer(
+            w0, np.zeros(n_visible, np.float32),
+            np.zeros(n_hidden, np.float32),
+            seed=gen.stream_seed,
+            unit_id=zlib.crc32(f"rbm_pre{level}".encode()),
+            learning_rate=learning_rate, momentum=momentum,
+            weights_decay=weights_decay)
+        dev = jnp.asarray(v)
+        idx = np.arange(len(v))
+        for epoch in range(epochs):
+            tr.train_epoch(dev, idx, batch, epoch)
+        w, _, hb = (np.asarray(p) for p in tr.params)
+        if level == 0:
+            # fold the [0,1] rescale into the layer: σ((a·x+b)·W + c) ==
+            # σ(x·(a·W) + (c + b·ΣᵢWᵢ)) — exact, so the fine-tune MLP
+            # reproduces the pretrained hidden probs on raw inputs
+            hb = hb + b * w.sum(axis=0)
+            w = a * w
+        out.append((w, hb))
+        # next level trains on this level's hidden probabilities
+        v = np.asarray(rbm_ops.hidden_probs(jnp.asarray(v),
+                                            tr.params[0], tr.params[2],
+                                            jnp), np.float32)
+    return out
+
+
+class MnistRBMWorkflow(StandardWorkflow):
+    """Sigmoid MLP whose hidden layers are RBM-pretrainable."""
+
+    def __init__(self, workflow=None, name="MnistRBMWorkflow",
+                 layers=None, decision_config=None,
+                 snapshotter_config=None, **kwargs):
+        loader = MnistLoader(
+            minibatch_size=root.mnist_rbm.get("minibatch_size", 100),
+            synthetic_sizes=kwargs.get("synthetic_sizes")
+            or root.mnist_rbm.synthetic.to_dict())
+        super().__init__(
+            None, name,
+            layers=layers or root.mnist_rbm.get("layers")
+            or _mlp_layers(root.mnist_rbm.get("hidden", [256, 64])),
+            loader=loader,
+            loss_function="softmax",
+            decision_config=decision_config
+            or root.mnist_rbm.decision.to_dict(),
+            snapshotter_config=snapshotter_config)
+
+    def install_pretrained(self, stack) -> None:
+        """Copy pretrained (W, hbias) pairs into the hidden layers'
+        Vectors (requires ``initialize()`` first)."""
+        for unit, (w, hb) in zip(self.forwards, stack):
+            if unit.weights.mem.shape != w.shape:
+                raise ValueError(
+                    f"{unit.name}: pretrained {w.shape} vs layer "
+                    f"{unit.weights.mem.shape}")
+            unit.weights.mem = np.asarray(w, np.float32)
+            unit.bias.mem = np.asarray(hb, np.float32)
+
+
+def run(device: Device | None = None, epochs: int | None = None,
+        pretrain: bool = True, **kwargs) -> MnistRBMWorkflow:
+    """Pretrain the stack (optional), install, fine-tune; returns the
+    finished workflow."""
+    wf = MnistRBMWorkflow(**kwargs)
+    if epochs is not None:
+        wf.decision.max_epochs = epochs
+    wf.initialize(device=device or Device.create("auto"))
+    if pretrain:
+        cfg = root.mnist_rbm.pretrain.to_dict()
+        # pretrain on the TRAIN split only — original_data is laid out
+        # [test | valid | train], and CD must not see evaluation rows
+        n_eval = sum(wf.loader.class_lengths[:2])
+        stack = pretrain_stack(
+            np.asarray(wf.loader.original_data.mem[n_eval:]),
+            root.mnist_rbm.get("hidden", [256, 64]),
+            epochs=cfg.get("epochs", 3),
+            learning_rate=cfg.get("learning_rate", 0.1),
+            momentum=cfg.get("momentum", 0.5),
+            weights_decay=cfg.get("weights_decay", 2e-4),
+            batch=wf.loader.max_minibatch_size)
+        wf.install_pretrained(stack)
+    wf.run()
+    return wf
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--backend", default="auto",
+                        choices=("auto", "numpy", "xla"))
+    parser.add_argument("--epochs", type=int, default=None)
+    parser.add_argument("--no-pretrain", action="store_true")
+    args = parser.parse_args(argv)
+    wf = run(device=Device.create(args.backend), epochs=args.epochs,
+             pretrain=not args.no_pretrain)
+    for m in wf.decision.epoch_metrics[-3:]:
+        print(m)
+
+
+if __name__ == "__main__":
+    main()

@@ -113,7 +113,7 @@ class WineWorkflow(StandardWorkflow):
                if k in ("synthetic_sizes",)})
         super().__init__(
             None, name,
-            layers=layers or root.wine.get("layers") or root.wine.layers,
+            layers=layers or root.wine.get("layers"),
             loader=loader,
             loss_function="softmax",
             decision_config=decision_config

@@ -117,22 +117,38 @@ class RBM(Forward):
 
 
 class RBMTrainer(AcceleratedUnit):
-    """CD-1 contrastive-divergence update on the linked RBM's parameters;
-    publishes ``recon_err`` (mean reconstruction mse) per minibatch."""
+    """CD-1 contrastive-divergence update on the linked RBM's parameters
+    with momentum + L2 weight decay (the reference trainer's
+    hyperparameter set); publishes ``recon_err`` (mean reconstruction
+    mse) per minibatch."""
 
     def __init__(self, workflow=None, name=None, learning_rate=0.1,
-                 **kwargs):
+                 momentum=0.0, weights_decay=0.0, **kwargs):
         super().__init__(workflow, name, **kwargs)
         self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.weights_decay = weights_decay
         self.recon_err = np.inf
         self.rng = prng.get("rbm")
         self.unit_id = zlib.crc32((self.name or "rbm_tr").encode())
         self._step = 0
+        self.velocity_weights = Vector()
+        self.velocity_vbias = Vector()
+        self.velocity_hbias = Vector()
 
     def setup_from_forward(self, fwd: RBM) -> "RBMTrainer":
         self.forward_unit = fwd
         self.link_attrs(fwd, "weights", "vbias", "hbias", "input")
         return self
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device, **kwargs)
+        if not self.velocity_weights:
+            self.velocity_weights.mem = np.zeros_like(self.weights.mem)
+            self.velocity_vbias.mem = np.zeros_like(self.vbias.mem)
+            self.velocity_hbias.mem = np.zeros_like(self.hbias.mem)
+        self.init_vectors(self.velocity_weights, self.velocity_vbias,
+                          self.velocity_hbias)
 
     def _counters(self):
         loader = getattr(self.workflow, "loader", None) \
@@ -147,29 +163,42 @@ class RBMTrainer(AcceleratedUnit):
     def numpy_run(self) -> None:
         bs = self.current_batch_size
         v0 = self.input.mem.reshape(len(self.input.mem), -1)[:bs]
-        w, vb, hb, recon = rbm_ops.np_cd1_step(
-            self.weights.mem, self.vbias.mem, self.hbias.mem, v0,
-            self.learning_rate, self.rng.stream_seed, self._counters())
+        (w, vb, hb), (vw, vvb, vhb), recon = rbm_ops.cd1_momentum_step(
+            (self.weights.mem, self.vbias.mem, self.hbias.mem),
+            (self.velocity_weights.mem, self.velocity_vbias.mem,
+             self.velocity_hbias.mem),
+            v0, self.learning_rate, self.momentum, self.weights_decay,
+            self.rng.stream_seed, self._counters(), np)
         self.weights.mem, self.vbias.mem, self.hbias.mem = \
             w.astype(np.float32), vb.astype(np.float32), \
             hb.astype(np.float32)
+        self.velocity_weights.mem = vw.astype(np.float32)
+        self.velocity_vbias.mem = vvb.astype(np.float32)
+        self.velocity_hbias.mem = vhb.astype(np.float32)
         self.recon_err = float(recon)
 
     def xla_run(self) -> None:
         import jax.numpy as jnp
         seed = self.rng.stream_seed
         if not hasattr(self, "_fn"):
-            # lr is a traced argument — mutating self.learning_rate (LR
-            # schedules) must not be frozen into the compiled closure
+            # lr/momentum/decay are traced arguments — mutating them
+            # (LR schedules) must not be frozen into the compiled closure
             self._fn = self.jit(
-                lambda w, vb, hb, v, lr, c0, c1, c2: rbm_ops.cd1_step(
-                    w, vb, hb, v.reshape(len(v), -1), lr, seed,
+                lambda ps, vs, v, lr, mom, wd, c0, c1, c2:
+                rbm_ops.cd1_momentum_step(
+                    ps, vs, v.reshape(len(v), -1), lr, mom, wd, seed,
                     (c0, c1, c2), jnp))
         bs = self.current_batch_size
-        w, vb, hb, recon = self._fn(
-            self.weights.devmem, self.vbias.devmem, self.hbias.devmem,
+        (w, vb, hb), (vw, vvb, vhb), recon = self._fn(
+            (self.weights.devmem, self.vbias.devmem, self.hbias.devmem),
+            (self.velocity_weights.devmem, self.velocity_vbias.devmem,
+             self.velocity_hbias.devmem),
             self.input.devmem[:bs], jnp.float32(self.learning_rate),
+            jnp.float32(self.momentum), jnp.float32(self.weights_decay),
             *map(np.uint32, self._counters()))
         self.weights.devmem, self.vbias.devmem, self.hbias.devmem = \
             w, vb, hb
+        self.velocity_weights.devmem = vw
+        self.velocity_vbias.devmem = vvb
+        self.velocity_hbias.devmem = vhb
         self.recon_err = float(recon)

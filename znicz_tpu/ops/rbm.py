@@ -52,13 +52,11 @@ def visible_probs(h, w, vbias, xp=np):
     return _sigmoid(_matmul(h, w.T, xp) + vbias, xp)
 
 
-def cd1_step(w, vbias, hbias, v0, lr: float, seed: int, counters,
-             xp=np):
-    """One CD-1 update over minibatch ``v0``.
+def cd1_grads(w, vbias, hbias, v0, seed: int, counters, xp=np):
+    """CD-1 statistics over minibatch ``v0``: (gw, gvb, ghb, recon mse).
 
     Positive phase uses h₀ *probabilities* for statistics but a sampled
-    h₀ to drive the reconstruction; negative phase is mean-field.
-    Returns (w', vbias', hbias', reconstruction mse)."""
+    h₀ to drive the reconstruction; negative phase is mean-field."""
     b = v0.shape[0]
     h0p = hidden_probs(v0, w, hbias, xp)
     h0s = sample_bernoulli(h0p, seed, counters, xp)
@@ -68,7 +66,37 @@ def cd1_step(w, vbias, hbias, v0, lr: float, seed: int, counters,
     gvb = (v0 - v1).mean(axis=0)
     ghb = (h0p - h1p).mean(axis=0)
     recon = ((v0 - v1) ** 2).mean()
+    return gw, gvb, ghb, recon
+
+
+def cd1_step(w, vbias, hbias, v0, lr: float, seed: int, counters,
+             xp=np):
+    """One plain CD-1 update (no momentum/decay); returns
+    (w', vbias', hbias', reconstruction mse)."""
+    gw, gvb, ghb, recon = cd1_grads(w, vbias, hbias, v0, seed, counters,
+                                    xp)
     return (w + lr * gw, vbias + lr * gvb, hbias + lr * ghb, recon)
+
+
+def cd1_momentum_step(params, vels, v0, lr, momentum, weights_decay,
+                      seed: int, counters, xp=np):
+    """CD-1 with momentum + L2 weight decay (the reference trainer's
+    full hyperparameter set; Hinton's practical-guide recipe):
+
+        vel  ← m·vel + lr·(g − λ·w)          (decay on weights only)
+        par  ← par + vel
+
+    ``params``/``vels`` are (w, vbias, hbias) triples; returns
+    (params', vels', recon mse)."""
+    w, vbias, hbias = params
+    vw, vvb, vhb = vels
+    gw, gvb, ghb, recon = cd1_grads(w, vbias, hbias, v0, seed, counters,
+                                    xp)
+    vw2 = momentum * vw + lr * (gw - weights_decay * w)
+    vvb2 = momentum * vvb + lr * gvb
+    vhb2 = momentum * vhb + lr * ghb
+    return ((w + vw2, vbias + vvb2, hbias + vhb2),
+            (vw2, vvb2, vhb2), recon)
 
 
 def np_cd1_step(w, vbias, hbias, v0, lr, seed, counters):

@@ -1,0 +1,90 @@
+"""Fused RBM training: whole CD-1 epochs as one jitted ``lax.scan``.
+
+The TPU hot path for the RBM units (same design as ``parallel.som`` for
+the Kohonen pair and ``parallel.fused`` for the gradient chain —
+SURVEY.md §3.5 non-backprop training pattern): the dataset stays
+HBM-resident, an epoch's minibatch index matrix drives a scan whose body
+is ``ops.rbm.cd1_momentum_step``, and the host syncs once per epoch.
+The per-step RNG counters equal the unit path's (unit_id, epoch,
+samples-consumed), so the fused epochs sample the SAME Bernoulli states
+as the tick loop — equivalence is testable bit-level."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import rbm as rbm_ops
+
+
+class FusedRBMTrainer:
+    """Device-resident RBM parameters + a compiled CD-1 epoch function.
+
+    ``unit_id``/``seed`` must match the unit-graph trainer's for
+    bit-equivalence (pass ``RBMTrainer.unit_id`` and the ``rbm`` stream
+    seed)."""
+
+    def __init__(self, w: np.ndarray, vbias: np.ndarray,
+                 hbias: np.ndarray, *, seed: int, unit_id: int,
+                 learning_rate=0.1, momentum=0.0, weights_decay=0.0):
+        self.params = (jnp.asarray(w), jnp.asarray(vbias),
+                       jnp.asarray(hbias))
+        self.vels = tuple(jnp.zeros_like(p) for p in self.params)
+        self.seed = int(seed)
+        self.unit_id = int(unit_id)
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.weights_decay = weights_decay
+        self._epoch_fn = None
+
+    def _build(self):
+        seed, unit_id = self.seed, self.unit_id
+
+        def epoch(params, vels, data, idx, ctrs, epoch_no, lr, mom, wd):
+            def body(carry, step):
+                params, vels = carry
+                step_idx, ctr = step
+                v0 = jnp.take(data, step_idx, axis=0)
+                v0 = v0.reshape(len(v0), -1)
+                params, vels, recon = rbm_ops.cd1_momentum_step(
+                    params, vels, v0, lr, mom, wd, seed,
+                    (jnp.uint32(unit_id), epoch_no, ctr), jnp)
+                return (params, vels), recon
+            (params, vels), recons = jax.lax.scan(body, (params, vels),
+                                                  (idx, ctrs))
+            return params, vels, recons
+
+        self._epoch_fn = jax.jit(epoch, donate_argnums=(0, 1))
+
+    def train_epoch(self, data, indices: np.ndarray, batch: int,
+                    epoch: int) -> float:
+        """One epoch over ``indices`` (truncated to full batches — the
+        scan body needs one static shape); returns mean recon mse."""
+        if self._epoch_fn is None:
+            self._build()
+        steps = len(indices) // batch
+        if steps == 0:
+            raise ValueError("fewer samples than one batch")
+        idx = np.asarray(indices[:steps * batch], np.int32).reshape(
+            steps, batch)
+        # counters = samples consumed after each step (loader's
+        # minibatch_offset in the unit graph)
+        ctrs = ((np.arange(steps) + 1) * batch).astype(np.uint32)
+        self.params, self.vels, recons = self._epoch_fn(
+            self.params, self.vels, data, idx, ctrs, jnp.uint32(epoch),
+            jnp.float32(self.learning_rate), jnp.float32(self.momentum),
+            jnp.float32(self.weights_decay))
+        return float(np.asarray(recons).mean())
+
+    def write_back(self, rbm_unit, trainer_unit=None) -> None:
+        """Install trained parameters into the unit graph's Vectors."""
+        w, vb, hb = (np.asarray(p) for p in self.params)
+        rbm_unit.weights.mem = w
+        rbm_unit.vbias.mem = vb
+        rbm_unit.hbias.mem = hb
+        if trainer_unit is not None:
+            vw, vvb, vhb = (np.asarray(v) for v in self.vels)
+            trainer_unit.velocity_weights.mem = vw
+            trainer_unit.velocity_vbias.mem = vvb
+            trainer_unit.velocity_hbias.mem = vhb

@@ -58,12 +58,12 @@ def render_plot_svg(metrics: list, width=640, height=240) -> str:
     dropped per-series instead of poisoning the scale."""
     import math
     pad = 34
-    series = []
+    series = []        # (key, color, [(epoch index, value), ...])
     for k, c in _PLOT_KEYS:
-        v = [float(m[k]) for m in metrics
-             if k in m and math.isfinite(float(m[k]))]
-        if len(v) >= 2:
-            series.append((k, c, v))
+        pts = [(i, float(m[k])) for i, m in enumerate(metrics)
+               if k in m and math.isfinite(float(m[k]))]
+        if len(pts) >= 2:
+            series.append((k, c, pts))
     parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
              f'height="{height}" style="background:#fff;font:10px '
              f'monospace">']
@@ -72,7 +72,7 @@ def render_plot_svg(metrics: list, width=640, height=240) -> str:
                      f'text-anchor="middle">waiting for ≥2 finite '
                      f'epochs…</text></svg>')
         return "".join(parts)
-    n = max(len(v) for _, _, v in series)
+    n = max(i for _, _, pts in series for i, _ in pts) + 1
 
     def sx(i):
         return pad + i * (width - 2 * pad) / max(n - 1, 1)
@@ -81,15 +81,18 @@ def render_plot_svg(metrics: list, width=640, height=240) -> str:
                  f'width="{width - 2 * pad}" '
                  f'height="{height - 2 * pad + 10}" fill="none" '
                  f'stroke="#ccc"/>')
-    for pos, (k, color, v) in enumerate(series):
-        lo, hi = min(v), max(v)
+    for pos, (k, color, pts_kv) in enumerate(series):
+        vals = [v for _, v in pts_kv]
+        lo, hi = min(vals), max(vals)
         span = (hi - lo) or 1.0
 
         def sy(val, lo=lo, span=span):
             return height - pad - (val - lo) * (height - 2 * pad) / span
 
+        # x keeps the epoch index, so curves stay epoch-aligned even
+        # when a series has non-finite gaps
         pts = " ".join(f"{sx(i):.1f},{sy(val):.1f}"
-                       for i, val in enumerate(v))
+                       for i, val in pts_kv)
         parts.append(f'<polyline points="{pts}" fill="none" '
                      f'stroke="{color}" stroke-width="1.5"/>')
         parts.append(f'<text x="{pad + 4 + 210 * (pos % 3)}" '
