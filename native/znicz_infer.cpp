@@ -29,6 +29,10 @@ namespace {
 //   conv:     p0=kh, p1=kw, p2=cin, p3=cout, p4=sh, p5=sw, p6=ph, p7=pw
 //   pool:     p0=kh, p1=kw, p4=sh, p5=sw, p6=ph, p7=pw
 //   lrn:      p0=n; alpha/beta/k packed in the weight blob (3 floats)
+//   deconv:   p0=kh, p1=kw, p2=cout, p3=cin, p4=sh, p5=sw, p6=ph, p7=pw
+//             (weights in the framework's (KH, KW, C_out, C_in) layout)
+//   depool:   p0=kh, p1=kw, p2=tie (EXPORT-stream index of the paired
+//             max-pool), p4=sh, p5=sw, p6=ph, p7=pw
 //   activation/dropout/softmax: none
 
 enum Kind : uint32_t {
@@ -40,6 +44,8 @@ enum Kind : uint32_t {
   kActivation = 5,
   kDropout = 6,     // inference identity (inverted dropout)
   kSoftmax = 7,
+  kDeconv = 8,      // decoder path (autoencoders)
+  kDepool = 9,      // unpooling via the tied max-pool's winner offsets
 };
 
 enum Act : uint32_t {
@@ -67,6 +73,20 @@ struct Shape {  // NHWC; fc activations use h=w=1, c=features
   int64_t n = 0, h = 0, w = 0, c = 0;
   int64_t size() const { return n * h * w * c; }
 };
+
+// Overflow-safe product for geometry validation: a hostile .znn could
+// pick factors whose int64 product wraps to a small value and bypasses
+// the blob-size check (then the kernels index past the blob).  Returns
+// -1 on overflow, which never equals a vector size.
+int64_t checked_prod(std::initializer_list<int64_t> fs) {
+  int64_t acc = 1;
+  for (int64_t f : fs) {
+    if (f <= 0) return -1;
+    if (acc > (int64_t{1} << 46) / f) return -1;   // far above any real
+    acc *= f;                                      // model, far below
+  }                                                // int64 wrap
+  return acc;
+}
 
 float apply_act(uint32_t a, float x) {
   switch (a) {
@@ -138,18 +158,21 @@ void conv_forward(const Layer& L, const std::vector<float>& in, Shape& s,
 }
 
 void pool_forward(const Layer& L, bool avg, const std::vector<float>& in,
-                  Shape& s, std::vector<float>& out) {
+                  Shape& s, std::vector<float>& out,
+                  std::vector<int32_t>* offsets) {
   const int kh = L.p[0], kw = L.p[1];
   const int sh = L.p[4], sw = L.p[5], ph = L.p[6], pw = L.p[7];
   const int64_t oh = (s.h + 2 * ph - kh) / sh + 1;
   const int64_t ow = (s.w + 2 * pw - kw) / sw + 1;
   out.assign(s.n * oh * ow * s.c, 0.0f);
+  if (offsets) offsets->assign(out.size(), 0);
   const float inv_area = 1.0f / (kh * kw);
   for (int64_t b = 0; b < s.n; ++b)
     for (int64_t oy = 0; oy < oh; ++oy)
       for (int64_t ox = 0; ox < ow; ++ox)
         for (int64_t c = 0; c < s.c; ++c) {
           float best = avg ? 0.0f : -1e30f;
+          int32_t slot = 0;
           for (int ky = 0; ky < kh; ++ky) {
             const int64_t iy = oy * sh + ky - ph;
             for (int kx = 0; kx < kw; ++kx) {
@@ -159,16 +182,79 @@ void pool_forward(const Layer& L, bool avg, const std::vector<float>& in,
                 v = in[((b * s.h + iy) * s.w + ix) * s.c + c];
               else if (!avg)
                 v = -1e30f;   // outside: never wins the max
-              if (avg)
+              if (avg) {
                 best += v;
-              else if (v > best)
+              } else if (v > best) {
                 best = v;
+                slot = ky * kw + kx;
+              }
             }
           }
-          out[((b * oh + oy) * ow + ox) * s.c + c] =
-              avg ? best * inv_area : best;
+          const int64_t o = ((b * oh + oy) * ow + ox) * s.c + c;
+          out[o] = avg ? best * inv_area : best;
+          if (offsets) (*offsets)[o] = slot;
         }
   s = {s.n, oh, ow, s.c};
+}
+
+void deconv_forward(const Layer& L, const std::vector<float>& in,
+                    Shape& s, std::vector<float>& out) {
+  const int kh = L.p[0], kw = L.p[1], cout = L.p[2], cin = L.p[3];
+  const int sh = L.p[4], sw = L.p[5], ph = L.p[6], pw = L.p[7];
+  const int64_t oh = sh * (s.h - 1) + kh - 2 * ph;
+  const int64_t ow = sw * (s.w - 1) + kw - 2 * pw;
+  out.assign(s.n * oh * ow * cout, 0.0f);
+  if (!L.b.empty())
+    for (int64_t i = 0; i < s.n * oh * ow; ++i)
+      std::memcpy(out.data() + i * cout, L.b.data(),
+                  cout * sizeof(float));
+  for (int64_t b = 0; b < s.n; ++b)
+    for (int64_t iy = 0; iy < s.h; ++iy)
+      for (int64_t ix = 0; ix < s.w; ++ix) {
+        const float* x = in.data() + ((b * s.h + iy) * s.w + ix) * cin;
+        for (int ky = 0; ky < kh; ++ky) {
+          const int64_t oy = iy * sh + ky - ph;
+          if (oy < 0 || oy >= oh) continue;
+          for (int kx = 0; kx < kw; ++kx) {
+            const int64_t ox = ix * sw + kx - pw;
+            if (ox < 0 || ox >= ow) continue;
+            float* y = out.data() + ((b * oh + oy) * ow + ox) * cout;
+            // w layout (KH, KW, C_out, C_in):
+            const float* wp =
+                L.w.data() + ((ky * kw + kx) * cout) * cin;
+            for (int ci = 0; ci < cin; ++ci) {
+              const float xi = x[ci];
+              if (xi == 0.0f) continue;
+              for (int co = 0; co < cout; ++co)
+                y[co] += xi * wp[co * cin + ci];
+            }
+          }
+        }
+      }
+  s = {s.n, oh, ow, cout};
+}
+
+void depool_forward(const Layer& L, const std::vector<float>& in,
+                    const std::vector<int32_t>& offsets,
+                    const Shape& pool_in, Shape& s,
+                    std::vector<float>& out) {
+  const int kw = L.p[1];
+  const int sh = L.p[4], sw = L.p[5], ph = L.p[6], pw = L.p[7];
+  out.assign(pool_in.size(), 0.0f);
+  for (int64_t b = 0; b < s.n; ++b)
+    for (int64_t oy = 0; oy < s.h; ++oy)
+      for (int64_t ox = 0; ox < s.w; ++ox)
+        for (int64_t c = 0; c < s.c; ++c) {
+          const int64_t o = ((b * s.h + oy) * s.w + ox) * s.c + c;
+          const int32_t slot = offsets[o];
+          const int64_t iy = oy * sh + slot / kw - ph;
+          const int64_t ix = ox * sw + slot % kw - pw;
+          if (iy < 0 || iy >= pool_in.h || ix < 0 || ix >= pool_in.w)
+            continue;
+          out[((b * pool_in.h + iy) * pool_in.w + ix) * pool_in.c + c] +=
+              in[o];
+        }
+  s = pool_in;
 }
 
 void lrn_forward(const Layer& L, const std::vector<float>& in, Shape& s,
@@ -285,18 +371,33 @@ int64_t zn_infer(void* handle, const float* input, int64_t batch,
   Shape s{batch, h, w, c};
   std::vector<float> cur(input, input + s.size());
   std::vector<float> next;
+  // decoder support: max-pool layers record winner offsets + their
+  // input shape so a later depool (tied by export-stream index) can
+  // scatter back through them.  Only pools actually tied by a depool
+  // pay the recording cost — classifiers keep the zero-overhead path.
+  const size_t n_layers = m->layers.size();
+  std::vector<std::vector<int32_t>> pool_off(n_layers);
+  std::vector<Shape> pool_in(n_layers);
+  std::vector<Shape> pool_out(n_layers);
+  std::vector<bool> tied(n_layers, false);
+  for (const auto& L : m->layers)
+    if (L.kind == kDepool && L.p[2] >= 0 &&
+        L.p[2] < static_cast<int32_t>(n_layers))
+      tied[L.p[2]] = true;
   // Every layer validates its declared geometry against the running
   // activation shape before touching memory — a model whose fc
   // in_features (or conv cin / window extents) disagree with the actual
   // tensor must fail with -1, not read past the buffer.
-  for (const auto& L : m->layers) {
+  for (size_t li = 0; li < n_layers; ++li) {
+    const auto& L = m->layers[li];
     switch (L.kind) {
       case kFC: {
         // flatten whatever is upstream
         Shape flat{s.n, 1, 1, s.h * s.w * s.c};
         const int64_t fin = L.p[0], fout = L.p[1];
         if (fin != flat.c || fout <= 0 ||
-            static_cast<int64_t>(L.w.size()) != fin * fout ||
+            static_cast<int64_t>(L.w.size()) !=
+                checked_prod({fin, fout}) ||
             (!L.b.empty() && static_cast<int64_t>(L.b.size()) != fout))
           return -1;
         s = flat;
@@ -313,7 +414,8 @@ int64_t zn_infer(void* handle, const float* input, int64_t batch,
             pw < 0 || cin != s.c || cout <= 0 ||
             (s.h + 2 * ph - kh) / sh + 1 <= 0 ||
             (s.w + 2 * pw - kw) / sw + 1 <= 0 ||
-            static_cast<int64_t>(L.w.size()) != kh * kw * cin * cout ||
+            static_cast<int64_t>(L.w.size()) !=
+                checked_prod({kh, kw, cin, cout}) ||
             (!L.b.empty() && static_cast<int64_t>(L.b.size()) != cout))
           return -1;
         conv_forward(L, cur, s, next);
@@ -329,7 +431,46 @@ int64_t zn_infer(void* handle, const float* input, int64_t batch,
             pw < 0 || (s.h + 2 * ph - kh) / sh + 1 <= 0 ||
             (s.w + 2 * pw - kw) / sw + 1 <= 0)
           return -1;
-        pool_forward(L, L.kind == kAvgPool, cur, s, next);
+        pool_in[li] = s;
+        pool_forward(L, L.kind == kAvgPool, cur, s, next,
+                     (L.kind == kMaxPool && tied[li]) ? &pool_off[li]
+                                                      : nullptr);
+        pool_out[li] = s;
+        cur.swap(next);
+        break;
+      }
+      case kDeconv: {
+        const int64_t kh = L.p[0], kw = L.p[1], cout = L.p[2],
+                      cin = L.p[3], sh = L.p[4], sw = L.p[5],
+                      ph = L.p[6], pw = L.p[7];
+        if (kh <= 0 || kw <= 0 || sh <= 0 || sw <= 0 || ph < 0 ||
+            pw < 0 || cin != s.c || cout <= 0 ||
+            sh * (s.h - 1) + kh - 2 * ph <= 0 ||
+            sw * (s.w - 1) + kw - 2 * pw <= 0 ||
+            static_cast<int64_t>(L.w.size()) !=
+                checked_prod({kh, kw, cout, cin}) ||
+            (!L.b.empty() && static_cast<int64_t>(L.b.size()) != cout))
+          return -1;
+        deconv_forward(L, cur, s, next);
+        act_inplace(L.act, next);
+        cur.swap(next);
+        break;
+      }
+      case kDepool: {
+        const int64_t tie = L.p[2];
+        if (tie < 0 || tie >= static_cast<int64_t>(n_layers) ||
+            pool_off[tie].empty() ||
+            m->layers[tie].kind != kMaxPool ||
+            s.n != pool_out[tie].n || s.h != pool_out[tie].h ||
+            s.w != pool_out[tie].w || s.c != pool_out[tie].c ||
+            L.p[0] != m->layers[tie].p[0] ||
+            L.p[1] != m->layers[tie].p[1] ||
+            L.p[4] != m->layers[tie].p[4] ||     // full geometry must
+            L.p[5] != m->layers[tie].p[5] ||     // match: wrong stride/
+            L.p[6] != m->layers[tie].p[6] ||     // padding would scatter
+            L.p[7] != m->layers[tie].p[7])       // silently wrong
+          return -1;
+        depool_forward(L, cur, pool_off[tie], pool_in[tie], s, next);
         cur.swap(next);
         break;
       }

@@ -97,6 +97,46 @@ class TestNativeEngine:
         got = model.infer(x, 5)
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
+    def test_autoencoder_matches_golden(self, engine, tmp_path):
+        """Decoder path: conv + maxpool encoder, depool + deconv decoder
+        — the native engine replays the winner offsets through the tied
+        unpooling (reference libZnicz decoder support)."""
+        from znicz_tpu.loader.fullbatch import FullBatchLoaderMSE
+        from znicz_tpu.standard_workflow import StandardWorkflow
+
+        class Loader(FullBatchLoaderMSE):
+            def load_data(self):
+                gen = prng.get("nat_ae")
+                n = 30
+                self.original_data.mem = np.asarray(
+                    gen.normal(size=(n, 12, 12, 1)), np.float32)
+                self.original_labels.mem = np.zeros(n, np.int32)
+                self.class_lengths = [0, 0, n]
+
+        layers = [
+            {"type": "conv", "->": {"n_kernels": 4, "kx": 5, "ky": 5,
+                                    "padding": 2},
+             "<-": {"learning_rate": 2e-4, "gradient_moment": 0.9}},
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+            {"type": "depooling", "->": {"tie": 1}},
+            {"type": "deconv", "->": {"n_kernels": 4, "kx": 5, "ky": 5,
+                                      "padding": 2, "n_channels": 1},
+             "<-": {"learning_rate": 2e-4, "gradient_moment": 0.9}},
+        ]
+        prng.seed_all(13)
+        wf = StandardWorkflow(
+            None, "natae", layers=layers, loader=Loader(minibatch_size=15),
+            loss_function="mse",
+            decision_config={"max_epochs": 2, "fail_iterations": 10})
+        wf.initialize(device=Device.create("numpy"))
+        wf.run()
+        path = export_workflow(wf, str(tmp_path / "ae.znn"))
+        model = engine.load(path)
+        x = wf.loader.original_data.mem[:6]
+        ref = _numpy_forward(wf, x).reshape(6, -1)
+        got = model.infer(x, 12 * 12 * 1)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
     def test_bad_file_rejected(self, engine, tmp_path):
         bad = tmp_path / "bad.znn"
         bad.write_bytes(b"NOPE" + b"\0" * 64)

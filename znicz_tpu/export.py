@@ -22,7 +22,8 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 
 KIND = {"fc": 0, "conv": 1, "max_pool": 2, "avg_pool": 3, "lrn": 4,
-        "activation": 5, "dropout": 6, "softmax": 7}
+        "activation": 5, "dropout": 6, "softmax": 7, "deconv": 8,
+        "depool": 9}
 ACT = {"linear": 0, "tanh": 1, "relu": 2, "strict_relu": 3, "sigmoid": 4}
 
 
@@ -41,12 +42,15 @@ def _pack_layer(fh, kind: int, act: int, p, w=None, b=None) -> None:
 def export_workflow(workflow, path: str) -> str:
     """Serialize a trained StandardWorkflow's forward chain to .znn.
 
-    Covers the inference-relevant unit zoo (fc/conv/pool/LRN/activation/
-    dropout/softmax); decoder (Deconv/Depooling) and non-gradient paths
-    (Kohonen/RBM) are training-side constructs the reference engines did
-    not serve either."""
+    Covers the inference-relevant unit zoo — fc/conv/pool/LRN/activation/
+    dropout/softmax plus the decoder path (Deconv/Depooling, so trained
+    autoencoders run natively); non-gradient training paths (Kohonen/RBM
+    trainers) are training-side constructs the reference engines did not
+    serve either."""
     from .nn.all2all import All2All, All2AllSoftmax
     from .nn.conv import Conv
+    from .nn.deconv import Deconv
+    from .nn.depooling import Depooling
     from .nn.dropout import DropoutForward
     from .nn.normalization import LRNormalizerForward
     from .nn import activation as act_units
@@ -55,7 +59,30 @@ def export_workflow(workflow, path: str) -> str:
     with open(path, "wb") as fh:
         fh.write(b"ZNN1")
         fh.write(struct.pack("<I", _count_layers(workflow)))
+        export_idx = {}   # forward unit -> its EXPORT-stream index
+        n_out = 0
         for fwd in workflow.forwards:
+            export_idx[id(fwd)] = n_out
+            n_out += 1
+            if isinstance(fwd, All2AllSoftmax):
+                n_out += 1           # fused softmax head adds a layer
+            if isinstance(fwd, Deconv):      # before Conv: subclass-ish
+                w = np.asarray(fwd.weights.mem, np.float32)
+                b = (np.asarray(fwd.bias.mem, np.float32)
+                     if fwd.include_bias else None)
+                kh, kw, cout, cin = w.shape   # (KH, KW, C_out, C_in)
+                (sh, sw), (ph, pw) = fwd.sliding, fwd.padding
+                _pack_layer(fh, KIND["deconv"],
+                            ACT[fwd.ACTIVATION.name],
+                            [kh, kw, cout, cin, sh, sw, ph, pw], w, b)
+                continue
+            if isinstance(fwd, Depooling):
+                tie = export_idx[id(fwd.pool_unit)]
+                (kh, kw) = fwd.ksize
+                (sh, sw), (ph, pw) = fwd.sliding, fwd.padding
+                _pack_layer(fh, KIND["depool"], 0,
+                            [kh, kw, tie, 0, sh, sw, ph, pw])
+                continue
             if isinstance(fwd, All2All):
                 w = np.asarray(fwd.weights.mem, np.float32)
                 b = (np.asarray(fwd.bias.mem, np.float32)

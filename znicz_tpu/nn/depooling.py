@@ -59,8 +59,12 @@ class Depooling(Forward):
         self.init_vectors(self.output)
 
     def numpy_run(self) -> None:
+        # batch from the live input, not the preallocated output: the
+        # golden path must serve ad-hoc smaller batches (e.g. export
+        # verification harnesses)
+        out_shape = (len(self.input.mem),) + tuple(self.output.shape[1:])
         self.output.mem = pool_ops.np_depooling(
-            self.input.mem, self.input_offset.mem, self.output.shape,
+            self.input.mem, self.input_offset.mem, out_shape,
             self.ksize, self.sliding, self.padding)
 
     def xla_run(self) -> None:
