@@ -82,7 +82,9 @@ class MnistLoader(FullBatchLoader):
         tr_y = _read_idx(os.path.join(d, "train-labels-idx1-ubyte.gz"))
         te_x = _read_idx(os.path.join(d, "t10k-images-idx3-ubyte.gz"))
         te_y = _read_idx(os.path.join(d, "t10k-labels-idx1-ubyte.gz"))
-        n_valid = 10000
+        # 10k held out for validation on the real 60k set; adapt for
+        # smaller drop-in datasets (same idx format, fewer rows)
+        n_valid = min(10000, len(tr_x) // 6)
         # order: [test | validation | train] to match class indices
         self.original_data.mem = np.concatenate(
             [te_x, tr_x[:n_valid], tr_x[n_valid:]]).astype(

@@ -290,10 +290,15 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
                                  cfg["beta"], cfg["k"])
         elif layer.kind == "dropout":
             if train:
-                aux = drop_ops.make_mask(
+                # aux stays None: the backward REGENERATES the mask from
+                # the same (seed, counters) — a counter-RNG mask is pure
+                # function of its coordinates, so caching an
+                # activation-sized buffer through the scan would only
+                # add HBM liveness (same fix as the unit path's Pallas
+                # dropout, ADVICE round 1)
+                h = h * drop_ops.make_mask(
                     cfg["seed"], (cfg["unit_id"], epoch, ctr),
                     tuple(h.shape), cfg["ratio"], jnp)
-                h = h * aux
             # eval: inverted dropout → identity
         elif layer.kind == "activation":
             h = spec.act(i).fwd(h, jnp)
@@ -332,11 +337,16 @@ def _loss_and_err(spec: ModelSpec, out, target, mask):
     return loss, diff / bs, jnp.zeros((), jnp.int32)
 
 
-def backward(spec: ModelSpec, params, caches, out, err):
+def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
+             train=True):
     """Hand-written gradient chain (same math as the GD* units).
 
     ``err`` on entry: w.r.t. the last layer's pre-activation (softmax
-    fused with CE; MSE pre-folded by the caller)."""
+    fused with CE; MSE pre-folded by the caller).  ``epoch``/``ctr``
+    re-key the dropout counter RNG — masks are regenerated here, not
+    cached, so they MUST match the forward's coordinates; pass
+    ``train=False`` when the caches came from an eval-mode forward
+    (dropout was an identity there, so err passes through)."""
     cdt = jnp.dtype(spec.compute_dtype)
     grads = [None] * len(spec.layers)
     n = len(spec.layers)
@@ -403,8 +413,12 @@ def backward(spec: ModelSpec, params, caches, out, err):
                 err.reshape(y_i.shape), aux, cfg["ksize"], cfg["stride"],
                 cfg["padding"])
         elif layer.kind == "dropout":
-            if aux is not None:
-                err = err.reshape(x_in.shape) * aux
+            if train:
+                # regenerate the forward's mask (identical counters →
+                # bit-identical draw)
+                err = err.reshape(x_in.shape) * drop_ops.make_mask(
+                    cfg["seed"], (cfg["unit_id"], epoch, ctr),
+                    tuple(x_in.shape), cfg["ratio"], jnp)
         elif layer.kind == "activation":
             err = spec.act(i).bwd(err.reshape(y_i.shape), y_i, x_in, jnp)
         else:
@@ -466,7 +480,8 @@ def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None,
         # backward() expects pre-activation err at a param layer; other
         # last-layer kinds fold their own activation in backward()
         err = spec.act(last).bwd(err, out, None, jnp)
-    grads = backward(spec, params, caches, out, err)
+    grads = backward(spec, params, caches, out, err, epoch=epoch,
+                     ctr=ctr)
     params, vels = apply_updates(spec, params, vels, grads, lr_scale)
     metrics = {"loss": loss, "n_err": n_err}
     return params, vels, metrics
