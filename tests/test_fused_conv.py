@@ -144,6 +144,60 @@ FULL_STACK_LAYERS = [
 ]
 
 
+class TestFusedWithPallasKernels:
+    def test_fused_epoch_with_interpret_pallas(self, monkeypatch):
+        """The TPU fused path runs Pallas kernels (dropout, LRN,
+        pool-select/scatter) INSIDE the jitted epoch scan — a
+        composition CPU tests otherwise never execute.  Interpret mode
+        makes the dispatchers take the Pallas tier here and the result
+        must match the XLA-tier run bit-for-all-practical-bits."""
+        from znicz_tpu.ops import tuning
+
+        wf = _workflow(layers=[
+            {"type": "conv_tanh", "->": {"n_kernels": 8, "kx": 3,
+                                         "padding": 1},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "max_pooling", "->": {"kx": 2}},
+            {"type": "norm", "->": {"n": 5}},
+            {"type": "dropout", "->": {"dropout_ratio": 0.3}},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ])
+        spec, params, vels = extract_model(wf)
+        ld = wf.loader
+        n0, n1, n2 = ld.class_lengths
+        idx = np.arange(n0 + n1, n0 + n1 + n2)
+        # deep-copy params/vels for the reference trainer: the epoch fn
+        # donates its buffers (donate_argnums), so the two trainers must
+        # not share arrays
+        import jax
+        cp = lambda t: jax.tree_util.tree_map(np.array, t)  # noqa: E731
+        # XLA-tier reference epoch — force the XLA formulations even if
+        # this ever runs on a TPU backend (where use_pallas() is already
+        # true and both runs would otherwise compare Pallas to itself)
+        monkeypatch.setattr(tuning, "_DISABLE", True)
+        tr_ref = FusedTrainer(spec=spec, params=cp(params),
+                              vels=cp(vels))
+        tr_ref.train_epoch(ld.original_data.devmem,
+                           ld.original_labels.devmem, idx,
+                           ld.max_minibatch_size, epoch=0)
+        # Pallas-tier (interpret) epoch over the same inputs
+        monkeypatch.setattr(tuning, "_DISABLE", False)
+        monkeypatch.setattr(tuning, "_INTERPRET", True)
+        assert tuning.use_pallas()
+        tr = FusedTrainer(spec=spec, params=params, vels=vels)
+        tr.train_epoch(ld.original_data.devmem,
+                       ld.original_labels.devmem, idx,
+                       ld.max_minibatch_size, epoch=0)
+        for i, ((w1, _), (w2, _)) in enumerate(zip(tr_ref.params,
+                                                   tr.params)):
+            if w1 is None:
+                continue
+            np.testing.assert_allclose(
+                np.asarray(w1), np.asarray(w2), rtol=5e-4, atol=1e-5,
+                err_msg=f"layer {i}: Pallas-tier fused epoch diverged")
+
+
 class TestRunVsRunFusedConvStack:
     def test_three_epoch_equivalence(self):
         """wf.run() (unit-graph loop: decision, shuffle stream, per-unit
