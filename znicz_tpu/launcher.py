@@ -132,8 +132,10 @@ class Launcher:
             if self.epochs is not None:
                 wf.decision.max_epochs = self.epochs
             with self._trace_ctx():
-                if self.fused and hasattr(wf, "run_fused"):
-                    wf.run_fused()
+                if hasattr(wf, "train"):
+                    # one path-selection policy for both entry points
+                    # (non-XLA devices fall back with a warning)
+                    wf.train(fused=self.fused)
                 else:
                     wf.run()
             self.workflow = wf

@@ -148,10 +148,7 @@ def run(device: Device | None = None, epochs: int | None = None,
     if epochs is not None:
         wf.decision.max_epochs = epochs
     wf.initialize(device=device or Device.create("auto"))
-    if fused and wf.device.is_xla:
-        wf.run_fused(mesh=mesh, max_epochs=epochs)
-    else:
-        wf.run()
+    wf.train(fused=fused, mesh=mesh, max_epochs=epochs)
     return wf
 
 

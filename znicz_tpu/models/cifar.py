@@ -134,13 +134,15 @@ class CifarWorkflow(StandardWorkflow):
 
 
 def run(device: Device | None = None, epochs: int | None = None,
-        **kwargs) -> CifarWorkflow:
-    """Build, initialize and train; returns the finished workflow."""
+        fused: bool = False, **kwargs) -> CifarWorkflow:
+    """Build, initialize and train; ``fused=True`` (the CLI's --fused)
+    takes the compiled whole-step path instead of the unit-graph tick
+    loop.  Returns the finished workflow."""
     wf = CifarWorkflow(**kwargs)
     if epochs is not None:
         wf.decision.max_epochs = epochs
     wf.initialize(device=device or Device.create("auto"))
-    wf.run()
+    wf.train(fused=fused, max_epochs=epochs)
     return wf
 
 

@@ -141,7 +141,8 @@ class MnistRBMWorkflow(StandardWorkflow):
 
 
 def run(device: Device | None = None, epochs: int | None = None,
-        pretrain: bool = True, **kwargs) -> MnistRBMWorkflow:
+        pretrain: bool = True, fused: bool = False,
+        **kwargs) -> MnistRBMWorkflow:
     """Pretrain the stack (optional), install, fine-tune; returns the
     finished workflow."""
     wf = MnistRBMWorkflow(**kwargs)
@@ -162,7 +163,7 @@ def run(device: Device | None = None, epochs: int | None = None,
             weights_decay=cfg.get("weights_decay", 2e-4),
             batch=wf.loader.max_minibatch_size)
         wf.install_pretrained(stack)
-    wf.run()
+    wf.train(fused=fused, max_epochs=epochs)
     return wf
 
 

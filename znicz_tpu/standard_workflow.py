@@ -180,6 +180,25 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         self.snapshotter.link_from(self.decision)
 
     # -- fused execution (the TPU hot path) -------------------------------
+    def train(self, fused: bool = False, mesh=None,
+              max_epochs: int | None = None,
+              compute_dtype: str | None = None,
+              profile_dir: str | None = None):
+        """One entry point over both execution paths (the samples' and
+        launcher's ``--fused`` plumbing): the compiled fused step when
+        requested AND the device supports it, else the unit-graph tick
+        loop — with a log line instead of a silent fallback."""
+        if fused:
+            if self.device.is_xla:
+                return self.run_fused(mesh=mesh, max_epochs=max_epochs,
+                                      compute_dtype=compute_dtype,
+                                      profile_dir=profile_dir)
+            self.warning("fused path needs an XLA device; falling back "
+                         "to the unit-graph tick loop")
+        if max_epochs is not None:
+            self.decision.max_epochs = max_epochs
+        return self.run()
+
     def run_fused(self, mesh=None, max_epochs: int | None = None,
                   compute_dtype: str | None = None,
                   profile_dir: str | None = None):
@@ -237,7 +256,12 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         cls_idx = {k: np.arange(bounds[k], bounds[k + 1])
                    for k in (TEST, VALID, TRAIN)}
         batch = loader.max_minibatch_size
-        epochs = max_epochs or decision.max_epochs or 10
+        # an explicit 0 means "stop after the first evaluation", exactly
+        # like the unit-graph decision — only None falls through
+        epochs = max_epochs if max_epochs is not None \
+            else decision.max_epochs
+        if epochs is None:
+            epochs = 10
         from .loader.base import CLASS_NAMES
         lr_policy = (self.lr_adjuster.policy
                      if self.lr_adjuster is not None else None)
