@@ -1,0 +1,64 @@
+"""Kanji sample functional tests (SURVEY.md §2.2 secondary samples):
+the procedural glyph classifier trained FROM DISK through the streaming
+on-the-fly image loader — the sample-level consumer of the loader
+family."""
+
+import numpy as np
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.config import root
+from znicz_tpu.models import kanji
+
+
+class TestKanjiSample:
+    def _small(self, tmp_path):
+        import copy
+        # deep copy: to_dict() returns the layers list by reference, and
+        # the in-place layer edit below would otherwise mutate the
+        # snapshot too (leaking the 6-way softmax into later tests)
+        saved = copy.deepcopy(root.kanji.to_dict())
+        root.kanji.update({"n_classes": 6, "minibatch_size": 30,
+                           "per_class": {"train": 20, "valid": 6}})
+        root.kanji.layers[3]["->"]["output_sample_shape"] = 6
+        return saved, str(tmp_path / "glyphs")
+
+    def test_renderer_deterministic(self, tmp_path):
+        prng.seed_all(5)
+        strokes = kanji.class_strokes(4, 24)
+        gen1 = prng.RandomGenerator("g", 7)
+        img1 = kanji.render_glyph(strokes[0], 24, gen1)
+        gen2 = prng.RandomGenerator("g", 7)
+        img2 = kanji.render_glyph(strokes[0], 24, gen2)
+        np.testing.assert_array_equal(img1, img2)
+        assert img1.shape == (24, 24) and img1.max() > 0
+
+    def test_kanji_converges_from_disk(self, tmp_path):
+        saved, data_dir = self._small(tmp_path)
+        try:
+            prng.seed_all(1234)
+            wf = kanji.run(device=Device.create("xla"), epochs=6,
+                           data_dir=data_dir)
+            traj = [m["validation_err_pct"]
+                    for m in wf.decision.epoch_metrics]
+            assert traj[-1] < 25.0, traj
+            # the tree was rendered once and is reused
+            assert wf.loader.n_classes == 6
+        finally:
+            root.kanji.update(saved)
+
+    def test_kanji_fused_streaming(self, tmp_path):
+        """fused=True routes through StreamTrainer (disk-backed epochs
+        with the double-buffered prefetcher)."""
+        saved, data_dir = self._small(tmp_path)
+        try:
+            prng.seed_all(1234)
+            wf = kanji.run(device=Device.create("xla"), epochs=3,
+                           fused=True, data_dir=data_dir)
+            ms = wf.decision.epoch_metrics
+            assert len(ms) == 3
+            assert np.isfinite(ms[-1]["validation_loss"])
+            assert ms[-1]["validation_err_pct"] <= ms[0][
+                "validation_err_pct"]
+        finally:
+            root.kanji.update(saved)
