@@ -11,6 +11,7 @@ from znicz_tpu.backends import Device, NumpyDevice
 from znicz_tpu.loader import (RecordFile, RecordLoader, RecordWriter,
                               TRAIN, write_records)
 from znicz_tpu.loader.streaming import BatchPrefetcher, StreamingLoader
+from znicz_tpu.parallel import fused as fused_mod
 from znicz_tpu.workflow import Workflow
 
 
@@ -242,6 +243,92 @@ class TestStreamTrainerEquivalence:
         assert ms[-1]["train_loss"] < ms[0]["train_loss"]
         # weights were written back into the unit graph
         assert np.isfinite(wf.forwards[0].weights.mem).all()
+
+
+class TestStreamingMSE:
+    AE_LAYERS = (
+        fused_mod.LayerSpec("fc", "tanh", True,
+                            (0.01, 0.0, 0.0, 0.9), (0.01, 0.0, 0.0, 0.9)),
+        fused_mod.LayerSpec("fc", "linear", True,
+                            (0.01, 0.0, 0.0, 0.9), (0.01, 0.0, 0.0, 0.9)),
+    )
+
+    def _ae(self, feats=25, hidden=8):
+        gen = prng.get("mse_stream")
+        spec = fused_mod.ModelSpec(self.AE_LAYERS, loss="mse")
+        params = [
+            (gen.normal(0, 0.1, (feats, hidden)),
+             np.zeros(hidden, np.float32)),
+            (gen.normal(0, 0.1, (hidden, feats)),
+             np.zeros(feats, np.float32)),
+        ]
+        vels = [(np.zeros_like(w), np.zeros_like(b)) for w, b in params]
+        return spec, params, vels
+
+    def test_mse_input_target_matches_resident(self, tmp_path):
+        """Autoencoder over .znr shards: StreamTrainer(mse_target=
+        'input') must train bit-identically to the resident FusedTrainer
+        fed target=data (VERDICT round 1 left streaming MSE refused)."""
+        import jax.numpy as jnp
+        from znicz_tpu.parallel import FusedTrainer
+        from znicz_tpu.parallel.stream import StreamTrainer
+
+        prng.seed_all(77)
+        data, _ = _dataset(n=48, shape=(5, 5, 1), classes=3)
+        flat = data.reshape(48, -1)
+        spec, params, vels = self._ae(feats=25)
+        res = FusedTrainer(spec=spec, params=params, vels=vels)
+        idx = np.arange(48)
+        for ep in range(2):
+            rm = res.train_epoch(jnp.asarray(flat), jnp.asarray(flat),
+                                 idx, 16, epoch=ep)
+        paths = write_records(str(tmp_path / "ae.znr"), flat,
+                              np.zeros(48, np.int32), shard_size=20)
+        sld = RecordLoader(Workflow(name="w"), train_paths=paths,
+                           minibatch_size=16)
+        sld.initialize(NumpyDevice())
+        st = StreamTrainer(spec=spec, params=params, vels=vels,
+                           loader=sld)        # mse_target="input"
+        for ep in range(2):
+            sm = st.train_epoch(None, None, idx, 16, epoch=ep)
+        # scan-compiled vs per-step-compiled programs reassociate the
+        # MSE reduction: equal to float noise, not bit-equal
+        np.testing.assert_allclose(rm["loss"], sm["loss"], rtol=1e-6)
+        for (rw, _), (sw, _) in zip(res.params, st.params):
+            np.testing.assert_allclose(np.asarray(rw), np.asarray(sw),
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_mse_labels_block_targets(self, tmp_path):
+        """Denoising-style: the .znr label block carries the float
+        target tensor (arbitrary label_shape), mse_target='labels'."""
+        import jax.numpy as jnp
+        from znicz_tpu.parallel import FusedTrainer
+        from znicz_tpu.parallel.stream import StreamTrainer
+
+        prng.seed_all(78)
+        gen = prng.get("denoise")
+        clean = np.asarray(gen.normal(size=(40, 25)), np.float32)
+        noisy = clean + np.asarray(gen.normal(0, 0.3, (40, 25)),
+                                   np.float32)
+        spec, params, vels = self._ae(feats=25)
+        res = FusedTrainer(spec=spec, params=params, vels=vels)
+        idx = np.arange(40)
+        for ep in range(2):
+            rm = res.train_epoch(jnp.asarray(noisy), jnp.asarray(clean),
+                                 idx, 20, epoch=ep)
+        paths = write_records(str(tmp_path / "dn.znr"), noisy, clean,
+                              shard_size=24)
+        sld = RecordLoader(Workflow(name="w"), train_paths=paths,
+                           minibatch_size=20)
+        sld.initialize(NumpyDevice())
+        st = StreamTrainer(spec=spec, params=params, vels=vels,
+                           loader=sld, mse_target="labels")
+        for ep in range(2):
+            sm = st.train_epoch(None, None, idx, 20, epoch=ep)
+        np.testing.assert_allclose(rm["loss"], sm["loss"], rtol=1e-6)
+        for (rw, _), (sw, _) in zip(res.params, st.params):
+            np.testing.assert_allclose(np.asarray(rw), np.asarray(sw),
+                                       rtol=1e-5, atol=1e-7)
 
 
 class TestOnTheFlyImages:

@@ -224,12 +224,16 @@ class BatchPrefetcher:
     host pipeline is genuinely slower than the step."""
 
     def __init__(self, loader: StreamingLoader, index_rows,
-                 depth: int = 2, device_put=None):
+                 depth: int = 2, device_put=None,
+                 skip_labels: bool = False):
         import jax
         self.loader = loader
         self.rows = index_rows
         self.depth = depth
         self._put = device_put or jax.device_put
+        #: don't decode-transfer the label block (consumer reconstructs
+        #: the input — autoencoder streaming); yields (x, None)
+        self.skip_labels = skip_labels
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._err = None
         self._stopped = False
@@ -240,7 +244,8 @@ class BatchPrefetcher:
         try:
             for row in self.rows:
                 x, t = self.loader.read_batch(np.asarray(row))
-                item = (self._put(x), self._put(t))
+                item = (self._put(x),
+                        None if self.skip_labels else self._put(t))
                 while not self._stopped:     # bounded-put with stop check
                     try:
                         self._q.put(item, timeout=0.2)

@@ -36,7 +36,7 @@ class StreamTrainer(FusedTrainer):
 
     def __init__(self, workflow=None, spec=None, params=None, vels=None,
                  mesh=None, loader: StreamingLoader | None = None,
-                 prefetch_depth: int = 2):
+                 prefetch_depth: int = 2, mse_target: str = "input"):
         super().__init__(workflow, spec=spec, params=params, vels=vels,
                          mesh=mesh)
         self.loader = loader if loader is not None \
@@ -44,18 +44,30 @@ class StreamTrainer(FusedTrainer):
         if not isinstance(self.loader, StreamingLoader):
             raise TypeError("StreamTrainer needs a StreamingLoader")
         self.prefetch_depth = prefetch_depth
+        #: for MSE heads: "input" reconstructs x (the autoencoder
+        #: default — streaming loaders serve no separate target tensor);
+        #: "labels" regresses on the record's label block (arbitrary
+        #: label_shape/dtype in .znr shards, e.g. denoising targets)
+        if mse_target not in ("input", "labels"):
+            raise ValueError(f"mse_target {mse_target!r}")
+        self.mse_target = mse_target
+        #: x doubles as the target: skip the label decode+transfer too
+        self._x_is_target = (self.spec.loss == "mse"
+                             and mse_target == "input")
         self._step_fn = None
         self._eval_fn = None
 
     # -- per-minibatch compiled steps -------------------------------------
     def _build_steps(self):
         spec = self.spec
+        x_is_target = self._x_is_target
 
         def step(params, vels, x, t, mask, epoch, ctr, lr_scale):
             if self._batch_sharding is not None:
                 x = jax.lax.with_sharding_constraint(
                     x, self._batch_sharding)
-            return train_minibatch(spec, params, vels, x, t, mask,
+            return train_minibatch(spec, params, vels, x,
+                                   x if x_is_target else t, mask,
                                    epoch=epoch, ctr=ctr,
                                    lr_scale=lr_scale)
 
@@ -63,7 +75,8 @@ class StreamTrainer(FusedTrainer):
             if self._batch_sharding is not None:
                 x = jax.lax.with_sharding_constraint(
                     x, self._batch_sharding)
-            return eval_minibatch(spec, params, x, t, mask)
+            return eval_minibatch(spec, params, x,
+                                  x if x_is_target else t, mask)
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1))
         self._eval_fn = jax.jit(estep)
@@ -85,7 +98,8 @@ class StreamTrainer(FusedTrainer):
         idx, mask, ctrs = self._idx_matrix(np.asarray(indices), batch,
                                            ctr_base)
         pf = BatchPrefetcher(self.loader, idx, depth=self.prefetch_depth,
-                             device_put=self._device_put)
+                             device_put=self._device_put,
+                             skip_labels=self._x_is_target)
         losses, n_errs = [], []
         ep = jnp.uint32(epoch)
         ls = jnp.float32(lr_scale)
@@ -105,7 +119,8 @@ class StreamTrainer(FusedTrainer):
             self._build_steps()
         idx, mask, _ = self._idx_matrix(np.asarray(indices), batch)
         pf = BatchPrefetcher(self.loader, idx, depth=self.prefetch_depth,
-                             device_put=self._device_put)
+                             device_put=self._device_put,
+                             skip_labels=self._x_is_target)
         losses, n_errs = [], []
         for step_i, (x, t) in enumerate(pf):
             m = self._eval_fn(self.params, x, t,

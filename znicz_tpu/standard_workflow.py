@@ -232,14 +232,22 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         if isinstance(self.loader, StreamingLoader):
             # disk-backed dataset: stream minibatches through the
             # double-buffered prefetcher instead of scanning a resident
-            # tensor (same step math/RNG — parallel/stream.py)
-            if self.loss_function == "mse":
-                raise NotImplementedError(
-                    "streaming loaders serve (data, labels); MSE target "
-                    "tensors need the resident path")
+            # tensor (same step math/RNG — parallel/stream.py).  MSE
+            # heads: when the loader's label block is a float TENSOR
+            # (denoising-style .znr shards) it is the regression target;
+            # scalar/int labels mean the autoencoder contract —
+            # reconstruct the input
             from .parallel.stream import StreamTrainer
+            mse_target = "input"
+            if self.loss_function == "mse":
+                ldt = np.dtype(getattr(self.loader, "label_dtype",
+                                       np.int32))
+                lsh = tuple(getattr(self.loader, "label_shape", ()))
+                if ldt.kind == "f" and lsh:
+                    mse_target = "labels"
             trainer = StreamTrainer(spec=spec, params=params, vels=vels,
-                                    mesh=mesh, loader=self.loader)
+                                    mesh=mesh, loader=self.loader,
+                                    mse_target=mse_target)
         else:
             trainer = FusedTrainer(spec=spec, params=params, vels=vels,
                                    mesh=mesh)
