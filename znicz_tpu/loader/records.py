@@ -160,6 +160,11 @@ class RecordFile:
         idx = np.asarray(indices)
         return np.asarray(self.data[idx]), np.asarray(self.labels[idx])
 
+    def read_data(self, indices) -> np.ndarray:
+        """Data rows only — the label block is never touched (mmap pages
+        stay cold), for consumers that reconstruct the input."""
+        return np.asarray(self.data[np.asarray(indices)])
+
 
 def write_records(path: str, data: np.ndarray, labels: np.ndarray,
                   shard_size: int | None = None) -> list[str]:

@@ -59,6 +59,11 @@ class StreamingLoader(Loader):
     def read_batch(self, indices) -> tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
 
+    def read_data(self, indices) -> np.ndarray:
+        """Data rows only — overridden where skipping the label block
+        saves real IO (RecordLoader); the default just drops it."""
+        return self.read_batch(indices)[0]
+
     # -- Loader plumbing ---------------------------------------------------
     def load_data(self) -> None:
         self.load_meta()
@@ -138,6 +143,18 @@ class RecordLoader(StreamingLoader):
             data[sel] = d
             labels[sel] = l
         return data, labels
+
+    def read_data(self, indices) -> np.ndarray:
+        """Data rows only — skips the label block's IO entirely (a
+        denoising-sized label block would double the disk read)."""
+        idx = np.asarray(indices, np.int64)
+        which = np.searchsorted(self._bounds, idx, side="right") - 1
+        data = np.empty((len(idx), *self.sample_shape), np.float32)
+        for f_i in np.unique(which):
+            sel = which == f_i
+            local = idx[sel] - self._file_base[f_i]
+            data[sel] = self._files[f_i].read_data(local)
+        return data
 
 
 class OnTheFlyImageLoader(StreamingLoader):
@@ -231,8 +248,9 @@ class BatchPrefetcher:
         self.rows = index_rows
         self.depth = depth
         self._put = device_put or jax.device_put
-        #: don't decode-transfer the label block (consumer reconstructs
-        #: the input — autoencoder streaming); yields (x, None)
+        #: consumer reconstructs the input (autoencoder streaming):
+        #: yields (x, None), reading via loader.read_data so the label
+        #: block's IO is skipped too
         self.skip_labels = skip_labels
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._err = None
@@ -243,9 +261,12 @@ class BatchPrefetcher:
     def _produce(self) -> None:
         try:
             for row in self.rows:
-                x, t = self.loader.read_batch(np.asarray(row))
-                item = (self._put(x),
-                        None if self.skip_labels else self._put(t))
+                if self.skip_labels:
+                    x = self.loader.read_data(np.asarray(row))
+                    item = (self._put(x), None)
+                else:
+                    x, t = self.loader.read_batch(np.asarray(row))
+                    item = (self._put(x), self._put(t))
                 while not self._stopped:     # bounded-put with stop check
                     try:
                         self._q.put(item, timeout=0.2)

@@ -183,7 +183,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
     def train(self, fused: bool = False, mesh=None,
               max_epochs: int | None = None,
               compute_dtype: str | None = None,
-              profile_dir: str | None = None):
+              profile_dir: str | None = None,
+              mse_target: str | None = None):
         """One entry point over both execution paths (the samples' and
         launcher's ``--fused`` plumbing): the compiled fused step when
         requested AND the device supports it, else the unit-graph tick
@@ -192,7 +193,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             if self.device.is_xla:
                 return self.run_fused(mesh=mesh, max_epochs=max_epochs,
                                       compute_dtype=compute_dtype,
-                                      profile_dir=profile_dir)
+                                      profile_dir=profile_dir,
+                                      mse_target=mse_target)
             self.warning("fused path needs an XLA device; falling back "
                          "to the unit-graph tick loop")
         if max_epochs is not None:
@@ -201,7 +203,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
 
     def run_fused(self, mesh=None, max_epochs: int | None = None,
                   compute_dtype: str | None = None,
-                  profile_dir: str | None = None):
+                  profile_dir: str | None = None,
+                  mse_target: str | None = None):
         """Train via the compiled fused step instead of the unit-graph
         tick loop: whole epochs run as one device-side ``lax.scan``
         (optionally mesh-sharded), with Decision's improvement/stop logic
@@ -218,9 +221,11 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         else:
             ctx = contextlib.nullcontext()
         with ctx:
-            return self._run_fused_body(mesh, max_epochs, compute_dtype)
+            return self._run_fused_body(mesh, max_epochs, compute_dtype,
+                                        mse_target)
 
-    def _run_fused_body(self, mesh, max_epochs, compute_dtype):
+    def _run_fused_body(self, mesh, max_epochs, compute_dtype,
+                        mse_target=None):
         from .loader.base import TEST, TRAIN, VALID
         from .parallel import FusedTrainer, fused
 
@@ -233,18 +238,18 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             # disk-backed dataset: stream minibatches through the
             # double-buffered prefetcher instead of scanning a resident
             # tensor (same step math/RNG — parallel/stream.py).  MSE
-            # heads: when the loader's label block is a float TENSOR
-            # (denoising-style .znr shards) it is the regression target;
-            # scalar/int labels mean the autoencoder contract —
-            # reconstruct the input
+            # heads: an explicit ``mse_target`` wins; otherwise a FLOAT
+            # label block (denoising shards, regression targets of any
+            # shape) is the target, and int labels mean the autoencoder
+            # contract — reconstruct the input
             from .parallel.stream import StreamTrainer
-            mse_target = "input"
-            if self.loss_function == "mse":
-                ldt = np.dtype(getattr(self.loader, "label_dtype",
-                                       np.int32))
-                lsh = tuple(getattr(self.loader, "label_shape", ()))
-                if ldt.kind == "f" and lsh:
-                    mse_target = "labels"
+            if mse_target is None:
+                mse_target = "input"
+                if self.loss_function == "mse":
+                    ldt = np.dtype(getattr(self.loader, "label_dtype",
+                                           np.int32))
+                    if ldt.kind == "f":
+                        mse_target = "labels"
             trainer = StreamTrainer(spec=spec, params=params, vels=vels,
                                     mesh=mesh, loader=self.loader,
                                     mse_target=mse_target)
