@@ -1,0 +1,42 @@
+"""video_ae sample functional tests (SURVEY.md §2.2 secondary samples):
+frame autoencoder over synthetic clips — tied conv/deconv decoder,
+per-sequence splits, fused path with weight tying."""
+
+import numpy as np
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.config import root
+from znicz_tpu.models import video_ae
+
+
+class TestVideoAE:
+    SMALL = {"n_train_seq": 8, "n_valid_seq": 2, "n_test_seq": 0,
+             "frames_per_seq": 10}
+
+    def test_sequence_generator(self):
+        gen = prng.RandomGenerator("v", 3)
+        clip = video_ae.synth_sequence(gen, 6, 16)
+        assert clip.shape == (6, 16, 16, 1)
+        assert 0.0 <= clip.min() and clip.max() <= 1.0
+        # the blob moves: consecutive frames differ
+        assert np.abs(clip[1] - clip[0]).max() > 0.1
+
+    def test_reconstruction_improves(self):
+        prng.seed_all(1234)
+        wf = video_ae.run(device=Device.create("xla"), epochs=6,
+                          synthetic_sizes=self.SMALL)
+        ms = wf.decision.epoch_metrics
+        assert ms[-1]["validation_mse"] < ms[0]["validation_mse"]
+        assert ms[-1]["validation_mse"] < 0.15, ms[-1]
+
+    def test_fused_tied_decoder(self):
+        """fused path with the tied depool/deconv decoder (shared-W
+        sequential updates) trains finite and improving."""
+        prng.seed_all(1234)
+        wf = video_ae.run(device=Device.create("xla"), epochs=4,
+                          fused=True, synthetic_sizes=self.SMALL)
+        ms = wf.decision.epoch_metrics
+        assert len(ms) == 4
+        assert np.isfinite(ms[-1]["train_mse"])
+        assert ms[-1]["train_mse"] < ms[0]["train_mse"]
