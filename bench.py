@@ -410,6 +410,11 @@ def _kernel_cases():
         ("gd_lrn",
          lambda: elementwise.pallas_gd_lrn(err4, x4, d_lrn),
          lambda: lrn_ops.xla_gd_lrn(err4, x4, d_lrn), "close"),
+        ("lrn_y", lambda: elementwise.pallas_lrn_y(x4),
+         lambda: lrn_ops.xla_lrn(x4)[0], "close"),
+        ("gd_lrn_x",
+         lambda: elementwise.pallas_gd_lrn_x(err4, x4),
+         lambda: lrn_ops.xla_gd_lrn_x(err4, x4), "close"),
         ("pool_select",
          lambda: elementwise.pallas_pool_select(taps)[0],
          lambda: jnp.max(taps, axis=0), "close"),

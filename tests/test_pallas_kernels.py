@@ -77,6 +77,24 @@ class TestLRNKernel:
         np.testing.assert_allclose(np.asarray(e), e_ref, rtol=1e-5,
                                    atol=1e-6)
 
+    def test_remat_variants_match_cached(self):
+        """lrn_y / gd_lrn_x (no cached denom — the fused path's forms)
+        must agree with the cached-denom kernels bit-for-bit: identical
+        expressions evaluated over the same x, just fewer HBM passes."""
+        x = _x((3, 5, 5, 19))
+        err = _x((3, 5, 5, 19), "err")
+        y_cached, d = elementwise.pallas_lrn(jnp.asarray(x))
+        y = elementwise.pallas_lrn_y(jnp.asarray(x))
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(y_cached))
+        e_cached = elementwise.pallas_gd_lrn(jnp.asarray(err),
+                                             jnp.asarray(x), d)
+        e = elementwise.pallas_gd_lrn_x(jnp.asarray(err), jnp.asarray(x))
+        np.testing.assert_array_equal(np.asarray(e), np.asarray(e_cached))
+        # numpy golden for the recompute form
+        e_np = lrn_ops.np_gd_lrn_x(err, x)
+        np.testing.assert_allclose(np.asarray(e), e_np, rtol=1e-5,
+                                   atol=1e-6)
+
 
 class TestConvGradKernels:
     """Implicit-GEMM Pallas tiers for conv gradients and the deconv

@@ -30,12 +30,13 @@ from . import activations, rngbits, tuning
 _LANES = 128
 
 
-def _flatten_blocks(n: int, block_rows: int = 256):
+def _flatten_blocks(n: int, n_operands: int = 2):
     """(rows, padded_rows, block_rows) for an n-element flat tensor laid
-    out (rows, 128)."""
+    out (rows, 128); blocks VMEM-budget-sized for ``n_operands`` live
+    buffers (tuning.block_rows — big blocks keep the grid short)."""
     npad = tuning.round_up(max(n, _LANES), _LANES)
     rows = npad // _LANES
-    br = min(block_rows, tuning.round_up(rows, 8))
+    br = tuning.block_rows(n_operands, _LANES, rows=rows)
     rows_pad = tuning.round_up(rows, br)
     return rows, rows_pad, br, npad
 
@@ -64,14 +65,14 @@ def _act_bwd_kernel(e_ref, y_ref, x_ref, o_ref, *, name):
         o_ref.dtype)
 
 
-def _lastaxis_blocks(x):
+def _lastaxis_blocks(x, n_operands: int = 2):
     """(x2, rows, rows_pad, br, c): last axis preserved as the lane dim —
     required by position-dependent activations (sincos's even/odd lanes);
     used whenever the activation's math references the last-axis index."""
     c = x.shape[-1]
     rows = int(x.size // c)
     x2 = x.reshape(rows, c)
-    br = min(256, tuning.round_up(rows, 8))
+    br = tuning.block_rows(n_operands, c, rows=rows)
     rows_pad = tuning.round_up(rows, br)
     if rows_pad != rows:
         x2 = jnp.pad(x2, ((0, rows_pad - rows), (0, 0)))
@@ -115,9 +116,9 @@ def pallas_act_bwd(name: str, err_y, y, x=None):
     """err_x from (err_y, y[, x]) — the unit-zoo derivative convention."""
     act = activations.BY_NAME[name]
     if name in _POSITIONAL:
-        e2, rows, rows_pad, br, c = _lastaxis_blocks(err_y)
-        y2 = _lastaxis_blocks(y)[0]
-        x2 = _lastaxis_blocks(x)[0]
+        e2, rows, rows_pad, br, c = _lastaxis_blocks(err_y, 4)
+        y2 = _lastaxis_blocks(y, 4)[0]
+        x2 = _lastaxis_blocks(x, 4)[0]
         spec = pl.BlockSpec((br, c), lambda i: (i, 0))
         out = pl.pallas_call(
             functools.partial(_act_bwd_kernel, name=name),
@@ -128,7 +129,7 @@ def pallas_act_bwd(name: str, err_y, y, x=None):
         )(e2, y2, x2)
         return out[:rows].reshape(err_y.shape)
     n = err_y.size
-    rows, rows_pad, br, npad = _flatten_blocks(n)
+    rows, rows_pad, br, npad = _flatten_blocks(n, 4)
     e2 = _to_rows(err_y, npad, rows_pad)
     y2 = _to_rows(y, npad, rows_pad)
     spec = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
@@ -199,67 +200,34 @@ def pallas_dropout(x, seed: int, counters, ratio: float):
 
 
 # -- LRN -------------------------------------------------------------------
+# The kernel bodies reuse normalization's xp-generic formulas with
+# xp=jnp so the Mosaic tier can never silently diverge from the
+# numpy/XLA tiers — one accumulation order, bit-for-bit across tiers.
+
 def _lrn_fwd_kernel(x_ref, y_ref, d_ref, *, n, alpha, beta, k):
+    from . import normalization as lrn_math
     x = x_ref[:].astype(jnp.float32)
-    c = x.shape[-1]
-    half_lo, half_hi = (n - 1) // 2, n // 2
-    sq = x * x
-    pad = jnp.pad(sq, ((0, 0), (half_lo, half_hi)))
-    acc = pad[:, 0:c]
-    for i in range(1, n):
-        acc = acc + pad[:, i:i + c]
-    d = k + alpha * acc
+    y, d = lrn_math._fwd(x, n, alpha, beta, k, jnp)
     d_ref[:] = d
-    y_ref[:] = (x * d ** (-beta)).astype(y_ref.dtype)
+    y_ref[:] = y.astype(y_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "alpha", "beta", "k"))
-def pallas_lrn(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
-    """Cross-channel LRN fwd: rows = every spatial position, channels on
-    the lane axis; window sum + powers in one VMEM pass → (y, denom)."""
-    c = x.shape[-1]
-    lead = x.shape[:-1]
-    rows = int(x.size // c)
-    x2 = x.reshape(rows, c)
-    br = min(256, tuning.round_up(rows, 8))
-    rows_pad = tuning.round_up(rows, br)
-    if rows_pad != rows:
-        x2 = jnp.pad(x2, ((0, rows_pad - rows), (0, 0)))
-    y, d = pl.pallas_call(
-        functools.partial(_lrn_fwd_kernel, n=n, alpha=alpha, beta=beta,
-                          k=k),
-        grid=(rows_pad // br,),
-        in_specs=[pl.BlockSpec((br, c), lambda i: (i, 0))],
-        out_specs=[pl.BlockSpec((br, c), lambda i: (i, 0)),
-                   pl.BlockSpec((br, c), lambda i: (i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((rows_pad, c), x.dtype),
-                   jax.ShapeDtypeStruct((rows_pad, c), jnp.float32)],
-        interpret=tuning.interpret_mode(),
-    )(x2)
-    return (y[:rows].reshape(*lead, c), d[:rows].reshape(*lead, c))
-
-
-def _lrn_bwd_kernel(e_ref, x_ref, d_ref, o_ref, *, n, alpha, beta):
-    e = e_ref[:].astype(jnp.float32)
+def _lrn_fwd_y_kernel(x_ref, y_ref, *, n, alpha, beta, k):
+    from . import normalization as lrn_math
     x = x_ref[:].astype(jnp.float32)
-    d = d_ref[:].astype(jnp.float32)
-    c = x.shape[-1]
-    half_lo, half_hi = (n - 1) // 2, n // 2
-    q = e * x * d ** (-beta - 1.0)
-    pad = jnp.pad(q, ((0, 0), (half_lo, half_hi)))
-    acc = pad[:, 0:c]
-    for i in range(1, n):
-        acc = acc + pad[:, i:i + c]
-    o_ref[:] = (e * d ** (-beta) - 2.0 * alpha * beta * x * acc).astype(
-        o_ref.dtype)
+    y_ref[:] = lrn_math._fwd(x, n, alpha, beta, k, jnp)[0].astype(
+        y_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "alpha", "beta", "k"))
-def pallas_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
+def _lrn_pallas(kernel, inputs, out_dtypes, n_operands):
+    """Shared rows×channels tiling for the LRN kernel family: channels
+    on the lane axis, row blocks budget-sized for ``n_operands`` live
+    buffers; pads rows to the block, slices the pad back off."""
+    x = inputs[0]
     c = x.shape[-1]
     lead = x.shape[:-1]
     rows = int(x.size // c)
-    br = min(256, tuning.round_up(rows, 8))
+    br = tuning.block_rows(n_operands, c, rows=rows)
     rows_pad = tuning.round_up(rows, br)
 
     def to2(a):
@@ -267,14 +235,73 @@ def pallas_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
         return jnp.pad(a2, ((0, rows_pad - rows), (0, 0))) \
             if rows_pad != rows else a2
     spec = pl.BlockSpec((br, c), lambda i: (i, 0))
-    out = pl.pallas_call(
-        functools.partial(_lrn_bwd_kernel, n=n, alpha=alpha, beta=beta),
-        grid=(rows_pad // br,),
-        in_specs=[spec, spec, spec], out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((rows_pad, c), jnp.float32),
+    many = len(out_dtypes) > 1
+    shapes = [jax.ShapeDtypeStruct((rows_pad, c), dt)
+              for dt in out_dtypes]
+    outs = pl.pallas_call(
+        kernel, grid=(rows_pad // br,),
+        in_specs=[spec] * len(inputs),
+        out_specs=[spec] * len(out_dtypes) if many else spec,
+        out_shape=shapes if many else shapes[0],
         interpret=tuning.interpret_mode(),
-    )(to2(err), to2(x), to2(d))
-    return out[:rows].reshape(*lead, c)
+    )(*(to2(a) for a in inputs))
+    res = tuple(o[:rows].reshape(*lead, c)
+                for o in (outs if many else (outs,)))
+    return res if many else res[0]
+
+
+@functools.partial(jax.jit, static_argnames=("n", "alpha", "beta", "k"))
+def pallas_lrn(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    """Cross-channel LRN fwd: rows = every spatial position, channels on
+    the lane axis; window sum + powers in one VMEM pass → (y, denom)."""
+    return _lrn_pallas(
+        functools.partial(_lrn_fwd_kernel, n=n, alpha=alpha, beta=beta,
+                          k=k),
+        (x,), (x.dtype, jnp.float32), 4)      # 1 in + 2 out + temps
+
+
+def _lrn_bwd_kernel(e_ref, x_ref, d_ref, o_ref, *, n, alpha, beta):
+    from . import normalization as lrn_math
+    e = e_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.float32)
+    d = d_ref[:].astype(jnp.float32)
+    o_ref[:] = lrn_math._bwd(e, x, d, n, alpha, beta, jnp).astype(
+        o_ref.dtype)
+
+
+def _lrn_bwd_x_kernel(e_ref, x_ref, o_ref, *, n, alpha, beta, k):
+    """Backward with in-kernel denom recompute — saves the fwd's d
+    write plus this read, the two HBM passes the remat removes."""
+    from . import normalization as lrn_math
+    e = e_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.float32)
+    o_ref[:] = lrn_math._bwd_recompute(e, x, n, alpha, beta, k,
+                                       jnp).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "alpha", "beta", "k"))
+def pallas_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    return _lrn_pallas(
+        functools.partial(_lrn_bwd_kernel, n=n, alpha=alpha, beta=beta),
+        (err, x, d), (jnp.float32,), 5)       # 3 in + 1 out + temps
+
+
+@functools.partial(jax.jit, static_argnames=("n", "alpha", "beta", "k"))
+def pallas_lrn_y(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    """LRN forward emitting only y — one HBM read + one write."""
+    return _lrn_pallas(
+        functools.partial(_lrn_fwd_y_kernel, n=n, alpha=alpha, beta=beta,
+                          k=k),
+        (x,), (x.dtype,), 3)                  # 1 in + 1 out + temps
+
+
+@functools.partial(jax.jit, static_argnames=("n", "alpha", "beta", "k"))
+def pallas_gd_lrn_x(err, x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    """LRN backward recomputing the denominator from x in VMEM."""
+    return _lrn_pallas(
+        functools.partial(_lrn_bwd_x_kernel, n=n, alpha=alpha, beta=beta,
+                          k=k),
+        (err, x), (jnp.float32,), 4)          # 2 in + 1 out + temps
 
 
 # -- pooling winner select -------------------------------------------------
@@ -299,7 +326,7 @@ def pallas_pool_select(taps, use_abs: bool = False):
     the select/argmax core of the reference pooling kernel; tap stacking
     and the backward scatter stay in XLA (SURVEY.md §7 hard part (a))."""
     t, rows, c = taps.shape
-    br = min(256, tuning.round_up(rows, 8))
+    br = tuning.block_rows(t + 2, c, rows=rows)
     rows_pad = tuning.round_up(rows, br)
     if rows_pad != rows:
         taps = jnp.pad(taps, ((0, 0), (0, rows_pad - rows), (0, 0)))
@@ -333,7 +360,7 @@ def pallas_pool_scatter(err, offsets, n_taps: int):
     regular strided placement of the taps into dx stays in XLA, mirroring
     the forward's stack-in-XLA / select-in-Pallas split."""
     rows, c = err.shape
-    br = min(256, tuning.round_up(rows, 8))
+    br = tuning.block_rows(n_taps + 2, c, rows=rows)
     rows_pad = tuning.round_up(rows, br)
     if rows_pad != rows:
         err = jnp.pad(err, ((0, rows_pad - rows), (0, 0)))
@@ -367,7 +394,7 @@ def pallas_pool_gather(taps, offsets):
     each window's recorded winner tap and sum — ``out = Σ_t
     taps[t]·(offsets == t)`` in one pass over the (T, rows, C) stack."""
     t, rows, c = taps.shape
-    br = min(256, tuning.round_up(rows, 8))
+    br = tuning.block_rows(t + 2, c, rows=rows)
     rows_pad = tuning.round_up(rows, br)
     if rows_pad != rows:
         taps = jnp.pad(taps, ((0, 0), (0, rows_pad - rows), (0, 0)))

@@ -47,10 +47,23 @@ def _window_sum(a, n: int, xp):
     return acc
 
 
+def _dpow_nbeta(d, beta, xp):
+    """d^(−β), with β=0.75 (every shipped config) as 1/(√d·√√d).
+
+    sqrt/mul/div are correctly-rounded IEEE ops in numpy, XLA and
+    Mosaic alike, so the same expression stays bit-reproducible across
+    all three tiers — a transcendental ``pow`` is neither (and costs a
+    log+exp pair on the VPU).  Non-default β falls back to pow."""
+    if beta == 0.75:
+        r = xp.sqrt(d)
+        return 1.0 / (r * xp.sqrt(r))
+    return d ** (-beta)
+
+
 def _fwd(x, n, alpha, beta, k, xp):
     s = _window_sum(x * x, n, xp)
     d = k + alpha * s
-    return x * d ** (-beta), d
+    return x * _dpow_nbeta(d, beta, xp), d
 
 
 def np_lrn(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
@@ -63,9 +76,9 @@ def xla_lrn(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
 
 
 def _bwd(err, x, d, n, alpha, beta, xp):
-    q = err * x * d ** (-beta - 1.0)
-    return err * d ** (-beta) - 2.0 * alpha * beta * x * _window_sum(
-        q, n, xp)
+    p = _dpow_nbeta(d, beta, xp)
+    q = err * x * (p / d)
+    return err * p - 2.0 * alpha * beta * x * _window_sum(q, n, xp)
 
 
 def np_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
@@ -74,6 +87,28 @@ def np_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
 
 def xla_gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
     return _bwd(err, x, d, n, alpha, beta, jnp)
+
+
+# -- remat variants (fused-path fast forms) --------------------------------
+# LRN is HBM-bound: the denominator d is a full activation-sized tensor,
+# and caching it from forward to backward costs one HBM write + one read
+# of the biggest tensors in the net (AlexNet: (B,55,55,96)+(B,27,27,256)).
+# Recomputing d from x inside the backward (one extra windowed VPU sum —
+# FLOPs the TPU has to spare) removes both passes.  The unit-graph path
+# keeps the (y, denom) contract for parity with the reference's
+# LRNormalizerForward; the fused trainer uses these.
+
+def _bwd_recompute(err, x, n, alpha, beta, k, xp):
+    d = k + alpha * _window_sum(x * x, n, xp)
+    return _bwd(err, x, d, n, alpha, beta, xp)
+
+
+def np_gd_lrn_x(err, x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    return _bwd_recompute(err, x, n, alpha, beta, k, np)
+
+
+def xla_gd_lrn_x(err, x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    return _bwd_recompute(err, x, n, alpha, beta, k, jnp)
 
 
 # -- dispatchers (Pallas kernel on TPU, XLA formulation elsewhere) ---------
@@ -91,3 +126,21 @@ def gd_lrn(err, x, d, n=5, alpha=1e-4, beta=0.75, k=2.0):
         from . import elementwise
         return elementwise.pallas_gd_lrn(err, x, d, n, alpha, beta, k)
     return xla_gd_lrn(err, x, d, n, alpha, beta, k)
+
+
+def lrn_y(x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    """Forward emitting only y (denom rematerialized in backward)."""
+    from . import tuning
+    if tuning.use_pallas():
+        from . import elementwise
+        return elementwise.pallas_lrn_y(x, n, alpha, beta, k)
+    return xla_lrn(x, n, alpha, beta, k)[0]
+
+
+def gd_lrn_x(err, x, n=5, alpha=1e-4, beta=0.75, k=2.0):
+    """Backward recomputing denom from x in-kernel (no cached d)."""
+    from . import tuning
+    if tuning.use_pallas():
+        from . import elementwise
+        return elementwise.pallas_gd_lrn_x(err, x, n, alpha, beta, k)
+    return xla_gd_lrn_x(err, x, n, alpha, beta, k)

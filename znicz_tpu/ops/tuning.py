@@ -56,3 +56,24 @@ def min_tile(dtype) -> tuple[int, int]:
 
 def round_up(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult
+
+
+#: Per-operand VMEM budget for elementwise block sizing (bytes).
+#: Default measured on v5e (2026-07-30 A/B, AlexNet batch 256): 256-row
+#: blocks (128 KiB) beat 2048-row blocks by ~14% — the short-block
+#: pipeline hides HBM latency better than big transfers, so the budget
+#: floor is the sweet spot.  Raise via env to re-run the experiment.
+_VMEM_BUDGET = int(os.environ.get("ZNICZ_TPU_VMEM_BUDGET", 768 * 1024))
+
+
+def block_rows(n_operands: int, lanes: int = 128, dtype_bytes: int = 4,
+               rows: int | None = None) -> int:
+    """Rows per elementwise block for an (rows, lanes) layout: all
+    operands' blocks fit the VMEM budget double-buffered, floored at
+    the 256-row minimum that measured fastest (see _VMEM_BUDGET)."""
+    per_buf = _VMEM_BUDGET // max(1, n_operands * 2)
+    br = max(256, per_buf // max(1, lanes * dtype_bytes))
+    br = 1 << (br.bit_length() - 1)          # floor to a power of two
+    if rows is not None:
+        br = min(br, round_up(rows, 8))
+    return br

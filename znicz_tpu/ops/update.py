@@ -58,8 +58,8 @@ def _update_kernel(h_ref, w_ref, g_ref, v_ref, wo_ref, vo_ref):
     vo_ref[:] = v_new.astype(vo_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
-def pallas_sgd_update(w, grad, vel, hypers, block: int = 1024):
+@jax.jit
+def pallas_sgd_update(w, grad, vel, hypers):
     """Fused update over a flattened parameter.
 
     ``hypers`` = f32[4] array (lr, weights_decay, l1_vs_l2, momentum) so
@@ -69,7 +69,7 @@ def pallas_sgd_update(w, grad, vel, hypers, block: int = 1024):
     npad = tuning.round_up(max(n, 128), 128)
     cols = 128
     rows = npad // cols
-    br = min(block // cols * cols // cols or 1, rows)
+    br = tuning.block_rows(5, cols, rows=rows)   # 3 in + 2 out
 
     def flat(a):
         a = jnp.ravel(a).astype(jnp.float32)

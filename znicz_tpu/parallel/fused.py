@@ -215,7 +215,8 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
 
     For softmax loss the last layer's output is the *logits* (loss fusion
     happens in the step).  ``caches[i]`` = (layer input, kind-specific
-    residual: pooling winner slots / LRN denom / dropout mask).
+    residual: pooling winner slots; LRN denoms and dropout masks are
+    rematerialized in the backward, not cached).
     ``epoch``/``ctr`` (may be traced) feed the counter RNG of stochastic
     layers when ``train``."""
     cdt = jnp.dtype(spec.compute_dtype)
@@ -286,8 +287,12 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
                     h, cfg["ksize"], cfg["stride"], cfg["padding"], None,
                     use_abs=use_abs, deterministic=True)
         elif layer.kind == "lrn":
-            h, aux = lrn_ops.lrn(h, cfg["n"], cfg["alpha"],
-                                 cfg["beta"], cfg["k"])
+            # aux stays None: the backward recomputes the denominator
+            # from the cached x_in (LRN is HBM-bound; caching the
+            # activation-sized d costs more than the windowed VPU sum
+            # that rebuilds it — same remat rationale as dropout masks)
+            h = lrn_ops.lrn_y(h, cfg["n"], cfg["alpha"],
+                              cfg["beta"], cfg["k"])
         elif layer.kind == "dropout":
             if train:
                 # aux stays None: the backward REGENERATES the mask from
@@ -405,9 +410,9 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
                 err.reshape(y_i.shape), x_in.shape, cfg["ksize"],
                 cfg["stride"], cfg["padding"])
         elif layer.kind == "lrn":
-            err = lrn_ops.gd_lrn(err.reshape(y_i.shape), x_in, aux,
-                                 cfg["n"], cfg["alpha"], cfg["beta"],
-                                 cfg["k"])
+            err = lrn_ops.gd_lrn_x(err.reshape(y_i.shape), x_in,
+                                   cfg["n"], cfg["alpha"], cfg["beta"],
+                                   cfg["k"])
         elif layer.kind == "depooling":
             err = pool_ops.gd_depooling(
                 err.reshape(y_i.shape), aux, cfg["ksize"], cfg["stride"],
