@@ -81,6 +81,33 @@ class TestTraining:
         assert ms[-1]["train_err_pct"] < 20.0
         assert ms[-1]["train_loss"] < ms[0]["train_loss"] * 0.5
 
+    def test_imagenet_pipeline_from_disk(self, small_net, tmp_path):
+        """data_dir mode: the on-the-fly ImageNet-style pipeline (decode
+        → random crop+mirror → prefetch) feeds the fused trainer."""
+        from PIL import Image
+        gen = prng.get("alexdisk")
+        for split, n in (("train", 8), ("valid", 4)):
+            for cname in ("a", "b", "c"):
+                d = tmp_path / split / cname
+                d.mkdir(parents=True)
+                for i in range(n):
+                    arr = gen.randint(0, 255, (32, 32, 3)).astype(
+                        np.uint8)
+                    Image.fromarray(arr).save(d / f"{i}.png")
+        root.alexnet.update({"data_dir": str(tmp_path), "decode_size": 75,
+                             "minibatch_size": 8, "n_classes": 3})
+        try:
+            prng.seed_all(3)
+            wf = alexnet.run(device=Device.create("xla"), epochs=2,
+                             layers=tanh_layers())
+        finally:
+            root.alexnet.update({"data_dir": None, "decode_size": 256})
+        ld = wf.loader
+        assert ld.sample_shape == (67, 67, 3)
+        assert ld.n_classes == 3
+        ms = wf.decision.epoch_metrics
+        assert len(ms) >= 2 and np.isfinite(ms[-1]["train_loss"])
+
     def test_unit_graph_numpy_vs_xla_minibatch(self, small_net):
         """One forward+backward tick, both backends, same weights."""
         layers = tanh_layers()

@@ -399,3 +399,104 @@ class TestOnTheFlyImages:
         np.testing.assert_array_equal(l, labels[[2, 7, 19]])
         ld.run()
         assert ld.minibatch_labels.mem.shape == (5, 4)
+
+
+class TestAugmentation:
+    """RandomCropFlip — the reference ImageNet-pipeline recipe (random
+    crop + mirror at train, center crop at eval), counter-RNG keyed."""
+
+    def _loader(self, tmp_path, augment, n=16, hw=(12, 10)):
+        gen = prng.get("aug")
+        data = np.asarray(gen.normal(size=(n, *hw, 3)), np.float32)
+        labels = np.arange(n, dtype=np.int32) % 3
+        paths = write_records(str(tmp_path / "a.znr"), data, labels)
+        ld = RecordLoader(Workflow(name="w"), train_paths=paths[:],
+                          validation_paths=write_records(
+                              str(tmp_path / "v.znr"), data[:4],
+                              labels[:4]),
+                          minibatch_size=4, augment=augment)
+        ld.initialize(NumpyDevice())
+        return ld, data
+
+    def test_shapes_and_center_eval(self, tmp_path):
+        from znicz_tpu.loader import RandomCropFlip
+        aug = RandomCropFlip((8, 8), seed=7)
+        ld, data = self._loader(tmp_path, aug)
+        assert ld.sample_shape == (8, 8, 3)
+        assert ld.minibatch_data.mem.shape == (4, 8, 8, 3)
+        # rows 0..3 are validation (global index < train base): center
+        # crop, no mirror, independent of epoch
+        d0, _ = ld.fetch([0, 1, 2, 3], epoch=0)
+        d9, _ = ld.fetch([0, 1, 2, 3], epoch=9)
+        np.testing.assert_array_equal(d0, d9)
+        np.testing.assert_array_equal(d0, data[:4][:, 2:10, 1:9])
+
+    def test_train_rows_deterministic_per_epoch(self, tmp_path):
+        from znicz_tpu.loader import RandomCropFlip
+        aug = RandomCropFlip((8, 8), seed=7)
+        ld, data = self._loader(tmp_path, aug)
+        rows = [4, 7, 10]                      # train rows (base = 4)
+        a, _ = ld.fetch(rows, epoch=3)
+        b, _ = ld.fetch(rows, epoch=3)
+        np.testing.assert_array_equal(a, b)    # pure in (seed,epoch,idx)
+        c, _ = ld.fetch(rows, epoch=4)
+        assert not np.array_equal(a, c)        # epochs re-draw
+        # batch composition must not matter
+        solo, _ = ld.fetch([7], epoch=3)
+        np.testing.assert_array_equal(solo[0], a[1])
+
+    def test_crops_are_views_of_source(self, tmp_path):
+        """Every augmented frame equals some contiguous (possibly
+        mirrored) window of its source frame."""
+        from znicz_tpu.loader import RandomCropFlip
+        aug = RandomCropFlip((8, 8), seed=7)
+        ld, data = self._loader(tmp_path, aug)
+        out, _ = ld.fetch([5, 6], epoch=1)
+        src = data[[1, 2]]                     # global 5,6 → train 1,2
+        for j in range(2):
+            found = any(
+                np.array_equal(out[j], win) or
+                np.array_equal(out[j], win[:, ::-1])
+                for t in range(12 - 8 + 1) for le in range(10 - 8 + 1)
+                for win in [src[j, t:t + 8, le:le + 8]])
+            assert found
+
+    def test_unit_graph_serving_augments(self, tmp_path):
+        from znicz_tpu.loader import RandomCropFlip
+        aug = RandomCropFlip((8, 8), seed=7)
+        ld, _ = self._loader(tmp_path, aug)
+        ld.run()                               # first minibatch (train)
+        assert ld.minibatch_data.mem.shape == (4, 8, 8, 3)
+
+    def test_oversized_crop_rejected(self, tmp_path):
+        from znicz_tpu.loader import RandomCropFlip
+        with pytest.raises(ValueError, match="exceeds"):
+            self._loader(tmp_path, RandomCropFlip((20, 20)))
+
+    def test_mirror_without_crop_still_flips(self, tmp_path):
+        """Frame == crop size must not bypass mirroring (review fix)."""
+        from znicz_tpu.loader import RandomCropFlip
+        aug = RandomCropFlip((12, 10), mirror=True, seed=11)
+        ld, data = self._loader(tmp_path, aug)
+        assert ld.sample_shape == (12, 10, 3)
+        rows = list(range(4, 20))              # all train rows
+        out, _ = ld.fetch(rows, epoch=0)
+        flipped = [j for j in range(len(rows))
+                   if np.array_equal(out[j], data[j][:, ::-1])]
+        kept = [j for j in range(len(rows))
+                if np.array_equal(out[j], data[j])]
+        assert len(flipped) + len(kept) == len(rows)
+        assert flipped and kept                # both outcomes occur
+
+    def test_spatial_labels_rejected(self, tmp_path):
+        """Augmentation over image-shaped label blocks (denoising
+        targets) would misalign input and target — must raise."""
+        from znicz_tpu.loader import RandomCropFlip
+        gen = prng.get("auglbl")
+        data = np.asarray(gen.normal(size=(8, 12, 10, 3)), np.float32)
+        paths = write_records(str(tmp_path / "s.znr"), data, data)
+        ld = RecordLoader(Workflow(name="w"), train_paths=paths,
+                          minibatch_size=4,
+                          augment=RandomCropFlip((8, 8)))
+        with pytest.raises(ValueError, match="spatial labels"):
+            ld.initialize(NumpyDevice())

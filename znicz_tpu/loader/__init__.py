@@ -5,6 +5,7 @@ Parity target: the reference loader layer (SURVEY.md §2.1 Loader base row:
 ``LoaderMSE``, normalizer family).
 """
 
+from .augment import RandomCropFlip
 from .base import TEST, TRAIN, VALID, Loader
 from .fullbatch import FullBatchLoader, FullBatchLoaderMSE
 from .records import RecordFile, RecordWriter, write_records
@@ -14,4 +15,4 @@ from .streaming import (BatchPrefetcher, OnTheFlyImageLoader,
 __all__ = ["TEST", "TRAIN", "VALID", "Loader", "FullBatchLoader",
            "FullBatchLoaderMSE", "RecordFile", "RecordWriter",
            "write_records", "BatchPrefetcher", "OnTheFlyImageLoader",
-           "RecordLoader", "StreamingLoader"]
+           "RecordLoader", "StreamingLoader", "RandomCropFlip"]

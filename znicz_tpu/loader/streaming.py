@@ -46,11 +46,16 @@ class StreamingLoader(Loader):
     first, then validation, then train — the base class's index space).
     """
 
-    def __init__(self, workflow=None, name=None, **kwargs):
+    def __init__(self, workflow=None, name=None, augment=None, **kwargs):
         super().__init__(workflow, name or "streaming_loader", **kwargs)
         self.sample_shape: tuple = ()
+        self.raw_sample_shape: tuple = ()
         self.label_shape: tuple = ()      # () = scalar class labels
         self.label_dtype = np.int32
+        #: optional train-time policy (loader.augment.RandomCropFlip):
+        #: applied host-side per fetch; train/eval told apart per-row by
+        #: global index, so eval rows are deterministic in any batch
+        self.augment = augment
 
     # -- subclass API ------------------------------------------------------
     def load_meta(self) -> None:
@@ -64,9 +69,40 @@ class StreamingLoader(Loader):
         saves real IO (RecordLoader); the default just drops it."""
         return self.read_batch(indices)[0]
 
+    # -- augmentation ------------------------------------------------------
+    def _train_base(self) -> int:
+        return self.class_lengths[TEST] + self.class_lengths[VALID]
+
+    def _augmented(self, data, indices, epoch):
+        if self.augment is None:
+            return data
+        idx = np.asarray(indices)
+        return self.augment.apply(data, idx, epoch,
+                                  idx >= self._train_base())
+
+    def fetch(self, indices, epoch=None):
+        """read_batch + augmentation — what consumers should call."""
+        data, labels = self.read_batch(indices)
+        return self._augmented(data, indices, epoch), labels
+
+    def fetch_data(self, indices, epoch=None):
+        return self._augmented(self.read_data(indices), indices, epoch)
+
     # -- Loader plumbing ---------------------------------------------------
     def load_data(self) -> None:
         self.load_meta()
+        #: decoded (pre-augmentation) shape — what read_batch returns;
+        #: sample_shape is what the model sees
+        self.raw_sample_shape = self.sample_shape
+        if self.augment is not None:
+            if len(self.label_shape) >= 2:
+                # a spatial label block (e.g. denoising targets) would
+                # stay uncropped and misalign with the augmented input
+                raise ValueError(
+                    f"{self.name}: augmentation with spatial labels "
+                    f"{self.label_shape} is unsupported — targets would "
+                    "not follow the input crops")
+            self.sample_shape = self.augment.out_shape(self.sample_shape)
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device, **kwargs)
@@ -79,7 +115,7 @@ class StreamingLoader(Loader):
         self.minibatch_labels.initialize(device)
 
     def fill_minibatch(self, indices: np.ndarray, klass: int) -> None:
-        data, labels = self.read_batch(indices)
+        data, labels = self.fetch(indices, epoch=self.epoch_number)
         size = len(indices)
         if size < self.max_minibatch_size:       # static-shape padding
             pad = self.max_minibatch_size - size
@@ -133,7 +169,7 @@ class RecordLoader(StreamingLoader):
     def read_batch(self, indices) -> tuple[np.ndarray, np.ndarray]:
         idx = np.asarray(indices, np.int64)
         which = np.searchsorted(self._bounds, idx, side="right") - 1
-        data = np.empty((len(idx), *self.sample_shape), np.float32)
+        data = np.empty((len(idx), *self.raw_sample_shape), np.float32)
         labels = np.empty((len(idx), *self.label_shape),
                           self.label_dtype)
         for f_i in np.unique(which):
@@ -149,7 +185,7 @@ class RecordLoader(StreamingLoader):
         denoising-sized label block would double the disk read)."""
         idx = np.asarray(indices, np.int64)
         which = np.searchsorted(self._bounds, idx, side="right") - 1
-        data = np.empty((len(idx), *self.sample_shape), np.float32)
+        data = np.empty((len(idx), *self.raw_sample_shape), np.float32)
         for f_i in np.unique(which):
             sel = which == f_i
             local = idx[sel] - self._file_base[f_i]
@@ -242,11 +278,13 @@ class BatchPrefetcher:
 
     def __init__(self, loader: StreamingLoader, index_rows,
                  depth: int = 2, device_put=None,
-                 skip_labels: bool = False):
+                 skip_labels: bool = False, epoch=None):
         import jax
         self.loader = loader
         self.rows = index_rows
         self.depth = depth
+        #: augmentation coordinate (None → eval: center crops only)
+        self.epoch = epoch
         self._put = device_put or jax.device_put
         #: consumer reconstructs the input (autoencoder streaming):
         #: yields (x, None), reading via loader.read_data so the label
@@ -262,10 +300,12 @@ class BatchPrefetcher:
         try:
             for row in self.rows:
                 if self.skip_labels:
-                    x = self.loader.read_data(np.asarray(row))
+                    x = self.loader.fetch_data(np.asarray(row),
+                                               epoch=self.epoch)
                     item = (self._put(x), None)
                 else:
-                    x, t = self.loader.read_batch(np.asarray(row))
+                    x, t = self.loader.fetch(np.asarray(row),
+                                             epoch=self.epoch)
                     item = (self._put(x), self._put(t))
                 while not self._stopped:     # bounded-put with stop check
                     try:

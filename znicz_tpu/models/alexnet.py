@@ -78,6 +78,12 @@ root.alexnet.setdefaults({
     "decision": {"max_epochs": 10, "fail_iterations": 50},
     "synthetic": {"n_train": 512, "n_valid": 128, "n_test": 128,
                   "noise": 0.4},
+    #: directory-per-class tree with train/ (and optionally valid/,
+    #: test/) subtrees → the reference's on-the-fly ImageNet pipeline:
+    #: decode at decode_size, random-crop to size + mirror at train
+    #: time, center crop at eval (loader.augment.RandomCropFlip)
+    "data_dir": None,
+    "decode_size": 256,
 })
 
 
@@ -118,16 +124,52 @@ class ImagenetSyntheticLoader(FullBatchLoader):
         self.class_lengths = [n_test, n_valid, n_train]
 
 
+def make_imagenet_loader(data_dir: str, size: int = 227,
+                         decode_size: int = 256,
+                         minibatch_size: int = 128):
+    """The reference's on-the-fly ImageNet pipeline, TPU-edition: disk
+    tree bigger than HBM, host decode at (decode_size)² in a thread
+    pool, counter-RNG random (size)² crop + mirror at train time, all
+    overlapped with device compute by the double-buffered prefetcher
+    (SURVEY.md §2.2 "Znicz loaders" row, imagenet pipeline)."""
+    import os
+
+    from ..loader.augment import RandomCropFlip
+    from ..loader.streaming import OnTheFlyImageLoader
+    splits = {}
+    for split, key in (("train", "train_paths"),
+                       ("valid", "validation_paths"),
+                       ("test", "test_paths")):
+        p = os.path.join(data_dir, split)
+        if os.path.isdir(p):
+            splits[key] = [p]
+    if "train_paths" not in splits:
+        raise ValueError(f"{data_dir}: no train/ subtree")
+    return OnTheFlyImageLoader(
+        size=(decode_size, decode_size),
+        augment=RandomCropFlip((size, size)),
+        minibatch_size=minibatch_size, **splits)
+
+
 class AlexNetWorkflow(StandardWorkflow):
     """BASELINE config 3: the ImageNet AlexNet training workflow."""
 
     def __init__(self, workflow=None, name="AlexNetWorkflow", layers=None,
-                 decision_config=None, snapshotter_config=None, **kwargs):
-        loader = ImagenetSyntheticLoader(
-            minibatch_size=root.alexnet.get("minibatch_size", 128),
-            size=root.alexnet.get("size", 227),
-            n_classes=root.alexnet.get("n_classes", 1000),
-            synthetic_sizes=kwargs.get("synthetic_sizes"))
+                 decision_config=None, snapshotter_config=None,
+                 data_dir=None, **kwargs):
+        data_dir = data_dir or root.alexnet.get("data_dir")
+        if data_dir:
+            loader = make_imagenet_loader(
+                data_dir,
+                size=root.alexnet.get("size", 227),
+                decode_size=root.alexnet.get("decode_size", 256),
+                minibatch_size=root.alexnet.get("minibatch_size", 128))
+        else:
+            loader = ImagenetSyntheticLoader(
+                minibatch_size=root.alexnet.get("minibatch_size", 128),
+                size=root.alexnet.get("size", 227),
+                n_classes=root.alexnet.get("n_classes", 1000),
+                synthetic_sizes=kwargs.get("synthetic_sizes"))
         super().__init__(
             None, name,
             layers=layers or root.alexnet.get("layers")

@@ -99,7 +99,7 @@ class StreamTrainer(FusedTrainer):
                                            ctr_base)
         pf = BatchPrefetcher(self.loader, idx, depth=self.prefetch_depth,
                              device_put=self._device_put,
-                             skip_labels=self._x_is_target)
+                             skip_labels=self._x_is_target, epoch=epoch)
         losses, n_errs = [], []
         ep = jnp.uint32(epoch)
         ls = jnp.float32(lr_scale)

@@ -385,3 +385,17 @@ class StandardWorkflow(StandardWorkflowBase):
         self.link_gds()
         if snapshotter_config is not None:
             self.link_snapshotter(**snapshotter_config)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        # classifier sanity: a loader-derived class count that exceeds
+        # the softmax width would one-hot to all-zero rows and train
+        # silently wrong (ops/softmax.py one_hot semantics) — fail loud
+        if self.loss_function == "softmax" and self.forwards:
+            n_out = int(self.forwards[-1].output.shape[-1])
+            n_cls = getattr(self.loader, "n_classes", None)
+            if n_cls is not None and int(n_cls) > n_out:
+                raise ValueError(
+                    f"{self.name}: loader serves {n_cls} classes but the "
+                    f"softmax layer is {n_out}-wide — labels ≥ {n_out} "
+                    "would train silently wrong")
