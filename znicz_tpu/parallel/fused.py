@@ -59,6 +59,13 @@ class ModelSpec:
     layers: tuple[LayerSpec, ...]
     loss: str                     # "softmax" | "mse"
     compute_dtype: str = "float32"
+    #: dtype activations are STORED in between layers (and therefore in
+    #: the backward caches).  "bfloat16" halves the dominant HBM traffic
+    #: of activation-bound nets (AlexNet's LRN/pool stack) while master
+    #: params, gradients and the loss head stay f32 — the TPU-native
+    #: mixed-precision recipe.  Default f32 keeps every bit-exact
+    #: backend-equivalence contract intact.
+    storage_dtype: str = "float32"
 
     def __post_init__(self):
         # the softmax-CE head consumes 2D logits and backward() hands the
@@ -220,6 +227,7 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
     ``epoch``/``ctr`` (may be traced) feed the counter RNG of stochastic
     layers when ``train``."""
     cdt = jnp.dtype(spec.compute_dtype)
+    sdt = jnp.dtype(spec.storage_dtype)
     h = x
     caches = []
     auxes = []       # per-layer residuals, kept even without caches so
@@ -309,6 +317,12 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
             h = spec.act(i).fwd(h, jnp)
         else:
             raise NotImplementedError(layer.kind)
+        if sdt != jnp.float32 and not is_last:
+            # storage cast between layers: the next layer's input (and
+            # its backward cache) live in sdt; the last layer's output
+            # stays f32 so the loss head and its error are full
+            # precision
+            h = h.astype(sdt)
         auxes.append(aux)
         if want_caches:
             caches.append((x_in, aux))

@@ -183,6 +183,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
     def train(self, fused: bool = False, mesh=None,
               max_epochs: int | None = None,
               compute_dtype: str | None = None,
+              storage_dtype: str | None = None,
               profile_dir: str | None = None,
               mse_target: str | None = None):
         """One entry point over both execution paths (the samples' and
@@ -193,6 +194,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             if self.device.is_xla:
                 return self.run_fused(mesh=mesh, max_epochs=max_epochs,
                                       compute_dtype=compute_dtype,
+                                      storage_dtype=storage_dtype,
                                       profile_dir=profile_dir,
                                       mse_target=mse_target)
             self.warning("fused path needs an XLA device; falling back "
@@ -203,6 +205,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
 
     def run_fused(self, mesh=None, max_epochs: int | None = None,
                   compute_dtype: str | None = None,
+                  storage_dtype: str | None = None,
                   profile_dir: str | None = None,
                   mse_target: str | None = None):
         """Train via the compiled fused step instead of the unit-graph
@@ -222,17 +225,21 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             ctx = contextlib.nullcontext()
         with ctx:
             return self._run_fused_body(mesh, max_epochs, compute_dtype,
-                                        mse_target)
+                                        storage_dtype, mse_target)
 
     def _run_fused_body(self, mesh, max_epochs, compute_dtype,
-                        mse_target=None):
+                        storage_dtype=None, mse_target=None):
+        import dataclasses
+
         from .loader.base import TEST, TRAIN, VALID
         from .parallel import FusedTrainer, fused
 
         assert self.initialized, "initialize() first"
         spec, params, vels = fused.extract_model(self)
         if compute_dtype is not None:
-            spec = fused.ModelSpec(spec.layers, spec.loss, compute_dtype)
+            spec = dataclasses.replace(spec, compute_dtype=compute_dtype)
+        if storage_dtype is not None:
+            spec = dataclasses.replace(spec, storage_dtype=storage_dtype)
         from .loader.streaming import StreamingLoader
         if isinstance(self.loader, StreamingLoader):
             # disk-backed dataset: stream minibatches through the
