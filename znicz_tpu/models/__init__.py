@@ -1,4 +1,4 @@
 """Runnable sample models (reference: veles/znicz/samples — SURVEY.md §2.2):
 MNIST MLP, CIFAR-10 conv, AlexNet, MNIST autoencoder, Kohonen SOM,
 Wine tabular MLP, stacked-RBM DBN pretraining, kanji glyph streaming,
-video frame autoencoder."""
+video frame autoencoder, YaleFaces identity-under-lighting."""

@@ -101,7 +101,10 @@ def render_dataset(directory: str, n_classes: int, per_class: dict,
         with open(marker) as fh:
             if fh.read().strip() == want:
                 return splits
-        shutil.rmtree(directory, ignore_errors=True)
+    # stale OR partial tree (interrupted render leaves no marker):
+    # always start clean — leftover glyphs of another config would mix
+    # into the directory scan
+    shutil.rmtree(directory, ignore_errors=True)
     strokes = class_strokes(n_classes, size)
     gen = prng.get("kanji_render")
     for split, n_per in per_class.items():
