@@ -272,18 +272,23 @@ def _reduce_for_cpu(args):
     args.epochs, args.ticks, args.warm = 1, 0, 1
 
 
-def bench_training(args) -> int:
-    result = {"metric": f"{args.config}_train_images_per_sec_per_chip",
-              "value": None, "unit": "images/sec", "vs_baseline": None}
+def _bring_up(args, result, reduce_on_cpu: bool = True):
+    """Shared backend bring-up: await the TPU, else labeled CPU
+    fallback.  Mutates ``result`` (device/note/error fields) and
+    returns the platform string, or None when even the fallback failed
+    (caller emits and exits) — the single copy of the resilience
+    contract every bench mode relies on (VERDICT r1 item 1)."""
     try:
         platform, kind = _await_backend(args.backend_wait)
         result["device"] = kind
         if platform == "cpu":
             # jax silently defaulted to host CPU (no TPU registered at
-            # all): keep the run small and say so — a full-size AlexNet
-            # epoch on CPU takes hours and isn't the headline metric.
+            # all): keep the run small and say so — full-size epochs on
+            # CPU take hours and aren't the headline metric.
             result["note"] = "no TPU registered; reduced-size CPU run"
-            _reduce_for_cpu(args)
+            if reduce_on_cpu:
+                _reduce_for_cpu(args)
+        return platform
     except Exception as e:
         # TPU never came up: emit a labeled reduced-size CPU number so
         # the line still parses, and carry the init error for the record.
@@ -296,10 +301,19 @@ def bench_training(args) -> int:
                 raise RuntimeError(f"got {dev.platform}, wanted cpu")
             kind = getattr(dev, "device_kind", "cpu")
             result["device"] = f"cpu-fallback ({kind})"
-            _reduce_for_cpu(args)
+            if reduce_on_cpu:
+                _reduce_for_cpu(args)
+            return "cpu"
         except Exception as e2:
             result["error"] += f"; cpu fallback failed: {e2}"[:200]
-            return _emit(result)
+            return None
+
+
+def bench_training(args) -> int:
+    result = {"metric": f"{args.config}_train_images_per_sec_per_chip",
+              "value": None, "unit": "images/sec", "vs_baseline": None}
+    if _bring_up(args, result) is None:
+        return _emit(result)
     try:
         from znicz_tpu.ops import flops as flops_mod
 
@@ -339,7 +353,8 @@ def bench_training(args) -> int:
             achieved = fused_ips * fl["train_step"] / 1e12
             result["tflops_per_sec"] = round(achieved, 2)
             result["flops_per_image"] = fl["train_step"]
-            peak = flops_mod.peak_tflops(kind, spec.compute_dtype)
+            peak = flops_mod.peak_tflops(result.get("device", ""),
+                                         spec.compute_dtype)
             if peak:
                 result["mfu"] = round(achieved / peak, 4)
                 result["peak_tflops"] = peak
@@ -466,6 +481,96 @@ def _kernel_cases():
     return cases
 
 
+def bench_ablate(args) -> int:
+    """Layer-kind ablation of the fused step (--ablate): times the
+    config's full net against variants with whole layer kinds removed,
+    plus the bf16-storage variant — the reproducible source of the
+    'where the time goes' table in docs/performance.md."""
+    import dataclasses
+
+    result = {"metric": f"{args.config}_ablation", "value": None,
+              "unit": "ms_per_step", "vs_baseline": None}
+    if _bring_up(args, result) is None:
+        return _emit(result)
+    try:
+        from znicz_tpu.parallel import fused, FusedTrainer
+
+        wf = _build(args.config, args.minibatch, args.n_train)
+        base_spec, params, vels = fused.extract_model(wf)
+        ld = wf.loader
+        data = ld.original_data.devmem
+        target = (ld.original_targets.devmem
+                  if getattr(wf, "loss_function", "softmax") == "mse"
+                  else ld.original_labels.devmem)
+        n = ld.class_lengths[2]
+        idx = np.arange(ld.total_samples - n, ld.total_samples)
+        batch = ld.max_minibatch_size
+        import jax
+
+        def time_spec(spec, keep=None):
+            if keep is not None:
+                keep_idx = [i for i, la in enumerate(spec.layers)
+                            if keep(la)]
+                remap = {old: new for new, old in enumerate(keep_idx)}
+                kept_layers = []
+                for old in keep_idx:
+                    la = spec.layers[old]
+                    cfg = la.cfg
+                    if "tie" in cfg:
+                        # deconv/depool cross-references are layer
+                        # INDICES — remap them past the removed layers
+                        if cfg["tie"] not in remap:
+                            raise RuntimeError(
+                                f"variant removes layer {cfg['tie']} "
+                                f"that layer {old} ties to")
+                        cfg["tie"] = remap[cfg["tie"]]
+                        la = dataclasses.replace(
+                            la, config=tuple(sorted(cfg.items())))
+                    kept_layers.append(la)
+                spec = dataclasses.replace(spec,
+                                           layers=tuple(kept_layers))
+                ps = [params[i] for i in keep_idx]
+                vs = [vels[i] for i in keep_idx]
+            else:
+                ps, vs = params, vels
+            cp = jax.tree_util.tree_map(np.array, (ps, vs))
+            tr = FusedTrainer(spec=spec, params=cp[0], vels=cp[1])
+            for _ in range(getattr(args, "warm", 2)):
+                tr.train_epoch(data, target, idx, batch, sync=True)
+            t0 = time.perf_counter()
+            last = None
+            for _ in range(args.epochs):
+                last = tr.train_epoch(data, target, idx, batch,
+                                      sync=False)
+            np.asarray(last["loss"])
+            dt = time.perf_counter() - t0
+            return dt / max(1, args.epochs * (n // batch)) * 1e3
+
+        # only shape-preserving kinds can be ablated (pooling changes
+        # every downstream activation shape, so it has no variant)
+        variants = [
+            ("full", None, base_spec),
+            ("no_lrn", lambda la: la.kind != "lrn", base_spec),
+            ("no_dropout", lambda la: la.kind != "dropout", base_spec),
+            ("storage_bf16", None,
+             dataclasses.replace(base_spec, storage_dtype="bfloat16")),
+        ]
+        rows = {}
+        for name, keep, spec in variants:
+            try:
+                rows[name] = round(time_spec(spec, keep), 2)
+            except Exception as e:   # a variant may be unbuildable
+                rows[name] = f"error: {e}"[:120]
+            print(f"  {name:14s} {rows[name]} ms/step", file=sys.stderr)
+        result["value"] = rows.get("full")
+        result["rows"] = rows
+    except Exception as e:
+        result.setdefault("error", "")
+        result["error"] = (result["error"]
+                           + f" ablate failed: {e!r}").strip()[:600]
+    return _emit(result)
+
+
 def _time_thunk(thunk, iters=20):
     from znicz_tpu.ops import tuning
     if tuning.interpret_mode():
@@ -485,21 +590,9 @@ def bench_kernels(args) -> int:
 
     result = {"metric": "pallas_kernel_validation", "value": None,
               "unit": "kernels_passed", "vs_baseline": None}
-    try:
-        platform, kind = _await_backend(args.backend_wait)
-        result["device"] = kind
-    except Exception as e:
-        result["error"] = f"tpu backend init failed: {e}"[:400]
-        try:
-            _force_cpu()
-            dev = jax.devices()[0]
-            if dev.platform != "cpu":
-                raise RuntimeError(f"got {dev.platform}, wanted cpu")
-            platform = "cpu"
-            result["device"] = "cpu-fallback"
-        except Exception as e2:
-            result["error"] += f"; cpu fallback failed: {e2}"[:200]
-            return _emit(result)
+    platform = _bring_up(args, result, reduce_on_cpu=False)
+    if platform is None:
+        return _emit(result)
     from znicz_tpu.ops import tuning
     if not tuning.use_pallas():
         result["error"] = (f"platform {platform!r}: Pallas disabled and "
@@ -561,12 +654,17 @@ def main(argv=None) -> int:
                         " (bfloat16 halves activation HBM traffic;"
                         " params/grads/loss stay f32)")
     p.add_argument("--kernels", action="store_true")
+    p.add_argument("--ablate", action="store_true",
+                   help="time the fused step with layer kinds removed"
+                        " (the 'where the time goes' table)")
     p.add_argument("--stream", action="store_true",
                    help="also measure the disk-backed streaming path")
     args = p.parse_args(argv)
     try:
         if args.kernels:
             return bench_kernels(args)
+        if args.ablate:
+            return bench_ablate(args)
         return bench_training(args)
     except SystemExit:
         raise
