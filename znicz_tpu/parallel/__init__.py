@@ -10,8 +10,11 @@ for the sharded batch dimension, riding ICI.  Multi-host runs bootstrap
 via ``jax.distributed`` (DCN coordination) instead of a Twisted server.
 """
 
+from .checkpoint import (TrainerCheckpointer, restore_trainer,
+                         save_trainer)
 from .fused import (FusedTrainer, ModelSpec, extract_model)
 from .mesh import make_mesh, shard_batch, shard_params
 
 __all__ = ["FusedTrainer", "ModelSpec", "extract_model", "make_mesh",
-           "shard_batch", "shard_params"]
+           "shard_batch", "shard_params", "TrainerCheckpointer",
+           "save_trainer", "restore_trainer"]
