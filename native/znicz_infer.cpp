@@ -33,6 +33,9 @@ namespace {
 //             (weights in the framework's (KH, KW, C_out, C_in) layout)
 //   depool:   p0=kh, p1=kw, p2=tie (EXPORT-stream index of the paired
 //             max-pool), p4=sh, p5=sw, p6=ph, p7=pw
+//   kohonen:  p0=n_neurons, p1=n_features; weights (n_neurons ×
+//             n_features); output = NEGATED squared distances (B, N)
+//             so the winner is argmax, like every other head
 //   activation/dropout/softmax: none
 
 enum Kind : uint32_t {
@@ -46,6 +49,7 @@ enum Kind : uint32_t {
   kSoftmax = 7,
   kDeconv = 8,      // decoder path (autoencoders)
   kDepool = 9,      // unpooling via the tied max-pool's winner offsets
+  kKohonen = 10,    // trained-SOM serving (winner-take-all head)
 };
 
 enum Act : uint32_t {
@@ -255,6 +259,27 @@ void depool_forward(const Layer& L, const std::vector<float>& in,
               in[o];
         }
   s = pool_in;
+}
+
+void kohonen_forward(const Layer& L, const std::vector<float>& in,
+                     Shape& s, std::vector<float>& out) {
+  // SOM serving: out[b, i] = -||x_b - w_i||² — winner is argmax, the
+  // same head convention as the classifier paths.
+  const int64_t n_neurons = L.p[0], feats = L.p[1], batch = s.n;
+  out.assign(batch * n_neurons, 0.0f);
+  for (int64_t b = 0; b < batch; ++b) {
+    const float* x = in.data() + b * feats;
+    for (int64_t i = 0; i < n_neurons; ++i) {
+      const float* wi = L.w.data() + i * feats;
+      float acc = 0.0f;
+      for (int64_t j = 0; j < feats; ++j) {
+        const float d = x[j] - wi[j];
+        acc += d * d;
+      }
+      out[b * n_neurons + i] = -acc;
+    }
+  }
+  s = Shape{batch, 1, 1, n_neurons};
 }
 
 void lrn_forward(const Layer& L, const std::vector<float>& in, Shape& s,
@@ -479,6 +504,18 @@ int64_t zn_infer(void* handle, const float* input, int64_t batch,
         lrn_forward(L, cur, s, next);
         cur.swap(next);
         break;
+      case kKohonen: {
+        const int64_t n_neurons = L.p[0], feats = L.p[1];
+        const Shape flat{s.n, 1, 1, s.h * s.w * s.c};
+        if (n_neurons <= 0 || feats != flat.c ||
+            static_cast<int64_t>(L.w.size()) !=
+                checked_prod({n_neurons, feats}))
+          return -1;
+        s = flat;
+        kohonen_forward(L, cur, s, next);
+        cur.swap(next);
+        break;
+      }
       case kActivation:
         act_inplace(L.act, cur);
         break;

@@ -172,3 +172,32 @@ class TestNativeEngine:
         assert ok.shape == (2, 3)
         with pytest.raises(RuntimeError):        # 7 features != fc fin=4
             model.infer(np.zeros((2, 7), np.float32), 3)
+
+    def test_som_winner_serving(self, engine, tmp_path):
+        """Trained-SOM export: the C++ kohonen head's argmax winners
+        must equal the framework's winner-take-all forward."""
+        from znicz_tpu.models import kohonen as som
+        from znicz_tpu.ops import kohonen as som_ops
+
+        saved = root.kohonen.to_dict()
+        root.kohonen.update({"shape": [5, 4], "minibatch_size": 25})
+        root.kohonen.synthetic.update({"n_train": 100})
+        try:
+            prng.seed_all(11)
+            wf = som.KohonenWorkflow()
+            wf.initialize(device=Device.create("numpy"))
+            wf.run()                       # a few epochs of SOM training
+        finally:
+            root.kohonen.update(saved)
+        w = np.asarray(wf.forward.weights.mem, np.float32)
+        x = np.asarray(
+            wf.loader.original_data.mem[:32], np.float32).reshape(32, -1)
+        want, _ = som_ops.np_forward(x, w)
+        path = export_workflow(wf, str(tmp_path / "som.znn"))
+        model = engine.load(path)
+        scores = model.infer(x, out_features=w.shape[0])
+        got = np.argmax(scores, axis=1)
+        np.testing.assert_array_equal(got, np.asarray(want))
+        # scores are NEGATED squared distances exactly
+        d = ((x[:, None, :] - w[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(scores, -d, rtol=1e-4, atol=1e-4)

@@ -23,8 +23,15 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 KIND = {"fc": 0, "conv": 1, "max_pool": 2, "avg_pool": 3, "lrn": 4,
         "activation": 5, "dropout": 6, "softmax": 7, "deconv": 8,
-        "depool": 9}
+        "depool": 9, "kohonen": 10}
 ACT = {"linear": 0, "tanh": 1, "relu": 2, "strict_relu": 3, "sigmoid": 4}
+
+
+def _write_header(fh, n_layers: int) -> None:
+    """The one place the .znn container header is written — every
+    export branch goes through it (and _pack_layer for rows)."""
+    fh.write(b"ZNN1")
+    fh.write(struct.pack("<I", n_layers))
 
 
 def _pack_layer(fh, kind: int, act: int, p, w=None, b=None) -> None:
@@ -44,10 +51,23 @@ def export_workflow(workflow, path: str) -> str:
 
     Covers the inference-relevant unit zoo — fc/conv/pool/LRN/activation/
     dropout/softmax plus the decoder path (Deconv/Depooling, so trained
-    autoencoders run natively); non-gradient training paths (Kohonen/RBM
-    trainers) are training-side constructs the reference engines did not
-    serve either."""
+    autoencoders run natively) and trained-SOM serving (a
+    KohonenForward head exports as negated squared distances; the RBM
+    *trainers* remain training-side constructs with no inference
+    parity to serve)."""
     from .nn.all2all import All2All, All2AllSoftmax
+    from .nn.kohonen import KohonenForward
+
+    som = getattr(workflow, "forward", None)
+    if not hasattr(workflow, "forwards") and isinstance(som,
+                                                        KohonenForward):
+        # SOM workflows have a single winner-take-all forward, not a
+        # layer chain
+        with open(path, "wb") as fh:
+            _write_header(fh, 1)
+            w = np.asarray(som.weights.mem, np.float32)
+            _pack_layer(fh, KIND["kohonen"], 0, list(w.shape), w)
+        return path
     from .nn.conv import Conv
     from .nn.deconv import Deconv
     from .nn.depooling import Depooling
@@ -57,8 +77,7 @@ def export_workflow(workflow, path: str) -> str:
     from .nn import pooling as pool_units
 
     with open(path, "wb") as fh:
-        fh.write(b"ZNN1")
-        fh.write(struct.pack("<I", _count_layers(workflow)))
+        _write_header(fh, _count_layers(workflow))
         export_idx = {}   # forward unit -> its EXPORT-stream index
         n_out = 0
         for fwd in workflow.forwards:
