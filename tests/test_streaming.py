@@ -500,3 +500,18 @@ class TestAugmentation:
                           augment=RandomCropFlip((8, 8)))
         with pytest.raises(ValueError, match="spatial labels"):
             ld.initialize(NumpyDevice())
+
+    def test_unit_and_prefetcher_paths_agree(self, tmp_path):
+        """The unit-graph serve (fill_minibatch @ epoch_number) and the
+        fused streaming serve (BatchPrefetcher @ epoch) must produce
+        identical augmented pixels for the same rows/epoch — the same
+        cross-path RNG contract dropout has."""
+        from znicz_tpu.loader import RandomCropFlip
+        aug = RandomCropFlip((8, 8), seed=7)
+        ld, _ = self._loader(tmp_path, aug)
+        rows = np.asarray([4, 9, 14, 19])
+        ld.epoch_number = 3
+        ld.fill_minibatch(rows, TRAIN)
+        unit_served = np.array(ld.minibatch_data.mem)
+        (x, _t), = list(BatchPrefetcher(ld, [rows], epoch=3))
+        np.testing.assert_array_equal(unit_served, np.asarray(x))
