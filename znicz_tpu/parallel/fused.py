@@ -109,6 +109,17 @@ def extract_model(workflow) -> tuple[ModelSpec, list, list]:
 
     layers, params, vels = [], [], []
     for fwd, gdu in zip(workflow.forwards, workflow.gds):
+        if getattr(gdu, "accumulate_gradient", False) \
+                or not getattr(gdu, "apply_gradient", True):
+            # manual gradient-accumulation schedules configured on the
+            # GD units have no per-unit expression in the fused step —
+            # silently training with per-step updates would diverge
+            # from the unit graph.  The fused-path equivalent is
+            # FusedTrainer(accum_steps=k).
+            raise NotImplementedError(
+                f"{gdu.name}: accumulate_gradient/apply_gradient "
+                "schedules need the unit-graph path (wf.run()) or "
+                "FusedTrainer(accum_steps=k)")
         hypers = (getattr(gdu, "learning_rate", 0.0),
                   getattr(gdu, "weights_decay", 0.0),
                   getattr(gdu, "l1_vs_l2", 0.0),
@@ -375,10 +386,9 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
         x_in, aux = caches[i]
         y_i = caches[i + 1][0] if i < n - 1 else out
         cfg = layer.cfg
-        if layer.kind in PARAM_KINDS and (w is not None
-                                          or layer.kind == "deconv"):
-            if layer.kind == "deconv" and w is None:
-                w = params[cfg["tie"]][0]        # tied encoder weights
+        slot = _grad_slot(layer, params, i)
+        if slot is not None:
+            w = slot[0]                # tied deconv: encoder weights
             # fold through the fused activation (last layer already is
             # pre-activation — see docstring)
             err_pre = err if i == n - 1 \
@@ -487,8 +497,10 @@ def apply_updates(spec: ModelSpec, params, vels, grads, lr_scale=1.0):
             [tuple(v) for v in new_v])
 
 
-def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None,
-                    epoch=0, ctr=0, lr_scale=1.0):
+def grad_minibatch(spec: ModelSpec, params, x, target, mask=None,
+                   epoch=0, ctr=0):
+    """(grads, metrics) of one minibatch — train_minibatch without the
+    update, the building block gradient accumulation composes."""
     if mask is None:
         mask = jnp.ones((x.shape[0],), jnp.float32)
     out, caches = forward(spec, params, x, want_caches=True, train=True,
@@ -501,8 +513,44 @@ def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None,
         err = spec.act(last).bwd(err, out, None, jnp)
     grads = backward(spec, params, caches, out, err, epoch=epoch,
                      ctr=ctr)
+    return grads, {"loss": loss, "n_err": n_err}
+
+
+def _grad_slot(layer: LayerSpec, params, i: int):
+    """(w, b) a layer's gradient entry is shaped like, or None for
+    gradient-less layers — THE single definition of backward()'s
+    gradient structure (tied deconv: grads live at the deconv's own
+    index, shaped like the shared encoder weights)."""
+    w, b = params[i]
+    if layer.kind in PARAM_KINDS and (w is not None
+                                      or layer.kind == "deconv"):
+        if layer.kind == "deconv" and w is None:
+            w = params[layer.cfg["tie"]][0]
+        return w, b
+    return None
+
+
+def grad_zeros(spec: ModelSpec, params):
+    """Zero accumulator matching backward()'s gradient structure
+    (f32 — the accumulation dtype regardless of storage/compute)."""
+    zs = []
+    for i, layer in enumerate(spec.layers):
+        slot = _grad_slot(layer, params, i)
+        if slot is None:
+            zs.append(None)
+        else:
+            w, b = slot
+            zs.append((jnp.zeros(w.shape, jnp.float32),
+                       jnp.zeros(b.shape, jnp.float32)
+                       if b is not None else None))
+    return zs
+
+
+def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None,
+                    epoch=0, ctr=0, lr_scale=1.0):
+    grads, metrics = grad_minibatch(spec, params, x, target, mask,
+                                    epoch=epoch, ctr=ctr)
     params, vels = apply_updates(spec, params, vels, grads, lr_scale)
-    metrics = {"loss": loss, "n_err": n_err}
     return params, vels, metrics
 
 
@@ -523,12 +571,28 @@ class FusedTrainer:
     single-device jit."""
 
     def __init__(self, workflow=None, spec: ModelSpec | None = None,
-                 params=None, vels=None, mesh=None):
+                 params=None, vels=None, mesh=None, accum_steps: int = 1):
         if workflow is not None:
             spec, params, vels = extract_model(workflow)
         self.spec = spec
         self.mesh = mesh
         self.workflow = workflow
+        #: micro-batch gradient accumulation: gradients of ``k``
+        #: consecutive minibatches SUM before one update — the fused
+        #: equivalent of the unit graph's accumulate_gradient +
+        #: deferred apply_gradient (nn_units.py), for effective batches
+        #: beyond what HBM fits in one forward.  The summed gradient is
+        #: applied unscaled, exactly like the unit semantics (fold any
+        #: 1/k into the learning rate if means are wanted).  A trailing
+        #: partial group flushes at the end of EACH train_epoch call —
+        #: callers chunking one epoch across calls (run_fused's
+        #: deferred-tail pattern) would get different grouping than a
+        #: whole-epoch call, so accum>1 expects whole epochs per call.
+        if not isinstance(accum_steps, int) or isinstance(
+                accum_steps, bool) or accum_steps < 1:
+            raise ValueError(f"accum_steps must be a positive int, got "
+                             f"{accum_steps!r}")
+        self.accum_steps = accum_steps
         if mesh is not None:
             self._param_shardings = []
             pidx = 0   # alternate TP axis over *parameterized* layers only
@@ -567,23 +631,62 @@ class FusedTrainer:
     # -- epoch-granular compiled drivers ----------------------------------
     def _build(self):
         spec = self.spec
+        accum = self.accum_steps
 
         def train_epoch(params, vels, data, target, idx, mask, ctrs,
                         epoch, lr_scale):
-            def body(carry, step):
-                params, vels = carry
-                step_idx, step_mask, step_ctr = step
+            def gather(step_idx):
                 x = jnp.take(data, step_idx, axis=0)
-                t = jnp.take(target, step_idx, axis=0)
                 if self._batch_sharding is not None:
                     x = jax.lax.with_sharding_constraint(
                         x, self._batch_sharding)
-                params, vels, m = train_minibatch(
-                    spec, params, vels, x, t, step_mask, epoch=epoch,
-                    ctr=step_ctr, lr_scale=lr_scale)
-                return (params, vels), m
-            (params, vels), ms = jax.lax.scan(body, (params, vels),
-                                              (idx, mask, ctrs))
+                return x, jnp.take(target, step_idx, axis=0)
+
+            if accum == 1:
+                def body(carry, step):
+                    params, vels = carry
+                    step_idx, step_mask, step_ctr = step
+                    x, t = gather(step_idx)
+                    params, vels, m = train_minibatch(
+                        spec, params, vels, x, t, step_mask,
+                        epoch=epoch, ctr=step_ctr, lr_scale=lr_scale)
+                    return (params, vels), m
+                (params, vels), ms = jax.lax.scan(body, (params, vels),
+                                                  (idx, mask, ctrs))
+                return params, vels, ms
+
+            # micro-batch accumulation: grads of `accum` consecutive
+            # steps sum in an f32 accumulator; every accum-th step
+            # applies ONE update with the sum (unit-graph
+            # accumulate_gradient semantics).  A trailing partial group
+            # at epoch end applies too — deferring it across epochs
+            # would silently mix epochs' RNG coordinates.
+            zeros = grad_zeros(spec, params)
+            n_steps = idx.shape[0]
+
+            def body(carry, step):
+                params, vels, acc = carry
+                step_i, step_idx, step_mask, step_ctr = step
+                x, t = gather(step_idx)
+                grads, m = grad_minibatch(spec, params, x, t, step_mask,
+                                          epoch=epoch, ctr=step_ctr)
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                last_of_group = ((step_i + 1) % accum == 0) | (
+                    step_i + 1 == n_steps)
+
+                def apply(ops):
+                    p, v, a = ops
+                    p, v = apply_updates(spec, p, v, a, lr_scale)
+                    return p, v, jax.tree_util.tree_map(
+                        jnp.zeros_like, a)
+
+                params, vels, acc = jax.lax.cond(
+                    last_of_group, apply, lambda ops: ops,
+                    (params, vels, acc))
+                return (params, vels, acc), m
+            (params, vels, _), ms = jax.lax.scan(
+                body, (params, vels, zeros),
+                (jnp.arange(n_steps), idx, mask, ctrs))
             return params, vels, ms
 
         def eval_epoch(params, data, target, idx, mask):
