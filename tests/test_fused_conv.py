@@ -375,3 +375,18 @@ class TestFusedConvMesh:
         assert np.isfinite(m["loss"]).all()
         # conv weights actually sharded over the model axis
         assert len(tr.params[0][0].sharding.device_set) == 8
+
+    def test_dtype_knobs_from_config_tree(self):
+        """root.common.{compute,storage}_dtype reach the fused spec via
+        train() — the two-file-CLI/--set route to mixed precision."""
+        wf = _workflow()
+        saved = {k: root.common.get(k)
+                 for k in ("storage_dtype", "compute_dtype")}
+        root.common.update({"storage_dtype": "bfloat16",
+                            "compute_dtype": "bfloat16"})
+        try:
+            tr = wf.train(fused=True, max_epochs=1)
+        finally:
+            root.common.update(saved)
+        assert tr.spec.storage_dtype == "bfloat16"
+        assert tr.spec.compute_dtype == "bfloat16"

@@ -214,6 +214,48 @@ class TestStreamTrainerEquivalence:
                 np.testing.assert_array_equal(np.asarray(rb),
                                               np.asarray(sb))
 
+    def test_accum_bitwise_vs_resident_fused(self, tmp_path):
+        """accum_steps>1: the streaming host-loop grouping must
+        reproduce the resident in-scan grouping bit-for-bit (including
+        the trailing partial group — 3 steps, accum 2)."""
+        from znicz_tpu.config import root
+        from znicz_tpu.models import mnist
+        from znicz_tpu.parallel import FusedTrainer, fused
+        from znicz_tpu.parallel.stream import StreamTrainer
+
+        saved = root.mnist.to_dict()
+        root.mnist.update({"minibatch_size": 20})
+        root.mnist.synthetic.update({"n_train": 60, "n_valid": 10,
+                                     "n_test": 0})
+        try:
+            prng.seed_all(42)
+            wf = mnist.MnistWorkflow()
+            wf.initialize(device=Device.create("xla"))
+        finally:
+            root.mnist.update(saved)
+        spec, params, vels = fused.extract_model(wf)
+        ld = wf.loader
+        idx = np.arange(10, 70)
+
+        res = FusedTrainer(spec=spec, params=params, vels=vels,
+                           accum_steps=2)
+        rm = res.train_epoch(ld.original_data.devmem,
+                             ld.original_labels.devmem, idx, 20,
+                             epoch=0)
+        paths = write_records(
+            str(tmp_path / "a.znr"), np.asarray(ld.original_data.mem),
+            np.asarray(ld.original_labels.mem))
+        sld = RecordLoader(Workflow(name="w2"), train_paths=paths,
+                           minibatch_size=20)
+        sld.initialize(NumpyDevice())
+        st = StreamTrainer(spec=spec, params=params, vels=vels,
+                           loader=sld, accum_steps=2)
+        sm = st.train_epoch(None, None, idx, 20, epoch=0)
+        np.testing.assert_array_equal(rm["loss"], sm["loss"])
+        for (rw, _), (sw, _) in zip(res.params, st.params):
+            np.testing.assert_array_equal(np.asarray(rw),
+                                          np.asarray(sw))
+
     def test_run_fused_end_to_end(self, tmp_path):
         """StandardWorkflow.run_fused over a RecordLoader: trains, logs
         metrics, writes weights back."""

@@ -157,7 +157,13 @@ class Config:
 root = Config("root")
 root.common.update({
     "precision_type": "float32",
-    "compute_dtype": "bfloat16",   # TPU MXU-native accumulation input dtype
+    # mixed-precision knobs consumed by StandardWorkflow.train():
+    # compute_dtype = MXU operand dtype, storage_dtype = inter-layer
+    # activation dtype.  None → the fused path's float32 defaults,
+    # keeping fused vs unit-graph numerics identical; set "bfloat16"
+    # (config file or --set) to opt in.
+    "compute_dtype": None,
+    "storage_dtype": None,
     "engine": {"backend": "auto"},  # auto | numpy | xla
     "seed": 1234,
     "snapshot_dir": "snapshots",

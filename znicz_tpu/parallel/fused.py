@@ -118,8 +118,10 @@ def extract_model(workflow) -> tuple[ModelSpec, list, list]:
             # FusedTrainer(accum_steps=k).
             raise NotImplementedError(
                 f"{gdu.name}: accumulate_gradient/apply_gradient "
-                "schedules need the unit-graph path (wf.run()) or "
-                "FusedTrainer(accum_steps=k)")
+                "schedules need the unit-graph path (wf.run()); for "
+                "fused accumulation clear those unit flags and use "
+                "FusedTrainer(spec, params, vels, accum_steps=k) — "
+                "extract_model cannot translate a per-unit schedule")
         hypers = (getattr(gdu, "learning_rate", 0.0),
                   getattr(gdu, "weights_decay", 0.0),
                   getattr(gdu, "l1_vs_l2", 0.0),

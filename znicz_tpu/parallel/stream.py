@@ -36,9 +36,10 @@ class StreamTrainer(FusedTrainer):
 
     def __init__(self, workflow=None, spec=None, params=None, vels=None,
                  mesh=None, loader: StreamingLoader | None = None,
-                 prefetch_depth: int = 2, mse_target: str = "input"):
+                 prefetch_depth: int = 2, mse_target: str = "input",
+                 accum_steps: int = 1):
         super().__init__(workflow, spec=spec, params=params, vels=vels,
-                         mesh=mesh)
+                         mesh=mesh, accum_steps=accum_steps)
         self.loader = loader if loader is not None \
             else getattr(workflow, "loader", None)
         if not isinstance(self.loader, StreamingLoader):
@@ -80,6 +81,33 @@ class StreamTrainer(FusedTrainer):
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1))
         self._eval_fn = jax.jit(estep)
+        if self.accum_steps > 1:
+            # gradient accumulation over the streamed step loop: grads
+            # per micro-batch, one update per group — the host-loop
+            # mirror of FusedTrainer's in-scan grouping (same flush-at-
+            # call-end contract)
+            from .fused import apply_updates, grad_minibatch
+
+            def gstep(params, x, t, mask, epoch, ctr):
+                if self._batch_sharding is not None:
+                    x = jax.lax.with_sharding_constraint(
+                        x, self._batch_sharding)
+                return grad_minibatch(spec, params, x,
+                                      x if x_is_target else t, mask,
+                                      epoch=epoch, ctr=ctr)
+
+            def gapply(params, vels, acc, lr_scale):
+                return apply_updates(spec, params, vels, acc, lr_scale)
+
+            def gadd(acc, grads):
+                return jax.tree_util.tree_map(jnp.add, acc, grads)
+
+            self._grad_fn = jax.jit(gstep)
+            # donate only the velocity/accumulator buffers: params are
+            # read by every layer's decay term before their new value
+            # exists, so XLA can't reuse them and warns
+            self._apply_fn = jax.jit(gapply, donate_argnums=(1, 2))
+            self._acc_add_fn = jax.jit(gadd, donate_argnums=(0,))
 
     def _device_put(self, a):
         if self._batch_sharding is not None:
@@ -103,11 +131,27 @@ class StreamTrainer(FusedTrainer):
         losses, n_errs = [], []
         ep = jnp.uint32(epoch)
         ls = jnp.float32(lr_scale)
+        accum = self.accum_steps
+        acc = None
+        n_steps = idx.shape[0]
         for step_i, (x, t) in enumerate(pf):
-            self.params, self.vels, m = self._step_fn(
-                self.params, self.vels, x, t,
-                jnp.asarray(mask[step_i]), ep,
-                jnp.uint32(ctrs[step_i]), ls)
+            if accum == 1:
+                self.params, self.vels, m = self._step_fn(
+                    self.params, self.vels, x, t,
+                    jnp.asarray(mask[step_i]), ep,
+                    jnp.uint32(ctrs[step_i]), ls)
+            else:
+                grads, m = self._grad_fn(self.params, x, t,
+                                         jnp.asarray(mask[step_i]), ep,
+                                         jnp.uint32(ctrs[step_i]))
+                # a group's first grads ARE the accumulator (right
+                # structure, dtype and sharding — no zeros round-trip)
+                acc = grads if acc is None \
+                    else self._acc_add_fn(acc, grads)
+                if (step_i + 1) % accum == 0 or step_i + 1 == n_steps:
+                    self.params, self.vels = self._apply_fn(
+                        self.params, self.vels, acc, ls)
+                    acc = None
             losses.append(m["loss"])
             n_errs.append(m["n_err"])
         ms = {"loss": jnp.stack(losses), "n_err": jnp.stack(n_errs)}

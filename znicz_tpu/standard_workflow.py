@@ -189,7 +189,17 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         """One entry point over both execution paths (the samples' and
         launcher's ``--fused`` plumbing): the compiled fused step when
         requested AND the device supports it, else the unit-graph tick
-        loop — with a log line instead of a silent fallback."""
+        loop — with a log line instead of a silent fallback.
+
+        ``compute_dtype``/``storage_dtype`` default from the config
+        tree (``root.common.compute_dtype``/``storage_dtype``) so every
+        sample and the two-file CLI reach the mixed-precision knobs via
+        config files or ``--set`` without per-sample plumbing."""
+        from .config import root
+        if compute_dtype is None:
+            compute_dtype = root.common.get("compute_dtype")
+        if storage_dtype is None:
+            storage_dtype = root.common.get("storage_dtype")
         if fused:
             if self.device.is_xla:
                 return self.run_fused(mesh=mesh, max_epochs=max_epochs,
