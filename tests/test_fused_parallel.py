@@ -196,3 +196,66 @@ class TestGradientAccumulation:
         wf.gds[0].accumulate_gradient = True
         with pytest.raises(NotImplementedError, match="accum_steps"):
             extract_model(wf)
+
+    def test_accum_with_stochastic_layers(self):
+        """Accumulation through dropout+LRN: the per-micro-batch RNG
+        counters (epoch, consumed-samples ctr) must match the manual
+        reference exactly — masks are keyed per micro-batch, not per
+        group."""
+        from znicz_tpu.models import cifar
+
+        saved = root.cifar.synthetic.to_dict()
+        root.cifar.synthetic.update({"n_train": 120, "n_valid": 40,
+                                     "n_test": 40, "noise": 0.3,
+                                     "size": 12})
+        root.cifar.minibatch_size = 30
+        layers = [
+            {"type": "conv_tanh", "->": {"n_kernels": 6, "kx": 3,
+                                         "padding": 1},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "norm", "->": {"n": 5}},
+            {"type": "dropout", "->": {"dropout_ratio": 0.3}},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ]
+        try:
+            prng.seed_all(7)
+            wf = cifar.CifarWorkflow(layers=layers)
+            wf.initialize(device=Device.create("xla"))
+        finally:
+            root.cifar.synthetic.update(saved)
+            root.cifar.minibatch_size = 100
+        spec, params, vels = fused.extract_model(wf)
+        ld = wf.loader
+        idx = np.arange(80, 200)               # the 120 train rows
+        batch = 30
+        tr = FusedTrainer(spec=spec,
+                          params=jax.tree_util.tree_map(np.array, params),
+                          vels=jax.tree_util.tree_map(np.array, vels),
+                          accum_steps=2)
+        tr.train_epoch(ld.original_data.devmem,
+                       ld.original_labels.devmem, idx, batch,
+                       sync=True, epoch=5)
+        rows, mask, ctrs = tr._idx_matrix(idx, batch)
+        # manual reference with explicit per-step RNG coordinates
+        p = jax.device_put(params)
+        v = jax.device_put(vels)
+        acc = fused.grad_zeros(spec, p)
+        for i in range(len(rows)):
+            x = jnp.take(ld.original_data.devmem,
+                         jnp.asarray(rows[i]), axis=0)
+            t = jnp.take(ld.original_labels.devmem,
+                         jnp.asarray(rows[i]), axis=0)
+            g, _ = fused.grad_minibatch(spec, p, x, t,
+                                        jnp.asarray(mask[i]),
+                                        epoch=jnp.uint32(5),
+                                        ctr=jnp.uint32(ctrs[i]))
+            acc = jax.tree_util.tree_map(jnp.add, acc, g)
+            if (i + 1) % 2 == 0 or i + 1 == len(rows):
+                p, v = fused.apply_updates(spec, p, v, acc)
+                acc = fused.grad_zeros(spec, p)
+        for (w1, _), (w2, _) in zip(tr.params, p):
+            if w1 is None:
+                continue
+            np.testing.assert_allclose(np.asarray(w1), np.asarray(w2),
+                                       rtol=1e-6, atol=1e-7)
