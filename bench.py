@@ -201,11 +201,23 @@ def measure_stream(wf, epochs: int, warm: int = 2,
         spec = dataclasses.replace(spec, storage_dtype=storage)
     ld = wf.loader
     n = ld.class_lengths[2]
+    data = np.asarray(ld.original_data.mem)
+    # MSE configs: reconstruct-the-input (AE contract — label block
+    # unused, its IO skipped) vs distinct targets (denoising-style —
+    # targets ride the shards' label block); mirror of the
+    # run_fused auto-detection so resident and stream regress on the
+    # SAME target tensor
+    mse_target = "input"
+    label_block = np.asarray(ld.original_labels.mem)
+    if getattr(wf, "loss_function", "softmax") == "mse":
+        targets = np.asarray(ld.original_targets.mem)
+        if not np.array_equal(targets, data):
+            mse_target = "labels"
+            label_block = targets
     tmp = tempfile.mkdtemp(prefix="znicz_bench_znr_")
     try:
         paths = write_records(
-            tmp + "/train.znr", np.asarray(ld.original_data.mem),
-            np.asarray(ld.original_labels.mem),
+            tmp + "/train.znr", data, label_block,
             shard_size=max(64, n // 4))
         sld = RecordLoader(Workflow(name="bench_stream"),
                            train_paths=paths,
@@ -213,7 +225,7 @@ def measure_stream(wf, epochs: int, warm: int = 2,
         from znicz_tpu.backends import NumpyDevice
         sld.initialize(NumpyDevice())
         tr = StreamTrainer(spec=spec, params=params, vels=vels,
-                           loader=sld)
+                           loader=sld, mse_target=mse_target)
         idx = np.arange(ld.total_samples - n, ld.total_samples)
         batch = ld.max_minibatch_size
         for _ in range(warm):
@@ -358,8 +370,10 @@ def bench_training(args) -> int:
             if peak:
                 result["mfu"] = round(achieved / peak, 4)
                 result["peak_tflops"] = peak
-            if args.stream and \
-                    getattr(wf, "loss_function", "softmax") != "mse":
+            # MSE heads stream too: StreamTrainer's mse_target="input"
+            # default reconstructs x (the AE contract) and skips the
+            # label block's IO entirely
+            if args.stream:
                 stream_ips = measure_stream(wf, args.epochs,
                                             getattr(args, "warm", 2),
                                             dtype=args.dtype,
@@ -490,6 +504,12 @@ def bench_ablate(args) -> int:
 
     result = {"metric": f"{args.config}_ablation", "value": None,
               "unit": "ms_per_step", "vs_baseline": None}
+    if args.config == "kohonen":
+        # config-determined: answer before waiting out backend bring-up
+        result["error"] = ("ablation needs a layer-chain config; the "
+                           "SOM has a dedicated epoch scan with no "
+                           "removable layer kinds")
+        return _emit(result)
     if _bring_up(args, result) is None:
         return _emit(result)
     try:
