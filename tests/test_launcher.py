@@ -19,6 +19,9 @@ def small_mnist():
     yield
     root.mnist.synthetic.update(saved)
     root.mnist.minibatch_size = saved_mb
+    # --set-grown subtrees (mnist.snapshotter.*) are process-global:
+    # scrub so later MnistWorkflow tests don't silently gain one
+    root.mnist.__dict__.pop("snapshotter", None)
 
 
 @pytest.fixture
@@ -147,3 +150,97 @@ class TestLauncher:
         assert rc == 0
         out = capsys.readouterr().out
         assert "epoch" in out
+
+
+class TestProductJourney:
+    def test_cli_train_resume_export_serve(self, small_mnist,
+                                           config_file, tmp_path,
+                                           monkeypatch):
+        """The full user journey in one test: two-file CLI fused
+        training with a snapshot → --snapshot resume continues at the
+        stored epoch → .znn export → the C++ engine serves predictions
+        matching the framework's own."""
+        import jax.numpy as jnp
+
+        from znicz_tpu.export import NativeEngine, export_workflow
+        from znicz_tpu.parallel import fused
+
+        monkeypatch.chdir(tmp_path)          # snapshots land here
+        try:
+            # 1. train fused via the launcher, snapshotter via --set
+            ln = Launcher("znicz_tpu.models.mnist", config=config_file,
+                          backend="xla", epochs=2, fused=True, seed=31,
+                          overrides=["mnist.snapshotter.interval=1"])
+            wf = ln.run()
+            assert len(wf.decision.epoch_metrics) == 2
+            snap = wf.snapshotter.last_path
+            assert snap and os.path.exists(snap)
+
+            # 2. resume from the snapshot and continue training
+            ln2 = Launcher("znicz_tpu.models.mnist", config=config_file,
+                           backend="xla", epochs=4, fused=True, seed=31,
+                           snapshot=snap)
+            wf2 = ln2.run()
+            ms = wf2.decision.epoch_metrics
+            assert ms[-1]["epoch"] >= 3      # continued, not restarted
+            assert ms[-1]["train_loss"] <= wf.decision.epoch_metrics[
+                -1]["train_loss"] * 1.1
+
+            # 3. export the resumed model and serve it natively
+            path = export_workflow(wf2, str(tmp_path / "m.znn"))
+            model = NativeEngine().load(path)
+            x = np.asarray(wf2.loader.original_data.mem[:16],
+                           np.float32)
+            spec, params, _ = fused.extract_model(wf2)
+            want = np.asarray(fused.predict(
+                spec, [(jnp.asarray(w) if w is not None else None,
+                        jnp.asarray(b) if b is not None else None)
+                       for w, b in params], jnp.asarray(x)))
+            got = model.infer(x, out_features=want.shape[1])
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+            assert (np.argmax(got, 1) == np.argmax(want, 1)).all()
+        finally:
+            pass  # config scrub lives in the small_mnist fixture
+
+    def test_fused_midrun_snapshot_resume_equals_continuous(
+            self, small_mnist, config_file, tmp_path, monkeypatch):
+        """Resume from a MID-RUN snapshot must reproduce the continuous
+        run exactly: the snapshot includes that epoch's deferred tail
+        update (review r2: saving without it dropped a gradient step)
+        and the PRNG stream positions (shuffle order continues instead
+        of restarting from the seed).  Final-epoch snapshots
+        deliberately exclude the tail — the reference's stop-tick
+        gate-skip — so the mid-run file is captured via a save hook."""
+        import shutil
+
+        from znicz_tpu.snapshotter import SnapshotterToFile
+
+        monkeypatch.chdir(tmp_path)
+        stash = {}
+        orig_save = SnapshotterToFile.save
+
+        def keeping_save(self_s, tag):
+            path = orig_save(self_s, tag)
+            epoch = len(self_s.workflow.decision.epoch_metrics) - 1
+            if tag == "current" and epoch == 0:
+                stash["p"] = path + ".epoch0"
+                shutil.copy(path, stash["p"])
+                shutil.copy(path + ".json", stash["p"] + ".json")
+            return path
+
+        monkeypatch.setattr(SnapshotterToFile, "save", keeping_save)
+        try:
+            ln = Launcher("znicz_tpu.models.mnist", config=config_file,
+                          backend="xla", epochs=4, fused=True, seed=77,
+                          overrides=["mnist.snapshotter.interval=1"])
+            w_cont = np.array(ln.run().forwards[0].weights.mem)
+            assert "p" in stash
+
+            ln2 = Launcher("znicz_tpu.models.mnist", config=config_file,
+                           backend="xla", epochs=4, fused=True, seed=77,
+                           snapshot=stash["p"])
+            w_res = np.array(ln2.run().forwards[0].weights.mem)
+            np.testing.assert_allclose(w_cont, w_res, rtol=1e-6,
+                                       atol=1e-7)
+        finally:
+            pass  # config scrub lives in the small_mnist fixture

@@ -25,7 +25,8 @@ from .. import prng
 from ..backends import Device
 from ..config import root
 from ..loader.fullbatch import FullBatchLoader
-from ..standard_workflow import StandardWorkflow
+from ..standard_workflow import (StandardWorkflow,
+                                 sample_snapshotter_config)
 
 
 def make_layers(n_classes: int = 1000, lr: float = 0.01,
@@ -178,7 +179,8 @@ class AlexNetWorkflow(StandardWorkflow):
             loss_function="softmax",
             decision_config=decision_config
             or root.alexnet.decision.to_dict(),
-            snapshotter_config=snapshotter_config)
+            snapshotter_config=sample_snapshotter_config(
+                root.alexnet, snapshotter_config))
 
 
 def run(device: Device | None = None, epochs: int | None = None,

@@ -20,7 +20,8 @@ import numpy as np
 from ..backends import Device
 from ..config import root
 from ..loader.fullbatch import FullBatchLoaderMSE
-from ..standard_workflow import StandardWorkflow
+from ..standard_workflow import (StandardWorkflow,
+                                 sample_snapshotter_config)
 from .mnist import MnistLoader
 
 root.mnist_ae.setdefaults({
@@ -70,7 +71,8 @@ class MnistAEWorkflow(StandardWorkflow):
             loss_function="mse",
             decision_config=decision_config
             or root.mnist_ae.decision.to_dict(),
-            snapshotter_config=snapshotter_config)
+            snapshotter_config=sample_snapshotter_config(
+                root.mnist_ae, snapshotter_config))
 
 
 def run(device: Device | None = None, epochs: int | None = None,

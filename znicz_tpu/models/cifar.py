@@ -27,7 +27,8 @@ from .. import prng
 from ..backends import Device
 from ..config import root
 from ..loader.fullbatch import FullBatchLoader
-from ..standard_workflow import StandardWorkflow
+from ..standard_workflow import (StandardWorkflow,
+                                 sample_snapshotter_config)
 
 root.cifar.setdefaults({
     "minibatch_size": 100,
@@ -130,7 +131,8 @@ class CifarWorkflow(StandardWorkflow):
             loss_function="softmax",
             decision_config=decision_config
             or root.cifar.decision.to_dict(),
-            snapshotter_config=snapshotter_config)
+            snapshotter_config=sample_snapshotter_config(
+                root.cifar, snapshotter_config))
 
 
 def run(device: Device | None = None, epochs: int | None = None,

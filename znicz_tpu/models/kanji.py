@@ -25,7 +25,8 @@ import numpy as np
 from .. import prng
 from ..backends import Device
 from ..config import root
-from ..standard_workflow import StandardWorkflow
+from ..standard_workflow import (StandardWorkflow,
+                                 sample_snapshotter_config)
 
 root.kanji.setdefaults({
     "minibatch_size": 50,
@@ -146,7 +147,8 @@ class KanjiWorkflow(StandardWorkflow):
             loader=loader,
             loss_function="softmax",
             decision_config=decision_config or cfg.decision.to_dict(),
-            snapshotter_config=snapshotter_config)
+            snapshotter_config=sample_snapshotter_config(
+                root.kanji, snapshotter_config))
 
 
 def run(device: Device | None = None, epochs: int | None = None,

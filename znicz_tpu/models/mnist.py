@@ -25,7 +25,8 @@ from .. import prng
 from ..backends import Device
 from ..config import root
 from ..loader.fullbatch import FullBatchLoader
-from ..standard_workflow import StandardWorkflow
+from ..standard_workflow import (StandardWorkflow,
+                                 sample_snapshotter_config)
 
 root.mnist.setdefaults({
     "minibatch_size": 100,
@@ -126,7 +127,8 @@ class MnistWorkflow(StandardWorkflow):
             loss_function="softmax",
             decision_config=decision_config
             or root.mnist.decision.to_dict(),
-            snapshotter_config=snapshotter_config)
+            snapshotter_config=sample_snapshotter_config(
+                root.mnist, snapshotter_config))
 
 
 def run(device: Device | None = None, epochs: int | None = None,

@@ -24,7 +24,8 @@ import numpy as np
 from .. import prng
 from ..backends import Device
 from ..config import root
-from ..standard_workflow import StandardWorkflow
+from ..standard_workflow import (StandardWorkflow,
+                                 sample_snapshotter_config)
 from .mnist import MnistLoader
 
 root.mnist_rbm.setdefaults({
@@ -126,7 +127,8 @@ class MnistRBMWorkflow(StandardWorkflow):
             loss_function="softmax",
             decision_config=decision_config
             or root.mnist_rbm.decision.to_dict(),
-            snapshotter_config=snapshotter_config)
+            snapshotter_config=sample_snapshotter_config(
+                root.mnist_rbm, snapshotter_config))
 
     def install_pretrained(self, stack) -> None:
         """Copy pretrained (W, hbias) pairs into the hidden layers'

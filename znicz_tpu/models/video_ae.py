@@ -22,7 +22,8 @@ from .. import prng
 from ..backends import Device
 from ..config import root
 from ..loader.fullbatch import FullBatchLoaderMSE
-from ..standard_workflow import StandardWorkflow
+from ..standard_workflow import (StandardWorkflow,
+                                 sample_snapshotter_config)
 
 root.video_ae.setdefaults({
     "minibatch_size": 50,
@@ -106,7 +107,8 @@ class VideoAEWorkflow(StandardWorkflow):
             loss_function="mse",
             decision_config=decision_config
             or root.video_ae.decision.to_dict(),
-            snapshotter_config=snapshotter_config)
+            snapshotter_config=sample_snapshotter_config(
+                root.video_ae, snapshotter_config))
 
 
 def run(device: Device | None = None, epochs: int | None = None,

@@ -25,7 +25,8 @@ from .. import prng
 from ..backends import Device
 from ..config import root
 from ..loader.fullbatch import FullBatchLoader
-from ..standard_workflow import StandardWorkflow
+from ..standard_workflow import (StandardWorkflow,
+                                 sample_snapshotter_config)
 
 root.wine.setdefaults({
     "minibatch_size": 30,
@@ -118,7 +119,8 @@ class WineWorkflow(StandardWorkflow):
             loss_function="softmax",
             decision_config=decision_config
             or root.wine.decision.to_dict(),
-            snapshotter_config=snapshotter_config)
+            snapshotter_config=sample_snapshotter_config(
+                root.wine, snapshotter_config))
 
 
 def run(device: Device | None = None, epochs: int | None = None,

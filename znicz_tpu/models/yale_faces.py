@@ -28,7 +28,8 @@ import numpy as np
 from .. import prng
 from ..backends import Device
 from ..config import root
-from ..standard_workflow import StandardWorkflow
+from ..standard_workflow import (StandardWorkflow,
+                                 sample_snapshotter_config)
 
 root.yale_faces.setdefaults({
     "minibatch_size": 40,
@@ -186,7 +187,8 @@ class YaleFacesWorkflow(StandardWorkflow):
             loader=loader,
             loss_function="softmax",
             decision_config=decision_config or cfg.decision.to_dict(),
-            snapshotter_config=snapshotter_config)
+            snapshotter_config=sample_snapshotter_config(
+                root.yale_faces, snapshotter_config))
 
 
 def run(device: Device | None = None, epochs: int | None = None,

@@ -91,3 +91,31 @@ def get(name: str = "default") -> RandomGenerator:
     if name not in _streams:
         _streams[name] = RandomGenerator(name, _global_seed)
     return _streams[name]
+
+
+def state() -> dict:
+    """JSON-serializable snapshot of every stream's position (numpy
+    bit-generator state, the stream's derived seed, and the host-side
+    key fold count).  Checkpointing this makes resume BIT-reproducible:
+    the loader's shuffle stream continues from where the snapshot left
+    it instead of restarting from the seed (snapshotter.py stores it
+    in the meta sidecar)."""
+    return {name: {"bg": gen.numpy.bit_generator.state,
+                   "stream_seed": gen.stream_seed,
+                   "fold": gen._fold_count}
+            for name, gen in _streams.items()}
+
+
+def set_state(st: dict) -> None:
+    """Restore stream positions captured by :func:`state` (streams not
+    yet created are instantiated first).  The JAX key re-derives from
+    the SAVED stream seed — resuming under a different global seed must
+    not half-restore a stream (numpy at the old position, counter keys
+    from the new seed)."""
+    for name, s in st.items():
+        gen = get(name)
+        gen.numpy.bit_generator.state = s["bg"]
+        if "stream_seed" in s:
+            gen.stream_seed = int(s["stream_seed"])
+            gen.key = jax.random.key(gen.stream_seed % (2 ** 63))
+        gen._fold_count = int(s["fold"])

@@ -49,6 +49,10 @@ def collect_state(workflow) -> tuple[dict[str, np.ndarray], dict]:
             seen_vectors.add(id(vec))
             arrays[f"{unit.name}/{attr}"] = np.asarray(vec.mem)
     meta = {"time": time.time()}
+    from . import prng
+    # stream positions make resume bit-reproducible (the loader's
+    # shuffle stream continues instead of restarting from the seed)
+    meta["prng_state"] = prng.state()
     loader = getattr(workflow, "loader", None)
     if loader is not None:
         meta["epoch_number"] = loader.epoch_number
@@ -58,6 +62,11 @@ def collect_state(workflow) -> tuple[dict[str, np.ndarray], dict]:
                                            np.inf))
         meta["best_mse"] = float(getattr(decision, "best_mse", np.inf))
         meta["epoch_metrics"] = decision.epoch_metrics
+    snap = getattr(workflow, "snapshotter", None)
+    if snap is not None:
+        # resume must keep the periodic cadence aligned with the
+        # continuous run (interval>1: saves land at the same epochs)
+        meta["snapshotter_epochs_seen"] = snap._epochs_seen
     return arrays, meta
 
 
@@ -71,6 +80,9 @@ def restore_state(workflow, arrays: dict, meta: dict) -> None:
                 if getattr(unit, "device", None) is not None \
                         and unit.device is not None and unit.device.is_xla:
                     vec.unmap()
+    if "prng_state" in meta:
+        from . import prng
+        prng.set_state(meta["prng_state"])
     loader = getattr(workflow, "loader", None)
     if loader is not None and "epoch_number" in meta:
         loader.epoch_number = int(meta["epoch_number"])
@@ -83,6 +95,9 @@ def restore_state(workflow, arrays: dict, meta: dict) -> None:
             decision.best_mse = meta["best_mse"]
         if "epoch_metrics" in meta:
             decision.epoch_metrics = list(meta["epoch_metrics"])
+    snap = getattr(workflow, "snapshotter", None)
+    if snap is not None and "snapshotter_epochs_seen" in meta:
+        snap._epochs_seen = int(meta["snapshotter_epochs_seen"])
 
 
 class SnapshotterBase(Unit):
@@ -102,6 +117,20 @@ class SnapshotterBase(Unit):
         self.last_path: str | None = None
         self.best_path: str | None = None
 
+    def epoch_end(self, improved: bool, before_save=None) -> None:
+        """One epoch's snapshot cadence — THE single definition shared
+        by the unit tick path (run()) and the fused epoch loop: save
+        "current" every ``interval`` epochs and on improvement, plus
+        "best" on improvement.  ``before_save`` runs only when a save
+        will actually happen (the fused path syncs weights there)."""
+        self._epochs_seen += 1
+        if self._epochs_seen % self.interval == 0 or improved:
+            if before_save is not None:
+                before_save()
+            self.last_path = self.save("current")
+            if improved and self.keep_best:
+                self.best_path = self.save("best")
+
 
 class SnapshotterToFile(SnapshotterBase):
     """Writes ``<dir>/<prefix>_current.npz`` every ``interval`` epochs and
@@ -111,14 +140,10 @@ class SnapshotterToFile(SnapshotterBase):
         decision = self.workflow.decision
         if not bool(self.workflow.loader.last_minibatch):
             return
-        self._epochs_seen += 1
         improved = bool(decision.snapshot_suggested)
         if improved:
             decision.snapshot_suggested.set(False)
-        if self._epochs_seen % self.interval == 0 or improved:
-            self.last_path = self.save("current")
-        if improved and self.keep_best:
-            self.best_path = self.save("best")
+        self.epoch_end(improved)
 
     def save(self, tag: str) -> str:
         os.makedirs(self.directory, exist_ok=True)

@@ -365,16 +365,49 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             decision.epoch_metrics.append(metrics)
             loader.epoch_number = epoch + 1
             self.metrics_writer.write(kind="epoch", **metrics)
-            if decision.better_than_best(metrics):
+            improved = decision.better_than_best(metrics)
+            if improved:
                 decision.improved.set(True)
                 decision._fails = 0
             else:
                 decision._fails += 1
+            snap = getattr(self, "snapshotter", None)
+            if snap is not None:
+                # Deferred-tail correctness: a mid-training snapshot
+                # must include this epoch's tail update (a continuous
+                # run applies it at the next epoch's start; resume
+                # starts with pending=None, so saving without it would
+                # silently drop one update).  On the FINAL epoch the
+                # unit graph's stop tick gate-skips that update, so the
+                # tail stays pending and the save matches the unit
+                # path's final snapshot exactly.
+                is_final = (epoch == epochs - 1
+                            or decision._fails >= decision.fail_iterations)
+
+                def _sync_weights():
+                    nonlocal pending
+                    if not is_final and pending is not None:
+                        trainer.train_epoch(
+                            data, target, pending[0], batch,
+                            epoch=pending[1], lr_scale=pending[2],
+                            ctr_base=pending[3], sync=False)
+                        pending = None
+                    trainer.write_back()
+
+                snap.epoch_end(improved, before_save=_sync_weights)
             if decision._fails >= decision.fail_iterations:
                 break
         decision.complete.set(True)
         trainer.write_back()
         return trainer
+
+
+def sample_snapshotter_config(tree, explicit):
+    """THE defaulting rule every sample uses for its snapshotter:
+    an explicit argument (even ``{}`` = all defaults) wins; otherwise
+    the sample's config tree (``root.<name>.snapshotter``, reachable
+    from config files and ``--set``) provides it."""
+    return explicit if explicit is not None else tree.get("snapshotter")
 
 
 class StandardWorkflow(StandardWorkflowBase):
@@ -393,6 +426,13 @@ class StandardWorkflow(StandardWorkflowBase):
     def create_workflow(self, loader, decision_config: dict,
                         snapshotter_config: dict | None,
                         lr_adjuster_config: dict | None = None) -> None:
+        # configs may arrive as Config subtrees (samples defaulting from
+        # root.<name>.snapshotter etc., --set-created nodes) — coerce
+        def as_dict(c):
+            return c.to_dict() if hasattr(c, "to_dict") else c
+        decision_config = as_dict(decision_config)
+        snapshotter_config = as_dict(snapshotter_config)
+        lr_adjuster_config = as_dict(lr_adjuster_config)
         self.link_loader(loader)
         self.link_forwards()
         self.link_evaluator()
