@@ -143,3 +143,28 @@ class TestScheduleEquivalence:
             np.testing.assert_allclose(f1.weights.mem, f2.weights.mem,
                                        rtol=5e-4, atol=1e-5,
                                        err_msg=f1.name)
+
+    def test_unit_graph_vs_fused_by_iteration_schedule(self,
+                                                       small_mnist):
+        """Iteration-granular schedule (by_epoch=False): the fused path
+        traces one lr scale PER MINIBATCH — weights must match the
+        unit-graph loop that mutates lr before every tick."""
+        from znicz_tpu.models.mnist import MnistWorkflow
+        cfg = {"policy": ("inv", {"gamma": 0.05, "power": 0.6}),
+               "by_epoch": False}
+        prng.seed_all(321)
+        wf = MnistWorkflow(lr_adjuster_config=cfg)
+        wf.decision.max_epochs = 3
+        wf.initialize(device=Device.create("xla"))
+        wf.run()
+        assert wf.lr_adjuster is not None          # plumbing, not vacuous
+        assert wf.lr_adjuster._minibatches > 3     # counted per tick
+        prng.seed_all(321)
+        wf2 = MnistWorkflow(lr_adjuster_config=cfg)
+        wf2.decision.max_epochs = 3
+        wf2.initialize(device=Device.create("xla"))
+        wf2.run_fused(max_epochs=3)
+        for f1, f2 in zip(wf.forwards, wf2.forwards):
+            np.testing.assert_allclose(f1.weights.mem, f2.weights.mem,
+                                       rtol=5e-4, atol=1e-5,
+                                       err_msg=f1.name)

@@ -157,6 +157,7 @@ class AlexNetWorkflow(StandardWorkflow):
 
     def __init__(self, workflow=None, name="AlexNetWorkflow", layers=None,
                  decision_config=None, snapshotter_config=None,
+                 lr_adjuster_config=None,
                  data_dir=None, **kwargs):
         data_dir = data_dir or root.alexnet.get("data_dir")
         if data_dir:
@@ -180,7 +181,8 @@ class AlexNetWorkflow(StandardWorkflow):
             decision_config=decision_config
             or root.alexnet.decision.to_dict(),
             snapshotter_config=sample_snapshotter_config(
-                root.alexnet, snapshotter_config))
+                root.alexnet, snapshotter_config),
+            lr_adjuster_config=lr_adjuster_config)
 
 
 def run(device: Device | None = None, epochs: int | None = None,

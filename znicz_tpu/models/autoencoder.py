@@ -58,7 +58,8 @@ class MnistAEWorkflow(StandardWorkflow):
     """BASELINE config 4: Conv/Pool encoder + Depool/Deconv decoder, MSE."""
 
     def __init__(self, workflow=None, name="MnistAEWorkflow", layers=None,
-                 decision_config=None, snapshotter_config=None, **kwargs):
+                 decision_config=None, snapshotter_config=None,
+                 lr_adjuster_config=None, **kwargs):
         loader = MnistAELoader(
             minibatch_size=root.mnist_ae.get("minibatch_size", 100),
             synthetic_sizes=kwargs.get("synthetic_sizes")
@@ -72,7 +73,8 @@ class MnistAEWorkflow(StandardWorkflow):
             decision_config=decision_config
             or root.mnist_ae.decision.to_dict(),
             snapshotter_config=sample_snapshotter_config(
-                root.mnist_ae, snapshotter_config))
+                root.mnist_ae, snapshotter_config),
+            lr_adjuster_config=lr_adjuster_config)
 
 
 def run(device: Device | None = None, epochs: int | None = None,

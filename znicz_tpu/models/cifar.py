@@ -119,7 +119,8 @@ class CifarWorkflow(StandardWorkflow):
     """BASELINE config 2: Conv+Pool+LRN+FC + GDConv/GDPooling chain."""
 
     def __init__(self, workflow=None, name="CifarWorkflow", layers=None,
-                 decision_config=None, snapshotter_config=None, **kwargs):
+                 decision_config=None, snapshotter_config=None,
+                 lr_adjuster_config=None, **kwargs):
         loader = CifarLoader(
             minibatch_size=root.cifar.get("minibatch_size", 100),
             **{k: v for k, v in kwargs.items()
@@ -132,7 +133,8 @@ class CifarWorkflow(StandardWorkflow):
             decision_config=decision_config
             or root.cifar.decision.to_dict(),
             snapshotter_config=sample_snapshotter_config(
-                root.cifar, snapshotter_config))
+                root.cifar, snapshotter_config),
+            lr_adjuster_config=lr_adjuster_config)
 
 
 def run(device: Device | None = None, epochs: int | None = None,

@@ -126,7 +126,8 @@ class KanjiWorkflow(StandardWorkflow):
 
     def __init__(self, workflow=None, name="KanjiWorkflow", layers=None,
                  data_dir: str | None = None, decision_config=None,
-                 snapshotter_config=None, **kwargs):
+                 snapshotter_config=None,
+                 lr_adjuster_config=None, **kwargs):
         from ..loader.streaming import OnTheFlyImageLoader
 
         cfg = root.kanji
@@ -148,7 +149,8 @@ class KanjiWorkflow(StandardWorkflow):
             loss_function="softmax",
             decision_config=decision_config or cfg.decision.to_dict(),
             snapshotter_config=sample_snapshotter_config(
-                root.kanji, snapshotter_config))
+                root.kanji, snapshotter_config),
+            lr_adjuster_config=lr_adjuster_config)
 
 
 def run(device: Device | None = None, epochs: int | None = None,

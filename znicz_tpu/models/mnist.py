@@ -114,7 +114,8 @@ class MnistWorkflow(StandardWorkflow):
     """BASELINE config 1: All2AllTanh → All2AllSoftmax + GD chain."""
 
     def __init__(self, workflow=None, name="MnistWorkflow", layers=None,
-                 decision_config=None, snapshotter_config=None, **kwargs):
+                 decision_config=None, snapshotter_config=None,
+                 lr_adjuster_config=None, **kwargs):
         loader = MnistLoader(
             minibatch_size=root.mnist.get("minibatch_size", 100),
             **{k: v for k, v in kwargs.items()
@@ -128,7 +129,8 @@ class MnistWorkflow(StandardWorkflow):
             decision_config=decision_config
             or root.mnist.decision.to_dict(),
             snapshotter_config=sample_snapshotter_config(
-                root.mnist, snapshotter_config))
+                root.mnist, snapshotter_config),
+            lr_adjuster_config=lr_adjuster_config)
 
 
 def run(device: Device | None = None, epochs: int | None = None,

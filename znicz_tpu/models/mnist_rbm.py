@@ -114,7 +114,8 @@ class MnistRBMWorkflow(StandardWorkflow):
 
     def __init__(self, workflow=None, name="MnistRBMWorkflow",
                  layers=None, decision_config=None,
-                 snapshotter_config=None, **kwargs):
+                 snapshotter_config=None,
+                 lr_adjuster_config=None, **kwargs):
         loader = MnistLoader(
             minibatch_size=root.mnist_rbm.get("minibatch_size", 100),
             synthetic_sizes=kwargs.get("synthetic_sizes")
@@ -128,7 +129,8 @@ class MnistRBMWorkflow(StandardWorkflow):
             decision_config=decision_config
             or root.mnist_rbm.decision.to_dict(),
             snapshotter_config=sample_snapshotter_config(
-                root.mnist_rbm, snapshotter_config))
+                root.mnist_rbm, snapshotter_config),
+            lr_adjuster_config=lr_adjuster_config)
 
     def install_pretrained(self, stack) -> None:
         """Copy pretrained (W, hbias) pairs into the hidden layers'

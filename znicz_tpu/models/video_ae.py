@@ -95,7 +95,8 @@ class VideoAEWorkflow(StandardWorkflow):
 
     def __init__(self, workflow=None, name="VideoAEWorkflow",
                  layers=None, decision_config=None,
-                 snapshotter_config=None, **kwargs):
+                 snapshotter_config=None,
+                 lr_adjuster_config=None, **kwargs):
         loader = VideoFrameLoader(
             minibatch_size=root.video_ae.get("minibatch_size", 50),
             synthetic_sizes=kwargs.get("synthetic_sizes")
@@ -108,7 +109,8 @@ class VideoAEWorkflow(StandardWorkflow):
             decision_config=decision_config
             or root.video_ae.decision.to_dict(),
             snapshotter_config=sample_snapshotter_config(
-                root.video_ae, snapshotter_config))
+                root.video_ae, snapshotter_config),
+            lr_adjuster_config=lr_adjuster_config)
 
 
 def run(device: Device | None = None, epochs: int | None = None,

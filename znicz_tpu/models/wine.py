@@ -107,7 +107,8 @@ class WineWorkflow(StandardWorkflow):
     """Reference samples/Wine: 13-feature MLP, tanh hidden, softmax."""
 
     def __init__(self, workflow=None, name="WineWorkflow", layers=None,
-                 decision_config=None, snapshotter_config=None, **kwargs):
+                 decision_config=None, snapshotter_config=None,
+                 lr_adjuster_config=None, **kwargs):
         loader = WineLoader(
             minibatch_size=root.wine.get("minibatch_size", 30),
             **{k: v for k, v in kwargs.items()
@@ -120,7 +121,8 @@ class WineWorkflow(StandardWorkflow):
             decision_config=decision_config
             or root.wine.decision.to_dict(),
             snapshotter_config=sample_snapshotter_config(
-                root.wine, snapshotter_config))
+                root.wine, snapshotter_config),
+            lr_adjuster_config=lr_adjuster_config)
 
 
 def run(device: Device | None = None, epochs: int | None = None,

@@ -162,7 +162,8 @@ class YaleFacesWorkflow(StandardWorkflow):
 
     def __init__(self, workflow=None, name="YaleFacesWorkflow",
                  layers=None, data_dir: str | None = None,
-                 decision_config=None, snapshotter_config=None, **kwargs):
+                 decision_config=None, snapshotter_config=None,
+                 lr_adjuster_config=None, **kwargs):
         from ..loader.augment import RandomCropFlip
         from ..loader.streaming import OnTheFlyImageLoader
 
@@ -188,7 +189,8 @@ class YaleFacesWorkflow(StandardWorkflow):
             loss_function="softmax",
             decision_config=decision_config or cfg.decision.to_dict(),
             snapshotter_config=sample_snapshotter_config(
-                root.yale_faces, snapshotter_config))
+                root.yale_faces, snapshotter_config),
+            lr_adjuster_config=lr_adjuster_config)
 
 
 def run(device: Device | None = None, epochs: int | None = None,

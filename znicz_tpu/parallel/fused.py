@@ -636,7 +636,10 @@ class FusedTrainer:
         accum = self.accum_steps
 
         def train_epoch(params, vels, data, target, idx, mask, ctrs,
-                        epoch, lr_scale):
+                        epoch, scales):
+            # `scales` = per-STEP lr multipliers (a scalar schedule is
+            # broadcast host-side), so per-minibatch policies
+            # (lr_adjust by_epoch=False) trace in without recompiles
             def gather(step_idx):
                 x = jnp.take(data, step_idx, axis=0)
                 if self._batch_sharding is not None:
@@ -647,28 +650,29 @@ class FusedTrainer:
             if accum == 1:
                 def body(carry, step):
                     params, vels = carry
-                    step_idx, step_mask, step_ctr = step
+                    step_idx, step_mask, step_ctr, step_scale = step
                     x, t = gather(step_idx)
                     params, vels, m = train_minibatch(
                         spec, params, vels, x, t, step_mask,
-                        epoch=epoch, ctr=step_ctr, lr_scale=lr_scale)
+                        epoch=epoch, ctr=step_ctr, lr_scale=step_scale)
                     return (params, vels), m
-                (params, vels), ms = jax.lax.scan(body, (params, vels),
-                                                  (idx, mask, ctrs))
+                (params, vels), ms = jax.lax.scan(
+                    body, (params, vels), (idx, mask, ctrs, scales))
                 return params, vels, ms
 
             # micro-batch accumulation: grads of `accum` consecutive
             # steps sum in an f32 accumulator; every accum-th step
             # applies ONE update with the sum (unit-graph
-            # accumulate_gradient semantics).  A trailing partial group
-            # at epoch end applies too — deferring it across epochs
-            # would silently mix epochs' RNG coordinates.
+            # accumulate_gradient semantics) at that step's lr scale.
+            # A trailing partial group at epoch end applies too —
+            # deferring it across epochs would silently mix epochs'
+            # RNG coordinates.
             zeros = grad_zeros(spec, params)
             n_steps = idx.shape[0]
 
             def body(carry, step):
                 params, vels, acc = carry
-                step_i, step_idx, step_mask, step_ctr = step
+                step_i, step_idx, step_mask, step_ctr, step_scale = step
                 x, t = gather(step_idx)
                 grads, m = grad_minibatch(spec, params, x, t, step_mask,
                                           epoch=epoch, ctr=step_ctr)
@@ -678,7 +682,7 @@ class FusedTrainer:
 
                 def apply(ops):
                     p, v, a = ops
-                    p, v = apply_updates(spec, p, v, a, lr_scale)
+                    p, v = apply_updates(spec, p, v, a, step_scale)
                     return p, v, jax.tree_util.tree_map(
                         jnp.zeros_like, a)
 
@@ -688,7 +692,7 @@ class FusedTrainer:
                 return (params, vels, acc), m
             (params, vels, _), ms = jax.lax.scan(
                 body, (params, vels, zeros),
-                (jnp.arange(n_steps), idx, mask, ctrs))
+                (jnp.arange(n_steps), idx, mask, ctrs, scales))
             return params, vels, ms
 
         def eval_epoch(params, data, target, idx, mask):
@@ -729,7 +733,7 @@ class FusedTrainer:
 
     def train_epoch(self, data, target, indices, batch: int,
                     sync: bool = True, epoch: int | None = None,
-                    lr_scale: float = 1.0, ctr_base: int = 0) -> dict:
+                    lr_scale=1.0, ctr_base: int = 0) -> dict:
         """One epoch on device.  ``sync=False`` returns device arrays
         without a host readback — on tunneled TPUs a device→host fetch
         costs ~100× a step, so throughput loops should defer syncing.
@@ -737,7 +741,9 @@ class FusedTrainer:
         ``epoch`` keys the stochastic layers' counter RNG; when omitted
         an internal counter advances per call, so repeated calls never
         silently reuse dropout masks.  ``lr_scale`` multiplies every
-        layer's learning rate (traced — LR schedules don't recompile)."""
+        layer's learning rate (traced — LR schedules don't recompile):
+        a scalar, or a per-minibatch array of len(steps) for
+        iteration-granular policies (lr_adjust by_epoch=False)."""
         if epoch is None:
             epoch = self._auto_epoch
         self._auto_epoch = epoch + 1
@@ -745,9 +751,11 @@ class FusedTrainer:
             self._build()
         idx, mask, ctrs = self._idx_matrix(np.asarray(indices), batch,
                                            ctr_base)
+        scales = np.broadcast_to(
+            np.asarray(lr_scale, np.float32), (idx.shape[0],))
         self.params, self.vels, ms = self._train_epoch_fn(
             self.params, self.vels, data, target, idx, mask, ctrs,
-            jnp.uint32(epoch), jnp.float32(lr_scale))
+            jnp.uint32(epoch), jnp.asarray(scales))
         return {k: np.asarray(v) for k, v in ms.items()} if sync else ms
 
     def eval_epoch(self, data, target, indices, batch: int,

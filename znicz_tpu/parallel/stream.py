@@ -117,7 +117,7 @@ class StreamTrainer(FusedTrainer):
     # -- epoch drivers -----------------------------------------------------
     def train_epoch(self, data, target, indices, batch: int,
                     sync: bool = True, epoch: int | None = None,
-                    lr_scale: float = 1.0, ctr_base: int = 0) -> dict:
+                    lr_scale=1.0, ctr_base: int = 0) -> dict:
         if epoch is None:
             epoch = self._auto_epoch
         self._auto_epoch = epoch + 1
@@ -130,11 +130,13 @@ class StreamTrainer(FusedTrainer):
                              skip_labels=self._x_is_target, epoch=epoch)
         losses, n_errs = [], []
         ep = jnp.uint32(epoch)
-        ls = jnp.float32(lr_scale)
+        scales = np.broadcast_to(np.asarray(lr_scale, np.float32),
+                                 (idx.shape[0],))
         accum = self.accum_steps
         acc = None
         n_steps = idx.shape[0]
         for step_i, (x, t) in enumerate(pf):
+            ls = jnp.float32(scales[step_i])
             if accum == 1:
                 self.params, self.vels, m = self._step_fn(
                     self.params, self.vels, x, t,

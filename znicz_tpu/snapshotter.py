@@ -62,6 +62,14 @@ def collect_state(workflow) -> tuple[dict[str, np.ndarray], dict]:
                                            np.inf))
         meta["best_mse"] = float(getattr(decision, "best_mse", np.inf))
         meta["epoch_metrics"] = decision.epoch_metrics
+        # early-stop state: a resume that reset the fail counter would
+        # train past where the continuous run stopped
+        meta["decision_fails"] = int(getattr(decision, "_fails", 0))
+    adj = getattr(workflow, "lr_adjuster", None)
+    if adj is not None:
+        # by_epoch=False schedules key on this counter — resume must
+        # continue the schedule, not restart it from iteration 0
+        meta["lr_adjust_minibatches"] = int(adj._minibatches)
     snap = getattr(workflow, "snapshotter", None)
     if snap is not None:
         # resume must keep the periodic cadence aligned with the
@@ -95,6 +103,11 @@ def restore_state(workflow, arrays: dict, meta: dict) -> None:
             decision.best_mse = meta["best_mse"]
         if "epoch_metrics" in meta:
             decision.epoch_metrics = list(meta["epoch_metrics"])
+        if "decision_fails" in meta:
+            decision._fails = int(meta["decision_fails"])
+    adj = getattr(workflow, "lr_adjuster", None)
+    if adj is not None and "lr_adjust_minibatches" in meta:
+        adj._minibatches = int(meta["lr_adjust_minibatches"])
     snap = getattr(workflow, "snapshotter", None)
     if snap is not None and "snapshotter_epochs_seen" in meta:
         snap._epochs_seen = int(meta["snapshotter_epochs_seen"])

@@ -295,16 +295,18 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         from .loader.base import CLASS_NAMES
         lr_policy = (self.lr_adjuster.policy
                      if self.lr_adjuster is not None else None)
+        lr_by_epoch = True
         if self.lr_adjuster is not None:
             adj = self.lr_adjuster
-            if adj.bias_policy is not adj.policy or not adj.by_epoch:
-                # the fused step traces ONE per-epoch scale into both
-                # weight and bias updates — refuse configurations it
-                # cannot reproduce rather than silently diverging
+            lr_by_epoch = adj.by_epoch
+            if adj.bias_policy is not adj.policy:
+                # the fused step traces ONE scale into both weight and
+                # bias updates — refuse configurations it cannot
+                # reproduce rather than silently diverging
                 raise NotImplementedError(
-                    "run_fused supports a single by-epoch LR policy; "
-                    "separate bias_policy or by_epoch=False schedules "
-                    "need the unit-graph path (wf.run())")
+                    "run_fused traces one LR scale for weights and "
+                    "biases; a separate bias_policy needs the "
+                    "unit-graph path (wf.run())")
         first = True
         # Unit-graph parity for the stop tick: in the tick where Decision
         # sets ``complete`` the GD units are gate-skipped, so the LAST
@@ -319,13 +321,26 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             first = False                    # stream (unit-graph parity)
             metrics = {"epoch": epoch}
             perm = loader._shuffled[TRAIN]
-            scale = lr_policy.scale(epoch) if lr_policy is not None \
-                else 1.0
+            n_train = len(cls_idx[TRAIN])
+            steps_per_epoch = max(1, -(-n_train // batch))
+            if lr_policy is None:
+                scale, tail_scale = 1.0, 1.0
+            elif lr_by_epoch:
+                scale = tail_scale = lr_policy.scale(epoch)
+            else:
+                # iteration-granular policy: one scale per train
+                # minibatch, iterations counted across epochs exactly
+                # like LearningRateAdjust._minibatches on the tick path
+                base_it = epoch * steps_per_epoch
+                scale = np.asarray(
+                    [lr_policy.scale(base_it + i)
+                     for i in range(steps_per_epoch - 1)], np.float32)
+                tail_scale = lr_policy.scale(base_it
+                                             + steps_per_epoch - 1)
             if pending is not None:
                 trainer.train_epoch(data, target, pending[0], batch,
                                     epoch=pending[1], lr_scale=pending[2],
                                     ctr_base=pending[3], sync=False)
-            n_train = len(cls_idx[TRAIN])
             split = ((n_train - 1) // batch) * batch
             head, tail = perm[:split], perm[split:]
             if len(head):
@@ -341,7 +356,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             # differ slightly from the unit graph's dropout-active ones;
             # weights stay exactly equal either way
             em_tail = trainer.eval_epoch(data, target, tail, batch)
-            pending = (tail, epoch, scale, split)
+            pending = (tail, epoch, tail_scale, split)
             metrics["train_loss"] = float(
                 np.concatenate([tm["loss"], em_tail["loss"]]).mean())
             metrics["train_n_err"] = int(tm["n_err"].sum()
