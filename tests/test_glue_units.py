@@ -168,3 +168,29 @@ class TestScheduleEquivalence:
             np.testing.assert_allclose(f1.weights.mem, f2.weights.mem,
                                        rtol=5e-4, atol=1e-5,
                                        err_msg=f1.name)
+
+    def test_unit_graph_vs_fused_separate_bias_policy(self,
+                                                      small_mnist):
+        """Separate bias_policy: the fused path traces distinct weight
+        and bias scale vectors — weights AND biases must match the
+        unit-graph loop."""
+        from znicz_tpu.models.mnist import MnistWorkflow
+        cfg = {"policy": ("exp", {"gamma": 0.6}),
+               "bias_policy": ("inv", {"gamma": 0.2, "power": 0.5})}
+        prng.seed_all(321)
+        wf = MnistWorkflow(lr_adjuster_config=cfg)
+        wf.decision.max_epochs = 3
+        wf.initialize(device=Device.create("xla"))
+        wf.run()
+        prng.seed_all(321)
+        wf2 = MnistWorkflow(lr_adjuster_config=cfg)
+        wf2.decision.max_epochs = 3
+        wf2.initialize(device=Device.create("xla"))
+        wf2.run_fused(max_epochs=3)
+        for f1, f2 in zip(wf.forwards, wf2.forwards):
+            np.testing.assert_allclose(f1.weights.mem, f2.weights.mem,
+                                       rtol=5e-4, atol=1e-5,
+                                       err_msg=f1.name)
+            np.testing.assert_allclose(f1.bias.mem, f2.bias.mem,
+                                       rtol=5e-4, atol=1e-5,
+                                       err_msg=f1.name + " bias")

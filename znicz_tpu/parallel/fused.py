@@ -457,7 +457,8 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
     return grads
 
 
-def apply_updates(spec: ModelSpec, params, vels, grads, lr_scale=1.0):
+def apply_updates(spec: ModelSpec, params, vels, grads, lr_scale=1.0,
+                  lr_scale_bias=None):
     # Inline update math (not the Pallas update kernel): inside the fused
     # step XLA fuses these elementwise ops into the surrounding graph; the
     # Pallas kernel serves the unit-graph path where each op dispatches
@@ -470,6 +471,8 @@ def apply_updates(spec: ModelSpec, params, vels, grads, lr_scale=1.0):
     # Vector's two sequential updates land exactly as the unit graph's:
     # the deconv's update first, then the conv's decay term reads the
     # already-updated W.
+    if lr_scale_bias is None:
+        lr_scale_bias = lr_scale
     n = len(spec.layers)
     cur_w = [p[0] for p in params]
     cur_b = [p[1] for p in params]
@@ -492,7 +495,7 @@ def apply_updates(spec: ModelSpec, params, vels, grads, lr_scale=1.0):
         if b is not None:
             lrb, wdb, l1b, momb = layer.hypers_bias
             regb = wdb * ((1.0 - l1b) * b + 0.5 * l1b * jnp.sign(b))
-            vb2 = momb * vb - lrb * lr_scale * (gb + regb)
+            vb2 = momb * vb - lrb * lr_scale_bias * (gb + regb)
             cur_b[i] = b + vb2
             new_v[i][1] = vb2
     return ([(w, b) for w, b in zip(cur_w, cur_b)],
@@ -549,10 +552,11 @@ def grad_zeros(spec: ModelSpec, params):
 
 
 def train_minibatch(spec: ModelSpec, params, vels, x, target, mask=None,
-                    epoch=0, ctr=0, lr_scale=1.0):
+                    epoch=0, ctr=0, lr_scale=1.0, lr_scale_bias=None):
     grads, metrics = grad_minibatch(spec, params, x, target, mask,
                                     epoch=epoch, ctr=ctr)
-    params, vels = apply_updates(spec, params, vels, grads, lr_scale)
+    params, vels = apply_updates(spec, params, vels, grads, lr_scale,
+                                 lr_scale_bias)
     return params, vels, metrics
 
 
@@ -636,10 +640,11 @@ class FusedTrainer:
         accum = self.accum_steps
 
         def train_epoch(params, vels, data, target, idx, mask, ctrs,
-                        epoch, scales):
-            # `scales` = per-STEP lr multipliers (a scalar schedule is
-            # broadcast host-side), so per-minibatch policies
-            # (lr_adjust by_epoch=False) trace in without recompiles
+                        epoch, scales, scales_b):
+            # `scales`/`scales_b` = per-STEP lr multipliers for weights
+            # and biases (scalar schedules broadcast host-side), so
+            # per-minibatch policies (lr_adjust by_epoch=False) and
+            # separate bias policies trace in without recompiles
             def gather(step_idx):
                 x = jnp.take(data, step_idx, axis=0)
                 if self._batch_sharding is not None:
@@ -650,14 +655,16 @@ class FusedTrainer:
             if accum == 1:
                 def body(carry, step):
                     params, vels = carry
-                    step_idx, step_mask, step_ctr, step_scale = step
+                    step_idx, step_mask, step_ctr, s_w, s_b = step
                     x, t = gather(step_idx)
                     params, vels, m = train_minibatch(
                         spec, params, vels, x, t, step_mask,
-                        epoch=epoch, ctr=step_ctr, lr_scale=step_scale)
+                        epoch=epoch, ctr=step_ctr, lr_scale=s_w,
+                        lr_scale_bias=s_b)
                     return (params, vels), m
                 (params, vels), ms = jax.lax.scan(
-                    body, (params, vels), (idx, mask, ctrs, scales))
+                    body, (params, vels),
+                    (idx, mask, ctrs, scales, scales_b))
                 return params, vels, ms
 
             # micro-batch accumulation: grads of `accum` consecutive
@@ -672,7 +679,8 @@ class FusedTrainer:
 
             def body(carry, step):
                 params, vels, acc = carry
-                step_i, step_idx, step_mask, step_ctr, step_scale = step
+                (step_i, step_idx, step_mask, step_ctr, s_w,
+                 s_b) = step
                 x, t = gather(step_idx)
                 grads, m = grad_minibatch(spec, params, x, t, step_mask,
                                           epoch=epoch, ctr=step_ctr)
@@ -682,7 +690,7 @@ class FusedTrainer:
 
                 def apply(ops):
                     p, v, a = ops
-                    p, v = apply_updates(spec, p, v, a, step_scale)
+                    p, v = apply_updates(spec, p, v, a, s_w, s_b)
                     return p, v, jax.tree_util.tree_map(
                         jnp.zeros_like, a)
 
@@ -692,7 +700,8 @@ class FusedTrainer:
                 return (params, vels, acc), m
             (params, vels, _), ms = jax.lax.scan(
                 body, (params, vels, zeros),
-                (jnp.arange(n_steps), idx, mask, ctrs, scales))
+                (jnp.arange(n_steps), idx, mask, ctrs, scales,
+                 scales_b))
             return params, vels, ms
 
         def eval_epoch(params, data, target, idx, mask):
@@ -709,6 +718,16 @@ class FusedTrainer:
 
         self._train_epoch_fn = jax.jit(train_epoch, donate_argnums=(0, 1))
         self._eval_epoch_fn = jax.jit(eval_epoch)
+
+    @staticmethod
+    def _step_scales(lr_scale, lr_scale_bias, n_steps: int):
+        """Per-step (weight, bias) lr multiplier vectors from scalar or
+        array schedules — one definition for resident and streaming."""
+        scales = np.broadcast_to(np.asarray(lr_scale, np.float32),
+                                 (n_steps,))
+        scales_b = scales if lr_scale_bias is None else np.broadcast_to(
+            np.asarray(lr_scale_bias, np.float32), (n_steps,))
+        return scales, scales_b
 
     def _idx_matrix(self, indices: np.ndarray, batch: int,
                     ctr_base: int = 0) -> tuple[np.ndarray, np.ndarray,
@@ -733,7 +752,8 @@ class FusedTrainer:
 
     def train_epoch(self, data, target, indices, batch: int,
                     sync: bool = True, epoch: int | None = None,
-                    lr_scale=1.0, ctr_base: int = 0) -> dict:
+                    lr_scale=1.0, ctr_base: int = 0,
+                    lr_scale_bias=None) -> dict:
         """One epoch on device.  ``sync=False`` returns device arrays
         without a host readback — on tunneled TPUs a device→host fetch
         costs ~100× a step, so throughput loops should defer syncing.
@@ -743,7 +763,9 @@ class FusedTrainer:
         silently reuse dropout masks.  ``lr_scale`` multiplies every
         layer's learning rate (traced — LR schedules don't recompile):
         a scalar, or a per-minibatch array of len(steps) for
-        iteration-granular policies (lr_adjust by_epoch=False)."""
+        iteration-granular policies (lr_adjust by_epoch=False);
+        ``lr_scale_bias`` does the same for bias learning rates
+        (default: follow ``lr_scale``)."""
         if epoch is None:
             epoch = self._auto_epoch
         self._auto_epoch = epoch + 1
@@ -751,11 +773,12 @@ class FusedTrainer:
             self._build()
         idx, mask, ctrs = self._idx_matrix(np.asarray(indices), batch,
                                            ctr_base)
-        scales = np.broadcast_to(
-            np.asarray(lr_scale, np.float32), (idx.shape[0],))
+        scales, scales_b = self._step_scales(lr_scale, lr_scale_bias,
+                                             idx.shape[0])
         self.params, self.vels, ms = self._train_epoch_fn(
             self.params, self.vels, data, target, idx, mask, ctrs,
-            jnp.uint32(epoch), jnp.asarray(scales))
+            jnp.uint32(epoch), jnp.asarray(scales),
+            jnp.asarray(scales_b))
         return {k: np.asarray(v) for k, v in ms.items()} if sync else ms
 
     def eval_epoch(self, data, target, indices, batch: int,

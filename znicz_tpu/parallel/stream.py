@@ -63,14 +63,16 @@ class StreamTrainer(FusedTrainer):
         spec = self.spec
         x_is_target = self._x_is_target
 
-        def step(params, vels, x, t, mask, epoch, ctr, lr_scale):
+        def step(params, vels, x, t, mask, epoch, ctr, lr_scale,
+                 lr_scale_bias):
             if self._batch_sharding is not None:
                 x = jax.lax.with_sharding_constraint(
                     x, self._batch_sharding)
             return train_minibatch(spec, params, vels, x,
                                    x if x_is_target else t, mask,
                                    epoch=epoch, ctr=ctr,
-                                   lr_scale=lr_scale)
+                                   lr_scale=lr_scale,
+                                   lr_scale_bias=lr_scale_bias)
 
         def estep(params, x, t, mask):
             if self._batch_sharding is not None:
@@ -96,8 +98,9 @@ class StreamTrainer(FusedTrainer):
                                       x if x_is_target else t, mask,
                                       epoch=epoch, ctr=ctr)
 
-            def gapply(params, vels, acc, lr_scale):
-                return apply_updates(spec, params, vels, acc, lr_scale)
+            def gapply(params, vels, acc, lr_scale, lr_scale_bias):
+                return apply_updates(spec, params, vels, acc, lr_scale,
+                                     lr_scale_bias)
 
             def gadd(acc, grads):
                 return jax.tree_util.tree_map(jnp.add, acc, grads)
@@ -117,7 +120,8 @@ class StreamTrainer(FusedTrainer):
     # -- epoch drivers -----------------------------------------------------
     def train_epoch(self, data, target, indices, batch: int,
                     sync: bool = True, epoch: int | None = None,
-                    lr_scale=1.0, ctr_base: int = 0) -> dict:
+                    lr_scale=1.0, ctr_base: int = 0,
+                    lr_scale_bias=None) -> dict:
         if epoch is None:
             epoch = self._auto_epoch
         self._auto_epoch = epoch + 1
@@ -130,18 +134,19 @@ class StreamTrainer(FusedTrainer):
                              skip_labels=self._x_is_target, epoch=epoch)
         losses, n_errs = [], []
         ep = jnp.uint32(epoch)
-        scales = np.broadcast_to(np.asarray(lr_scale, np.float32),
-                                 (idx.shape[0],))
+        scales, scales_b = self._step_scales(lr_scale, lr_scale_bias,
+                                             idx.shape[0])
         accum = self.accum_steps
         acc = None
         n_steps = idx.shape[0]
         for step_i, (x, t) in enumerate(pf):
             ls = jnp.float32(scales[step_i])
+            lsb = jnp.float32(scales_b[step_i])
             if accum == 1:
                 self.params, self.vels, m = self._step_fn(
                     self.params, self.vels, x, t,
                     jnp.asarray(mask[step_i]), ep,
-                    jnp.uint32(ctrs[step_i]), ls)
+                    jnp.uint32(ctrs[step_i]), ls, lsb)
             else:
                 grads, m = self._grad_fn(self.params, x, t,
                                          jnp.asarray(mask[step_i]), ep,
@@ -152,7 +157,7 @@ class StreamTrainer(FusedTrainer):
                     else self._acc_add_fn(acc, grads)
                 if (step_i + 1) % accum == 0 or step_i + 1 == n_steps:
                     self.params, self.vels = self._apply_fn(
-                        self.params, self.vels, acc, ls)
+                        self.params, self.vels, acc, ls, lsb)
                     acc = None
             losses.append(m["loss"])
             n_errs.append(m["n_err"])

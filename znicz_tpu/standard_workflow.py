@@ -293,27 +293,22 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         if epochs is None:
             epochs = 10
         from .loader.base import CLASS_NAMES
-        lr_policy = (self.lr_adjuster.policy
-                     if self.lr_adjuster is not None else None)
+        lr_policy = bias_policy = None
         lr_by_epoch = True
         if self.lr_adjuster is not None:
             adj = self.lr_adjuster
+            lr_policy = adj.policy
             lr_by_epoch = adj.by_epoch
             if adj.bias_policy is not adj.policy:
-                # the fused step traces ONE scale into both weight and
-                # bias updates — refuse configurations it cannot
-                # reproduce rather than silently diverging
-                raise NotImplementedError(
-                    "run_fused traces one LR scale for weights and "
-                    "biases; a separate bias_policy needs the "
-                    "unit-graph path (wf.run())")
+                bias_policy = adj.bias_policy   # separate bias schedule
         first = True
         # Unit-graph parity for the stop tick: in the tick where Decision
         # sets ``complete`` the GD units are gate-skipped, so the LAST
         # train minibatch of the final epoch never updates weights.  The
         # fused loop reproduces this by deferring each epoch's last
         # minibatch update until it knows training continues.
-        pending = None   # (tail_indices, epoch, lr_scale, ctr_base)
+        pending = None   # (tail_idx, epoch, lr_scale, ctr_base,
+        #            lr_scale_bias)
         for epoch in range(loader.epoch_number, epochs):
             loader.epoch_number = epoch
             if not first:   # initialize() already built epoch 0's plan —
@@ -323,29 +318,37 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             perm = loader._shuffled[TRAIN]
             n_train = len(cls_idx[TRAIN])
             steps_per_epoch = max(1, -(-n_train // batch))
-            if lr_policy is None:
-                scale, tail_scale = 1.0, 1.0
-            elif lr_by_epoch:
-                scale = tail_scale = lr_policy.scale(epoch)
-            else:
-                # iteration-granular policy: one scale per train
-                # minibatch, iterations counted across epochs exactly
-                # like LearningRateAdjust._minibatches on the tick path
+
+            def _scales(policy):
+                """(head scales, tail scale) for one policy; iteration
+                counting matches LearningRateAdjust._minibatches on
+                the tick path."""
+                if policy is None:
+                    return 1.0, 1.0
+                if lr_by_epoch:
+                    s = policy.scale(epoch)
+                    return s, s
                 base_it = epoch * steps_per_epoch
-                scale = np.asarray(
-                    [lr_policy.scale(base_it + i)
+                head_s = np.asarray(
+                    [policy.scale(base_it + i)
                      for i in range(steps_per_epoch - 1)], np.float32)
-                tail_scale = lr_policy.scale(base_it
-                                             + steps_per_epoch - 1)
+                return head_s, policy.scale(base_it + steps_per_epoch
+                                            - 1)
+            scale, tail_scale = _scales(lr_policy)
+            scale_b, tail_scale_b = (_scales(bias_policy)
+                                     if bias_policy is not None
+                                     else (None, None))
             if pending is not None:
                 trainer.train_epoch(data, target, pending[0], batch,
                                     epoch=pending[1], lr_scale=pending[2],
-                                    ctr_base=pending[3], sync=False)
+                                    ctr_base=pending[3], sync=False,
+                                    lr_scale_bias=pending[4])
             split = ((n_train - 1) // batch) * batch
             head, tail = perm[:split], perm[split:]
             if len(head):
                 tm = trainer.train_epoch(data, target, head, batch,
-                                         epoch=epoch, lr_scale=scale)
+                                         epoch=epoch, lr_scale=scale,
+                                         lr_scale_bias=scale_b)
             else:
                 tm = {"loss": np.zeros((0,)), "n_err": np.zeros((0,))}
             # the tail minibatch's metrics come from a forward pass over
@@ -356,7 +359,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             # differ slightly from the unit graph's dropout-active ones;
             # weights stay exactly equal either way
             em_tail = trainer.eval_epoch(data, target, tail, batch)
-            pending = (tail, epoch, tail_scale, split)
+            pending = (tail, epoch, tail_scale, split, tail_scale_b)
             metrics["train_loss"] = float(
                 np.concatenate([tm["loss"], em_tail["loss"]]).mean())
             metrics["train_n_err"] = int(tm["n_err"].sum()
@@ -380,6 +383,13 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             decision.epoch_metrics.append(metrics)
             loader.epoch_number = epoch + 1
             self.metrics_writer.write(kind="epoch", **metrics)
+            if self.lr_adjuster is not None:
+                # keep the tick-path iteration counter current so
+                # snapshots persist the TRUE schedule position (a
+                # tick-path resume of a fused run must continue the
+                # by_epoch=False schedule, not restart it)
+                self.lr_adjuster._minibatches = \
+                    (epoch + 1) * steps_per_epoch
             improved = decision.better_than_best(metrics)
             if improved:
                 decision.improved.set(True)
@@ -405,7 +415,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                         trainer.train_epoch(
                             data, target, pending[0], batch,
                             epoch=pending[1], lr_scale=pending[2],
-                            ctr_base=pending[3], sync=False)
+                            ctr_base=pending[3], sync=False,
+                            lr_scale_bias=pending[4])
                         pending = None
                     trainer.write_back()
 
