@@ -3,6 +3,8 @@ the procedural glyph classifier trained FROM DISK through the streaming
 on-the-fly image loader — the sample-level consumer of the loader
 family."""
 
+import os
+
 import numpy as np
 
 from znicz_tpu import prng
@@ -60,5 +62,36 @@ class TestKanjiSample:
             assert np.isfinite(ms[-1]["validation_loss"])
             assert ms[-1]["validation_err_pct"] <= ms[0][
                 "validation_err_pct"]
+        finally:
+            root.kanji.update(saved)
+
+    def test_streaming_snapshot_resume(self, tmp_path, monkeypatch):
+        """Snapshots work through the STREAMING fused path too: the
+        epoch loop's snapshot block drives StreamTrainer (pending tail
+        applied via the loader, weights written back), and a resumed
+        run continues from the stored epoch."""
+        from znicz_tpu.snapshotter import SnapshotterToFile
+
+        saved, data_dir = self._small(tmp_path)
+        monkeypatch.chdir(tmp_path)
+        try:
+            prng.seed_all(7)
+            wf = kanji.run(device=Device.create("xla"), epochs=2,
+                           fused=True, data_dir=data_dir,
+                           snapshotter_config={"interval": 1})
+            snap = wf.snapshotter.last_path
+            assert snap and os.path.exists(snap)
+
+            prng.seed_all(7)
+            wf2 = kanji.KanjiWorkflow(data_dir=data_dir)
+            wf2.initialize(device=Device.create("xla"))
+            meta = SnapshotterToFile.load(wf2, snap)
+            assert int(meta["epoch_number"]) == 2
+            wf2.train(fused=True, max_epochs=4)
+            ms = wf2.decision.epoch_metrics
+            assert ms and ms[-1]["epoch"] >= 3   # continued, not reset
+            np.testing.assert_allclose(
+                ms[-1]["train_loss"],
+                min(m["train_loss"] for m in ms), rtol=1.0)
         finally:
             root.kanji.update(saved)
