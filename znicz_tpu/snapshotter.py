@@ -159,6 +159,11 @@ class SnapshotterToFile(SnapshotterBase):
         self.epoch_end(improved)
 
     def save(self, tag: str) -> str:
+        """Crash-safe save: both files are written to temp names and
+        os.replace()d into place, so an unclean death (SIGKILL,
+        preemption — the very case restart-from-snapshot exists for)
+        can never leave a truncated snapshot; at worst the metadata
+        sidecar is one save older than the arrays."""
         os.makedirs(self.directory, exist_ok=True)
         arrays, meta = collect_state(self.workflow)
         base = os.path.join(self.directory, f"{self.prefix}_{tag}.npz")
@@ -166,14 +171,17 @@ class SnapshotterToFile(SnapshotterBase):
             path = f"{base}.{self.compression}"
             buf = io.BytesIO()
             np.savez(buf, **arrays)         # raw; outer codec compresses
-            with _OPENERS[self.compression](path, "wb") as fh:
+            with _OPENERS[self.compression](path + ".tmp", "wb") as fh:
                 fh.write(buf.getbuffer())   # zero-copy view: snapshots
                 #                            can be GBs of params
         else:
             path = base
-            np.savez_compressed(path, **arrays)
-        with open(path + ".json", "w") as fh:
+            with open(path + ".tmp", "wb") as fh:
+                np.savez_compressed(fh, **arrays)
+        with open(path + ".json.tmp", "w") as fh:
             json.dump(meta, fh, default=float)
+        os.replace(path + ".tmp", path)
+        os.replace(path + ".json.tmp", path + ".json")
         self.debug("snapshot → %s", path)
         return path
 
