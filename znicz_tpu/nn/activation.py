@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax.numpy as jnp
 
 from ..ops import activations
 from .nn_units import Forward, GradientDescentBase

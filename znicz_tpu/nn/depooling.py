@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..memory import Vector
 from ..ops import pooling as pool_ops
 from .nn_units import Forward, GradientDescentBase
 

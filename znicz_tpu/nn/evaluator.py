@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax.numpy as jnp
 
 from ..accelerated_units import AcceleratedUnit
 from ..memory import Vector

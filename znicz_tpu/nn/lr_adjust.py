@@ -13,7 +13,6 @@ a recompile."""
 
 from __future__ import annotations
 
-import numpy as np
 
 from ..units import Unit
 

@@ -18,7 +18,6 @@ per-buffer kernel launches; the XLA tier fuses equivalently under jit.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

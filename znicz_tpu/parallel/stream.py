@@ -22,7 +22,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..loader.streaming import BatchPrefetcher, StreamingLoader
-from . import mesh as mesh_lib
 from .fused import FusedTrainer, eval_minibatch, train_minibatch
 
 
