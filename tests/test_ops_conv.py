@@ -221,3 +221,86 @@ def test_dropout_mask_identical_and_backward():
                                x * mask_np)
     np.testing.assert_allclose(dropout.np_gd_dropout(err, mask_np),
                                mask_np)
+
+
+class TestRandomGeometrySweep:
+    """Seeded random conv/pool geometries beyond the hand-picked cases:
+    numpy golden vs XLA vs jax.grad over ~a dozen configurations each —
+    the backend-equivalence contract at fuzz breadth (SURVEY.md §4)."""
+
+    def test_conv_fwd_and_grads(self):
+        gen = np.random.default_rng(20260730)
+        for _ in range(10):
+            b = int(gen.integers(1, 4))
+            h = int(gen.integers(4, 13))
+            w_ = int(gen.integers(4, 13))
+            cin = int(gen.integers(1, 6))
+            cout = int(gen.integers(1, 7))
+            kh = int(gen.integers(1, min(h, 5) + 1))
+            kw = int(gen.integers(1, min(w_, 5) + 1))
+            stride = int(gen.integers(1, 3))
+            # padding < kernel: every real conv config satisfies this,
+            # and padding ≥ kernel aborts XLA-CPU's transposed-conv
+            # compiler (negative padding in the lhs transpose)
+            pad = int(gen.integers(0, min(kh, kw)))
+            x = gen.standard_normal((b, h, w_, cin)).astype(np.float32)
+            wgt = gen.standard_normal((kh, kw, cin, cout)).astype(
+                np.float32) * 0.2
+            y_np = conv.np_conv2d(x, wgt, stride, pad)
+            y_x = np.asarray(conv.xla_conv2d(jnp.asarray(x),
+                                             jnp.asarray(wgt), stride,
+                                             pad))
+            np.testing.assert_allclose(
+                y_x, y_np, rtol=2e-4, atol=2e-5,
+                err_msg=f"fwd {b,h,w_,cin,cout,kh,kw,stride,pad}")
+            err = gen.standard_normal(y_np.shape).astype(np.float32)
+            gw_np = conv.np_conv2d_grad_weights(x, err, wgt.shape,
+                                                stride, pad)
+            gx_np = conv.np_conv2d_grad_input(err, wgt, x.shape,
+                                              stride, pad)
+            # jax.grad cross-check: the hand-written grads must be the
+            # true derivative
+            loss = lambda xx, ww: jnp.sum(          # noqa: E731
+                conv.xla_conv2d(xx, ww, stride, pad)
+                * jnp.asarray(err))
+            gx_j = np.asarray(jax.grad(loss, 0)(jnp.asarray(x),
+                                                jnp.asarray(wgt)))
+            gw_j = np.asarray(jax.grad(loss, 1)(jnp.asarray(x),
+                                                jnp.asarray(wgt)))
+            np.testing.assert_allclose(
+                gx_np, gx_j, rtol=3e-4, atol=3e-5,
+                err_msg=f"gx {b,h,w_,cin,cout,kh,kw,stride,pad}")
+            np.testing.assert_allclose(
+                gw_np, gw_j, rtol=3e-4, atol=3e-5,
+                err_msg=f"gw {b,h,w_,cin,cout,kh,kw,stride,pad}")
+
+    def test_pool_fwd_and_scatter(self):
+        from znicz_tpu.ops import pooling as pool
+        gen = np.random.default_rng(123456)
+        for _ in range(12):
+            b = int(gen.integers(1, 4))
+            h = int(gen.integers(3, 12))
+            w_ = int(gen.integers(3, 12))
+            c = int(gen.integers(1, 6))
+            kh = int(gen.integers(1, min(h, 4) + 1))
+            kw = int(gen.integers(1, min(w_, 4) + 1))
+            stride = int(gen.integers(1, 4))
+            pad = int(gen.integers(0, min(kh, kw)))
+            x = gen.standard_normal((b, h, w_, c)).astype(np.float32)
+            y_np, off_np = pool.np_max_pooling(x, (kh, kw),
+                                               (stride, stride), pad)
+            y_x, off_x = pool.max_pooling(jnp.asarray(x), (kh, kw),
+                                          (stride, stride), pad)
+            np.testing.assert_allclose(
+                np.asarray(y_x), y_np, rtol=1e-6, atol=1e-7,
+                err_msg=f"pool {b,h,w_,c,kh,kw,stride,pad}")
+            err = gen.standard_normal(y_np.shape).astype(np.float32)
+            gx_np = pool.np_gd_max_pooling(err, off_np, x.shape,
+                                           (kh, kw), (stride, stride),
+                                           pad)
+            gx_x = pool.gd_max_pooling(jnp.asarray(err),
+                                       jnp.asarray(off_np), x.shape,
+                                       (kh, kw), (stride, stride), pad)
+            np.testing.assert_allclose(
+                np.asarray(gx_x), gx_np, rtol=1e-6, atol=1e-7,
+                err_msg=f"gd_pool {b,h,w_,c,kh,kw,stride,pad}")
