@@ -1,0 +1,120 @@
+// znicz-tpu native .znr record reader — the data-plane half of the
+// streaming loader (SURVEY.md §2.2 "Znicz loaders" row; the reference's
+// LMDB row was served by a C library too, via the lmdb bindings).
+//
+// Split of responsibilities: Python (loader/records.py) parses the
+// header it wrote and hands this library the resolved geometry; this
+// library owns the hot path — mmap the shard once and gather minibatch
+// rows with a multithreaded copy, entirely off the GIL so decode/
+// prefetch threads keep feeding the device.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+//
+// Build: make -C native      (produces libznr_reader.so)
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Shard {
+  const char* base = nullptr;   // whole-file mapping
+  size_t map_len = 0;
+  int64_t n = 0;
+  int64_t data_at = 0;          // byte offset of the data block
+  int64_t labels_at = 0;        // byte offset of the label block
+  int64_t row_bytes = 0;        // one data row
+  int64_t label_row_bytes = 0;  // one label row
+};
+
+void copy_rows(const char* src_base, int64_t src_off, int64_t row_bytes,
+               const int64_t* idx, int64_t lo, int64_t hi, char* out) {
+  for (int64_t i = lo; i < hi; ++i) {
+    std::memcpy(out + i * row_bytes,
+                src_base + src_off + idx[i] * row_bytes,
+                static_cast<size_t>(row_bytes));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open + mmap a shard with pre-resolved geometry.  Returns nullptr on
+// any inconsistency (the caller already validated the header, but the
+// file on disk must actually be big enough for the declared blocks).
+void* znr_open(const char* path, int64_t n, int64_t data_at,
+               int64_t labels_at, int64_t row_bytes,
+               int64_t label_row_bytes) {
+  if (n < 0 || data_at < 0 || labels_at < data_at || row_bytes <= 0 ||
+      label_row_bytes < 0)
+    return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { ::close(fd); return nullptr; }
+  const int64_t need = labels_at + n * label_row_bytes;
+  if (data_at + n * row_bytes > labels_at ||
+      st.st_size < need) { ::close(fd); return nullptr; }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);                       // mapping keeps the file alive
+  if (map == MAP_FAILED) return nullptr;
+  auto* s = new Shard;
+  s->base = static_cast<const char*>(map);
+  s->map_len = static_cast<size_t>(st.st_size);
+  s->n = n;
+  s->data_at = data_at;
+  s->labels_at = labels_at;
+  s->row_bytes = row_bytes;
+  s->label_row_bytes = label_row_bytes;
+  return s;
+}
+
+// Gather k rows into caller buffers; out_labels may be null (label IO
+// skipped — the autoencoder streaming contract).  Returns 0, or -1 on
+// any out-of-range index (nothing partial is trusted then).
+int znr_gather(void* handle, const int64_t* idx, int64_t k,
+               char* out_data, char* out_labels, int n_threads) {
+  auto* s = static_cast<Shard*>(handle);
+  if (!s || k < 0) return -1;
+  for (int64_t i = 0; i < k; ++i)
+    if (idx[i] < 0 || idx[i] >= s->n) return -1;
+  const int64_t per_thread_min = 8;
+  int64_t want = (k + per_thread_min - 1) / per_thread_min;
+  int nt = static_cast<int>(
+      std::min<int64_t>(want, n_threads > 0 ? n_threads : 1));
+  if (nt <= 1 || k < 2 * per_thread_min) {
+    copy_rows(s->base, s->data_at, s->row_bytes, idx, 0, k, out_data);
+  } else {
+    std::vector<std::thread> ts;
+    const int64_t chunk = (k + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      const int64_t lo = t * chunk;
+      const int64_t hi = std::min<int64_t>(lo + chunk, k);
+      if (lo >= hi) break;
+      ts.emplace_back(copy_rows, s->base, s->data_at, s->row_bytes,
+                      idx, lo, hi, out_data);
+    }
+    for (auto& t : ts) t.join();
+  }
+  if (out_labels && s->label_row_bytes > 0)
+    copy_rows(s->base, s->labels_at, s->label_row_bytes, idx, 0, k,
+              out_labels);
+  return 0;
+}
+
+void znr_close(void* handle) {
+  auto* s = static_cast<Shard*>(handle);
+  if (!s) return;
+  munmap(const_cast<char*>(s->base), s->map_len);
+  delete s;
+}
+
+}  // extern "C"

@@ -557,3 +557,58 @@ class TestAugmentation:
         unit_served = np.array(ld.minibatch_data.mem)
         (x, _t), = list(BatchPrefetcher(ld, [rows], epoch=3))
         np.testing.assert_array_equal(unit_served, np.asarray(x))
+
+
+class TestNativeRecordReader:
+    """C++ .znr data plane (native/znr_reader.cpp): byte-identical to
+    the numpy memmap fallback, label-skip honored, bad indices loud."""
+
+    def test_parity_with_numpy_path(self, tmp_path, monkeypatch):
+        from znicz_tpu.loader import records as rec
+        gen = prng.get("znr_native")
+        data = np.asarray(gen.normal(size=(40, 7, 5, 2)), np.float32)
+        labels = np.asarray(gen.normal(size=(40, 3)), np.float32)
+        p = write_records(str(tmp_path / "n.znr"), data, labels)[0]
+        rf = rec.RecordFile(p)
+        idx = [0, 39, 7, 7, 21]
+        if rf._h:      # native available (compiler present)
+            d_n, l_n = rf.read_batch(idx)
+            x_n = rf.read_data(idx)
+        else:
+            pytest.skip("native reader unavailable")
+        # scalar labels + negative indices through the NATIVE path
+        p2 = write_records(str(tmp_path / "s.znr"), data,
+                           np.arange(40, dtype=np.int32))[0]
+        rf3 = rec.RecordFile(p2)
+        assert rf3._h
+        _, l3 = rf3.read_batch(idx)
+        np.testing.assert_array_equal(l3, np.asarray(idx, np.int32))
+        _, lneg = rf3.read_batch([-1, -40])
+        np.testing.assert_array_equal(lneg, [39, 0])
+        # fancy index forms keep numpy semantics (fallback dispatch)
+        mask = np.zeros(40, bool)
+        mask[[2, 5]] = True
+        dm, lm = rf3.read_batch(mask)
+        np.testing.assert_array_equal(lm, [2, 5])
+        # force the numpy fallback on a fresh handle
+        monkeypatch.setattr(rec, "_native_lib", None)
+        monkeypatch.setattr(rec, "_native_tried", True)
+        rf2 = rec.RecordFile(p)
+        assert rf2._h is None
+        d_p, l_p = rf2.read_batch(idx)
+        np.testing.assert_array_equal(d_n, d_p)
+        np.testing.assert_array_equal(l_n, l_p)
+        np.testing.assert_array_equal(x_n, d_p)
+
+    def test_bad_index_rejected(self, tmp_path):
+        from znicz_tpu.loader import records as rec
+        data = np.zeros((4, 2, 2, 1), np.float32)
+        p = write_records(str(tmp_path / "b.znr"), data,
+                          np.zeros(4, np.int32))[0]
+        rf = rec.RecordFile(p)
+        if not rf._h:
+            pytest.skip("native reader unavailable")
+        with pytest.raises(IndexError):
+            rf.read_batch([0, 4])
+        with pytest.raises(IndexError):
+            rf.read_batch([-5])          # below -n: invalid either path

@@ -28,13 +28,71 @@ is just a list of shards.
 
 from __future__ import annotations
 
+import ctypes
 import json
 import os
+import subprocess
 
 import numpy as np
 
 _MAGIC = b"ZNR1"
 _ALIGN = 64
+
+#: the C++ data plane (native/znr_reader.cpp): mmap + multithreaded
+#: row gather entirely off the GIL.  Loaded lazily and optional — the
+#: numpy memmap path below stays the golden fallback (e.g. when no
+#: compiler is present).  ZNICZ_TPU_NO_NATIVE_IO=1 forces the fallback.
+_native_lib = None
+_native_tried = False
+
+
+def _native() -> ctypes.CDLL | None:
+    global _native_lib, _native_tried
+    if _native_tried:
+        return _native_lib
+    _native_tried = True
+    if os.environ.get("ZNICZ_TPU_NO_NATIVE_IO") == "1":
+        return None
+    try:
+        d = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "native")
+        so = os.path.join(d, "libznr_reader.so")
+        src = os.path.join(d, "znr_reader.cpp")
+        if not os.path.exists(so) or (os.path.exists(src)
+                                      and os.path.getmtime(so)
+                                      < os.path.getmtime(src)):
+            # cross-process build exclusion: concurrent loader workers
+            # must not compile the same .so on top of each other (a
+            # partially written ELF would silently poison the CDLL)
+            import time
+            lock = so + ".lock"
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                try:
+                    subprocess.run(["make", "-C", d,
+                                    "libznr_reader.so"],
+                                   check=True, capture_output=True)
+                finally:
+                    os.close(fd)
+                    os.unlink(lock)
+            except FileExistsError:
+                for _ in range(300):          # wait out the builder
+                    if not os.path.exists(lock):
+                        break
+                    time.sleep(0.1)
+        lib = ctypes.CDLL(so)
+        lib.znr_open.restype = ctypes.c_void_p
+        lib.znr_open.argtypes = [ctypes.c_char_p] + [ctypes.c_int64] * 5
+        lib.znr_gather.restype = ctypes.c_int
+        lib.znr_gather.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int]
+        lib.znr_close.argtypes = [ctypes.c_void_p]
+        _native_lib = lib
+    except Exception:
+        _native_lib = None
+    return _native_lib
 
 
 def _align(n: int) -> int:
@@ -151,19 +209,74 @@ class RecordFile:
             self.labels = self.labels.reshape(self.n)
         else:
             self.labels = self.labels.reshape(self.n, *self.label_shape)
+        # native data plane (optional): C++ mmap + threaded row gather
+        self._row_bytes = row * self.data_dtype.itemsize
+        self._label_row_bytes = lrow * self.label_dtype.itemsize
+        self._h = None
+        lib = _native()
+        if lib is not None:
+            self._h = lib.znr_open(
+                path.encode(), self.n, data_at, labels_at,
+                self._row_bytes, self._label_row_bytes)
 
     def __len__(self) -> int:
         return self.n
 
+    def _native_gather(self, idx: np.ndarray, want_labels: bool):
+        lib = _native()
+        k = len(idx)
+        idx64 = np.ascontiguousarray(idx, np.int64)
+        data = np.empty((k, *self.data_shape), self.data_dtype)
+        labels = (np.empty((k, *self.label_shape), self.label_dtype)
+                  if want_labels else None)
+        rc = lib.znr_gather(
+            self._h, idx64.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)), k,
+            data.ctypes.data_as(ctypes.c_char_p),
+            labels.ctypes.data_as(ctypes.c_char_p)
+            if labels is not None else None,
+            min(8, max(1, os.cpu_count() or 1)))
+        if rc != 0:
+            raise IndexError(f"{self.path}: row index out of range")
+        return data, labels
+
+    def _native_idx(self, idx: np.ndarray):
+        """Index forms the native fast path serves: 1-D integer rows
+        (negatives resolved).  Anything fancier (bool masks, 2-D index
+        arrays) keeps numpy's semantics via the fallback — the two
+        paths must never MEAN different things for the same input."""
+        if self._h is None or idx.ndim != 1 \
+                or not np.issubdtype(idx.dtype, np.integer):
+            return None
+        return np.where(idx < 0, idx + self.n, idx)
+
     def read_batch(self, indices) -> tuple[np.ndarray, np.ndarray]:
         """Materialized (copied) rows — safe to mutate / device_put."""
         idx = np.asarray(indices)
+        nidx = self._native_idx(idx)
+        if nidx is not None:
+            return self._native_gather(nidx, want_labels=True)
         return np.asarray(self.data[idx]), np.asarray(self.labels[idx])
 
     def read_data(self, indices) -> np.ndarray:
         """Data rows only — the label block is never touched (mmap pages
         stay cold), for consumers that reconstruct the input."""
-        return np.asarray(self.data[np.asarray(indices)])
+        idx = np.asarray(indices)
+        nidx = self._native_idx(idx)
+        if nidx is not None:
+            return self._native_gather(nidx, want_labels=False)[0]
+        return np.asarray(self.data[idx])
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            _native().znr_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def write_records(path: str, data: np.ndarray, labels: np.ndarray,
