@@ -1,0 +1,47 @@
+// Shared chunked-thread fan-out for the native components — ONE copy
+// of the spawn/join pattern (znicz_infer.cpp batch kernels,
+// znr_reader.cpp row gather), with a work threshold so small calls
+// stay serial: spawning threads costs tens of microseconds, which only
+// amortizes when a call carries real work.
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace znicz {
+
+// Run fn(lo, hi) over [0, n) across up to 8 threads.  `row_work` is a
+// per-row cost proxy (flops or bytes); the thread count is capped so
+// every thread gets at least ~64k units — below that the call runs
+// serially, preserving the latency of small-batch inference.
+inline void parallel_chunks(
+    int64_t n, int64_t row_work,
+    const std::function<void(int64_t, int64_t)>& fn) {
+  constexpr int64_t kMinWorkPerThread = 1 << 16;
+  const unsigned hw = std::thread::hardware_concurrency();
+  const int64_t max_threads = hw ? std::min(hw, 8u) : 1;
+  const int64_t by_work =
+      row_work > 0 ? std::max<int64_t>(1, (n * row_work)
+                                              / kMinWorkPerThread)
+                   : 1;
+  const int nt = static_cast<int>(
+      std::min(n, std::min(max_threads, by_work)));
+  if (nt <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  const int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min<int64_t>(n, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(fn, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace znicz

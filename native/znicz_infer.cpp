@@ -15,8 +15,11 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <functional>
 #include <string>
 #include <vector>
+
+#include "parallel.h"
 
 namespace {
 
@@ -107,12 +110,27 @@ void act_inplace(uint32_t a, std::vector<float>& v) {
   for (auto& x : v) x = apply_act(a, x);
 }
 
+// ---- batch-parallel driver ------------------------------------------------
+// Every layer kernel below writes a disjoint output slice per batch
+// row, so the batch loop threads trivially and results stay
+// BIT-IDENTICAL to the serial order (per-row float op order is
+// unchanged).  The reference engines leaned on threaded BLAS for the
+// same effect.  `row_work` = per-row flop proxy: small layers stay
+// serial (parallel.h threshold) so latency-sensitive small-batch
+// inference never pays thread spawn costs.
+void parallel_batch(int64_t n, int64_t row_work,
+                    const std::function<void(int64_t)>& row) {
+  znicz::parallel_chunks(n, row_work, [&row](int64_t lo, int64_t hi) {
+    for (int64_t b = lo; b < hi; ++b) row(b);
+  });
+}
+
 // ---- layer forward kernels (plain CPU; NHWC) ------------------------------
 void fc_forward(const Layer& L, const std::vector<float>& in, Shape& s,
                 std::vector<float>& out) {
   const int64_t fin = L.p[0], fout = L.p[1], batch = s.n;
   out.assign(batch * fout, 0.0f);
-  for (int64_t b = 0; b < batch; ++b) {
+  parallel_batch(batch, fin * fout, [&](int64_t b) {
     const float* x = in.data() + b * fin;
     float* y = out.data() + b * fout;
     if (!L.b.empty()) std::memcpy(y, L.b.data(), fout * sizeof(float));
@@ -122,7 +140,7 @@ void fc_forward(const Layer& L, const std::vector<float>& in, Shape& s,
       const float* wrow = L.w.data() + i * fout;  // (in, out) layout
       for (int64_t j = 0; j < fout; ++j) y[j] += xi * wrow[j];
     }
-  }
+  });
   s = {batch, 1, 1, fout};
 }
 
@@ -133,7 +151,8 @@ void conv_forward(const Layer& L, const std::vector<float>& in, Shape& s,
   const int64_t oh = (s.h + 2 * ph - kh) / sh + 1;
   const int64_t ow = (s.w + 2 * pw - kw) / sw + 1;
   out.assign(s.n * oh * ow * cout, 0.0f);
-  for (int64_t b = 0; b < s.n; ++b)
+  parallel_batch(s.n, oh * ow * cout * kh * kw * cin,
+                 [&](int64_t b) {
     for (int64_t oy = 0; oy < oh; ++oy)
       for (int64_t ox = 0; ox < ow; ++ox) {
         float* y = out.data() + ((b * oh + oy) * ow + ox) * cout;
@@ -158,6 +177,7 @@ void conv_forward(const Layer& L, const std::vector<float>& in, Shape& s,
           }
         }
       }
+  });
   s = {s.n, oh, ow, cout};
 }
 
@@ -171,7 +191,7 @@ void pool_forward(const Layer& L, bool avg, const std::vector<float>& in,
   out.assign(s.n * oh * ow * s.c, 0.0f);
   if (offsets) offsets->assign(out.size(), 0);
   const float inv_area = 1.0f / (kh * kw);
-  for (int64_t b = 0; b < s.n; ++b)
+  parallel_batch(s.n, oh * ow * s.c * kh * kw, [&](int64_t b) {
     for (int64_t oy = 0; oy < oh; ++oy)
       for (int64_t ox = 0; ox < ow; ++ox)
         for (int64_t c = 0; c < s.c; ++c) {
@@ -198,6 +218,7 @@ void pool_forward(const Layer& L, bool avg, const std::vector<float>& in,
           out[o] = avg ? best * inv_area : best;
           if (offsets) (*offsets)[o] = slot;
         }
+  });
   s = {s.n, oh, ow, s.c};
 }
 
@@ -212,7 +233,8 @@ void deconv_forward(const Layer& L, const std::vector<float>& in,
     for (int64_t i = 0; i < s.n * oh * ow; ++i)
       std::memcpy(out.data() + i * cout, L.b.data(),
                   cout * sizeof(float));
-  for (int64_t b = 0; b < s.n; ++b)
+  parallel_batch(s.n, s.h * s.w * cin * kh * kw * cout,
+                 [&](int64_t b) {
     for (int64_t iy = 0; iy < s.h; ++iy)
       for (int64_t ix = 0; ix < s.w; ++ix) {
         const float* x = in.data() + ((b * s.h + iy) * s.w + ix) * cin;
@@ -235,6 +257,7 @@ void deconv_forward(const Layer& L, const std::vector<float>& in,
           }
         }
       }
+  });
   s = {s.n, oh, ow, cout};
 }
 
@@ -245,7 +268,7 @@ void depool_forward(const Layer& L, const std::vector<float>& in,
   const int kw = L.p[1];
   const int sh = L.p[4], sw = L.p[5], ph = L.p[6], pw = L.p[7];
   out.assign(pool_in.size(), 0.0f);
-  for (int64_t b = 0; b < s.n; ++b)
+  parallel_batch(s.n, s.h * s.w * s.c, [&](int64_t b) {
     for (int64_t oy = 0; oy < s.h; ++oy)
       for (int64_t ox = 0; ox < s.w; ++ox)
         for (int64_t c = 0; c < s.c; ++c) {
@@ -258,6 +281,7 @@ void depool_forward(const Layer& L, const std::vector<float>& in,
           out[((b * pool_in.h + iy) * pool_in.w + ix) * pool_in.c + c] +=
               in[o];
         }
+  });
   s = pool_in;
 }
 
@@ -267,7 +291,7 @@ void kohonen_forward(const Layer& L, const std::vector<float>& in,
   // same head convention as the classifier paths.
   const int64_t n_neurons = L.p[0], feats = L.p[1], batch = s.n;
   out.assign(batch * n_neurons, 0.0f);
-  for (int64_t b = 0; b < batch; ++b) {
+  parallel_batch(batch, n_neurons * feats, [&](int64_t b) {
     const float* x = in.data() + b * feats;
     for (int64_t i = 0; i < n_neurons; ++i) {
       const float* wi = L.w.data() + i * feats;
@@ -278,7 +302,7 @@ void kohonen_forward(const Layer& L, const std::vector<float>& in,
       }
       out[b * n_neurons + i] = -acc;
     }
-  }
+  });
   s = Shape{batch, 1, 1, n_neurons};
 }
 
@@ -336,47 +360,46 @@ void* zn_load(const char* path) {
   const uint64_t max_floats =
       fsize > 0 ? static_cast<uint64_t>(fsize) / 4 : 0;
   Model* m = nullptr;
-  try {
+  bool failed = false;     // single fclose below (one cleanup path —
+  try {                    // also quiets GCC's use-after-free heuristic)
     char magic[4];
-    if (std::fread(magic, 1, 4, f) != 4 ||
-        std::memcmp(magic, "ZNN1", 4) != 0) {
-      std::fclose(f);
-      return nullptr;
-    }
     uint32_t n_layers = 0;
-    if (std::fread(&n_layers, 4, 1, f) != 1 || n_layers > 4096) {
-      std::fclose(f);
-      return nullptr;
-    }
-    m = new Model();
-    m->layers.resize(n_layers);
-    for (auto& L : m->layers) {
-      uint64_t wn = 0, bn = 0;
-      bool ok = std::fread(&L.kind, 4, 1, f) == 1 &&
-                std::fread(&L.act, 4, 1, f) == 1 &&
-                std::fread(L.p, 4, 8, f) == 8 &&
-                std::fread(&wn, 8, 1, f) == 1 && wn <= max_floats;
-      if (ok) {
-        L.w.resize(wn);
-        ok = wn == 0 || std::fread(L.w.data(), 4, wn, f) == wn;
-      }
-      if (ok) ok = std::fread(&bn, 8, 1, f) == 1 && bn <= max_floats;
-      if (ok) {
-        L.b.resize(bn);
-        ok = bn == 0 || std::fread(L.b.data(), 4, bn, f) == bn;
-      }
-      if (!ok) {
-        std::fclose(f);
-        delete m;
-        return nullptr;
+    if (std::fread(magic, 1, 4, f) != 4 ||
+        std::memcmp(magic, "ZNN1", 4) != 0 ||
+        std::fread(&n_layers, 4, 1, f) != 1 || n_layers > 4096) {
+      failed = true;
+    } else {
+      m = new Model();
+      m->layers.resize(n_layers);
+      for (auto& L : m->layers) {
+        uint64_t wn = 0, bn = 0;
+        bool ok = std::fread(&L.kind, 4, 1, f) == 1 &&
+                  std::fread(&L.act, 4, 1, f) == 1 &&
+                  std::fread(L.p, 4, 8, f) == 8 &&
+                  std::fread(&wn, 8, 1, f) == 1 && wn <= max_floats;
+        if (ok) {
+          L.w.resize(wn);
+          ok = wn == 0 || std::fread(L.w.data(), 4, wn, f) == wn;
+        }
+        if (ok) ok = std::fread(&bn, 8, 1, f) == 1 && bn <= max_floats;
+        if (ok) {
+          L.b.resize(bn);
+          ok = bn == 0 || std::fread(L.b.data(), 4, bn, f) == bn;
+        }
+        if (!ok) {
+          failed = true;
+          break;
+        }
       }
     }
   } catch (...) {
-    std::fclose(f);
+    failed = true;
+  }
+  std::fclose(f);
+  if (failed) {
     delete m;
     return nullptr;
   }
-  std::fclose(f);
   return m;
 }
 

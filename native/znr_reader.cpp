@@ -14,13 +14,13 @@
 
 #include <cstdint>
 #include <cstring>
-#include <thread>
-#include <vector>
 
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
+
+#include "parallel.h"
 
 namespace {
 
@@ -86,24 +86,12 @@ int znr_gather(void* handle, const int64_t* idx, int64_t k,
   if (!s || k < 0) return -1;
   for (int64_t i = 0; i < k; ++i)
     if (idx[i] < 0 || idx[i] >= s->n) return -1;
-  const int64_t per_thread_min = 8;
-  int64_t want = (k + per_thread_min - 1) / per_thread_min;
-  int nt = static_cast<int>(
-      std::min<int64_t>(want, n_threads > 0 ? n_threads : 1));
-  if (nt <= 1 || k < 2 * per_thread_min) {
-    copy_rows(s->base, s->data_at, s->row_bytes, idx, 0, k, out_data);
-  } else {
-    std::vector<std::thread> ts;
-    const int64_t chunk = (k + nt - 1) / nt;
-    for (int t = 0; t < nt; ++t) {
-      const int64_t lo = t * chunk;
-      const int64_t hi = std::min<int64_t>(lo + chunk, k);
-      if (lo >= hi) break;
-      ts.emplace_back(copy_rows, s->base, s->data_at, s->row_bytes,
-                      idx, lo, hi, out_data);
-    }
-    for (auto& t : ts) t.join();
-  }
+  (void)n_threads;   // cap now lives in parallel.h (shared policy)
+  znicz::parallel_chunks(k, s->row_bytes,
+                         [&](int64_t lo, int64_t hi) {
+    copy_rows(s->base, s->data_at, s->row_bytes, idx, lo, hi,
+              out_data);
+  });
   if (out_labels && s->label_row_bytes > 0)
     copy_rows(s->base, s->labels_at, s->label_row_bytes, idx, 0, k,
               out_labels);

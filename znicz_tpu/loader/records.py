@@ -66,12 +66,14 @@ def _native() -> ctypes.CDLL | None:
             # partially written ELF would silently poison the CDLL)
             import time
             lock = so + ".lock"
+
+            def build():
+                subprocess.run(["make", "-C", d, "libznr_reader.so"],
+                               check=True, capture_output=True)
             try:
                 fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
                 try:
-                    subprocess.run(["make", "-C", d,
-                                    "libznr_reader.so"],
-                                   check=True, capture_output=True)
+                    build()
                 finally:
                     os.close(fd)
                     os.unlink(lock)
@@ -79,7 +81,21 @@ def _native() -> ctypes.CDLL | None:
                 for _ in range(300):          # wait out the builder
                     if not os.path.exists(lock):
                         break
+                    try:                      # stale lock: a builder
+                        if (time.time()       # killed mid-make leaves
+                                - os.path.getmtime(lock)) > 60:
+                            os.unlink(lock)   # it forever — take over
+                            break
+                    except OSError:
+                        break
                     time.sleep(0.1)
+                # re-verify freshness: the other builder may have died
+                # before finishing; never CDLL a stale/partial .so
+                if not os.path.exists(so) or (
+                        os.path.exists(src)
+                        and os.path.getmtime(so)
+                        < os.path.getmtime(src)):
+                    build()
         lib = ctypes.CDLL(so)
         lib.znr_open.restype = ctypes.c_void_p
         lib.znr_open.argtypes = [ctypes.c_char_p] + [ctypes.c_int64] * 5
