@@ -13,16 +13,19 @@
 
 namespace znicz {
 
-// Run fn(lo, hi) over [0, n) across up to 8 threads.  `row_work` is a
-// per-row cost proxy (flops or bytes); the thread count is capped so
-// every thread gets at least ~64k units — below that the call runs
-// serially, preserving the latency of small-batch inference.
+// Run fn(lo, hi) over [0, n) across up to `cap` threads (≤8, and never
+// more than the hardware offers).  `row_work` is a per-row cost proxy
+// (flops or bytes); the thread count is capped so every thread gets at
+// least ~64k units — below that the call runs serially, preserving the
+// latency of small-batch inference.
 inline void parallel_chunks(
     int64_t n, int64_t row_work,
-    const std::function<void(int64_t, int64_t)>& fn) {
+    const std::function<void(int64_t, int64_t)>& fn, int cap = 8) {
   constexpr int64_t kMinWorkPerThread = 1 << 16;
   const unsigned hw = std::thread::hardware_concurrency();
-  const int64_t max_threads = hw ? std::min(hw, 8u) : 1;
+  const int64_t hw_cap = hw ? std::min(hw, 8u) : 1;
+  const int64_t max_threads =
+      cap > 0 ? std::min<int64_t>(hw_cap, cap) : 1;
   const int64_t by_work =
       row_work > 0 ? std::max<int64_t>(1, (n * row_work)
                                               / kMinWorkPerThread)

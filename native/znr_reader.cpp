@@ -86,12 +86,16 @@ int znr_gather(void* handle, const int64_t* idx, int64_t k,
   if (!s || k < 0) return -1;
   for (int64_t i = 0; i < k; ++i)
     if (idx[i] < 0 || idx[i] >= s->n) return -1;
-  (void)n_threads;   // cap now lives in parallel.h (shared policy)
-  znicz::parallel_chunks(k, s->row_bytes,
-                         [&](int64_t lo, int64_t hi) {
-    copy_rows(s->base, s->data_at, s->row_bytes, idx, lo, hi,
-              out_data);
-  });
+  // n_threads is the CALLER'S upper bound (e.g. 1 = keep gathers
+  // serial when several prefetch workers gather concurrently); the
+  // shared policy in parallel.h applies its own hardware/work caps
+  znicz::parallel_chunks(
+      k, s->row_bytes,
+      [&](int64_t lo, int64_t hi) {
+        copy_rows(s->base, s->data_at, s->row_bytes, idx, lo, hi,
+                  out_data);
+      },
+      n_threads);
   if (out_labels && s->label_row_bytes > 0)
     copy_rows(s->base, s->labels_at, s->label_row_bytes, idx, 0, k,
               out_labels);

@@ -612,3 +612,93 @@ class TestNativeRecordReader:
             rf.read_batch([0, 4])
         with pytest.raises(IndexError):
             rf.read_batch([-5])          # below -n: invalid either path
+
+
+class TestDeviceAugmentation:
+    """RandomCropFlip.device_apply: the resident fused path's on-device
+    twin of the host augmentation — same counter-RNG hash, same
+    pixels."""
+
+    def test_bit_identical_to_host(self):
+        import jax.numpy as jnp
+
+        from znicz_tpu.loader import RandomCropFlip
+        gen = prng.get("devaug")
+        data = np.asarray(gen.normal(size=(16, 12, 10, 3)), np.float32)
+        rows = np.asarray([3, 0, 11, 7, 15, 3, 8, 2, 9, 1, 4, 5, 6,
+                           10, 12, 13])
+        aug = RandomCropFlip((8, 8), mirror=True, seed=21)
+        host = aug.apply(data, rows, epoch=4,
+                         is_train=np.ones(len(rows), bool))
+        dev = np.asarray(aug.device_apply(
+            jnp.asarray(data), jnp.asarray(rows), jnp.uint32(4),
+            train=True))
+        np.testing.assert_array_equal(host, dev)
+        # eval: deterministic center crop
+        ev = np.asarray(aug.device_apply(
+            jnp.asarray(data), jnp.asarray(rows), 0, train=False))
+        np.testing.assert_array_equal(ev, data[:, 2:10, 1:9])
+
+    def test_resident_device_augment_equals_streaming_host(self,
+                                                           tmp_path):
+        """THE cross-path contract: FusedTrainer(augment=...) over the
+        resident decode-size tensor trains bit-identically to
+        StreamTrainer over a RecordLoader carrying the same policy —
+        one augmentation recipe, device or host.  Pixels are
+        bit-identical (test above); the trainer comparison is
+        tight-tolerance because XLA fuses the device crop into the
+        conv, which may re-vectorize the accumulation (ULP-level)."""
+        import jax.numpy as jnp
+
+        from znicz_tpu.loader import RandomCropFlip, RecordLoader
+        from znicz_tpu.parallel import FusedTrainer
+        from znicz_tpu.parallel.fused import LayerSpec, ModelSpec
+        from znicz_tpu.parallel.stream import StreamTrainer
+
+        gen = prng.get("devaug2")
+        n, big, crop, classes = 48, 12, 8, 5
+        data = np.asarray(gen.normal(size=(n, big, big, 2)), np.float32)
+        labels = gen.randint(0, classes, n).astype(np.int32)
+        hyp = (0.05, 0.0, 0.0, 0.9)
+        spec = ModelSpec(layers=(
+            LayerSpec("conv", "tanh", True, hyp, hyp,
+                      (("padding", (1, 1)), ("stride", (1, 1)))),
+            LayerSpec("fc", "linear", True, hyp, hyp)), loss="softmax")
+        params = [(np.asarray(gen.normal(0, 0.2, (3, 3, 2, 4)),
+                              np.float32), np.zeros(4, np.float32)),
+                  (np.asarray(gen.normal(0, 0.1,
+                                         (crop * crop * 4, classes)),
+                              np.float32),
+                   np.zeros(classes, np.float32))]
+        vels = [(np.zeros_like(w), np.zeros_like(b)) for w, b in params]
+        pol = RandomCropFlip((crop, crop), mirror=True, seed=77)
+
+        cp = lambda t: [tuple(np.array(a) for a in p)    # noqa: E731
+                        for p in t]
+        res = FusedTrainer(spec=spec, params=cp(params), vels=cp(vels),
+                           augment=pol)
+        idx = np.arange(n)
+        for ep in range(2):
+            rm = res.train_epoch(jnp.asarray(data), jnp.asarray(labels),
+                                 idx, 12, epoch=ep)
+
+        paths = write_records(str(tmp_path / "a.znr"), data, labels)
+        sld = RecordLoader(Workflow(name="w"), train_paths=paths,
+                           minibatch_size=12, augment=pol)
+        sld.initialize(NumpyDevice())
+        st = StreamTrainer(spec=spec, params=cp(params), vels=cp(vels),
+                           loader=sld)
+        for ep in range(2):
+            sm = st.train_epoch(None, None, idx, 12, epoch=ep)
+        np.testing.assert_allclose(rm["loss"], sm["loss"], rtol=1e-6)
+        for (rw, rb), (sw, sb) in zip(res.params, st.params):
+            np.testing.assert_allclose(np.asarray(rw), np.asarray(sw),
+                                       rtol=1e-5, atol=1e-7)
+            np.testing.assert_allclose(np.asarray(rb), np.asarray(sb),
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_stream_trainer_rejects_trainer_level_augment(self):
+        from znicz_tpu.loader import RandomCropFlip
+        from znicz_tpu.parallel.stream import StreamTrainer
+        with pytest.raises(ValueError, match="on the StreamingLoader"):
+            StreamTrainer(augment=RandomCropFlip((4, 4)))

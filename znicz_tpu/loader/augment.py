@@ -47,6 +47,46 @@ class RandomCropFlip:
                              f"{sample_shape[:2]}")
         return (h, w, *sample_shape[2:])
 
+    def device_apply(self, x, rows, epoch, train=True):
+        """jnp twin of :meth:`apply` for the RESIDENT fused path: the
+        same counter-RNG draws evaluated on device inside the jitted
+        scan — crop windows BIT-IDENTICAL to the host pipeline's for
+        the same (seed, epoch, global row), with no host round-trip
+        (TPU-first: augmentation rides the scan, not the feed).
+
+        ``train=False`` → deterministic center crop (the eval
+        contract).  Assumes every row is a train row — the fused
+        train_epoch serves train rows only."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import rngbits
+
+        big_h, big_w = int(x.shape[1]), int(x.shape[2])
+        h, w = self.out_hw
+        if (big_h, big_w) == (h, w) and not self.mirror:
+            return x
+        c_top, c_left = (big_h - h) // 2, (big_w - w) // 2
+        if not train:
+            return x[:, c_top:c_top + h, c_left:c_left + w]
+        keys = rngbits.fold(self.seed, jnp.uint32(epoch),
+                            rows.astype(jnp.uint32), xp=jnp)
+        # (B, 3) lanes through the SAME public recipe the host path
+        # draws with — one definition of the hash, two backends
+        u = rngbits.uniform01(keys[:, None], 3, xp=jnp)
+        tops = (u[:, 0] * (big_h - h + 1)).astype(jnp.int32)
+        lefts = (u[:, 1] * (big_w - w + 1)).astype(jnp.int32)
+        flips = (u[:, 2] >= 0.5) if self.mirror \
+            else jnp.zeros((x.shape[0],), bool)
+
+        def one(img, t, le, fl):
+            win = jax.lax.dynamic_slice(
+                img, (t, le) + (0,) * (img.ndim - 2),
+                (h, w) + tuple(img.shape[2:]))
+            return jnp.where(fl, win[:, ::-1], win)
+
+        return jax.vmap(one)(x, tops, lefts, flips)
+
     def apply(self, data: np.ndarray, indices, epoch,
               is_train) -> np.ndarray:
         """Crop/flip a (B, H, W, ...) batch.

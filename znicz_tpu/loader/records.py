@@ -58,44 +58,51 @@ def _native() -> ctypes.CDLL | None:
             os.path.dirname(os.path.abspath(__file__)))), "native")
         so = os.path.join(d, "libznr_reader.so")
         src = os.path.join(d, "znr_reader.cpp")
-        if not os.path.exists(so) or (os.path.exists(src)
-                                      and os.path.getmtime(so)
-                                      < os.path.getmtime(src)):
+
+        def fresh() -> bool:
+            return os.path.exists(so) and not (
+                os.path.exists(src)
+                and os.path.getmtime(so) < os.path.getmtime(src))
+
+        if not fresh():
             # cross-process build exclusion: concurrent loader workers
             # must not compile the same .so on top of each other (a
-            # partially written ELF would silently poison the CDLL)
+            # partially written ELF would silently poison the CDLL).
+            # EVERY build happens under the lock — including take-over
+            # after a stale lock (a builder killed mid-make): the stale
+            # path unlinks and loops back to re-ACQUIRE, never builds
+            # bare.  Freshness is re-checked once the lock is held, so
+            # waiters whose builder finished don't rebuild.
             import time
             lock = so + ".lock"
-
-            def build():
-                subprocess.run(["make", "-C", d, "libznr_reader.so"],
-                               check=True, capture_output=True)
-            try:
-                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            deadline = time.time() + 180
+            while time.time() < deadline:
                 try:
-                    build()
+                    fd = os.open(lock,
+                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                except FileExistsError:
+                    try:
+                        if (time.time()
+                                - os.path.getmtime(lock)) > 120:
+                            os.unlink(lock)   # stale: retry acquire
+                            continue
+                    except OSError:
+                        continue              # vanished: retry acquire
+                    time.sleep(0.1)
+                    if fresh():               # the other builder won
+                        break
+                    continue
+                try:
+                    if not fresh():
+                        subprocess.run(
+                            ["make", "-C", d, "libznr_reader.so"],
+                            check=True, capture_output=True)
                 finally:
                     os.close(fd)
                     os.unlink(lock)
-            except FileExistsError:
-                for _ in range(300):          # wait out the builder
-                    if not os.path.exists(lock):
-                        break
-                    try:                      # stale lock: a builder
-                        if (time.time()       # killed mid-make leaves
-                                - os.path.getmtime(lock)) > 60:
-                            os.unlink(lock)   # it forever — take over
-                            break
-                    except OSError:
-                        break
-                    time.sleep(0.1)
-                # re-verify freshness: the other builder may have died
-                # before finishing; never CDLL a stale/partial .so
-                if not os.path.exists(so) or (
-                        os.path.exists(src)
-                        and os.path.getmtime(so)
-                        < os.path.getmtime(src)):
-                    build()
+                break
+            if not fresh():
+                return None                   # keep the numpy fallback
         lib = ctypes.CDLL(so)
         lib.znr_open.restype = ctypes.c_void_p
         lib.znr_open.argtypes = [ctypes.c_char_p] + [ctypes.c_int64] * 5

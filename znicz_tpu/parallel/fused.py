@@ -577,12 +577,19 @@ class FusedTrainer:
     single-device jit."""
 
     def __init__(self, workflow=None, spec: ModelSpec | None = None,
-                 params=None, vels=None, mesh=None, accum_steps: int = 1):
+                 params=None, vels=None, mesh=None, accum_steps: int = 1,
+                 augment=None):
         if workflow is not None:
             spec, params, vels = extract_model(workflow)
         self.spec = spec
         self.mesh = mesh
         self.workflow = workflow
+        #: optional loader.augment.RandomCropFlip applied ON DEVICE
+        #: inside the epoch scan (device_apply): the resident path's
+        #: ImageNet recipe — data stays at decode size in HBM, crops
+        #: ride the scan.  Bit-identical to the streaming loaders'
+        #: host-side augmentation for the same (seed, epoch, row).
+        self.augment = augment
         #: micro-batch gradient accumulation: gradients of ``k``
         #: consecutive minibatches SUM before one update — the fused
         #: equivalent of the unit graph's accumulate_gradient +
@@ -639,6 +646,8 @@ class FusedTrainer:
         spec = self.spec
         accum = self.accum_steps
 
+        aug = self.augment
+
         def train_epoch(params, vels, data, target, idx, mask, ctrs,
                         epoch, scales, scales_b):
             # `scales`/`scales_b` = per-STEP lr multipliers for weights
@@ -650,6 +659,8 @@ class FusedTrainer:
                 if self._batch_sharding is not None:
                     x = jax.lax.with_sharding_constraint(
                         x, self._batch_sharding)
+                if aug is not None:
+                    x = aug.device_apply(x, step_idx, epoch, train=True)
                 return x, jnp.take(target, step_idx, axis=0)
 
             if accum == 1:
@@ -712,6 +723,8 @@ class FusedTrainer:
                 if self._batch_sharding is not None:
                     x = jax.lax.with_sharding_constraint(
                         x, self._batch_sharding)
+                if aug is not None:        # eval: center crop
+                    x = aug.device_apply(x, step_idx, 0, train=False)
                 return None, eval_minibatch(spec, params, x, t, step_mask)
             _, ms = jax.lax.scan(body, None, (idx, mask))
             return ms

@@ -36,7 +36,13 @@ class StreamTrainer(FusedTrainer):
     def __init__(self, workflow=None, spec=None, params=None, vels=None,
                  mesh=None, loader: StreamingLoader | None = None,
                  prefetch_depth: int = 2, mse_target: str = "input",
-                 accum_steps: int = 1):
+                 accum_steps: int = 1, augment=None):
+        if augment is not None:
+            # streaming augmentation lives on the LOADER (host-side in
+            # the prefetch stage) — a trainer-level augment here would
+            # double-apply
+            raise ValueError("StreamTrainer: set augment on the "
+                             "StreamingLoader, not the trainer")
         super().__init__(workflow, spec=spec, params=params, vels=vels,
                          mesh=mesh, accum_steps=accum_steps)
         self.loader = loader if loader is not None \
