@@ -613,6 +613,36 @@ class TestNativeRecordReader:
         with pytest.raises(IndexError):
             rf.read_batch([-5])          # below -n: invalid either path
 
+    def test_build_lock_stale_takeover(self, monkeypatch):
+        """A builder killed mid-make leaves its lock behind — the next
+        process must age it out, re-acquire, and end up with a usable
+        library (never a bare unlocked build, never a permanent
+        fallback)."""
+        import os
+        import time
+
+        from znicz_tpu.loader import records as rec
+        d = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(rec.__file__))), os.pardir, "native")
+        d = os.path.abspath(d)
+        lock = os.path.join(d, "libznr_reader.so.lock")
+        src = os.path.join(d, "znr_reader.cpp")
+        if not os.path.exists(src):
+            pytest.skip("native sources absent")
+        # a stale lock from a dead builder + a stale .so (touch src)
+        open(lock, "w").close()
+        os.utime(lock, (time.time() - 600, time.time() - 600))
+        os.utime(src)
+        monkeypatch.setattr(rec, "_native_lib", None)
+        monkeypatch.setattr(rec, "_native_tried", False)
+        try:
+            lib = rec._native()
+            assert lib is not None
+            assert not os.path.exists(lock)
+        finally:
+            if os.path.exists(lock):
+                os.unlink(lock)
+
 
 class TestDeviceAugmentation:
     """RandomCropFlip.device_apply: the resident fused path's on-device
@@ -702,3 +732,4 @@ class TestDeviceAugmentation:
         from znicz_tpu.parallel.stream import StreamTrainer
         with pytest.raises(ValueError, match="on the StreamingLoader"):
             StreamTrainer(augment=RandomCropFlip((4, 4)))
+
