@@ -613,35 +613,38 @@ class TestNativeRecordReader:
         with pytest.raises(IndexError):
             rf.read_batch([-5])          # below -n: invalid either path
 
-    def test_build_lock_stale_takeover(self, monkeypatch):
+    def test_build_lock_stale_takeover(self, tmp_path, monkeypatch):
         """A builder killed mid-make leaves its lock behind — the next
         process must age it out, re-acquire, and end up with a usable
         library (never a bare unlocked build, never a permanent
-        fallback)."""
+        fallback).  Runs against a sandbox copy of native/ so the
+        repo's live (possibly dlopen'ed) .so is never rewritten."""
         import os
+        import shutil
         import time
 
         from znicz_tpu.loader import records as rec
-        d = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(rec.__file__))), os.pardir, "native")
-        d = os.path.abspath(d)
-        lock = os.path.join(d, "libznr_reader.so.lock")
-        src = os.path.join(d, "znr_reader.cpp")
-        if not os.path.exists(src):
-            pytest.skip("native sources absent")
-        # a stale lock from a dead builder + a stale .so (touch src)
+        if not (shutil.which("g++") and shutil.which("make")):
+            pytest.skip("no native toolchain")
+        repo_native = os.path.abspath(os.path.join(os.path.dirname(
+            os.path.abspath(rec.__file__)), os.pardir, os.pardir,
+            "native"))
+        sandbox = str(tmp_path / "native")
+        os.makedirs(sandbox)
+        for f in ("znr_reader.cpp", "parallel.h", "Makefile"):
+            shutil.copy(os.path.join(repo_native, f),
+                        os.path.join(sandbox, f))
+        lock = os.path.join(sandbox, "libznr_reader.so.lock")
         open(lock, "w").close()
         os.utime(lock, (time.time() - 600, time.time() - 600))
-        os.utime(src)
+        monkeypatch.setenv("ZNICZ_TPU_NATIVE_DIR", sandbox)
         monkeypatch.setattr(rec, "_native_lib", None)
         monkeypatch.setattr(rec, "_native_tried", False)
-        try:
-            lib = rec._native()
-            assert lib is not None
-            assert not os.path.exists(lock)
-        finally:
-            if os.path.exists(lock):
-                os.unlink(lock)
+        lib = rec._native()
+        assert lib is not None
+        assert os.path.exists(os.path.join(sandbox,
+                                           "libznr_reader.so"))
+        assert not os.path.exists(lock)
 
 
 class TestDeviceAugmentation:

@@ -54,8 +54,9 @@ def _native() -> ctypes.CDLL | None:
     if os.environ.get("ZNICZ_TPU_NO_NATIVE_IO") == "1":
         return None
     try:
-        d = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), "native")
+        d = os.environ.get("ZNICZ_TPU_NATIVE_DIR") or os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), "native")
         so = os.path.join(d, "libznr_reader.so")
         src = os.path.join(d, "znr_reader.cpp")
 
