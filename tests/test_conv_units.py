@@ -251,3 +251,91 @@ class TestConvChainVsJaxGrad:
         np.testing.assert_allclose(g3.gradient_weights.mem,
                                    np.asarray(grads[2]), rtol=1e-3,
                                    atol=1e-6)
+
+
+class TestActivationVariants:
+    """Every fused-activation flavor of the conv/fc unit zoo (relu,
+    strict_relu, sigmoid alongside the tanh the other tests use):
+    numpy-vs-XLA one-epoch equivalence and fused-path parity through
+    StandardWorkflow — the variant classes the registries expose but
+    no sample config happens to pick."""
+
+    @pytest.mark.parametrize("conv_t,fc_t", [
+        ("conv_relu", "all2all_relu"),
+        ("conv_str", "all2all_str"),
+        ("conv_sigmoid", "all2all_sigmoid"),
+    ])
+    def test_variant_backends_and_fused(self, conv_t, fc_t):
+        from znicz_tpu.backends import Device
+        from znicz_tpu.config import root
+        from znicz_tpu.models import cifar
+        from znicz_tpu.parallel import FusedTrainer, extract_model
+
+        layers = [
+            {"type": conv_t, "->": {"n_kernels": 6, "kx": 3,
+                                    "padding": 1},
+             "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}},
+            {"type": "max_pooling", "->": {"kx": 2}},
+            {"type": fc_t, "->": {"output_sample_shape": 24},
+             "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}},
+        ]
+        saved = root.cifar.synthetic.to_dict()
+        saved_mb = root.cifar.get("minibatch_size", 100)
+        root.cifar.synthetic.update({"n_train": 80, "n_valid": 20,
+                                     "n_test": 20, "noise": 0.3,
+                                     "size": 10})
+        root.cifar.minibatch_size = 20
+        try:
+            prng.seed_all(99)
+            wf_np = cifar.CifarWorkflow(layers=layers)
+            wf_np.initialize(device=Device.create("numpy"))
+            prng.seed_all(99)
+            wf_x = cifar.CifarWorkflow(layers=layers)
+            wf_x.initialize(device=Device.create("xla"))
+            for wf in (wf_np, wf_x):
+                wf.run(max_ticks=8)
+            for f_np, f_x in zip(wf_np.forwards, wf_x.forwards):
+                if not f_np.weights:
+                    continue
+                np.testing.assert_allclose(
+                    f_np.weights.mem, f_x.weights.mem, rtol=5e-4,
+                    atol=2e-5, err_msg=f"{conv_t}/{f_np.name}")
+            # fused path: same minibatches → same weights as the graph
+            prng.seed_all(99)
+            wf_f = cifar.CifarWorkflow(layers=layers)
+            wf_f.initialize(device=Device.create("xla"))
+            spec, params, vels = extract_model(wf_f)
+            tr = FusedTrainer(spec=spec, params=params, vels=vels)
+            ld = wf_f.loader
+            n0, n1, n2 = ld.class_lengths
+            idx = np.arange(n0 + n1, n0 + n1 + n2)
+            tr.train_epoch(ld.original_data.devmem,
+                           ld.original_labels.devmem, idx, 20)
+            # drive the unit graph over the same (unshuffled) epoch
+            prng.seed_all(99)
+            wf_g = cifar.CifarWorkflow(layers=layers)
+            wf_g.initialize(device=Device.create("xla"))
+            ld_g = wf_g.loader
+            for off in range(0, n2, 20):
+                mb = idx[off:off + 20]
+                ld_g.minibatch_class = 2
+                ld_g.minibatch_size = len(mb)
+                ld_g.minibatch_offset = off + 20
+                ld_g.fill_minibatch(mb, 2)
+                for f in wf_g.forwards:
+                    f.run()
+                wf_g.evaluator.run()
+                for g in reversed(wf_g.gds):
+                    g.run()
+            for i, (f, (w, _)) in enumerate(zip(wf_g.forwards,
+                                                tr.params)):
+                if w is None:
+                    continue
+                np.testing.assert_allclose(
+                    np.asarray(w), f.weights.mem, rtol=5e-4, atol=2e-5,
+                    err_msg=f"{conv_t} fused layer {i}")
+        finally:
+            root.cifar.synthetic.update(saved)
+            root.cifar.minibatch_size = saved_mb
