@@ -257,3 +257,46 @@ class TestAutoencoderSample:
             np.testing.assert_allclose(
                 np.asarray(w), fwd.weights.mem, rtol=5e-4, atol=1e-5,
                 err_msg=f"layer {i} weights diverged")
+
+
+class TestDeconvSigmoidVariant:
+    def test_numpy_vs_xla_fwd_bwd(self, xla_device):
+        """The sigmoid deconv flavor (registry 'deconv_sigmoid') —
+        untested by any sample config: fwd numpy-vs-XLA and its GD
+        unit vs jax.grad."""
+        from znicz_tpu.nn.deconv import DeconvSigmoid
+        from znicz_tpu.nn.gd_deconv import GDDeconvSigmoid
+        from znicz_tpu.ops import deconv as deconv_ops
+
+        x = _x((2, 5, 5, 3))
+        prng.seed_all(11)
+        u_np = wire(DeconvSigmoid, x, n_kernels=3, kx=3, padding=1,
+                    n_channels=4)
+        prng.seed_all(11)
+        u_x = wire(DeconvSigmoid, x, n_kernels=3, kx=3, padding=1,
+                   n_channels=4, device=xla_device)
+        u_np.run()
+        u_x.run()
+        np.testing.assert_allclose(u_np.output.mem, u_x.output.mem,
+                                   rtol=2e-5, atol=2e-6)
+        assert (u_np.output.mem > 0).all()       # sigmoid range
+        assert (u_np.output.mem < 1).all()
+
+        err = _x(u_np.output.mem.shape, "err")
+        # snapshot BEFORE the GD tick: run() applies the SGD update
+        w = np.array(u_np.weights.mem, np.float32)
+        b = (np.array(u_np.bias.mem, np.float32) if u_np.bias
+             else np.float32(0.0))
+        g_np = wire_gd(GDDeconvSigmoid, u_np, err)
+        g_np.run()
+
+        def loss(w_, x_):
+            pre = deconv_ops.xla_deconv2d(x_, w_, u_np.sliding,
+                                          u_np.padding) + jnp.asarray(b)
+            act = 1.0 / (1.0 + jnp.exp(-pre))
+            return jnp.vdot(act, jnp.asarray(err))
+        gw_j = np.asarray(jax.grad(loss, 0)(
+            jnp.asarray(w), jnp.asarray(x, jnp.float32)))
+        np.testing.assert_allclose(
+            np.asarray(g_np.gradient_weights.mem), gw_j, rtol=3e-4,
+            atol=3e-5)
