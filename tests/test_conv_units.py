@@ -339,3 +339,34 @@ class TestActivationVariants:
         finally:
             root.cifar.synthetic.update(saved)
             root.cifar.minibatch_size = saved_mb
+
+
+class TestStochasticAbsVariants:
+    def test_abs_variant_and_gd(self, xla_device):
+        """StochasticAbsPooling + its GD unit (the |x|-scored flavor
+        no other test touches): backend parity on winners/output and
+        the offset-scatter backward routes err to the stored slots."""
+        from znicz_tpu.nn.gd_pooling import GDStochasticAbsPooling
+        from znicz_tpu.nn.pooling import StochasticAbsPooling
+
+        x = _x((2, 6, 6, 3))                 # signed: abs scoring
+        u_np = wire(StochasticAbsPooling, x, kx=2)
+        u_x = wire(StochasticAbsPooling, x, kx=2, device=xla_device)
+        u_np.run()
+        u_x.run()
+        np.testing.assert_array_equal(u_np.input_offset.mem,
+                                      u_x.input_offset.mem)
+        np.testing.assert_allclose(u_np.output.mem, u_x.output.mem,
+                                   rtol=1e-6)
+        err = _x(u_np.output.mem.shape, "err")
+        g_np = wire_gd(GDStochasticAbsPooling, u_np, err)
+        g_np.run()
+        g_x = wire_gd(GDStochasticAbsPooling, u_x, err,
+                      device=xla_device)
+        g_x.run()
+        np.testing.assert_allclose(g_np.err_input.mem,
+                                   g_x.err_input.mem, rtol=1e-6)
+        # scatter conservation: every err value lands on exactly one
+        # input slot
+        np.testing.assert_allclose(g_np.err_input.mem.sum(), err.sum(),
+                                   rtol=1e-5)
