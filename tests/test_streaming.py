@@ -638,6 +638,7 @@ class TestNativeRecordReader:
         open(lock, "w").close()
         os.utime(lock, (time.time() - 600, time.time() - 600))
         monkeypatch.setenv("ZNICZ_TPU_NATIVE_DIR", sandbox)
+        monkeypatch.delenv("ZNICZ_TPU_NO_NATIVE_IO", raising=False)
         monkeypatch.setattr(rec, "_native_lib", None)
         monkeypatch.setattr(rec, "_native_tried", False)
         lib = rec._native()
