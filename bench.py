@@ -319,6 +319,12 @@ def _reduce_for_cpu(args):
     args.epochs, args.ticks, args.warm = 1, 0, 1
 
 
+def _append_note(result, note: str) -> None:
+    """The ONE way a bench result accumulates advisory notes."""
+    result["note"] = (result["note"] + "; " + note
+                      if "note" in result else note)
+
+
 def _bring_up(args, result, reduce_on_cpu: bool = True):
     """Shared backend bring-up: await the TPU, else labeled CPU
     fallback.  Mutates ``result`` (device/note/error fields) and
@@ -332,7 +338,7 @@ def _bring_up(args, result, reduce_on_cpu: bool = True):
             # jax silently defaulted to host CPU (no TPU registered at
             # all): keep the run small and say so — full-size epochs on
             # CPU take hours and aren't the headline metric.
-            result["note"] = "no TPU registered; reduced-size CPU run"
+            _append_note(result, "no TPU registered; reduced-size CPU run")
             if reduce_on_cpu:
                 _reduce_for_cpu(args)
         return platform
@@ -388,9 +394,7 @@ def bench_training(args) -> int:
             # e.g. weight-tied Deconv: fall back to the unit-graph path
             # so the config still gets a measured number
             result["path"] = "unit_graph"
-            note = f"fused path unavailable: {e}"[:200]
-            result["note"] = (result["note"] + "; " + note
-                              if "note" in result else note)
+            _append_note(result, f"fused path unavailable: {e}"[:200])
             fused_ips = measure_unit_graph(wf, max(args.ticks, 1))
             spec = params = None
         result["value"] = round(fused_ips, 1)
@@ -429,6 +433,18 @@ def bench_training(args) -> int:
             if args.ticks > 0:
                 unit_graph = measure_unit_graph(wf, args.ticks)
                 result["vs_baseline"] = round(fused_ips / unit_graph, 2)
+        # a requested measurement must never quietly not run — covers
+        # both the non-alexnet --augment case and the unit-graph
+        # fallback (spec None) skipping --stream/--augment entirely
+        if args.stream and "stream_value" not in result:
+            _append_note(result, "--stream requested but not measured "
+                                 "(fused path unavailable)")
+        if args.augment and "augment_value" not in result:
+            _append_note(result,
+                         "--augment requested but not measured ("
+                         + ("only implemented for the alexnet config"
+                            if args.config != "alexnet"
+                            else "fused path unavailable") + ")")
     except Exception as e:
         result.setdefault("error", "")
         result["error"] = (result["error"]

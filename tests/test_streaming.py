@@ -614,11 +614,12 @@ class TestNativeRecordReader:
             rf.read_batch([-5])          # below -n: invalid either path
 
     def test_build_lock_stale_takeover(self, tmp_path, monkeypatch):
-        """A builder killed mid-make leaves its lock behind — the next
-        process must age it out, re-acquire, and end up with a usable
-        library (never a bare unlocked build, never a permanent
-        fallback).  Runs against a sandbox copy of native/ so the
-        repo's live (possibly dlopen'ed) .so is never rewritten."""
+        """A builder killed mid-make leaves its lock FILE behind, but
+        flock() is kernel-held: the lock died with the builder, so the
+        next process acquires immediately and ends up with a usable
+        library (never a permanent fallback, no mtime-based takeover
+        race).  Runs against a sandbox copy of native/ so the repo's
+        live (possibly dlopen'ed) .so is never rewritten."""
         import os
         import shutil
         import time
@@ -645,7 +646,8 @@ class TestNativeRecordReader:
         assert lib is not None
         assert os.path.exists(os.path.join(sandbox,
                                            "libznr_reader.so"))
-        assert not os.path.exists(lock)
+        # the lock file may remain — with flock() its existence is
+        # meaningless; what matters is it must not block this build
 
 
 class TestDeviceAugmentation:

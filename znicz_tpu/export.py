@@ -14,7 +14,6 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
 
 import numpy as np
 
@@ -217,11 +216,16 @@ class NativeModel:
 
 
 def build_native(force: bool = False) -> str:
-    """make -C native; returns the .so path."""
+    """make -C native (stale vs znicz_infer.cpp AND parallel.h, under
+    the shared cross-process flock); returns the .so path."""
+    from .native_build import ensure_built
     so = os.path.join(_NATIVE_DIR, "libznicz_infer.so")
-    src = os.path.join(_NATIVE_DIR, "znicz_infer.cpp")
-    if force or not os.path.exists(so) \
-            or os.path.getmtime(so) < os.path.getmtime(src):
-        subprocess.run(["make", "-C", _NATIVE_DIR],
-                       check=True, capture_output=True)
+    srcs = [os.path.join(_NATIVE_DIR, "znicz_infer.cpp"),
+            os.path.join(_NATIVE_DIR, "parallel.h")]
+    if force and os.path.exists(so):
+        os.unlink(so)
+    if not ensure_built(so, srcs, _NATIVE_DIR, "libznicz_infer.so") \
+            and not os.path.exists(so):
+        raise RuntimeError("libznicz_infer.so build failed; see "
+                           f"`make -C {_NATIVE_DIR}` output")
     return so

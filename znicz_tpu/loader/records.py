@@ -31,7 +31,6 @@ from __future__ import annotations
 import ctypes
 import json
 import os
-import subprocess
 
 import numpy as np
 
@@ -58,52 +57,14 @@ def _native() -> ctypes.CDLL | None:
             os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))), "native")
         so = os.path.join(d, "libznr_reader.so")
-        src = os.path.join(d, "znr_reader.cpp")
-
-        def fresh() -> bool:
-            return os.path.exists(so) and not (
-                os.path.exists(src)
-                and os.path.getmtime(so) < os.path.getmtime(src))
-
-        if not fresh():
-            # cross-process build exclusion: concurrent loader workers
-            # must not compile the same .so on top of each other (a
-            # partially written ELF would silently poison the CDLL).
-            # EVERY build happens under the lock — including take-over
-            # after a stale lock (a builder killed mid-make): the stale
-            # path unlinks and loops back to re-ACQUIRE, never builds
-            # bare.  Freshness is re-checked once the lock is held, so
-            # waiters whose builder finished don't rebuild.
-            import time
-            lock = so + ".lock"
-            deadline = time.time() + 180
-            while time.time() < deadline:
-                try:
-                    fd = os.open(lock,
-                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                except FileExistsError:
-                    try:
-                        if (time.time()
-                                - os.path.getmtime(lock)) > 120:
-                            os.unlink(lock)   # stale: retry acquire
-                            continue
-                    except OSError:
-                        continue              # vanished: retry acquire
-                    time.sleep(0.1)
-                    if fresh():               # the other builder won
-                        break
-                    continue
-                try:
-                    if not fresh():
-                        subprocess.run(
-                            ["make", "-C", d, "libznr_reader.so"],
-                            check=True, capture_output=True)
-                finally:
-                    os.close(fd)
-                    os.unlink(lock)
-                break
-            if not fresh():
-                return None                   # keep the numpy fallback
+        # every build input the Makefile lists — a parallel.h-only edit
+        # must trigger a rebuild too; exclusion + staleness live in the
+        # shared driver (native_build.py), same as the inference engine
+        from ..native_build import ensure_built
+        if not ensure_built(so, [os.path.join(d, "znr_reader.cpp"),
+                                 os.path.join(d, "parallel.h")],
+                            d, "libznr_reader.so"):
+            return None                       # keep the numpy fallback
         lib = ctypes.CDLL(so)
         lib.znr_open.restype = ctypes.c_void_p
         lib.znr_open.argtypes = [ctypes.c_char_p] + [ctypes.c_int64] * 5
@@ -237,9 +198,12 @@ class RecordFile:
         self._row_bytes = row * self.data_dtype.itemsize
         self._label_row_bytes = lrow * self.label_dtype.itemsize
         self._h = None
-        lib = _native()
-        if lib is not None:
-            self._h = lib.znr_open(
+        # the CDLL is cached on the instance so close() frees the handle
+        # through the same library that opened it, even if the module-
+        # level _native() is later disabled or reset (tests do this)
+        self._lib = _native()
+        if self._lib is not None:
+            self._h = self._lib.znr_open(
                 path.encode(), self.n, data_at, labels_at,
                 self._row_bytes, self._label_row_bytes)
 
@@ -247,7 +211,7 @@ class RecordFile:
         return self.n
 
     def _native_gather(self, idx: np.ndarray, want_labels: bool):
-        lib = _native()
+        lib = self._lib
         k = len(idx)
         idx64 = np.ascontiguousarray(idx, np.int64)
         data = np.empty((k, *self.data_shape), self.data_dtype)
@@ -293,7 +257,7 @@ class RecordFile:
 
     def close(self) -> None:
         if getattr(self, "_h", None):
-            _native().znr_close(self._h)
+            self._lib.znr_close(self._h)
             self._h = None
 
     def __del__(self):

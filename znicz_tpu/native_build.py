@@ -1,0 +1,76 @@
+"""Shared build driver for the native (C++) components.
+
+Both data-plane libraries — ``native/libznr_reader.so`` (mmap record
+gather, loader/records.py) and ``native/libznicz_infer.so`` (the C++
+inference engine, export.py) — are compiled on first use from the repo's
+``native/`` directory.  This module is the ONE implementation of the two
+hazards that entails:
+
+* **staleness** — the .so must be rebuilt when ANY of its build inputs
+  changed, including shared headers (``parallel.h``), not just the
+  primary .cpp;
+* **cross-process exclusion** — concurrent workers must not run ``make``
+  on the same target simultaneously (a partially written ELF would
+  silently poison the dlopen).  flock() on an open fd: the kernel drops
+  the lock when a builder dies, so there is no stale-lock takeover and
+  no check-then-unlink TOCTOU.  Retrying is limited to EWOULDBLOCK /
+  EAGAIN / EINTR — a filesystem where flock() fails outright (ENOLCK on
+  some NFS mounts) falls through to one unlocked best-effort build
+  attempt instead of spinning out the whole deadline.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import subprocess
+import time
+
+
+def is_fresh(so: str, srcs: list[str]) -> bool:
+    """True when ``so`` exists and is no older than every existing src."""
+    if not os.path.exists(so):
+        return False
+    so_m = os.path.getmtime(so)
+    return not any(os.path.exists(s) and so_m < os.path.getmtime(s)
+                   for s in srcs)
+
+
+def ensure_built(so: str, srcs: list[str], make_dir: str, target: str,
+                 deadline_s: float = 180.0) -> bool:
+    """Build ``target`` under flock if ``so`` is stale; True when fresh
+    on return.  Never raises for build failure — callers keep their
+    pure-Python fallback paths."""
+    if is_fresh(so, srcs):
+        return True
+    import fcntl
+    lock = so + ".lock"
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_WRONLY, 0o644)
+    except OSError:
+        fd = None                       # unwritable dir: try bare build
+    try:
+        got = fd is None                # no lock file → best-effort bare
+        if fd is not None:
+            deadline = time.time() + deadline_s
+            while time.time() < deadline:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    got = True
+                    break
+                except OSError as e:
+                    if e.errno not in (errno.EWOULDBLOCK, errno.EAGAIN,
+                                       errno.EINTR):
+                        got = True      # flock unsupported: build bare
+                        break
+                    time.sleep(0.1)
+        if got and not is_fresh(so, srcs):
+            try:
+                subprocess.run(["make", "-C", make_dir, target],
+                               check=True, capture_output=True)
+            except Exception:
+                pass
+    finally:
+        if fd is not None:
+            os.close(fd)                # releases the flock if held
+    return is_fresh(so, srcs)

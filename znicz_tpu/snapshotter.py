@@ -159,25 +159,30 @@ class SnapshotterToFile(SnapshotterBase):
         self.epoch_end(improved)
 
     def save(self, tag: str) -> str:
-        """Crash-safe save: both files are written to temp names and
-        os.replace()d into place, so an unclean death (SIGKILL,
-        preemption — the very case restart-from-snapshot exists for)
-        can never leave a truncated snapshot; at worst the metadata
-        sidecar is one save older than the arrays."""
+        """Crash-safe save, single-rename atomic: the metadata rides
+        INSIDE the .npz (a JSON-bytes array under ``__meta_json__``), so
+        arrays and counters commit in one os.replace() — an unclean
+        death (SIGKILL, preemption — the very case restart-from-snapshot
+        exists for) can never pair save-N arrays with save-N±1 meta.
+        A ``.json`` sidecar is still written for human inspection, but
+        load() never reads it."""
         os.makedirs(self.directory, exist_ok=True)
         arrays, meta = collect_state(self.workflow)
+        meta_blob = np.frombuffer(
+            json.dumps(meta, default=float).encode(), dtype=np.uint8)
         base = os.path.join(self.directory, f"{self.prefix}_{tag}.npz")
         if self.compression:
             path = f"{base}.{self.compression}"
             buf = io.BytesIO()
-            np.savez(buf, **arrays)         # raw; outer codec compresses
+            np.savez(buf, __meta_json__=meta_blob,
+                     **arrays)              # raw; outer codec compresses
             with _OPENERS[self.compression](path + ".tmp", "wb") as fh:
                 fh.write(buf.getbuffer())   # zero-copy view: snapshots
                 #                            can be GBs of params
         else:
             path = base
             with open(path + ".tmp", "wb") as fh:
-                np.savez_compressed(fh, **arrays)
+                np.savez_compressed(fh, __meta_json__=meta_blob, **arrays)
         with open(path + ".json.tmp", "w") as fh:
             json.dump(meta, fh, default=float)
         os.replace(path + ".tmp", path)
@@ -197,7 +202,10 @@ class SnapshotterToFile(SnapshotterBase):
             arrays = dict(np.load(buf, allow_pickle=False))
         else:
             arrays = dict(np.load(path, allow_pickle=False))
-        with open(path + ".json") as fh:
-            meta = json.load(fh)
+        if "__meta_json__" in arrays:       # atomic format (meta inside)
+            meta = json.loads(arrays.pop("__meta_json__").tobytes())
+        else:                               # pre-atomic snapshots
+            with open(path + ".json") as fh:
+                meta = json.load(fh)
         restore_state(workflow, arrays, meta)
         return meta
