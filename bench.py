@@ -31,10 +31,35 @@ Extra modes (not used by the driver):
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+
+def _preflight_lrn_pool(result) -> None:
+    """Compile-check the fused LRN+pool Mosaic kernels on tiny shapes
+    before they gate the headline number; on any lowering/runtime
+    failure fall back to the split layers and say so.  (The kernels are
+    exact-equivalence tested in interpret mode, but Mosaic lowering can
+    only be proven on the chip.)"""
+    try:
+        import jax.numpy as jnp
+        from znicz_tpu.ops import lrn_pool, tuning
+        if not tuning.use_pallas():
+            return                      # XLA fallback path, nothing to prove
+        x = jnp.arange(2 * 7 * 7 * 8, dtype=jnp.float32
+                       ).reshape(2, 7, 7, 8) * 0.01
+        y, idx = lrn_pool.pallas_lrn_maxpool(
+            x, 5, 1e-4, 0.75, 2.0, (3, 3), (2, 2), 0)
+        lrn_pool.pallas_gd_lrn_maxpool(
+            y * 0.1, idx, x, 5, 1e-4, 0.75, 2.0, (3, 3), (2, 2), 0
+        ).block_until_ready()
+    except Exception as e:
+        os.environ["ZNICZ_TPU_LRN_POOL"] = "split"
+        _append_note(result, f"lrn_pool fused kernel preflight failed "
+                             f"({e!r}"[:160] + "); using split layers")
 
 
 def _emit(obj) -> int:
@@ -367,6 +392,7 @@ def bench_training(args) -> int:
               "value": None, "unit": "images/sec", "vs_baseline": None}
     if _bring_up(args, result) is None:
         return _emit(result)
+    _preflight_lrn_pool(result)
     try:
         from znicz_tpu.ops import flops as flops_mod
 
@@ -573,6 +599,7 @@ def bench_ablate(args) -> int:
         return _emit(result)
     if _bring_up(args, result) is None:
         return _emit(result)
+    _preflight_lrn_pool(result)
     try:
         from znicz_tpu.parallel import fused, FusedTrainer
 
@@ -588,7 +615,9 @@ def bench_ablate(args) -> int:
         batch = ld.max_minibatch_size
         import jax
 
-        def time_spec(spec, keep=None):
+        def time_spec(spec, keep=None, ps=None, vs=None):
+            ps = params if ps is None else ps
+            vs = vels if vs is None else vs
             if keep is not None:
                 keep_idx = [i for i, la in enumerate(spec.layers)
                             if keep(la)]
@@ -610,10 +639,8 @@ def bench_ablate(args) -> int:
                     kept_layers.append(la)
                 spec = dataclasses.replace(spec,
                                            layers=tuple(kept_layers))
-                ps = [params[i] for i in keep_idx]
-                vs = [vels[i] for i in keep_idx]
-            else:
-                ps, vs = params, vels
+                ps = [ps[i] for i in keep_idx]
+                vs = [vs[i] for i in keep_idx]
             cp = jax.tree_util.tree_map(np.array, (ps, vs))
             tr = FusedTrainer(spec=spec, params=cp[0], vels=cp[1])
             for _ in range(getattr(args, "warm", 2)):
@@ -627,19 +654,34 @@ def bench_ablate(args) -> int:
             dt = time.perf_counter() - t0
             return dt / max(1, args.epochs * (n // batch)) * 1e3
 
+        # the same model with the LRN+pool merge disabled (split layers)
+        # — the A/B for the fused-pair kernel (ops/lrn_pool.py); its own
+        # params/vels: the split spec has more layer rows
+        os.environ["ZNICZ_TPU_LRN_POOL"] = "split"
+        try:
+            split_spec, split_params, split_vels = fused.extract_model(wf)
+        finally:
+            os.environ.pop("ZNICZ_TPU_LRN_POOL", None)
+
         # only shape-preserving kinds can be ablated (pooling changes
-        # every downstream activation shape, so it has no variant)
+        # every downstream activation shape, so it has no variant);
+        # no_lrn strips LRN from the SPLIT spec, where it is standalone
         variants = [
-            ("full", None, base_spec),
-            ("no_lrn", lambda la: la.kind != "lrn", base_spec),
-            ("no_dropout", lambda la: la.kind != "dropout", base_spec),
+            ("full", None, base_spec, None, None),
+            ("lrn_pool_split", None, split_spec, split_params,
+             split_vels),
+            ("no_lrn", lambda la: la.kind != "lrn", split_spec,
+             split_params, split_vels),
+            ("no_dropout", lambda la: la.kind != "dropout", base_spec,
+             None, None),
             ("storage_bf16", None,
-             dataclasses.replace(base_spec, storage_dtype="bfloat16")),
+             dataclasses.replace(base_spec, storage_dtype="bfloat16"),
+             None, None),
         ]
         rows = {}
-        for name, keep, spec in variants:
+        for name, keep, spec, ps, vs in variants:
             try:
-                rows[name] = round(time_spec(spec, keep), 2)
+                rows[name] = round(time_spec(spec, keep, ps, vs), 2)
             except Exception as e:   # a variant may be unbuildable
                 rows[name] = f"error: {e}"[:120]
             print(f"  {name:14s} {rows[name]} ms/step", file=sys.stderr)

@@ -1,0 +1,234 @@
+"""Fused LRN→max-pool pair (ops/lrn_pool.py + the extract_model merge).
+
+Contract (mirrors the repo's kernel-test convention): forward values and
+winner OFFSETS are bit-identical to the composed split ops (same window
+math, same flat tap order); backward gradients match to f32 tolerance
+(the in-kernel jnp math may FMA-contract where numpy rounds twice —
+same tolerance class as the standalone LRN kernel tests).  On the XLA
+dispatch tier (no Pallas) the merged spec is op-for-op the same
+composition as the split spec, so a merged-spec FusedTrainer trains
+BIT-identically to the split-spec one there — asserted below.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from znicz_tpu import prng
+from znicz_tpu.ops import lrn_pool, normalization as lrn_math, \
+    pooling as pool_ops, tuning
+
+
+@pytest.fixture
+def interpret_mode(monkeypatch):
+    monkeypatch.setattr(tuning, "_INTERPRET", True)
+    yield
+
+
+def _x(shape, stream="x", scale=1.0):
+    return np.asarray(prng.get(stream).normal(size=shape),
+                      np.float32) * scale
+
+
+GEOMS = [
+    # (B, H, W, C, ksize, stride)  — stride-W must be 2 (the gate)
+    (2, 9, 9, 8, (3, 3), (2, 2)),       # odd W (AlexNet-like)
+    (1, 8, 8, 16, (3, 3), (2, 2)),      # even W
+    (3, 11, 7, 4, (2, 3), (2, 2)),      # rectangular window, odd W
+    (2, 10, 12, 8, (2, 2), (1, 2)),     # row stride 1 (overlapping rows)
+    (2, 13, 9, 8, (4, 2), (3, 2)),      # tall window, row stride 3
+]
+
+
+@pytest.mark.usefixtures("interpret_mode")
+class TestFusedForward:
+    @pytest.mark.parametrize("b,h,w,c,ks,st", GEOMS)
+    def test_bit_identical_to_composed(self, b, h, w, c, ks, st):
+        x = _x((b, h, w, c))
+        y_ref, idx_ref = lrn_pool.np_lrn_maxpool(
+            x, 5, 1e-4, 0.75, 2.0, ks, st, 0)
+        y, idx = lrn_pool.pallas_lrn_maxpool(
+            jnp.asarray(x), 5, 1e-4, 0.75, 2.0, ks, st, 0)
+        np.testing.assert_array_equal(np.asarray(y), y_ref)
+        np.testing.assert_array_equal(np.asarray(idx), idx_ref)
+
+    def test_maxabs_variant(self):
+        x = _x((2, 9, 9, 8))
+        y_ref, idx_ref = lrn_pool.np_lrn_maxpool(
+            x, 5, 1e-4, 0.75, 2.0, (3, 3), (2, 2), 0, use_abs=True)
+        y, idx = lrn_pool.pallas_lrn_maxpool(
+            jnp.asarray(x), 5, 1e-4, 0.75, 2.0, (3, 3), (2, 2), 0,
+            use_abs=True)
+        np.testing.assert_array_equal(np.asarray(y), y_ref)
+        np.testing.assert_array_equal(np.asarray(idx), idx_ref)
+
+    def test_small_lrn_window(self):
+        x = _x((2, 9, 9, 8))
+        y_ref, idx_ref = lrn_pool.np_lrn_maxpool(
+            x, 3, 5e-4, 0.75, 1.0, (3, 3), (2, 2), 0)
+        y, idx = lrn_pool.pallas_lrn_maxpool(
+            jnp.asarray(x), 3, 5e-4, 0.75, 1.0, (3, 3), (2, 2), 0)
+        np.testing.assert_array_equal(np.asarray(y), y_ref)
+        np.testing.assert_array_equal(np.asarray(idx), idx_ref)
+
+    def test_gate(self):
+        assert lrn_pool.fusable((3, 3), (2, 2), 0)
+        assert not lrn_pool.fusable((3, 3), (2, 2), 1)    # padding
+        assert not lrn_pool.fusable((3, 3), (3, 3), 0)    # stride-W 3
+        assert not lrn_pool.fusable((2, 2), (2, 1), 0)    # stride-W 1
+
+
+@pytest.mark.usefixtures("interpret_mode")
+class TestFusedBackward:
+    @pytest.mark.parametrize("b,h,w,c,ks,st", GEOMS)
+    def test_matches_composed_golden(self, b, h, w, c, ks, st):
+        x = _x((b, h, w, c))
+        _, idx = lrn_pool.np_lrn_maxpool(x, 5, 1e-4, 0.75, 2.0, ks, st, 0)
+        errp = _x(idx.shape, "err", 0.1)
+        dx_ref = lrn_pool.np_gd_lrn_maxpool(
+            errp, idx, x, 5, 1e-4, 0.75, 2.0, ks, st, 0)
+        dx = lrn_pool.pallas_gd_lrn_maxpool(
+            jnp.asarray(errp), jnp.asarray(idx), jnp.asarray(x),
+            5, 1e-4, 0.75, 2.0, ks, st, 0)
+        np.testing.assert_allclose(np.asarray(dx),
+                                   np.asarray(dx_ref, np.float32),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_gradient_against_jax_autodiff(self):
+        """Independent check: the hand-written pair backward matches
+        jax.grad through the composed differentiable forward (max-pool
+        picks unique winners for random data, so grads agree)."""
+        import jax
+        x = _x((2, 9, 9, 8))
+        errp_shape = pool_ops.pool_out_shape(x.shape, (3, 3), (2, 2), 0)
+        errp = _x(errp_shape, "err", 0.1)
+
+        def scalar(xx):
+            y = lrn_math.xla_lrn(xx, 5, 1e-4, 0.75, 2.0)[0]
+            p, _ = pool_ops.xla_max_pooling(y, (3, 3), (2, 2), 0)
+            return jnp.sum(p * jnp.asarray(errp))
+
+        dx_auto = jax.grad(scalar)(jnp.asarray(x))
+        _, idx = lrn_pool.np_lrn_maxpool(x, 5, 1e-4, 0.75, 2.0,
+                                         (3, 3), (2, 2), 0)
+        dx = lrn_pool.pallas_gd_lrn_maxpool(
+            jnp.asarray(errp), jnp.asarray(idx), jnp.asarray(x),
+            5, 1e-4, 0.75, 2.0, (3, 3), (2, 2), 0)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_auto),
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestSpecMerge:
+    def _mk_layers(self):
+        from znicz_tpu.parallel.fused import LayerSpec
+        H = (0.01, 0.0, 0.0, 0.9)
+        mk = lambda kind, **cfg: LayerSpec(       # noqa: E731
+            kind=kind, activation="linear", include_bias=False,
+            hypers=H, hypers_bias=H, config=tuple(sorted(cfg.items())))
+        return mk
+
+    def test_merge_and_tie_remap(self):
+        from znicz_tpu.parallel.fused import _merge_lrn_pool
+        mk = self._mk_layers()
+        layers = [
+            mk("conv", stride=(1, 1), padding=0),            # 0
+            mk("lrn", n=5, alpha=1e-4, beta=0.75, k=2.0),    # 1 ┐ merge
+            mk("max_pool", ksize=(3, 3), stride=(2, 2),      # 2 ┘
+               padding=0),
+            mk("conv", stride=(1, 1), padding=0),            # 3
+            mk("depooling", ksize=(3, 3), stride=(2, 2),     # 4 tie → 2
+               padding=0, tie=2),
+            mk("deconv", stride=(1, 1), padding=0, tie=0),   # 5 tie → 0
+        ]
+        pv = [(None, None)] * len(layers)
+        out_l, out_p, out_v = _merge_lrn_pool(layers, list(pv), list(pv))
+        kinds = [la.kind for la in out_l]
+        assert kinds == ["conv", "lrn_pool", "conv", "depooling",
+                         "deconv"]
+        assert out_l[3].cfg["tie"] == 1     # pool(2) → merged(1)
+        assert out_l[4].cfg["tie"] == 0
+        assert len(out_p) == len(out_l) == len(out_v)
+        merged_cfg = out_l[1].cfg
+        assert merged_cfg["n"] == 5 and merged_cfg["ksize"] == (3, 3)
+        assert merged_cfg["use_abs"] is False
+
+    def test_non_fusable_kept_split(self):
+        from znicz_tpu.parallel.fused import _merge_lrn_pool
+        mk = self._mk_layers()
+        layers = [
+            mk("lrn", n=5, alpha=1e-4, beta=0.75, k=2.0),
+            mk("max_pool", ksize=(3, 3), stride=(3, 3), padding=0),
+        ]
+        pv = [(None, None)] * 2
+        out_l, _, _ = _merge_lrn_pool(layers, list(pv), list(pv))
+        assert [la.kind for la in out_l] == ["lrn", "max_pool"]
+
+    def test_env_disables_merge(self, monkeypatch):
+        from znicz_tpu.parallel.fused import _merge_lrn_pool
+        monkeypatch.setenv("ZNICZ_TPU_LRN_POOL", "split")
+        mk = self._mk_layers()
+        layers = [
+            mk("lrn", n=5, alpha=1e-4, beta=0.75, k=2.0),
+            mk("max_pool", ksize=(3, 3), stride=(2, 2), padding=0),
+        ]
+        pv = [(None, None)] * 2
+        out_l, _, _ = _merge_lrn_pool(layers, list(pv), list(pv))
+        assert [la.kind for la in out_l] == ["lrn", "max_pool"]
+
+
+class TestTrainEquivalence:
+    """Merged spec trains bit-identically to the split spec (and hence,
+    by the existing fused-vs-unit-graph suite, to the unit graph)."""
+
+    def _workflow(self):
+        from znicz_tpu.backends import Device
+        from znicz_tpu.config import root
+        from znicz_tpu.models import alexnet
+        from znicz_tpu.standard_workflow import StandardWorkflow
+
+        root.alexnet.synthetic.update({"n_train": 64, "n_valid": 32,
+                                       "n_test": 0})
+        root.alexnet.update({"minibatch_size": 32, "size": 67,
+                             "n_classes": 7})
+        root.alexnet.layers = alexnet.make_layers(
+            n_classes=7, widths=(8, 12, 8, 8, 8, 24, 16))
+        wf = alexnet.AlexNetWorkflow()
+        wf.initialize(device=Device.create("xla"))
+        return wf
+
+    def test_merged_equals_split(self, monkeypatch):
+        from znicz_tpu.parallel import FusedTrainer, fused
+
+        prng.seed_all(77)
+        wf = self._workflow()
+        spec_m, params_m, vels_m = fused.extract_model(wf)
+        assert any(la.kind == "lrn_pool" for la in spec_m.layers)
+        monkeypatch.setenv("ZNICZ_TPU_LRN_POOL", "split")
+        spec_s, params_s, vels_s = fused.extract_model(wf)
+        monkeypatch.delenv("ZNICZ_TPU_LRN_POOL")
+        assert all(la.kind != "lrn_pool" for la in spec_s.layers)
+
+        ld = wf.loader
+        idx = np.arange(ld.class_lengths[2])
+        data, labels = ld.original_data.devmem, ld.original_labels.devmem
+
+        def run(spec, params, vels):
+            tr = FusedTrainer(spec=spec, params=params, vels=vels)
+            for _ in range(2):
+                m = tr.train_epoch(data, labels, idx, 32, sync=True)
+            return m, tr.params
+
+        m_m, p_m = run(spec_m, params_m, vels_m)
+        m_s, p_s = run(spec_s, params_s, vels_s)
+        np.testing.assert_array_equal(np.asarray(m_m["loss"]),
+                                      np.asarray(m_s["loss"]))
+        flat_m = [np.asarray(a) for pair in p_m for a in pair
+                  if a is not None]
+        flat_s = [np.asarray(a) for pair in p_s for a in pair
+                  if a is not None]
+        assert len(flat_m) == len(flat_s)
+        for a, b in zip(flat_m, flat_s):
+            np.testing.assert_array_equal(a, b)

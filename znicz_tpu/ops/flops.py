@@ -99,6 +99,18 @@ def model_flops(spec, params, input_shape) -> dict:
             f = 2.0 * cfg["n"] * n_el + 6.0 * n_el
             fwd += f
             train += 2.0 * f
+        elif layer.kind == "lrn_pool":
+            # fused pair: LRN work on the input extent + pool compares
+            n_el = shape[0] * shape[1] * shape[2]
+            f = 2.0 * cfg["n"] * n_el + 6.0 * n_el
+            kh, kw = norm2(cfg["ksize"])
+            oh, ow = _conv_out_hw(shape[0], shape[1], kh, kw,
+                                  cfg["stride"], cfg["padding"])
+            c = shape[2]
+            f += float(kh * kw * oh * ow * c)
+            fwd += f
+            train += 2.0 * f
+            shape = (oh, ow, c)
         elif layer.kind in ("dropout", "activation"):
             n_el = 1
             for d in shape:

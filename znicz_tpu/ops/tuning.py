@@ -35,6 +35,13 @@ def interpret_mode() -> bool:
     return _INTERPRET and not on_tpu()
 
 
+def lrn_pool_merge() -> bool:
+    """Whether extract_model merges adjacent LRN + max-pool layers into
+    the fused pair op (ops/lrn_pool.py).  ZNICZ_TPU_LRN_POOL=split
+    disables the merge (A/B lever; read per call so bench can toggle)."""
+    return os.environ.get("ZNICZ_TPU_LRN_POOL", "fused") != "split"
+
+
 def force_pallas_conv() -> bool:
     """Whether ZNICZ_TPU_CONV=pallas routes the conv/deconv family to
     the implicit-GEMM Pallas tier (default: XLA's native conv lowering,

@@ -29,8 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import (activations, conv as conv_ops, deconv as deconv_ops,
-                   dropout as drop_ops, normalization as lrn_ops,
-                   pooling as pool_ops, softmax as softmax_ops)
+                   dropout as drop_ops, lrn_pool as lrn_pool_ops,
+                   normalization as lrn_ops, pooling as pool_ops,
+                   softmax as softmax_ops)
 from . import mesh as mesh_lib
 
 #: Layer kinds with trainable parameters.
@@ -41,8 +42,8 @@ PARAM_KINDS = ("fc", "conv", "deconv")
 class LayerSpec:
     kind: str                     # fc | conv | max_pool | maxabs_pool |
     #                               avg_pool | stochastic_pool |
-    #                               stochastic_abs_pool | lrn | dropout |
-    #                               activation
+    #                               stochastic_abs_pool | lrn | lrn_pool |
+    #                               dropout | activation
     activation: str               # activations.BY_NAME key; last fc layer
     include_bias: bool            # of a softmax model keeps "linear"
     hypers: tuple                 # (lr, weights_decay, l1_vs_l2, momentum)
@@ -225,7 +226,62 @@ def extract_model(workflow) -> tuple[ModelSpec, list, list]:
             params.append((None, None))
             vels.append((None, None))
     loss = workflow.loss_function
+    layers, params, vels = _merge_lrn_pool(layers, params, vels)
     return ModelSpec(tuple(layers), loss), params, vels
+
+
+def _merge_lrn_pool(layers, params, vels):
+    """Collapse adjacent (lrn, max_pool|maxabs_pool) pairs into the fused
+    ``lrn_pool`` kind (ops/lrn_pool.py: one HBM pass per direction, the
+    round-2 ablation's ~39%-of-step lever).  Bit-identical to the split
+    layers by construction (same window math, same flat tap order), so
+    the merge is on by default; ZNICZ_TPU_LRN_POOL=split keeps the split
+    layers (A/B lever).  ``tie`` indices (weight-tied deconv, depooling)
+    are remapped; a depooling tied to a merged pool keeps working — the
+    merged layer's aux IS the pool's winner-offset tensor."""
+    from ..ops import tuning
+    if not tuning.lrn_pool_merge():
+        return layers, params, vels
+    out_l, out_p, out_v = [], [], []
+    idx_map = {}
+    i = 0
+    while i < len(layers):
+        la = layers[i]
+        if (i + 1 < len(layers) and la.kind == "lrn"
+                and layers[i + 1].kind in ("max_pool", "maxabs_pool")
+                and lrn_pool_ops.fusable(layers[i + 1].cfg["ksize"],
+                                         layers[i + 1].cfg["stride"],
+                                         layers[i + 1].cfg["padding"])):
+            pool = layers[i + 1]
+            cfg = dict(la.config)
+            cfg.update(pool.config)
+            cfg["use_abs"] = pool.kind == "maxabs_pool"
+            merged = LayerSpec(
+                kind="lrn_pool", activation="linear", include_bias=False,
+                hypers=la.hypers, hypers_bias=la.hypers_bias,
+                config=tuple(sorted(cfg.items())))
+            idx_map[i] = len(out_l)
+            idx_map[i + 1] = len(out_l)   # ties to the pool → merged
+            out_l.append(merged)
+            out_p.append((None, None))
+            out_v.append((None, None))
+            i += 2
+        else:
+            idx_map[i] = len(out_l)
+            out_l.append(la)
+            out_p.append(params[i])
+            out_v.append(vels[i])
+            i += 1
+    if len(out_l) == len(layers):
+        return layers, params, vels
+    remapped = []
+    for la in out_l:
+        cfg = la.cfg
+        if "tie" in cfg:
+            cfg["tie"] = idx_map[cfg["tie"]]
+            la = dataclasses.replace(la, config=tuple(sorted(cfg.items())))
+        remapped.append(la)
+    return remapped, out_p, out_v
 
 
 # -- pure math (all traced; spec is static) --------------------------------
@@ -314,6 +370,14 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
             # that rebuilds it — same remat rationale as dropout masks)
             h = lrn_ops.lrn_y(h, cfg["n"], cfg["alpha"],
                               cfg["beta"], cfg["k"])
+        elif layer.kind == "lrn_pool":
+            # fused pair: the LRN output never touches HBM — the kernel
+            # normalizes in VMEM and pools in the same pass; aux is the
+            # pool's winner-offset tensor (depooling-tie compatible)
+            h, aux = lrn_pool_ops.lrn_maxpool(
+                h, cfg["n"], cfg["alpha"], cfg["beta"], cfg["k"],
+                cfg["ksize"], cfg["stride"], cfg["padding"],
+                cfg["use_abs"])
         elif layer.kind == "dropout":
             if train:
                 # aux stays None: the backward REGENERATES the mask from
@@ -439,6 +503,14 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
             err = lrn_ops.gd_lrn_x(err.reshape(y_i.shape), x_in,
                                    cfg["n"], cfg["alpha"], cfg["beta"],
                                    cfg["k"])
+        elif layer.kind == "lrn_pool":
+            # fused pair backward: pooled err scatters through the
+            # winner offsets and folds through the LRN derivative in one
+            # kernel — err_y never materializes
+            err = lrn_pool_ops.gd_lrn_maxpool(
+                err.reshape(y_i.shape), aux, x_in, cfg["n"],
+                cfg["alpha"], cfg["beta"], cfg["k"], cfg["ksize"],
+                cfg["stride"], cfg["padding"])
         elif layer.kind == "depooling":
             err = pool_ops.gd_depooling(
                 err.reshape(y_i.shape), aux, cfg["ksize"], cfg["stride"],
