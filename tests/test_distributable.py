@@ -1,0 +1,83 @@
+"""The Distributable protocol does real work (VERDICT r2 item 7):
+loaders publish their per-shard arrays via ``generate_data_for_slave``,
+``parallel.distributed.distribute`` assembles globally batch-sharded
+jax.Arrays and installs them via ``apply_data_from_master``, and
+training over the distributed arrays matches the undistributed run."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from znicz_tpu import prng
+from znicz_tpu.backends import Device
+from znicz_tpu.config import root
+from znicz_tpu.models import mnist
+from znicz_tpu.parallel import FusedTrainer, distributed, fused
+from znicz_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture
+def wf():
+    root.mnist.synthetic.update({"n_train": 192, "n_valid": 64,
+                                 "n_test": 0})
+    root.mnist.minibatch_size = 64
+    prng.seed_all(5)
+    w = mnist.MnistWorkflow()
+    w.initialize(device=Device.create("xla"))
+    return w
+
+
+def test_units_without_shard_state_return_none(wf):
+    payloads = {u.name: u.generate_data_for_slave()
+                for u in wf.units}
+    loaders = [n for n, p in payloads.items() if p]
+    assert loaders == [wf.loader.name]
+    payload = payloads[wf.loader.name]
+    assert set(payload) == {"original_data", "original_labels"}
+    local, total = payload["original_data"]
+    assert total == wf.loader.total_samples
+    assert len(local) == total          # single process: full slice
+
+
+def test_distribute_installs_batch_sharded_arrays(wf):
+    mesh = mesh_lib.make_mesh(n_data=8, n_model=1)
+    report = distributed.distribute(wf, mesh)
+    assert report == {wf.loader.name: ["original_data",
+                                       "original_labels"]}
+    garr = wf.loader.original_data.devmem
+    assert isinstance(garr, jax.Array)
+    spec = garr.sharding.spec
+    assert spec[0] == "data"            # batch axis split over the mesh
+    # one shard per device, each 1/8 of the rows
+    assert len(garr.sharding.device_set) == 8
+
+
+def test_training_over_distributed_arrays_matches_local(wf):
+    spec, params, vels = fused.extract_model(wf)
+    ld = wf.loader
+    idx = np.arange(192) + 64           # the train rows
+    labels = np.asarray(ld.original_labels.mem)
+    data = np.asarray(ld.original_data.mem)
+
+    tr_local = FusedTrainer(spec=spec, params=[
+        tuple(np.array(a) if a is not None else None for a in p)
+        for p in params], vels=[
+        tuple(np.array(a) if a is not None else None for a in v)
+        for v in vels])
+    m_local = tr_local.train_epoch(data, labels, idx, 64, sync=True)
+
+    mesh = mesh_lib.make_mesh(n_data=8, n_model=1)
+    distributed.distribute(wf, mesh)
+    tr_dist = FusedTrainer(spec=spec, params=params, vels=vels,
+                           mesh=mesh)
+    m_dist = tr_dist.train_epoch(ld.original_data.devmem,
+                                 ld.original_labels.devmem, idx, 64,
+                                 sync=True)
+    np.testing.assert_allclose(np.asarray(m_dist["loss"]),
+                               np.asarray(m_local["loss"]),
+                               rtol=1e-6, atol=1e-7)
+    for (wl, bl), (wd, bd) in zip(tr_local.params, tr_dist.params):
+        if wl is not None:
+            np.testing.assert_allclose(np.asarray(wd), np.asarray(wl),
+                                       rtol=1e-5, atol=1e-6)

@@ -6,14 +6,22 @@ empty — surveyed contract, SURVEY.md §2.1): the master–slave job protocol
 generate_data_for_master → apply_data_from_slave``.
 
 TPU-first redesign (SURVEY.md §2.4, the north star): the asynchronous
-parameter-server star becomes synchronous SPMD data parallelism — gradient
-aggregation (the reference's ``apply_data_from_slave`` fold) is a
-``jax.lax.psum`` over the mesh's data axis inside the jitted step, riding
-ICI.  The protocol methods are retained as the *sharding contract*: they
-describe which state a unit owns globally (weights: replicated) vs
-per-shard (minibatches: split), which is exactly what
-``znicz_tpu.parallel`` needs to build shardings.  Units that carry no
-distributed state inherit these no-ops.
+parameter-server star becomes synchronous SPMD data parallelism, and the
+surviving hooks are the **sharding contract**
+:func:`znicz_tpu.parallel.distributed.distribute` consumes:
+
+* ``generate_data_for_slave`` → ``{vector_name: (local_rows, total)}``
+  — the per-shard arrays this unit owns on this process (loaders return
+  their dataset shard; units with only replicated state return None);
+* ``apply_data_from_master`` — install the globally batch-sharded
+  jax.Arrays ``distribute`` assembled from every process's shard.
+
+The gradient-fold pair (``generate_data_for_master`` /
+``apply_data_from_slave``) is absorbed into the compiled step — the
+reference's aggregation point is a ``jax.lax.psum`` over the mesh's data
+axis riding ICI — so those hooks stay no-ops by design; ``drop_slave``
+maps to restart-from-checkpoint
+(:class:`znicz_tpu.parallel.distributed.CheckpointRecovery`).
 """
 
 from __future__ import annotations
@@ -26,17 +34,22 @@ class Distributable:
     negotiates_on_connect = False
 
     def generate_data_for_slave(self, slave=None):
-        """Master→slave payload (reference).  TPU mapping: the per-shard
-        slice spec this unit consumes (e.g. loader minibatch indices)."""
+        """Per-shard payload: ``{vector_name: (local_rows, total_rows)}``
+        of the arrays this unit owns that are SPLIT over the data axis,
+        or None when the unit carries only replicated state.  Consumed
+        by ``parallel.distributed.distribute`` (loaders implement it —
+        ``loader.fullbatch.FullBatchLoader.generate_data_for_slave``)."""
         return None
 
     def apply_data_from_master(self, data) -> None:
-        """Slave applies master payload (reference).  TPU mapping: install
-        the shard slice before the step."""
+        """Install the globally sharded arrays assembled from every
+        process's ``generate_data_for_slave`` payload (loaders set their
+        Vectors' devmem to the batch-sharded jax.Arrays)."""
 
     def generate_data_for_master(self):
-        """Slave→master payload (reference: gradients/stats).  TPU mapping:
-        the pytree this unit contributes to the cross-replica reduction."""
+        """Slave→master payload (reference: gradients/stats).  TPU
+        mapping: absorbed — the pytree a unit contributes to the
+        cross-replica reduction lives inside the jitted step (psum)."""
         return None
 
     def apply_data_from_slave(self, data, slave=None) -> None:

@@ -49,6 +49,35 @@ class FullBatchLoader(Loader):
         self.minibatch_labels.mem = np.zeros(
             (self.max_minibatch_size,), self.original_labels.dtype)
 
+    # -- Distributable protocol (the real hooks, consumed by
+    # parallel.distributed.distribute) --------------------------------
+    def _shard_vectors(self) -> tuple[str, ...]:
+        """Names of the Vectors that are PER-SHARD in distributed runs
+        (split over the mesh's data axis); everything else a unit owns
+        is replicated.  This tuple is the loader's sharding contract."""
+        return ("original_data", "original_labels")
+
+    def generate_data_for_slave(self, slave=None):
+        """This process's shard of every per-shard Vector (reference:
+        the master cutting a slave's minibatch slice — here each process
+        cuts its own contiguous row range, once per dataset)."""
+        from ..parallel import distributed
+        sl = distributed.process_shard(self.total_samples)
+        out = {}
+        for name in self._shard_vectors():
+            vec = getattr(self, name, None)
+            if vec is not None and vec:
+                out[name] = (np.asarray(vec.mem[sl]), self.total_samples)
+        return out or None
+
+    def apply_data_from_master(self, data) -> None:
+        """Install the globally sharded arrays the 'master' assembled
+        from every process's shard (reference: slave receiving its job
+        payload; here the payload is one global jax.Array per Vector,
+        batch-sharded over the mesh)."""
+        for name, garr in data.items():
+            getattr(self, name).devmem = garr
+
     def _normalize(self) -> None:
         """Apply the reference normalizer family (znicz_tpu.normalization);
         statistics are fitted on the whole resident dataset once and kept
@@ -96,6 +125,9 @@ class FullBatchLoaderMSE(FullBatchLoader):
         super().__init__(workflow, name, **kwargs)
         self.original_targets = Vector()
         self.minibatch_targets = Vector()
+
+    def _shard_vectors(self) -> tuple[str, ...]:
+        return super()._shard_vectors() + ("original_targets",)
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device, **kwargs)

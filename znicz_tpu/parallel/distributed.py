@@ -79,6 +79,35 @@ def shard_dataset(local_rows: np.ndarray, mesh, total_rows: int
         sharding, np.ascontiguousarray(local_rows), global_shape)
 
 
+def distribute(workflow, mesh) -> dict:
+    """Distribute an initialized workflow's per-shard state over ``mesh``
+    through the **Distributable protocol** — the SPMD rendition of the
+    reference master loop (SURVEY.md §2.1 Distributable row; §3.2):
+
+    for each unit, ``generate_data_for_slave()`` publishes the shard of
+    every per-shard array this process owns (``{name: (local_rows,
+    total_rows)}``; ``None`` = unit owns only replicated state); the
+    'master' role — here just this function, since every process runs
+    it symmetrically — assembles one globally batch-sharded jax.Array
+    per entry (:func:`shard_dataset`); ``apply_data_from_master``
+    installs them back into the unit.  Gradient aggregation (the
+    reference's ``apply_data_from_slave`` fold) stays inside the jitted
+    step as a psum over the data axis.
+
+    Returns ``{unit_name: [vector names sharded]}`` for logging."""
+    out = {}
+    for unit in workflow.units:
+        payload = unit.generate_data_for_slave()
+        if not payload:
+            continue
+        installed = {
+            name: shard_dataset(local, mesh, int(total))
+            for name, (local, total) in sorted(payload.items())}
+        unit.apply_data_from_master(installed)
+        out[unit.name] = sorted(installed)
+    return out
+
+
 class CheckpointRecovery:
     """Failure recovery loop: snapshot every N epochs, resume after a
     crash (reference: master requeued a lost slave's job; with SPMD the
