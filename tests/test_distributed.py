@@ -90,6 +90,70 @@ class TestTrueMultiProcess:
                                    rtol=1e-5, atol=1e-6)
 
 
+class TestTwoProcessCombined:
+    """VERDICT r2 item 5: 2 processes × 2 devices each (4-device global
+    mesh) with gradient accumulation + bf16 activation storage + a
+    mid-run checkpoint/rebuild — compared against a single-process run
+    of the identical math."""
+
+    def test_accum_bf16_checkpoint_matches_single(self, tmp_path):
+        import dataclasses
+        import os
+        import socket
+        import subprocess
+        import sys
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        out = tmp_path / "combined_final.npy"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        worker = os.path.join(repo, "tests", "_distributed_worker.py")
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(port), str(i), "2", str(out),
+             "combined"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True) for i in range(2)]
+        outs = [p.communicate(timeout=300) for p in procs]
+        for p, (so, se) in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{so}\n{se}"
+        w_multi = np.load(out)
+
+        # single-process reference: identical math (accum 2, bf16
+        # storage, checkpoint round-trip is an exact no-op here)
+        from znicz_tpu.parallel import FusedTrainer
+        from znicz_tpu.parallel.fused import LayerSpec, ModelSpec
+        n, feats, classes = 64, 32, 5
+        rng = np.random.default_rng(3)
+        data = rng.standard_normal((n, feats)).astype(np.float32)
+        labels = rng.integers(0, classes, n).astype(np.int32)
+        w0 = (rng.standard_normal((feats, classes)) * 0.1
+              ).astype(np.float32)
+        spec = dataclasses.replace(ModelSpec((LayerSpec(
+            kind="fc", activation="linear", include_bias=True,
+            hypers=(0.05, 0.0, 0.0, 0.9),
+            hypers_bias=(0.05, 0.0, 0.0, 0.9)),), "softmax"),
+            storage_dtype="bfloat16")
+        params = [(w0, np.zeros(classes, np.float32))]
+        vels = [(np.zeros_like(w0), np.zeros(classes, np.float32))]
+        tr = FusedTrainer(spec=spec, params=params, vels=vels,
+                          accum_steps=2)
+        idx = np.arange(n)
+        tr.train_epoch(data, labels, idx, 16, epoch=0)
+        # checkpoint round-trip (host copies), rebuild, second epoch
+        p2 = [(np.asarray(w), np.asarray(b)) for w, b in tr.params]
+        v2 = [(np.asarray(w), np.asarray(b)) for w, b in tr.vels]
+        tr2 = FusedTrainer(spec=spec, params=p2, vels=v2, accum_steps=2)
+        tr2.train_epoch(data, labels, idx, 16, epoch=1)
+        np.testing.assert_allclose(w_multi, np.asarray(tr2.params[0][0]),
+                                   rtol=1e-5, atol=1e-6)
+
+
 class TestRecovery:
     def test_crash_resume_continues_training(self, tmp_path):
         """Snapshot mid-training, rebuild from scratch, resume, finish —
