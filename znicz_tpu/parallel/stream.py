@@ -36,7 +36,8 @@ class StreamTrainer(FusedTrainer):
     def __init__(self, workflow=None, spec=None, params=None, vels=None,
                  mesh=None, loader: StreamingLoader | None = None,
                  prefetch_depth: int = 2, mse_target: str = "input",
-                 accum_steps: int = 1, augment=None):
+                 accum_steps: int = 1, augment=None,
+                 step_callback=None):
         if augment is not None:
             # streaming augmentation lives on the LOADER (host-side in
             # the prefetch stage) — a trainer-level augment here would
@@ -60,6 +61,11 @@ class StreamTrainer(FusedTrainer):
         #: x doubles as the target: skip the label decode+transfer too
         self._x_is_target = (self.spec.loss == "mse"
                              and mse_target == "input")
+        #: optional ``callback(epoch, step_index)`` invoked after every
+        #: streamed micro-step (between accumulation micro-steps too) —
+        #: progress reporting, watchdogs, and the failure-parity tests'
+        #: mid-group kill point
+        self.step_callback = step_callback
         self._step_fn = None
         self._eval_fn = None
 
@@ -166,6 +172,8 @@ class StreamTrainer(FusedTrainer):
                     acc = None
             losses.append(m["loss"])
             n_errs.append(m["n_err"])
+            if self.step_callback is not None:
+                self.step_callback(epoch, step_i)
         ms = {"loss": jnp.stack(losses), "n_err": jnp.stack(n_errs)}
         return {k: np.asarray(v) for k, v in ms.items()} if sync else ms
 

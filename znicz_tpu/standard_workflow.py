@@ -217,7 +217,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                   compute_dtype: str | None = None,
                   storage_dtype: str | None = None,
                   profile_dir: str | None = None,
-                  mse_target: str | None = None):
+                  mse_target: str | None = None,
+                  step_callback=None):
         """Train via the compiled fused step instead of the unit-graph
         tick loop: whole epochs run as one device-side ``lax.scan``
         (optionally mesh-sharded), with Decision's improvement/stop logic
@@ -235,11 +236,15 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             ctx = contextlib.nullcontext()
         with ctx:
             return self._run_fused_body(mesh, max_epochs, compute_dtype,
-                                        storage_dtype, mse_target)
+                                        storage_dtype, mse_target,
+                                        step_callback)
 
     def _run_fused_body(self, mesh, max_epochs, compute_dtype,
-                        storage_dtype=None, mse_target=None):
+                        storage_dtype=None, mse_target=None,
+                        step_callback=None):
         import dataclasses
+
+        from .config import root
 
         from .loader.base import TEST, TRAIN, VALID
         from .parallel import FusedTrainer, fused
@@ -269,10 +274,17 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                         mse_target = "labels"
             trainer = StreamTrainer(spec=spec, params=params, vels=vels,
                                     mesh=mesh, loader=self.loader,
-                                    mse_target=mse_target)
+                                    mse_target=mse_target,
+                                    accum_steps=int(
+                                        root.common.get("accum_steps")
+                                        or 1),
+                                    step_callback=step_callback)
         else:
             trainer = FusedTrainer(spec=spec, params=params, vels=vels,
-                                   mesh=mesh)
+                                   mesh=mesh,
+                                   accum_steps=int(
+                                       root.common.get("accum_steps")
+                                       or 1))
         trainer.workflow = self
         loader, decision = self.loader, self.decision
         if isinstance(loader, StreamingLoader):
