@@ -37,6 +37,19 @@ class TestMatmul:
                                             jnp.asarray(w)))
         np.testing.assert_allclose(g, p, rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.parametrize("shape", [(700, 72, 16), (128, 128, 128),
+                                       (9, 5, 3), (2000, 130, 260)])
+    def test_pallas_at_b_matches_numpy(self, pallas_interpret, shape):
+        """aᵀ@b without materializing aᵀ (the conv weight-grad shape:
+        M huge, K/N modest) — row blocks accumulate per output tile."""
+        m, k, n = shape
+        a = rng.standard_normal((m, k)).astype(np.float32)
+        b = rng.standard_normal((m, n)).astype(np.float32)
+        g = a.T @ b
+        p = np.asarray(matmul.pallas_matmul_at_b(jnp.asarray(a),
+                                                 jnp.asarray(b)))
+        np.testing.assert_allclose(g, p, rtol=1e-4, atol=1e-3)
+
 
 class TestSoftmax:
     def test_pallas_softmax(self, pallas_interpret):

@@ -187,16 +187,19 @@ def pallas_conv2d_grad_input(err, w, x_shape, stride=1, padding=0):
 def pallas_conv2d_grad_weights(x, err, w_shape, stride=1, padding=0):
     """Implicit-GEMM weight grad: colsᵀ·err on the MXU — cols is the
     same patch matrix as the forward, so dw = (B·OH·OW, C·KH·KW)ᵀ @
-    (B·OH·OW, OC), reshaped to (KH, KW, C, OC)."""
+    (B·OH·OW, OC), reshaped to (KH, KW, C, OC).  The transposed-lhs
+    kernel streams cols in its natural row-major layout (round-3 retile:
+    the old ``cols.T`` materialized an extra HBM copy of the ~KH·KW×
+    activation-sized patch matrix before the matmul)."""
     kh, kw, c, oc = w_shape
     (sh, sw), (ph, pw) = _norm2(stride), _norm2(padding)
     cols = lax.conv_general_dilated_patches(
         x, (kh, kw), (sh, sw), ((ph, ph), (pw, pw)),
         dimension_numbers=_DIMNUMS)          # (B, OH, OW, C*KH*KW)
     k = cols.shape[-1]
-    dw = matmul.pallas_matmul(cols.reshape(-1, k).T,
-                              err.reshape(-1, oc),
-                              out_dtype=jnp.float32)
+    dw = matmul.pallas_matmul_at_b(cols.reshape(-1, k),
+                                   err.reshape(-1, oc),
+                                   out_dtype=jnp.float32)
     return jnp.transpose(dw.reshape(c, kh, kw, oc), (1, 2, 0, 3))
 
 
