@@ -34,10 +34,14 @@ struct Shard {
   int64_t label_row_bytes = 0;  // one label row
 };
 
+// pos == nullptr: dense output (row i lands at slot i); otherwise row i
+// lands at slot pos[i] — the multi-shard scatter the Python loader used
+// to pay a second full memcpy for.
 void copy_rows(const char* src_base, int64_t src_off, int64_t row_bytes,
-               const int64_t* idx, int64_t lo, int64_t hi, char* out) {
+               const int64_t* idx, const int64_t* pos, int64_t lo,
+               int64_t hi, char* out) {
   for (int64_t i = lo; i < hi; ++i) {
-    std::memcpy(out + i * row_bytes,
+    std::memcpy(out + (pos ? pos[i] : i) * row_bytes,
                 src_base + src_off + idx[i] * row_bytes,
                 static_cast<size_t>(row_bytes));
   }
@@ -78,28 +82,43 @@ void* znr_open(const char* path, int64_t n, int64_t data_at,
 }
 
 // Gather k rows into caller buffers; out_labels may be null (label IO
-// skipped — the autoencoder streaming contract).  Returns 0, or -1 on
-// any out-of-range index (nothing partial is trusted then).
-int znr_gather(void* handle, const int64_t* idx, int64_t k,
-               char* out_data, char* out_labels, int n_threads) {
+// skipped — the autoencoder streaming contract).  ``pos`` may be null
+// (dense output) or give each row's output slot — the loader's
+// multi-shard scatter runs here instead of as a second Python memcpy.
+// Returns 0, or -1 on any out-of-range index or slot (nothing partial
+// is trusted then).
+int znr_gather_scatter(void* handle, const int64_t* idx, int64_t k,
+                       char* out_data, char* out_labels,
+                       const int64_t* pos, int64_t out_rows,
+                       int n_threads) {
   auto* s = static_cast<Shard*>(handle);
   if (!s || k < 0) return -1;
   for (int64_t i = 0; i < k; ++i)
     if (idx[i] < 0 || idx[i] >= s->n) return -1;
+  if (pos)
+    for (int64_t i = 0; i < k; ++i)
+      if (pos[i] < 0 || pos[i] >= out_rows) return -1;
   // n_threads is the CALLER'S upper bound (e.g. 1 = keep gathers
   // serial when several prefetch workers gather concurrently); the
   // shared policy in parallel.h applies its own hardware/work caps
   znicz::parallel_chunks(
       k, s->row_bytes,
       [&](int64_t lo, int64_t hi) {
-        copy_rows(s->base, s->data_at, s->row_bytes, idx, lo, hi,
+        copy_rows(s->base, s->data_at, s->row_bytes, idx, pos, lo, hi,
                   out_data);
       },
       n_threads);
   if (out_labels && s->label_row_bytes > 0)
-    copy_rows(s->base, s->labels_at, s->label_row_bytes, idx, 0, k,
+    copy_rows(s->base, s->labels_at, s->label_row_bytes, idx, pos, 0, k,
               out_labels);
   return 0;
+}
+
+int znr_gather(void* handle, const int64_t* idx, int64_t k,
+               char* out_data, char* out_labels, int n_threads) {
+  auto* s = static_cast<Shard*>(handle);
+  return znr_gather_scatter(handle, idx, k, out_data, out_labels,
+                            nullptr, s ? s->n : 0, n_threads);
 }
 
 void znr_close(void* handle) {

@@ -739,3 +739,77 @@ class TestDeviceAugmentation:
         with pytest.raises(ValueError, match="on the StreamingLoader"):
             StreamTrainer(augment=RandomCropFlip((4, 4)))
 
+    def test_stream_device_augment_equals_host_augment(self, tmp_path):
+        """device_augment=True ships raw decode-size rows and crops in
+        the jitted step — same counter-RNG, so training must match the
+        host-augmented stream (round-3: the --loader bench measured
+        host augmentation as the streamed pipeline's bottleneck)."""
+        from znicz_tpu.loader import RandomCropFlip, RecordLoader
+        from znicz_tpu.parallel.fused import LayerSpec, ModelSpec
+        from znicz_tpu.parallel.stream import StreamTrainer
+
+        gen = prng.get("devaug3")
+        n, big, crop, classes = 48, 12, 8, 5
+        data = np.asarray(gen.normal(size=(n, big, big, 2)), np.float32)
+        labels = gen.randint(0, classes, n).astype(np.int32)
+        hyp = (0.05, 0.0, 0.0, 0.9)
+        spec = ModelSpec(layers=(
+            LayerSpec("conv", "tanh", True, hyp, hyp,
+                      (("padding", (1, 1)), ("stride", (1, 1)))),
+            LayerSpec("fc", "linear", True, hyp, hyp)), loss="softmax")
+        params = [(np.asarray(gen.normal(0, 0.2, (3, 3, 2, 4)),
+                              np.float32), np.zeros(4, np.float32)),
+                  (np.asarray(gen.normal(0, 0.1,
+                                         (crop * crop * 4, classes)),
+                              np.float32),
+                   np.zeros(classes, np.float32))]
+        vels = [(np.zeros_like(w), np.zeros_like(b)) for w, b in params]
+        pol = RandomCropFlip((crop, crop), mirror=True, seed=77)
+        paths = write_records(str(tmp_path / "da.znr"), data, labels)
+        cp = lambda t: [tuple(np.array(a) for a in p)    # noqa: E731
+                        for p in t]
+        idx = np.arange(n)
+
+        def run(device_augment):
+            sld = RecordLoader(Workflow(name="w"), train_paths=paths,
+                               minibatch_size=12, augment=pol)
+            sld.initialize(NumpyDevice())
+            st = StreamTrainer(spec=spec, params=cp(params),
+                               vels=cp(vels), loader=sld,
+                               device_augment=device_augment)
+            for ep in range(2):
+                m = st.train_epoch(None, None, idx, 12, epoch=ep)
+            ev = st.eval_epoch(None, None, idx, 12)
+            return m, ev, st.params
+
+        hm, hev, hp = run(False)
+        dm, dev_, dp = run(True)
+        np.testing.assert_allclose(dm["loss"], hm["loss"], rtol=1e-6)
+        np.testing.assert_allclose(dev_["loss"], hev["loss"], rtol=1e-6)
+        for (hw, hb), (dw, db) in zip(hp, dp):
+            np.testing.assert_allclose(np.asarray(dw), np.asarray(hw),
+                                       rtol=1e-5, atol=1e-7)
+            np.testing.assert_allclose(np.asarray(db), np.asarray(hb),
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_device_augment_needs_policy(self, tmp_path):
+        from znicz_tpu.loader import RecordLoader
+        from znicz_tpu.parallel.fused import LayerSpec, ModelSpec
+        from znicz_tpu.parallel.stream import StreamTrainer
+        paths = write_records(str(tmp_path / "p.znr"),
+                              np.zeros((8, 4, 4, 1), np.float32),
+                              np.zeros(8, np.int32))
+        sld = RecordLoader(Workflow(name="w"), train_paths=paths,
+                           minibatch_size=4)          # no augment policy
+        sld.initialize(NumpyDevice())
+        hyp = (0.05, 0.0, 0.0, 0.9)
+        spec = ModelSpec((LayerSpec("fc", "linear", True, hyp, hyp),),
+                         "softmax")
+        params = [(np.zeros((16, 3), np.float32),
+                   np.zeros(3, np.float32))]
+        vels = [(np.zeros((16, 3), np.float32),
+                 np.zeros(3, np.float32))]
+        with pytest.raises(ValueError, match="augment policy"):
+            StreamTrainer(spec=spec, params=params, vels=vels,
+                          loader=sld, device_augment=True)
+

@@ -73,6 +73,12 @@ def _native() -> ctypes.CDLL | None:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_int]
+        lib.znr_gather_scatter.restype = ctypes.c_int
+        lib.znr_gather_scatter.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int]
         lib.znr_close.argtypes = [ctypes.c_void_p]
         _native_lib = lib
     except Exception:
@@ -217,13 +223,15 @@ class RecordFile:
         data = np.empty((k, *self.data_shape), self.data_dtype)
         labels = (np.empty((k, *self.label_shape), self.label_dtype)
                   if want_labels else None)
+        workers = int(os.environ.get("ZNICZ_TPU_IO_WORKERS", 0)) \
+            or min(8, max(1, os.cpu_count() or 1))
         rc = lib.znr_gather(
             self._h, idx64.ctypes.data_as(
                 ctypes.POINTER(ctypes.c_int64)), k,
             data.ctypes.data_as(ctypes.c_char_p),
             labels.ctypes.data_as(ctypes.c_char_p)
             if labels is not None else None,
-            min(8, max(1, os.cpu_count() or 1)))
+            workers)
         if rc != 0:
             raise IndexError(f"{self.path}: row index out of range")
         return data, labels
@@ -254,6 +262,38 @@ class RecordFile:
         if nidx is not None:
             return self._native_gather(nidx, want_labels=False)[0]
         return np.asarray(self.data[idx])
+
+    def read_batch_into(self, indices, data_out: np.ndarray,
+                        labels_out: np.ndarray | None,
+                        positions: np.ndarray) -> bool:
+        """Gather rows ``indices`` directly into caller buffers at row
+        slots ``positions`` (the multi-shard scatter) — one memcpy per
+        row in C++, no intermediate batch.  Returns False when the
+        native plane is unavailable (caller falls back)."""
+        idx = np.asarray(indices)
+        nidx = self._native_idx(idx)
+        if nidx is None or data_out.dtype != self.data_dtype \
+                or not data_out.flags.c_contiguous \
+                or (labels_out is not None
+                    and (labels_out.dtype != self.label_dtype
+                         or not labels_out.flags.c_contiguous)):
+            return False
+        idx64 = np.ascontiguousarray(nidx, np.int64)
+        pos64 = np.ascontiguousarray(positions, np.int64)
+        workers = int(os.environ.get("ZNICZ_TPU_IO_WORKERS", 0)) \
+            or min(8, max(1, os.cpu_count() or 1))
+        rc = self._lib.znr_gather_scatter(
+            self._h,
+            idx64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx64),
+            data_out.ctypes.data_as(ctypes.c_char_p),
+            labels_out.ctypes.data_as(ctypes.c_char_p)
+            if labels_out is not None else None,
+            pos64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(data_out), workers)
+        if rc != 0:
+            raise IndexError(f"{self.path}: row index/slot out of range")
+        return True
 
     def close(self) -> None:
         if getattr(self, "_h", None):

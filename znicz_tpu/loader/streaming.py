@@ -169,15 +169,29 @@ class RecordLoader(StreamingLoader):
     def read_batch(self, indices) -> tuple[np.ndarray, np.ndarray]:
         idx = np.asarray(indices, np.int64)
         which = np.searchsorted(self._bounds, idx, side="right") - 1
+        files = np.unique(which)
+        if len(files) == 1 and self._files[files[0]].data_dtype \
+                == np.float32:
+            # single-shard batch (the common case): the shard's own
+            # gather IS the result — no second alloc, no second memcpy
+            # (non-f32 shards keep the allocating path: consumers get
+            # float32, as before)
+            f_i = files[0]
+            return self._files[f_i].read_batch(idx - self._file_base[f_i])
         data = np.empty((len(idx), *self.raw_sample_shape), np.float32)
         labels = np.empty((len(idx), *self.label_shape),
                           self.label_dtype)
-        for f_i in np.unique(which):
+        for f_i in files:
             sel = which == f_i
             local = idx[sel] - self._file_base[f_i]
-            d, l = self._files[f_i].read_batch(local)
-            data[sel] = d
-            labels[sel] = l
+            rf = self._files[f_i]
+            # scatter straight into the batch buffers in C++ (one
+            # memcpy per row); python fallback pays the double copy
+            if not rf.read_batch_into(local, data, labels,
+                                      np.flatnonzero(sel)):
+                d, l = rf.read_batch(local)
+                data[sel] = d
+                labels[sel] = l
         return data, labels
 
     def read_data(self, indices) -> np.ndarray:
@@ -185,11 +199,19 @@ class RecordLoader(StreamingLoader):
         denoising-sized label block would double the disk read)."""
         idx = np.asarray(indices, np.int64)
         which = np.searchsorted(self._bounds, idx, side="right") - 1
+        files = np.unique(which)
+        if len(files) == 1 and self._files[files[0]].data_dtype \
+                == np.float32:
+            f_i = files[0]
+            return self._files[f_i].read_data(idx - self._file_base[f_i])
         data = np.empty((len(idx), *self.raw_sample_shape), np.float32)
-        for f_i in np.unique(which):
+        for f_i in files:
             sel = which == f_i
             local = idx[sel] - self._file_base[f_i]
-            data[sel] = self._files[f_i].read_data(local)
+            rf = self._files[f_i]
+            if not rf.read_batch_into(local, data, None,
+                                      np.flatnonzero(sel)):
+                data[sel] = rf.read_data(local)
         return data
 
 
@@ -278,13 +300,17 @@ class BatchPrefetcher:
 
     def __init__(self, loader: StreamingLoader, index_rows,
                  depth: int = 2, device_put=None,
-                 skip_labels: bool = False, epoch=None):
+                 skip_labels: bool = False, epoch=None,
+                 raw: bool = False):
         import jax
         self.loader = loader
         self.rows = index_rows
         self.depth = depth
         #: augmentation coordinate (None → eval: center crops only)
         self.epoch = epoch
+        #: raw=True ships UNAUGMENTED decode-size rows — the consumer
+        #: applies the policy on-device (StreamTrainer device_augment)
+        self.raw = raw
         self._put = device_put or jax.device_put
         #: consumer reconstructs the input (autoencoder streaming):
         #: yields (x, None), reading via loader.read_data so the label
@@ -300,12 +326,16 @@ class BatchPrefetcher:
         try:
             for row in self.rows:
                 if self.skip_labels:
-                    x = self.loader.fetch_data(np.asarray(row),
-                                               epoch=self.epoch)
+                    x = (self.loader.read_data(np.asarray(row))
+                         if self.raw else
+                         self.loader.fetch_data(np.asarray(row),
+                                                epoch=self.epoch))
                     item = (self._put(x), None)
                 else:
-                    x, t = self.loader.fetch(np.asarray(row),
-                                             epoch=self.epoch)
+                    x, t = (self.loader.read_batch(np.asarray(row))
+                            if self.raw else
+                            self.loader.fetch(np.asarray(row),
+                                              epoch=self.epoch))
                     item = (self._put(x), self._put(t))
                 while not self._stopped:     # bounded-put with stop check
                     try:

@@ -37,7 +37,7 @@ class StreamTrainer(FusedTrainer):
                  mesh=None, loader: StreamingLoader | None = None,
                  prefetch_depth: int = 2, mse_target: str = "input",
                  accum_steps: int = 1, augment=None,
-                 step_callback=None):
+                 step_callback=None, device_augment: bool = False):
         if augment is not None:
             # streaming augmentation lives on the LOADER (host-side in
             # the prefetch stage) — a trainer-level augment here would
@@ -66,6 +66,18 @@ class StreamTrainer(FusedTrainer):
         #: progress reporting, watchdogs, and the failure-parity tests'
         #: mid-group kill point
         self.step_callback = step_callback
+        #: move the loader's augmentation policy onto the DEVICE: the
+        #: prefetcher ships raw decode-size rows and the jitted step
+        #: applies ``policy.device_apply`` (bit-identical pixels to the
+        #: host application — same counter-RNG — but the crop runs on
+        #: the idle VPU instead of the loader-bound host CPU, which the
+        #: --loader bench measured as the augmented pipeline's
+        #: bottleneck)
+        self.device_augment = bool(device_augment)
+        if self.device_augment and getattr(self.loader, "augment",
+                                           None) is None:
+            raise ValueError("device_augment=True needs an augment "
+                             "policy on the StreamingLoader")
         self._step_fn = None
         self._eval_fn = None
 
@@ -73,22 +85,27 @@ class StreamTrainer(FusedTrainer):
     def _build_steps(self):
         spec = self.spec
         x_is_target = self._x_is_target
+        aug = self.loader.augment if self.device_augment else None
 
         def step(params, vels, x, t, mask, epoch, ctr, lr_scale,
-                 lr_scale_bias):
+                 lr_scale_bias, rows):
             if self._batch_sharding is not None:
                 x = jax.lax.with_sharding_constraint(
                     x, self._batch_sharding)
+            if aug is not None:
+                x = aug.device_apply(x, rows, epoch, train=True)
             return train_minibatch(spec, params, vels, x,
                                    x if x_is_target else t, mask,
                                    epoch=epoch, ctr=ctr,
                                    lr_scale=lr_scale,
                                    lr_scale_bias=lr_scale_bias)
 
-        def estep(params, x, t, mask):
+        def estep(params, x, t, mask, rows):
             if self._batch_sharding is not None:
                 x = jax.lax.with_sharding_constraint(
                     x, self._batch_sharding)
+            if aug is not None:
+                x = aug.device_apply(x, rows, 0, train=False)
             return eval_minibatch(spec, params, x,
                                   x if x_is_target else t, mask)
 
@@ -101,10 +118,12 @@ class StreamTrainer(FusedTrainer):
             # call-end contract)
             from .fused import apply_updates, grad_minibatch
 
-            def gstep(params, x, t, mask, epoch, ctr):
+            def gstep(params, x, t, mask, epoch, ctr, rows):
                 if self._batch_sharding is not None:
                     x = jax.lax.with_sharding_constraint(
                         x, self._batch_sharding)
+                if aug is not None:
+                    x = aug.device_apply(x, rows, epoch, train=True)
                 return grad_minibatch(spec, params, x,
                                       x if x_is_target else t, mask,
                                       epoch=epoch, ctr=ctr)
@@ -142,7 +161,8 @@ class StreamTrainer(FusedTrainer):
                                            ctr_base)
         pf = BatchPrefetcher(self.loader, idx, depth=self.prefetch_depth,
                              device_put=self._device_put,
-                             skip_labels=self._x_is_target, epoch=epoch)
+                             skip_labels=self._x_is_target, epoch=epoch,
+                             raw=self.device_augment)
         losses, n_errs = [], []
         ep = jnp.uint32(epoch)
         scales, scales_b = self._step_scales(lr_scale, lr_scale_bias,
@@ -153,15 +173,16 @@ class StreamTrainer(FusedTrainer):
         for step_i, (x, t) in enumerate(pf):
             ls = jnp.float32(scales[step_i])
             lsb = jnp.float32(scales_b[step_i])
+            rows = jnp.asarray(idx[step_i], jnp.int32)
             if accum == 1:
                 self.params, self.vels, m = self._step_fn(
                     self.params, self.vels, x, t,
                     jnp.asarray(mask[step_i]), ep,
-                    jnp.uint32(ctrs[step_i]), ls, lsb)
+                    jnp.uint32(ctrs[step_i]), ls, lsb, rows)
             else:
                 grads, m = self._grad_fn(self.params, x, t,
                                          jnp.asarray(mask[step_i]), ep,
-                                         jnp.uint32(ctrs[step_i]))
+                                         jnp.uint32(ctrs[step_i]), rows)
                 # a group's first grads ARE the accumulator (right
                 # structure, dtype and sharding — no zeros round-trip)
                 acc = grads if acc is None \
@@ -184,11 +205,13 @@ class StreamTrainer(FusedTrainer):
         idx, mask, _ = self._idx_matrix(np.asarray(indices), batch)
         pf = BatchPrefetcher(self.loader, idx, depth=self.prefetch_depth,
                              device_put=self._device_put,
-                             skip_labels=self._x_is_target)
+                             skip_labels=self._x_is_target,
+                             raw=self.device_augment)
         losses, n_errs = [], []
         for step_i, (x, t) in enumerate(pf):
             m = self._eval_fn(self.params, x, t,
-                              jnp.asarray(mask[step_i]))
+                              jnp.asarray(mask[step_i]),
+                              jnp.asarray(idx[step_i], jnp.int32))
             losses.append(m["loss"])
             n_errs.append(m["n_err"])
         ms = {"loss": jnp.stack(losses), "n_err": jnp.stack(n_errs)}

@@ -278,7 +278,14 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                                     accum_steps=int(
                                         root.common.get("accum_steps")
                                         or 1),
-                                    step_callback=step_callback)
+                                    step_callback=step_callback,
+                                    # bit-identical pixels to the host
+                                    # application, but the crop rides
+                                    # the device step instead of the
+                                    # loader-bound host CPU
+                                    device_augment=getattr(
+                                        self.loader, "augment",
+                                        None) is not None)
         else:
             trainer = FusedTrainer(spec=spec, params=params, vels=vels,
                                    mesh=mesh,
