@@ -77,12 +77,15 @@ def write_lmdb(path: str, items: list[tuple[bytes, bytes]],
     """items must be key-sorted.  ``force_overflow`` stores every value
     on overflow pages; ``per_leaf`` forces a multi-leaf (branch) tree."""
     data_pages: list[bytes] = []       # pgno 2..
-    next_pg = 2
+    raw_pages: set[int] = set()        # overflow CONTINUATIONS: no
+    next_pg = 2                        # header — never stamp a pgno
 
-    def alloc(page: bytes) -> int:
+    def alloc(page: bytes, raw: bool = False) -> int:
         nonlocal next_pg
         data_pages.append(page)
         pg = next_pg
+        if raw:
+            raw_pages.add(pg)
         next_pg += 1
         return pg
 
@@ -93,16 +96,16 @@ def write_lmdb(path: str, items: list[tuple[bytes, bytes]],
         nodes = []
         for key, val in group:
             if force_overflow or len(val) > 1500:
-                n_ov = -(-len(val) // (_PAGE - 16))
-                ov_pg = None
-                blob = val + b"\0" * (n_ov * (_PAGE - 16) - len(val))
-                for i in range(n_ov):
-                    head = struct.pack("<QHHI", 0, 0, _P_OVERFLOW,
-                                       n_ov if i == 0 else 0)
-                    pg = alloc(head + blob[i * (_PAGE - 16):
-                                           (i + 1) * (_PAGE - 16)])
-                    if i == 0:
-                        ov_pg = pg
+                # spec-conformant overflow chunk (mdb.c): ONE header on
+                # the first page, the value contiguous across all n_ov
+                # pages (no interleaved headers)
+                n_ov = -(-(16 + len(val)) // _PAGE)
+                head = struct.pack("<QHHI", 0, 0, _P_OVERFLOW, n_ov)
+                chunk = head + val
+                chunk += b"\0" * (n_ov * _PAGE - len(chunk))
+                ov_pg = alloc(chunk[:_PAGE])
+                for i in range(1, n_ov):
+                    alloc(chunk[i * _PAGE:(i + 1) * _PAGE], raw=True)
                 nodes.append(_node(key, val, bigdata_pgno=ov_pg))
             else:
                 nodes.append(_node(key, val))
@@ -115,10 +118,12 @@ def write_lmdb(path: str, items: list[tuple[bytes, bytes]],
                   for i, pg in enumerate(leaf_pgnos)]
         root = alloc(_page_with_nodes(0, _P_BRANCH, bnodes))
         depth = 2
-    # fix up pgnos in the page headers (alloc wrote pgno 0)
+    # fix up pgnos in the page headers (alloc wrote pgno 0); overflow
+    # continuation pages are raw value bytes — no header to stamp
     fixed = []
     for i, page in enumerate(data_pages):
-        fixed.append(struct.pack("<Q", 2 + i) + page[8:])
+        fixed.append(page if 2 + i in raw_pages
+                     else struct.pack("<Q", 2 + i) + page[8:])
     with open(path, "wb") as f:
         f.write(_meta_page(0, 0, 0xFFFFFFFFFFFFFFFF, 0, 0, 1))
         f.write(_meta_page(1, 1, root, depth, len(items), next_pg - 1))
@@ -172,6 +177,26 @@ class TestLMDBImport:
         expect = imgs.transpose(0, 2, 3, 1).astype(np.float32) / 255.0
         np.testing.assert_allclose(got, expect, rtol=0, atol=0)
         np.testing.assert_array_equal(got_labels, labels.astype(np.int32))
+        rf.close()
+
+    def test_multipage_overflow_values(self, tmp_path):
+        """Realistic Datum sizes span SEVERAL overflow pages (one
+        header, value contiguous across pages) — a 3×64×64 image is
+        ~12.3 KB ≈ 4 pages."""
+        imgs, labels = _dataset(n=3, c=3, h=64, w=64)
+        items = [(b"%08d" % i, _encode_datum(imgs[i], int(labels[i])))
+                 for i in range(3)]
+        assert all(len(v) > 3 * _PAGE for _, v in items)
+        mdb = str(tmp_path / "data.mdb")
+        write_lmdb(mdb, items)
+        out = str(tmp_path / "big.znr")
+        import_lmdb(mdb, out)
+        rf = rec.RecordFile(out)
+        got, got_labels = rf.read_batch([0, 1, 2])
+        expect = imgs.transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+        np.testing.assert_allclose(got, expect, rtol=0, atol=0)
+        np.testing.assert_array_equal(got_labels,
+                                      labels.astype(np.int32))
         rf.close()
 
     def test_reader_picks_newest_meta(self, tmp_path):

@@ -144,13 +144,16 @@ class TestSpecMerge:
             mk("deconv", stride=(1, 1), padding=0, tie=0),   # 5 tie → 0
         ]
         pv = [(None, None)] * len(layers)
-        out_l, out_p, out_v = _merge_lrn_pool(layers, list(pv), list(pv))
+        out_l, out_p, out_v, src = _merge_lrn_pool(layers, list(pv),
+                                                   list(pv))
         kinds = [la.kind for la in out_l]
         assert kinds == ["conv", "lrn_pool", "conv", "depooling",
                          "deconv"]
         assert out_l[3].cfg["tie"] == 1     # pool(2) → merged(1)
         assert out_l[4].cfg["tie"] == 0
         assert len(out_p) == len(out_l) == len(out_v)
+        # write_back map: spec rows address their ORIGINAL units
+        assert src == (0, 1, 3, 4, 5)
         merged_cfg = out_l[1].cfg
         assert merged_cfg["n"] == 5 and merged_cfg["ksize"] == (3, 3)
         assert merged_cfg["use_abs"] is False
@@ -163,8 +166,9 @@ class TestSpecMerge:
             mk("max_pool", ksize=(3, 3), stride=(3, 3), padding=0),
         ]
         pv = [(None, None)] * 2
-        out_l, _, _ = _merge_lrn_pool(layers, list(pv), list(pv))
+        out_l, _, _, src = _merge_lrn_pool(layers, list(pv), list(pv))
         assert [la.kind for la in out_l] == ["lrn", "max_pool"]
+        assert src == (0, 1)
 
     def test_env_disables_merge(self, monkeypatch):
         from znicz_tpu.parallel.fused import _merge_lrn_pool
@@ -175,8 +179,56 @@ class TestSpecMerge:
             mk("max_pool", ksize=(3, 3), stride=(2, 2), padding=0),
         ]
         pv = [(None, None)] * 2
-        out_l, _, _ = _merge_lrn_pool(layers, list(pv), list(pv))
+        out_l, _, _, _ = _merge_lrn_pool(layers, list(pv), list(pv))
         assert [la.kind for la in out_l] == ["lrn", "max_pool"]
+
+
+class TestWriteBack:
+    def test_write_back_lands_on_the_right_units(self):
+        """Review r3: the merge makes spec rows FEWER than forward
+        units; write_back must address units through spec.unit_index —
+        a positional zip put conv weights on a pooling unit."""
+        from znicz_tpu.backends import Device
+        from znicz_tpu.config import root
+        from znicz_tpu.models import alexnet
+        from znicz_tpu.nn.all2all import All2All
+        from znicz_tpu.nn.conv import Conv
+        from znicz_tpu.parallel import FusedTrainer, fused
+
+        saved = root.alexnet.to_dict()
+        try:
+            root.alexnet.synthetic.update({"n_train": 32, "n_valid": 0,
+                                           "n_test": 0})
+            root.alexnet.update({"minibatch_size": 16, "size": 67,
+                                 "n_classes": 7})
+            root.alexnet.layers = alexnet.make_layers(
+                n_classes=7, widths=(8, 12, 8, 8, 8, 24, 16))
+            prng.seed_all(3)
+            wf = alexnet.AlexNetWorkflow()
+            wf.initialize(device=Device.create("xla"))
+        finally:
+            root.alexnet.update(saved)
+        spec, params, vels = fused.extract_model(wf)
+        assert len(spec.layers) < len(wf.forwards)      # merge happened
+        assert len(spec.unit_index) == len(spec.layers)
+        tr = FusedTrainer(spec=spec, params=params, vels=vels)
+        ld = wf.loader
+        tr.train_epoch(ld.original_data.devmem,
+                       ld.original_labels.devmem, np.arange(32), 16)
+        tr.workflow = wf
+        tr.write_back()
+        n_checked = 0
+        for row, ((w, b), la) in enumerate(zip(tr.params, spec.layers)):
+            if w is None:
+                continue
+            unit = wf.forwards[spec.unit_index[row]]
+            # a weight row must land on a parameterized unit of the
+            # right kind, holding exactly the trained array
+            assert isinstance(unit, (Conv, All2All)), type(unit)
+            np.testing.assert_array_equal(np.asarray(unit.weights.mem),
+                                          np.asarray(w))
+            n_checked += 1
+        assert n_checked == 8            # 5 convs + 3 fc
 
 
 class TestTrainEquivalence:

@@ -224,8 +224,10 @@ def build_native(force: bool = False) -> str:
             os.path.join(_NATIVE_DIR, "parallel.h")]
     if force and os.path.exists(so):
         os.unlink(so)
-    if not ensure_built(so, srcs, _NATIVE_DIR, "libznicz_infer.so") \
-            and not os.path.exists(so):
-        raise RuntimeError("libznicz_infer.so build failed; see "
-                           f"`make -C {_NATIVE_DIR}` output")
+    if not ensure_built(so, srcs, _NATIVE_DIR, "libznicz_infer.so"):
+        # unlike the record reader (which has a numpy fallback and
+        # returns None), serving has no fallback: a STALE .so must not
+        # be silently dlopened after an edit whose rebuild failed
+        raise RuntimeError("libznicz_infer.so build failed or is stale; "
+                           f"see `make -C {_NATIVE_DIR}` output")
     return so

@@ -52,10 +52,15 @@ class LMDBReader:
         # (MDB_NOSUBDIR)
         if os.path.isdir(path):
             path = os.path.join(path, "data.mdb")
+        import mmap as mmap_mod
         with open(path, "rb") as f:
-            self._buf = f.read()
-        if len(self._buf) < 2 * 4096:
-            raise ValueError(f"{path}: too small to be an LMDB file")
+            size = os.fstat(f.fileno()).st_size
+            if size < 2 * 4096:
+                raise ValueError(f"{path}: too small to be an LMDB file")
+            # a real ImageNet LMDB is tens of GB: map it (O(1) memory,
+            # lazily paged) instead of slurping it into a bytes object
+            self._buf = mmap_mod.mmap(f.fileno(), 0,
+                                      access=mmap_mod.ACCESS_READ)
         metas = []
         for pgno in (0, 1):
             m = self._parse_meta(pgno * 4096)

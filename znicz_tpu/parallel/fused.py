@@ -67,6 +67,12 @@ class ModelSpec:
     #: mixed-precision recipe.  Default f32 keeps every bit-exact
     #: backend-equivalence contract intact.
     storage_dtype: str = "float32"
+    #: per-spec-row index into ``workflow.forwards``/``workflow.gds`` —
+    #: the write-back map.  The lrn_pool merge makes spec rows FEWER
+    #: than forward units, so a positional zip would install weights on
+    #: the wrong units; extract_model always fills this.  Empty ()
+    #: (hand-built specs with no workflow) means identity.
+    unit_index: tuple = ()
 
     def __post_init__(self):
         # the softmax-CE head consumes 2D logits and backward() hands the
@@ -226,8 +232,10 @@ def extract_model(workflow) -> tuple[ModelSpec, list, list]:
             params.append((None, None))
             vels.append((None, None))
     loss = workflow.loss_function
-    layers, params, vels = _merge_lrn_pool(layers, params, vels)
-    return ModelSpec(tuple(layers), loss), params, vels
+    layers, params, vels, unit_index = _merge_lrn_pool(layers, params,
+                                                       vels)
+    return (ModelSpec(tuple(layers), loss, unit_index=unit_index),
+            params, vels)
 
 
 def _merge_lrn_pool(layers, params, vels):
@@ -240,9 +248,11 @@ def _merge_lrn_pool(layers, params, vels):
     are remapped; a depooling tied to a merged pool keeps working — the
     merged layer's aux IS the pool's winner-offset tensor."""
     from ..ops import tuning
+    identity = tuple(range(len(layers)))
     if not tuning.lrn_pool_merge():
-        return layers, params, vels
+        return layers, params, vels, identity
     out_l, out_p, out_v = [], [], []
+    src = []          # spec row → ORIGINAL forwards index (write_back)
     idx_map = {}
     i = 0
     while i < len(layers):
@@ -265,15 +275,17 @@ def _merge_lrn_pool(layers, params, vels):
             out_l.append(merged)
             out_p.append((None, None))
             out_v.append((None, None))
+            src.append(i)                 # paramless: index is nominal
             i += 2
         else:
             idx_map[i] = len(out_l)
             out_l.append(la)
             out_p.append(params[i])
             out_v.append(vels[i])
+            src.append(i)
             i += 1
     if len(out_l) == len(layers):
-        return layers, params, vels
+        return layers, params, vels, identity
     remapped = []
     for la in out_l:
         cfg = la.cfg
@@ -281,7 +293,7 @@ def _merge_lrn_pool(layers, params, vels):
             cfg["tie"] = idx_map[cfg["tie"]]
             la = dataclasses.replace(la, config=tuple(sorted(cfg.items())))
         remapped.append(la)
-    return remapped, out_p, out_v
+    return remapped, out_p, out_v, tuple(src)
 
 
 # -- pure math (all traced; spec is static) --------------------------------
@@ -876,12 +888,18 @@ class FusedTrainer:
 
     # -- sync back into the unit graph ------------------------------------
     def write_back(self) -> None:
-        """Install trained params into the workflow's unit Vectors."""
+        """Install trained params into the workflow's unit Vectors.
+
+        Rows are addressed through ``spec.unit_index`` — after the
+        lrn_pool merge the spec has FEWER rows than the workflow has
+        forward units, so a positional zip would land weights on the
+        wrong units (review r3)."""
         if self.workflow is None:
             return
-        for fwd, gdu, (w, b), (vw, vb) in zip(
-                self.workflow.forwards, self.workflow.gds, self.params,
-                self.vels):
+        fwds, gds = self.workflow.forwards, self.workflow.gds
+        umap = self.spec.unit_index or tuple(range(len(self.params)))
+        for ui, (w, b), (vw, vb) in zip(umap, self.params, self.vels):
+            fwd, gdu = fwds[ui], gds[ui]
             if w is not None:
                 fwd.weights.mem = np.asarray(w)
                 if b is not None:
