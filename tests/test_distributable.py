@@ -53,6 +53,38 @@ def test_distribute_installs_batch_sharded_arrays(wf):
     assert len(garr.sharding.device_set) == 8
 
 
+def test_mse_loader_shards_targets(tmp_path):
+    """FullBatchLoaderMSE publishes original_targets too — a distinct
+    regression target must ride the data axis like the inputs."""
+    from znicz_tpu.loader.fullbatch import FullBatchLoaderMSE
+    from znicz_tpu.workflow import Workflow
+
+    class _Ld(FullBatchLoaderMSE):
+        def load_data(self):
+            gen = prng.get("mse_dist")
+            self.original_data.mem = np.asarray(
+                gen.normal(size=(64, 9)), np.float32)
+            self.original_targets.mem = np.asarray(
+                gen.normal(size=(64, 9)), np.float32)
+            self.original_labels.mem = np.zeros(64, np.int32)
+            self.class_lengths = [0, 0, 64]
+
+    w = Workflow(name="w")
+    ld = _Ld(w)
+    ld.initialize(device=Device.create("xla"))
+    payload = ld.generate_data_for_slave()
+    assert set(payload) == {"original_data", "original_labels",
+                            "original_targets"}
+    mesh = mesh_lib.make_mesh(n_data=8, n_model=1)
+    installed = {
+        name: distributed.shard_dataset(local, mesh, int(total))
+        for name, (local, total) in payload.items()}
+    ld.apply_data_from_master(installed)
+    t = ld.original_targets.devmem
+    assert t.sharding.spec[0] == "data"
+    assert len(t.sharding.device_set) == 8
+
+
 def test_training_over_distributed_arrays_matches_local(wf):
     spec, params, vels = fused.extract_model(wf)
     ld = wf.loader

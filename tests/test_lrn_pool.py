@@ -40,6 +40,10 @@ GEOMS = [
     (3, 11, 7, 4, (2, 3), (2, 2)),      # rectangular window, odd W
     (2, 10, 12, 8, (2, 2), (1, 2)),     # row stride 1 (overlapping rows)
     (2, 13, 9, 8, (4, 2), (3, 2)),      # tall window, row stride 3
+    # the two SHIPPED AlexNet geometries (shrunk batch/extent, real C):
+    # C=96 pads the lane axis, C=256 spans two full lane tiles
+    (1, 15, 15, 96, (3, 3), (2, 2)),    # L1-like
+    (1, 9, 9, 256, (3, 3), (2, 2)),     # L2-like
 ]
 
 

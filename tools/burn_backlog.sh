@@ -1,0 +1,31 @@
+#!/bin/bash
+# On-chip measurement backlog (VERDICT r2 item 1): run EVERYTHING in one
+# same-day session the moment the TPU tunnel answers.  Each line prints
+# one JSON result; the transcript is the BASELINE.md refresh source.
+#
+# Usage:  bash tools/burn_backlog.sh [outfile]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-backlog_$(date +%Y%m%d_%H%M%S).jsonl}"
+run() {
+  echo "### $*" >&2
+  timeout 3000 python "$@" 2> >(tail -5 >&2) | tail -1 | tee -a "$OUT"
+}
+
+# headline + batch sweep (fused pair merged = default)
+run bench.py
+run bench.py --minibatch 256
+# the LRN+pool merge A/B at both batches (rows full vs lrn_pool_split)
+run bench.py --ablate
+run bench.py --ablate --minibatch 256
+# kernel table (now incl. lrn_maxpool/gd_lrn_maxpool + retiled convs)
+run bench.py --kernels
+# precision / storage variants
+run bench.py --dtype bfloat16
+run bench.py --storage bfloat16 --minibatch 256
+# data-plane: stream + on-device augment + loader-only
+run bench.py --stream
+run bench.py --augment
+run bench.py --loader
+run bench.py --loader --augment
+echo "backlog complete → $OUT" >&2
