@@ -309,3 +309,24 @@ def import_pickle(path: str, out_path: str,
     return write_records(out_path, np.ascontiguousarray(data),
                          np.ascontiguousarray(labels),
                          shard_size=shard_size)
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m znicz_tpu.loader.importers {lmdb|pickle} SRC
+    DST.znr [--shard-size N]`` — the one-shot migration entry point."""
+    import argparse
+    p = argparse.ArgumentParser(
+        description="Convert reference on-disk datasets to .znr shards")
+    p.add_argument("format", choices=("lmdb", "pickle"))
+    p.add_argument("src")
+    p.add_argument("dst")
+    p.add_argument("--shard-size", type=int, default=None)
+    args = p.parse_args(argv)
+    fn = import_lmdb if args.format == "lmdb" else import_pickle
+    for path in fn(args.src, args.dst, shard_size=args.shard_size):
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
