@@ -101,6 +101,27 @@ class TestFusedBackward:
                                    np.asarray(dx_ref, np.float32),
                                    rtol=1e-5, atol=1e-7)
 
+    @pytest.mark.parametrize("act", ["strict_relu", "tanh", "sigmoid"])
+    def test_fold_act_matches_composed(self, act):
+        """fold_act folds the preceding layer's activation derivative
+        into the pair backward — must equal the composed golden
+        (pool bwd → lrn bwd → act bwd)."""
+        x = _x((2, 9, 9, 8), scale=0.7)
+        if act == "strict_relu":
+            x = np.maximum(x, 0.0)       # y of a strict-relu layer ≥ 0
+        _, idx = lrn_pool.np_lrn_maxpool(x, 5, 1e-4, 0.75, 2.0,
+                                         (3, 3), (2, 2), 0)
+        errp = _x(idx.shape, "err", 0.1)
+        dx_ref = lrn_pool.np_gd_lrn_maxpool(
+            errp, idx, x, 5, 1e-4, 0.75, 2.0, (3, 3), (2, 2), 0,
+            fold_act=act)
+        dx = lrn_pool.pallas_gd_lrn_maxpool(
+            jnp.asarray(errp), jnp.asarray(idx), jnp.asarray(x),
+            5, 1e-4, 0.75, 2.0, (3, 3), (2, 2), 0, fold_act=act)
+        np.testing.assert_allclose(np.asarray(dx),
+                                   np.asarray(dx_ref, np.float32),
+                                   rtol=1e-5, atol=1e-7)
+
     def test_gradient_against_jax_autodiff(self):
         """Independent check: the hand-written pair backward matches
         jax.grad through the composed differentiable forward (max-pool
@@ -161,6 +182,26 @@ class TestSpecMerge:
         merged_cfg = out_l[1].cfg
         assert merged_cfg["n"] == 5 and merged_cfg["ksize"] == (3, 3)
         assert merged_cfg["use_abs"] is False
+        # linear conv: nothing to fold
+        assert "fold_act" not in merged_cfg
+        assert "act_folded" not in out_l[0].cfg
+
+    def test_activation_fold_marks_both_layers(self):
+        from znicz_tpu.parallel.fused import LayerSpec, _merge_lrn_pool
+        H = (0.01, 0.0, 0.0, 0.9)
+        conv = LayerSpec(kind="conv", activation="strict_relu",
+                         include_bias=True, hypers=H, hypers_bias=H,
+                         config=(("padding", 0), ("stride", (1, 1))))
+        mk = self._mk_layers()
+        layers = [conv,
+                  mk("lrn", n=5, alpha=1e-4, beta=0.75, k=2.0),
+                  mk("max_pool", ksize=(3, 3), stride=(2, 2),
+                     padding=0)]
+        pv = [(None, None)] * 3
+        out_l, _, _, _ = _merge_lrn_pool(layers, list(pv), list(pv))
+        assert [la.kind for la in out_l] == ["conv", "lrn_pool"]
+        assert out_l[1].cfg["fold_act"] == "strict_relu"
+        assert out_l[0].cfg["act_folded"] is True
 
     def test_non_fusable_kept_split(self):
         from znicz_tpu.parallel.fused import _merge_lrn_pool

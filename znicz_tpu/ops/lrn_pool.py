@@ -79,18 +79,32 @@ def xla_lrn_maxpool(x, n, alpha, beta, k, ksize, stride, padding,
 
 
 def np_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize, stride,
-                      padding):
-    """Composed numpy golden backward: pooled err → dx."""
+                      padding, fold_act=None):
+    """Composed numpy golden backward: pooled err → dx.
+
+    ``fold_act``: name of the PRECEDING layer's activation whose
+    derivative is folded in (``dx · act.bwd(·, y=x)``) — x here IS that
+    layer's post-activation output, so the pair backward can emit the
+    pre-activation error directly and the separate elementwise pass
+    over the net's biggest tensor disappears."""
+    from . import activations
     err_y = pool_ops.np_gd_max_pooling(errp, offsets, x.shape, ksize,
                                        stride, padding)
-    return lrn_math.np_gd_lrn_x(err_y, x, n, alpha, beta, k)
+    dx = lrn_math.np_gd_lrn_x(err_y, x, n, alpha, beta, k)
+    if fold_act is not None:
+        dx = activations.BY_NAME[fold_act].bwd(dx, x, None, np)
+    return dx
 
 
 def xla_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize,
-                       stride, padding):
+                       stride, padding, fold_act=None):
+    from . import activations
     err_y = pool_ops.xla_gd_max_pooling(errp, offsets, x.shape, ksize,
                                         stride, padding)
-    return lrn_math.xla_gd_lrn_x(err_y, x, n, alpha, beta, k)
+    dx = lrn_math.xla_gd_lrn_x(err_y, x, n, alpha, beta, k)
+    if fold_act is not None:
+        dx = activations.BY_NAME[fold_act].bwd(dx, x, None, jnp)
+    return dx
 
 
 # -- the fused Pallas pair -------------------------------------------------
@@ -180,7 +194,7 @@ def pallas_lrn_maxpool(x, n, alpha, beta, k, ksize, stride, padding,
 
 
 def _lrn_pool_bwd_kernel(*refs, kh, kw, sh, oh, ow, we, wo, n, alpha,
-                         beta, k, n_contrib):
+                         beta, k, n_contrib, fold_act):
     """refs: xe_row, xo_row, n_contrib×errp rows, n_contrib×idx rows,
     dxe_out, dxo_out.
 
@@ -215,18 +229,31 @@ def _lrn_pool_bwd_kernel(*refs, kh, kw, sh, oh, ow, we, wo, n, alpha,
                 err_odd = err_odd + jnp.pad(
                     contrib,
                     ((0, 0), (0, 0), (off, wo - ow - off), (0, 0)))
-    dxe_ref[:] = lrn_math._bwd_recompute(
-        err_even, xe_ref[:].astype(jnp.float32), n, alpha, beta, k, jnp)
-    dxo_ref[:] = lrn_math._bwd_recompute(
-        err_odd, xo_ref[:].astype(jnp.float32), n, alpha, beta, k, jnp)
+    xe = xe_ref[:].astype(jnp.float32)
+    xo = xo_ref[:].astype(jnp.float32)
+    dxe = lrn_math._bwd_recompute(err_even, xe, n, alpha, beta, k, jnp)
+    dxo = lrn_math._bwd_recompute(err_odd, xo, n, alpha, beta, k, jnp)
+    if fold_act is not None:
+        # the preceding layer's activation derivative (needs y only,
+        # and y IS this x) — emits the pre-activation error in the same
+        # pass, saving the separate elementwise sweep over dx
+        from . import activations
+        act = activations.BY_NAME[fold_act]
+        dxe = act.bwd(dxe, xe, None, jnp)
+        dxo = act.bwd(dxo, xo, None, jnp)
+    dxe_ref[:] = dxe
+    dxo_ref[:] = dxo
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "n", "alpha", "beta", "k", "ksize", "stride", "padding"))
+    "n", "alpha", "beta", "k", "ksize", "stride", "padding",
+    "fold_act"))
 def pallas_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize,
-                          stride, padding):
+                          stride, padding, fold_act=None):
     """Fused backward: (pooled err, offsets, x) → dx; err_y never
-    touches HBM."""
+    touches HBM.  ``fold_act`` additionally folds the preceding
+    layer's activation derivative (y-only activations) into the same
+    pass — see np_gd_lrn_maxpool."""
     (kh, kw), (sh, sw) = norm2(ksize), norm2(stride)
     assert fusable(ksize, stride, padding), "gate with fusable() first"
     b, h, w, c = x.shape
@@ -250,7 +277,8 @@ def pallas_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize,
     dxe, dxo = pl.pallas_call(
         functools.partial(_lrn_pool_bwd_kernel, kh=kh, kw=kw, sh=sh,
                           oh=oh, ow=ow, we=we, wo=wo, n=n, alpha=alpha,
-                          beta=beta, k=k, n_contrib=n_contrib),
+                          beta=beta, k=k, n_contrib=n_contrib,
+                          fold_act=fold_act),
         grid=(b // bb, h),
         in_specs=([row_spec(we), row_spec(wo)]
                   + [contrib_spec(m) for m in range(n_contrib)] * 2),
@@ -277,9 +305,9 @@ def lrn_maxpool(x, n, alpha, beta, k, ksize, stride, padding,
 
 
 def gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize, stride,
-                   padding):
+                   padding, fold_act=None):
     if tuning.use_pallas() and fusable(ksize, stride, padding):
         return pallas_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k,
-                                     ksize, stride, padding)
+                                     ksize, stride, padding, fold_act)
     return xla_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize,
-                              stride, padding)
+                              stride, padding, fold_act)

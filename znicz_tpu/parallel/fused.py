@@ -270,6 +270,22 @@ def _merge_lrn_pool(layers, params, vels):
                 kind="lrn_pool", activation="linear", include_bias=False,
                 hypers=la.hypers, hypers_bias=la.hypers_bias,
                 config=tuple(sorted(cfg.items())))
+            # fold the PRECEDING conv's activation derivative into the
+            # pair backward when its bwd needs only y (y is the pair's
+            # input, already in the kernel's VMEM) — kills the separate
+            # elementwise sweep over the net's biggest dx tensor
+            if out_l and out_l[-1].kind in ("conv", "deconv"):
+                act = activations.BY_NAME[out_l[-1].activation]
+                if out_l[-1].activation != "linear" \
+                        and not act.needs_input:
+                    cfg["fold_act"] = out_l[-1].activation
+                    out_l[-1] = dataclasses.replace(
+                        out_l[-1],
+                        config=tuple(sorted(
+                            dict(out_l[-1].config,
+                                 act_folded=True).items())))
+                    merged = dataclasses.replace(
+                        merged, config=tuple(sorted(cfg.items())))
             idx_map[i] = len(out_l)
             idx_map[i + 1] = len(out_l)   # ties to the pool → merged
             out_l.append(merged)
@@ -468,10 +484,13 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
         if slot is not None:
             w = slot[0]                # tied deconv: encoder weights
             # fold through the fused activation (last layer already is
-            # pre-activation — see docstring)
-            err_pre = err if i == n - 1 \
-                else spec.act(i).bwd(err.reshape(y_i.shape), y_i, None,
-                                     jnp)
+            # pre-activation — see docstring); act_folded: the merged
+            # lrn_pool ABOVE already applied this derivative in-kernel
+            if i == n - 1 or cfg.get("act_folded"):
+                err_pre = err.reshape(y_i.shape) if i < n - 1 else err
+            else:
+                err_pre = spec.act(i).bwd(err.reshape(y_i.shape), y_i,
+                                          None, jnp)
             if layer.kind == "fc":
                 x2 = x_in.reshape(x_in.shape[0], -1)
                 err2 = err_pre.reshape(x2.shape[0], -1)
@@ -517,12 +536,14 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
                                    cfg["k"])
         elif layer.kind == "lrn_pool":
             # fused pair backward: pooled err scatters through the
-            # winner offsets and folds through the LRN derivative in one
-            # kernel — err_y never materializes
+            # winner offsets and folds through the LRN derivative (and
+            # optionally the preceding conv's activation derivative) in
+            # one kernel — err_y never materializes
             err = lrn_pool_ops.gd_lrn_maxpool(
                 err.reshape(y_i.shape), aux, x_in, cfg["n"],
                 cfg["alpha"], cfg["beta"], cfg["k"], cfg["ksize"],
-                cfg["stride"], cfg["padding"])
+                cfg["stride"], cfg["padding"],
+                cfg.get("fold_act"))
         elif layer.kind == "depooling":
             err = pool_ops.gd_depooling(
                 err.reshape(y_i.shape), aux, cfg["ksize"], cfg["stride"],
