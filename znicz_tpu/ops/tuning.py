@@ -42,6 +42,14 @@ def lrn_pool_merge() -> bool:
     return os.environ.get("ZNICZ_TPU_LRN_POOL", "fused") != "split"
 
 
+def lrn_pool_act_fold() -> bool:
+    """Whether the merge also folds the preceding conv's activation
+    derivative into the pair backward.  ZNICZ_TPU_LRN_POOL=nofold keeps
+    the merge but skips the fold — the --ablate lever that isolates the
+    fold's contribution on-chip."""
+    return os.environ.get("ZNICZ_TPU_LRN_POOL", "fused") != "nofold"
+
+
 def force_pallas_conv() -> bool:
     """Whether ZNICZ_TPU_CONV=pallas routes the conv/deconv family to
     the implicit-GEMM Pallas tier (default: XLA's native conv lowering,

@@ -274,7 +274,8 @@ def _merge_lrn_pool(layers, params, vels):
             # pair backward when its bwd needs only y (y is the pair's
             # input, already in the kernel's VMEM) — kills the separate
             # elementwise sweep over the net's biggest dx tensor
-            if out_l and out_l[-1].kind in ("conv", "deconv"):
+            if out_l and out_l[-1].kind in ("conv", "deconv") \
+                    and tuning.lrn_pool_act_fold():
                 act = activations.BY_NAME[out_l[-1].activation]
                 if out_l[-1].activation != "linear" \
                         and not act.needs_input:
