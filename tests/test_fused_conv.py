@@ -63,12 +63,15 @@ def _drive_graph(wf, idx):
 
 
 def _assert_params_match(wf, tr):
-    for i, (fwd, (w, b)) in enumerate(zip(wf.forwards, tr.params)):
+    # spec rows address units through unit_index (the lrn_pool merge
+    # makes them fewer than the forward units)
+    umap = tr.spec.unit_index or tuple(range(len(tr.params)))
+    for i, (ui, (w, b)) in enumerate(zip(umap, tr.params)):
         if w is None:
             continue
         np.testing.assert_allclose(
-            np.asarray(w), fwd.weights.mem, rtol=5e-4, atol=1e-5,
-            err_msg=f"layer {i} weights diverged")
+            np.asarray(w), wf.forwards[ui].weights.mem, rtol=5e-4,
+            atol=1e-5, err_msg=f"layer {i} weights diverged")
 
 
 class TestFusedConvEquivalence:
@@ -89,6 +92,84 @@ class TestFusedConvEquivalence:
                        ld.max_minibatch_size)
         _drive_graph(wf, idx)
         _assert_params_match(wf, tr)
+
+    def test_fused_matches_unit_graph_with_merged_lrn_pool(self):
+        """AlexNet layer order (conv → LRN → max-pool): extract_model
+        MERGES the pair, so this is the decisive unit-graph-vs-merged
+        equivalence — the reference execution model against the fused
+        pair op (forward, offsets, backward, activation fold)."""
+        wf = _workflow(layers=[
+            {"type": "conv_str",
+             "->": {"n_kernels": 8, "kx": 5, "sliding": 2},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "norm", "->": {"n": 5}},
+            {"type": "max_pooling", "->": {"kx": 3, "sliding": 2}},
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 24},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+        ])
+        spec, params, vels = extract_model(wf)
+        kinds = [layer.kind for layer in spec.layers]
+        assert kinds == ["conv", "lrn_pool", "fc", "fc"]
+        assert spec.layers[1].cfg["fold_act"] == "strict_relu"
+        tr = FusedTrainer(spec=spec, params=params, vels=vels)
+        ld = wf.loader
+        n0, n1, n2 = ld.class_lengths
+        idx = np.arange(n0 + n1, n0 + n1 + n2)
+        tr.train_epoch(ld.original_data.devmem,
+                       ld.original_labels.devmem, idx,
+                       ld.max_minibatch_size)
+        _drive_graph(wf, idx)
+        _assert_params_match(wf, tr)
+
+    def test_merged_equals_split_with_bf16_storage(self):
+        """storage_dtype=bfloat16: the pair kernel must SELECT in the
+        storage dtype (the split path pools the bf16-stored y), so
+        winner offsets and training stay identical to the split spec."""
+        import dataclasses
+        import os
+        wf = _workflow(layers=[
+            {"type": "conv_str",
+             "->": {"n_kernels": 8, "kx": 5, "sliding": 2},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "norm", "->": {"n": 5}},
+            {"type": "max_pooling", "->": {"kx": 3, "sliding": 2}},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+        ])
+        spec_m, params, vels = extract_model(wf)
+        os.environ["ZNICZ_TPU_LRN_POOL"] = "split"
+        try:
+            spec_s, params_s, vels_s = extract_model(wf)
+        finally:
+            os.environ.pop("ZNICZ_TPU_LRN_POOL", None)
+        ld = wf.loader
+        n0, n1, n2 = ld.class_lengths
+        idx = np.arange(n0 + n1, n0 + n1 + n2)
+
+        def run(spec, p, v):
+            spec = dataclasses.replace(spec, storage_dtype="bfloat16")
+            tr = FusedTrainer(
+                spec=spec,
+                params=[tuple(np.array(a) if a is not None else None
+                              for a in r) for r in p],
+                vels=[tuple(np.array(a) if a is not None else None
+                            for a in r) for r in v])
+            m = tr.train_epoch(ld.original_data.devmem,
+                               ld.original_labels.devmem, idx,
+                               ld.max_minibatch_size)
+            return m, tr.params
+
+        m_m, p_m = run(spec_m, params, vels)
+        m_s, p_s = run(spec_s, params_s, vels_s)
+        np.testing.assert_array_equal(np.asarray(m_m["loss"]),
+                                      np.asarray(m_s["loss"]))
+        for a, b in zip([np.asarray(x) for r in p_m for x in r
+                         if x is not None],
+                        [np.asarray(x) for r in p_s for x in r
+                         if x is not None]):
+            np.testing.assert_array_equal(a, b)
 
     def test_fused_matches_unit_graph_with_dropout(self):
         """Counter-RNG alignment: the fused step reproduces the unit
