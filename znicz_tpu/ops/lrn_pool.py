@@ -108,9 +108,24 @@ def xla_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize,
 
 
 # -- the fused Pallas pair -------------------------------------------------
-def _split_cols(x):
-    """(x_even, x_odd): column-parity halves along W (NHWC)."""
+def split_cols(x):
+    """(x_even, x_odd): column-parity halves along W (NHWC).  Public:
+    the fused path caches these INSTEAD of x for folded pairs, so the
+    backward never re-splits (one fewer full HBM round-trip over the
+    net's biggest activation)."""
     return x[:, :, 0::2, :], x[:, :, 1::2, :]
+
+
+def interleave_cols(xe, xo, w: int):
+    """Inverse of :func:`split_cols` (pads the odd half when W is odd)."""
+    b, h, we, c = xe.shape
+    if xo.shape[2] < we:
+        xo = jnp.pad(xo, ((0, 0), (0, 0), (0, we - xo.shape[2]),
+                          (0, 0)))
+    return jnp.stack([xe, xo], axis=3).reshape(b, h, 2 * we, c)[:, :, :w]
+
+
+_split_cols = split_cols
 
 
 def _batch_block(b: int, bytes_per_b: int, budget: int = 6 << 20) -> int:
@@ -158,16 +173,25 @@ def _lrn_pool_fwd_kernel(*refs, kh, kw, ow, n, alpha, beta, k, use_abs):
     idx_ref[:] = idx
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "n", "alpha", "beta", "k", "ksize", "stride", "padding", "use_abs"))
 def pallas_lrn_maxpool(x, n, alpha, beta, k, ksize, stride, padding,
                        use_abs=False):
     """Fused forward: x → (pooled, offsets); y never touches HBM."""
+    xe, xo = split_cols(x)
+    return pallas_lrn_maxpool_split(xe, xo, n, alpha, beta, k, ksize,
+                                    stride, padding, use_abs)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n", "alpha", "beta", "k", "ksize", "stride", "padding", "use_abs"))
+def pallas_lrn_maxpool_split(xe, xo, n, alpha, beta, k, ksize, stride,
+                             padding, use_abs=False):
+    """Fused forward over pre-split column-parity halves (the caller
+    may keep xe/xo as the backward cache — see split_cols)."""
     (kh, kw), (sh, sw) = norm2(ksize), norm2(stride)
     assert fusable(ksize, stride, padding), "gate with fusable() first"
-    b, h, w, c = x.shape
+    b, h, _, c = xe.shape
+    w = xe.shape[2] + xo.shape[2]
     oh, ow = out_size(h, kh, sh, 0), out_size(w, kw, sw, 0)
-    xe, xo = _split_cols(x)
     we, wo = xe.shape[2], xo.shape[2]
     bytes_per_b = 4 * c * (kh * (we + wo) + 4 * we + 2 * ow)
     bb = _batch_block(b, bytes_per_b)
@@ -186,7 +210,7 @@ def pallas_lrn_maxpool(x, n, alpha, beta, k, ksize, stride, padding,
         grid=(b // bb, oh),
         in_specs=e_spec + o_spec,
         out_specs=[out_spec, out_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, oh, ow, c), x.dtype),
+        out_shape=[jax.ShapeDtypeStruct((b, oh, ow, c), xe.dtype),
                    jax.ShapeDtypeStruct((b, oh, ow, c), jnp.int32)],
         interpret=tuning.interpret_mode(),
     )(*([xe] * kh + [xo] * kh))
@@ -245,20 +269,31 @@ def _lrn_pool_bwd_kernel(*refs, kh, kw, sh, oh, ow, we, wo, n, alpha,
     dxo_ref[:] = dxo
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "n", "alpha", "beta", "k", "ksize", "stride", "padding",
-    "fold_act"))
 def pallas_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize,
                           stride, padding, fold_act=None):
     """Fused backward: (pooled err, offsets, x) → dx; err_y never
     touches HBM.  ``fold_act`` additionally folds the preceding
     layer's activation derivative (y-only activations) into the same
     pass — see np_gd_lrn_maxpool."""
+    xe, xo = split_cols(x)
+    return pallas_gd_lrn_maxpool_split(errp, offsets, xe, xo, n, alpha,
+                                       beta, k, ksize, stride, padding,
+                                       fold_act)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n", "alpha", "beta", "k", "ksize", "stride", "padding",
+    "fold_act"))
+def pallas_gd_lrn_maxpool_split(errp, offsets, xe, xo, n, alpha, beta,
+                                k, ksize, stride, padding,
+                                fold_act=None):
+    """Fused backward over pre-split halves — when the forward cached
+    (xe, xo) the re-split of x disappears entirely."""
     (kh, kw), (sh, sw) = norm2(ksize), norm2(stride)
     assert fusable(ksize, stride, padding), "gate with fusable() first"
-    b, h, w, c = x.shape
+    b, h, _, c = xe.shape
+    w = xe.shape[2] + xo.shape[2]
     _, oh, ow, _ = errp.shape
-    xe, xo = _split_cols(x)
     we, wo = xe.shape[2], xo.shape[2]
     n_contrib = (kh + sh - 1) // sh
     bytes_per_b = 4 * c * (we + wo + 2 * n_contrib * ow
@@ -288,10 +323,7 @@ def pallas_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize,
         interpret=tuning.interpret_mode(),
     )(xe, xo, *([errp] * n_contrib + [offsets] * n_contrib))
     # interleave the parity halves back: (..., We, 2, C) → (..., 2·We, C)
-    if wo < we:
-        dxo = jnp.pad(dxo, ((0, 0), (0, 0), (0, we - wo), (0, 0)))
-    dx = jnp.stack([dxe, dxo], axis=3).reshape(b, h, 2 * we, c)
-    return dx[:, :, :w, :]
+    return interleave_cols(dxe, dxo, w)
 
 
 # -- dispatchers -----------------------------------------------------------
@@ -311,3 +343,28 @@ def gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize, stride,
                                      ksize, stride, padding, fold_act)
     return xla_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize,
                               stride, padding, fold_act)
+
+
+def lrn_maxpool_split(xe, xo, n, alpha, beta, k, ksize, stride, padding,
+                      use_abs=False):
+    """Split-input dispatcher (the fused path's cache-the-halves mode:
+    forward consumes and the backward reuses xe/xo, so x is never
+    re-split).  The XLA tier re-interleaves — it has no split gain."""
+    if tuning.use_pallas() and fusable(ksize, stride, padding):
+        return pallas_lrn_maxpool_split(xe, xo, n, alpha, beta, k,
+                                        ksize, stride, padding, use_abs)
+    w = xe.shape[2] + xo.shape[2]
+    return xla_lrn_maxpool(interleave_cols(xe, xo, w), n, alpha, beta,
+                           k, ksize, stride, padding, use_abs)
+
+
+def gd_lrn_maxpool_split(errp, offsets, xe, xo, n, alpha, beta, k,
+                         ksize, stride, padding, fold_act=None):
+    if tuning.use_pallas() and fusable(ksize, stride, padding):
+        return pallas_gd_lrn_maxpool_split(errp, offsets, xe, xo, n,
+                                           alpha, beta, k, ksize,
+                                           stride, padding, fold_act)
+    w = xe.shape[2] + xo.shape[2]
+    return xla_gd_lrn_maxpool(errp, offsets, interleave_cols(xe, xo, w),
+                              n, alpha, beta, k, ksize, stride, padding,
+                              fold_act)

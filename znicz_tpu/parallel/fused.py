@@ -402,11 +402,23 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
         elif layer.kind == "lrn_pool":
             # fused pair: the LRN output never touches HBM — the kernel
             # normalizes in VMEM and pools in the same pass; aux is the
-            # pool's winner-offset tensor (depooling-tie compatible)
-            h, aux = lrn_pool_ops.lrn_maxpool(
-                h, cfg["n"], cfg["alpha"], cfg["beta"], cfg["k"],
-                cfg["ksize"], cfg["stride"], cfg["padding"],
-                cfg["use_abs"])
+            # pool's winner-offset tensor (depooling-tie compatible).
+            # With the activation folded, NOTHING downstream needs the
+            # unsplit x (the conv below skips its activation backward),
+            # so the cache keeps the column-parity halves the kernel
+            # consumed — the backward never re-splits x
+            if "fold_act" in cfg:
+                xe, xo = lrn_pool_ops.split_cols(h)
+                x_in = (xe, xo)
+                h, aux = lrn_pool_ops.lrn_maxpool_split(
+                    xe, xo, cfg["n"], cfg["alpha"], cfg["beta"],
+                    cfg["k"], cfg["ksize"], cfg["stride"],
+                    cfg["padding"], cfg["use_abs"])
+            else:
+                h, aux = lrn_pool_ops.lrn_maxpool(
+                    h, cfg["n"], cfg["alpha"], cfg["beta"], cfg["k"],
+                    cfg["ksize"], cfg["stride"], cfg["padding"],
+                    cfg["use_abs"])
         elif layer.kind == "dropout":
             if train:
                 # aux stays None: the backward REGENERATES the mask from
@@ -487,8 +499,10 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
             # fold through the fused activation (last layer already is
             # pre-activation — see docstring); act_folded: the merged
             # lrn_pool ABOVE already applied this derivative in-kernel
+            # and returned a full-shape dx (y_i may be its split-halves
+            # cache tuple — never consumed here)
             if i == n - 1 or cfg.get("act_folded"):
-                err_pre = err.reshape(y_i.shape) if i < n - 1 else err
+                err_pre = err
             else:
                 err_pre = spec.act(i).bwd(err.reshape(y_i.shape), y_i,
                                           None, jnp)
@@ -540,11 +554,18 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
             # winner offsets and folds through the LRN derivative (and
             # optionally the preceding conv's activation derivative) in
             # one kernel — err_y never materializes
-            err = lrn_pool_ops.gd_lrn_maxpool(
-                err.reshape(y_i.shape), aux, x_in, cfg["n"],
-                cfg["alpha"], cfg["beta"], cfg["k"], cfg["ksize"],
-                cfg["stride"], cfg["padding"],
-                cfg.get("fold_act"))
+            if isinstance(x_in, tuple):      # split-halves cache (fold)
+                err = lrn_pool_ops.gd_lrn_maxpool_split(
+                    err.reshape(y_i.shape), aux, x_in[0], x_in[1],
+                    cfg["n"], cfg["alpha"], cfg["beta"], cfg["k"],
+                    cfg["ksize"], cfg["stride"], cfg["padding"],
+                    cfg.get("fold_act"))
+            else:
+                err = lrn_pool_ops.gd_lrn_maxpool(
+                    err.reshape(y_i.shape), aux, x_in, cfg["n"],
+                    cfg["alpha"], cfg["beta"], cfg["k"], cfg["ksize"],
+                    cfg["stride"], cfg["padding"],
+                    cfg.get("fold_act"))
         elif layer.kind == "depooling":
             err = pool_ops.gd_depooling(
                 err.reshape(y_i.shape), aux, cfg["ksize"], cfg["stride"],
