@@ -123,14 +123,17 @@ class TestFusedConvEquivalence:
         _drive_graph(wf, idx)
         _assert_params_match(wf, tr)
 
-    def test_merged_equals_split_with_bf16_storage(self):
+    @pytest.mark.parametrize("conv_type", ["conv_str", "conv_tanh"])
+    def test_merged_equals_split_with_bf16_storage(self, conv_type):
         """storage_dtype=bfloat16: the pair kernel must SELECT in the
         storage dtype (the split path pools the bf16-stored y), so
-        winner offsets and training stay identical to the split spec."""
+        winner offsets and training stay identical to the split spec.
+        conv_tanh exercises the VALUE-dependent activation fold, whose
+        derivative must also evaluate on the storage-dtype y."""
         import dataclasses
         import os
         wf = _workflow(layers=[
-            {"type": "conv_str",
+            {"type": conv_type,
              "->": {"n_kernels": 8, "kx": 5, "sliding": 2},
              "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
             {"type": "norm", "->": {"n": 5}},

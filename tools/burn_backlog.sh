@@ -4,17 +4,23 @@
 # one JSON result; the transcript is the BASELINE.md refresh source.
 #
 # Usage:  bash tools/burn_backlog.sh [outfile]
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 OUT="${1:-backlog_$(date +%Y%m%d_%H%M%S).jsonl}"
 run() {
   echo "### $*" >&2
-  timeout 3000 python "$@" 2> >(tail -5 >&2) | tail -1 | tee -a "$OUT"
+  if ! timeout 3000 python "$@" 2> >(tail -5 >&2) \
+      | tail -1 | tee -a "$OUT"; then
+    # a killed/crashed bench must leave a marker, not a silent gap
+    echo "{\"error\": \"bench failed/timed out\", \"cmd\": \"$*\"}" \
+      | tee -a "$OUT"
+  fi
 }
 
 # headline + batch sweep (fused pair merged = default)
 run bench.py
 run bench.py --minibatch 256
+run bench.py --minibatch 512
 # the LRN+pool merge A/B at both batches (rows full vs lrn_pool_split)
 run bench.py --ablate
 run bench.py --ablate --minibatch 256

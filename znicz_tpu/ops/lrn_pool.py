@@ -125,9 +125,6 @@ def interleave_cols(xe, xo, w: int):
     return jnp.stack([xe, xo], axis=3).reshape(b, h, 2 * we, c)[:, :, :w]
 
 
-_split_cols = split_cols
-
-
 def _batch_block(b: int, bytes_per_b: int, budget: int = 6 << 20) -> int:
     """Largest divisor of B whose working set fits the VMEM budget."""
     cap = max(1, budget // max(1, bytes_per_b))
@@ -260,11 +257,14 @@ def _lrn_pool_bwd_kernel(*refs, kh, kw, sh, oh, ow, we, wo, n, alpha,
     if fold_act is not None:
         # the preceding layer's activation derivative (needs y only,
         # and y IS this x) — emits the pre-activation error in the same
-        # pass, saving the separate elementwise sweep over dx
+        # pass, saving the separate elementwise sweep over dx.  y is
+        # passed in its STORAGE dtype (the raw ref value), exactly as
+        # the split path's act.bwd sees it — keeps bf16-storage
+        # bit-equality for value-dependent derivatives (tanh/sigmoid)
         from . import activations
         act = activations.BY_NAME[fold_act]
-        dxe = act.bwd(dxe, xe, None, jnp)
-        dxo = act.bwd(dxo, xo, None, jnp)
+        dxe = act.bwd(dxe, xe_ref[:], None, jnp)
+        dxo = act.bwd(dxo, xo_ref[:], None, jnp)
     dxe_ref[:] = dxe
     dxo_ref[:] = dxo
 

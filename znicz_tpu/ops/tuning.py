@@ -45,8 +45,10 @@ def lrn_pool_merge() -> bool:
 def lrn_pool_act_fold() -> bool:
     """Whether the merge also folds the preceding conv's activation
     derivative into the pair backward.  ZNICZ_TPU_LRN_POOL=nofold keeps
-    the merge but skips the fold — the --ablate lever that isolates the
-    fold's contribution on-chip."""
+    the merge but skips the fold AND, with it, the split-halves cache
+    (which is only correct when nothing downstream needs the unsplit
+    x — i.e. when the fold is on), so the --ablate row measures the two
+    together against the plain merge."""
     return os.environ.get("ZNICZ_TPU_LRN_POOL", "fused") != "nofold"
 
 
