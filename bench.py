@@ -51,6 +51,15 @@ def _preflight_lrn_pool(result) -> None:
             return                      # XLA fallback path, nothing to prove
         x = jnp.arange(2 * 7 * 7 * 8, dtype=jnp.float32
                        ).reshape(2, 7, 7, 8) * 0.01
+        # the exact kernels the headline config compiles: split-input
+        # variants with the strict-relu activation fold
+        xe, xo = lrn_pool.split_cols(x)
+        y, idx = lrn_pool.pallas_lrn_maxpool_split(
+            xe, xo, 5, 1e-4, 0.75, 2.0, (3, 3), (2, 2), 0)
+        lrn_pool.pallas_gd_lrn_maxpool_split(
+            y * 0.1, idx, xe, xo, 5, 1e-4, 0.75, 2.0, (3, 3), (2, 2),
+            0, fold_act="strict_relu").block_until_ready()
+        # plain-x variants (non-folded pairs dispatch these)
         y, idx = lrn_pool.pallas_lrn_maxpool(
             x, 5, 1e-4, 0.75, 2.0, (3, 3), (2, 2), 0)
         lrn_pool.pallas_gd_lrn_maxpool(
