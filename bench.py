@@ -796,6 +796,8 @@ def bench_ablate(args) -> int:
             split_spec, split_params, split_vels = fused.extract_model(wf)
             os.environ["ZNICZ_TPU_LRN_POOL"] = "nofold"
             nofold_spec = fused.extract_model(wf)[0]
+            os.environ["ZNICZ_TPU_LRN_POOL"] = "fused2"
+            fused2_spec = fused.extract_model(wf)[0]
         finally:
             os.environ.pop("ZNICZ_TPU_LRN_POOL", None)
 
@@ -804,6 +806,7 @@ def bench_ablate(args) -> int:
         # no_lrn strips LRN from the SPLIT spec, where it is standalone
         variants = [
             ("full", None, base_spec, None, None),
+            ("lrn_pool_fused2", None, fused2_spec, None, None),
             ("lrn_pool_nofold", None, nofold_spec, None, None),
             ("lrn_pool_split", None, split_spec, split_params,
              split_vels),

@@ -1,0 +1,92 @@
+"""Column-parity conv decompositions (phase-2 of the fused LRN+pool
+pair): the even/odd output columns of a stride-s conv computed as
+standalone convs (W-stride 2s, offset via asymmetric/negative padding),
+plus the matching weight/input gradient decompositions from split error
+halves.  Exactness is pinned against the plain conv + split/interleave
+composition."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from znicz_tpu import prng
+from znicz_tpu.ops import conv as conv_ops
+from znicz_tpu.ops.lrn_pool import interleave_cols, split_cols
+
+
+def _x(shape, stream="x"):
+    return np.asarray(prng.get(stream).normal(size=shape), np.float32)
+
+
+GEOMS = [
+    # (B, H, W, Cin, Cout, k, stride, padding) — AlexNet conv1/conv2
+    # geometries shrunk, plus odd/even W and asymmetric cases
+    (2, 23, 23, 3, 8, (11, 11), (4, 4), 0),     # conv1-like
+    (2, 13, 13, 8, 12, (5, 5), (1, 1), 2),      # conv2-like
+    (1, 10, 12, 4, 4, (3, 3), (2, 2), 1),
+    (2, 9, 7, 2, 6, (3, 2), (1, 2), 0),
+    (1, 8, 11, 3, 5, (1, 1), (1, 1), 0),        # 1x1
+]
+
+
+class TestForwardSplit:
+    @pytest.mark.parametrize("b,h,w,ci,co,k,st,pad", GEOMS)
+    def test_matches_plain_conv_split(self, b, h, w, ci, co, k, st, pad):
+        x = _x((b, h, w, ci))
+        wt = _x((*k, ci, co), "w") * 0.2
+        y = conv_ops.xla_conv2d(jnp.asarray(x), jnp.asarray(wt), st, pad)
+        ye_ref, yo_ref = split_cols(y)
+        ye, yo = conv_ops.xla_conv2d_split(jnp.asarray(x),
+                                           jnp.asarray(wt), st, pad)
+        assert ye.shape == ye_ref.shape and yo.shape == yo_ref.shape
+        np.testing.assert_allclose(np.asarray(ye), np.asarray(ye_ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(yo), np.asarray(yo_ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestGradSplit:
+    @pytest.mark.parametrize("b,h,w,ci,co,k,st,pad", GEOMS)
+    def test_grad_weights_matches_plain(self, b, h, w, ci, co, k, st,
+                                        pad):
+        x = _x((b, h, w, ci))
+        wt_shape = (*k, ci, co)
+        y_shape = (b,
+                   conv_ops.out_size(h, k[0], conv_ops._norm2(st)[0],
+                                     conv_ops._norm2(pad)[0]),
+                   conv_ops.out_size(w, k[1], conv_ops._norm2(st)[1],
+                                     conv_ops._norm2(pad)[1]), co)
+        err = _x(y_shape, "err")
+        ee, eo = split_cols(jnp.asarray(err))
+        ref = conv_ops.xla_conv2d_grad_weights(
+            jnp.asarray(x), jnp.asarray(err), wt_shape, st, pad)
+        got = conv_ops.xla_conv2d_grad_weights_split(
+            jnp.asarray(x), ee, eo, wt_shape, st, pad)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("b,h,w,ci,co,k,st,pad", GEOMS)
+    def test_grad_input_matches_plain(self, b, h, w, ci, co, k, st, pad):
+        x_shape = (b, h, w, ci)
+        wt = _x((*k, ci, co), "w") * 0.2
+        y_shape = (b,
+                   conv_ops.out_size(h, k[0], conv_ops._norm2(st)[0],
+                                     conv_ops._norm2(pad)[0]),
+                   conv_ops.out_size(w, k[1], conv_ops._norm2(st)[1],
+                                     conv_ops._norm2(pad)[1]), co)
+        err = _x(y_shape, "err")
+        ee, eo = split_cols(jnp.asarray(err))
+        ref = conv_ops.xla_conv2d_grad_input(
+            jnp.asarray(err), jnp.asarray(wt), x_shape, st, pad)
+        got = conv_ops.xla_conv2d_grad_input_split(
+            ee, eo, jnp.asarray(wt), x_shape, st, pad)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_interleave_round_trip():
+    x = jnp.asarray(_x((2, 5, 9, 4)))
+    xe, xo = split_cols(x)
+    np.testing.assert_array_equal(np.asarray(interleave_cols(xe, xo, 9)),
+                                  np.asarray(x))

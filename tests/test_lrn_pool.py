@@ -228,6 +228,71 @@ class TestSpecMerge:
         assert [la.kind for la in out_l] == ["lrn", "max_pool"]
 
 
+class TestPhase2SplitConv:
+    def test_fused2_matches_default_merge(self, monkeypatch):
+        """ZNICZ_TPU_LRN_POOL=fused2: the conv feeding each folded pair
+        emits parity halves directly and consumes split gradients.
+        The parity convs are allclose (not bit-equal) to the plain
+        conv, so training must match the default merge to float
+        tolerance."""
+        from znicz_tpu.backends import Device
+        from znicz_tpu.config import root
+        from znicz_tpu.models import alexnet
+        from znicz_tpu.parallel import FusedTrainer, fused
+
+        saved = root.alexnet.to_dict()
+        try:
+            root.alexnet.synthetic.update({"n_train": 64, "n_valid": 0,
+                                           "n_test": 0})
+            root.alexnet.update({"minibatch_size": 32, "size": 67,
+                                 "n_classes": 7})
+            root.alexnet.layers = alexnet.make_layers(
+                n_classes=7, widths=(8, 12, 8, 8, 8, 24, 16))
+            prng.seed_all(31)
+            wf = alexnet.AlexNetWorkflow()
+            wf.initialize(device=Device.create("xla"))
+        finally:
+            root.alexnet.update(saved)
+
+        spec0, params, vels = fused.extract_model(wf)
+        monkeypatch.setenv("ZNICZ_TPU_LRN_POOL", "fused2")
+        spec2, params2, vels2 = fused.extract_model(wf)
+        monkeypatch.delenv("ZNICZ_TPU_LRN_POOL")
+        split_convs = [la for la in spec2.layers
+                       if la.kind == "conv" and la.cfg.get("split_out")]
+        assert len(split_convs) == 2        # conv1 and conv2
+        assert any(la.cfg.get("emit_split") for la in spec2.layers
+                   if la.kind == "lrn_pool")
+        assert all(not la.cfg.get("split_out") for la in spec0.layers)
+
+        ld = wf.loader
+        idx = np.arange(64)
+        data = np.asarray(ld.original_data.mem)
+        labels = np.asarray(ld.original_labels.mem)
+
+        def run(spec, p, v):
+            tr = FusedTrainer(
+                spec=spec,
+                params=[tuple(np.array(a) if a is not None else None
+                              for a in r) for r in p],
+                vels=[tuple(np.array(a) if a is not None else None
+                            for a in r) for r in v])
+            for ep in range(2):
+                m = tr.train_epoch(data, labels, idx, 32, epoch=ep)
+            return m, tr.params
+
+        m0, p0 = run(spec0, params, vels)
+        m2, p2 = run(spec2, params2, vels2)
+        np.testing.assert_allclose(np.asarray(m2["loss"]),
+                                   np.asarray(m0["loss"]),
+                                   rtol=1e-5, atol=1e-6)
+        for (w0, _), (w2, _) in zip(p0, p2):
+            if w0 is not None:
+                np.testing.assert_allclose(np.asarray(w2),
+                                           np.asarray(w0),
+                                           rtol=2e-4, atol=2e-5)
+
+
 class TestWriteBack:
     def test_write_back_lands_on_the_right_units(self):
         """Review r3: the merge makes spec rows FEWER than forward

@@ -26,6 +26,9 @@ run bench.py --ablate
 run bench.py --ablate --minibatch 256
 # kernel table (now incl. lrn_maxpool/gd_lrn_maxpool + retiled convs)
 run bench.py --kernels
+# phase-2 split-conv candidate at both batches (opt-in lever)
+ZNICZ_TPU_LRN_POOL=fused2 run bench.py
+ZNICZ_TPU_LRN_POOL=fused2 run bench.py --minibatch 256
 # precision / storage variants
 run bench.py --dtype bfloat16
 run bench.py --storage bfloat16 --minibatch 256

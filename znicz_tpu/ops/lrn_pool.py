@@ -283,12 +283,14 @@ def pallas_gd_lrn_maxpool(errp, offsets, x, n, alpha, beta, k, ksize,
 
 @functools.partial(jax.jit, static_argnames=(
     "n", "alpha", "beta", "k", "ksize", "stride", "padding",
-    "fold_act"))
+    "fold_act", "return_split"))
 def pallas_gd_lrn_maxpool_split(errp, offsets, xe, xo, n, alpha, beta,
                                 k, ksize, stride, padding,
-                                fold_act=None):
+                                fold_act=None, return_split=False):
     """Fused backward over pre-split halves — when the forward cached
-    (xe, xo) the re-split of x disappears entirely."""
+    (xe, xo) the re-split of x disappears entirely.  ``return_split``
+    hands the (dxe, dxo) halves back un-interleaved (phase-2: the
+    split-out conv's gradients consume them directly)."""
     (kh, kw), (sh, sw) = norm2(ksize), norm2(stride)
     assert fusable(ksize, stride, padding), "gate with fusable() first"
     b, h, _, c = xe.shape
@@ -322,6 +324,8 @@ def pallas_gd_lrn_maxpool_split(errp, offsets, xe, xo, n, alpha, beta,
                    jax.ShapeDtypeStruct((b, h, wo, c), jnp.float32)],
         interpret=tuning.interpret_mode(),
     )(xe, xo, *([errp] * n_contrib + [offsets] * n_contrib))
+    if return_split:
+        return dxe, dxo
     # interleave the parity halves back: (..., We, 2, C) → (..., 2·We, C)
     return interleave_cols(dxe, dxo, w)
 
@@ -359,12 +363,15 @@ def lrn_maxpool_split(xe, xo, n, alpha, beta, k, ksize, stride, padding,
 
 
 def gd_lrn_maxpool_split(errp, offsets, xe, xo, n, alpha, beta, k,
-                         ksize, stride, padding, fold_act=None):
+                         ksize, stride, padding, fold_act=None,
+                         return_split=False):
     if tuning.use_pallas() and fusable(ksize, stride, padding):
         return pallas_gd_lrn_maxpool_split(errp, offsets, xe, xo, n,
                                            alpha, beta, k, ksize,
-                                           stride, padding, fold_act)
+                                           stride, padding, fold_act,
+                                           return_split)
     w = xe.shape[2] + xo.shape[2]
-    return xla_gd_lrn_maxpool(errp, offsets, interleave_cols(xe, xo, w),
-                              n, alpha, beta, k, ksize, stride, padding,
-                              fold_act)
+    dx = xla_gd_lrn_maxpool(errp, offsets,
+                            interleave_cols(xe, xo, w), n, alpha, beta,
+                            k, ksize, stride, padding, fold_act)
+    return split_cols(dx) if return_split else dx

@@ -42,6 +42,18 @@ def lrn_pool_merge() -> bool:
     return os.environ.get("ZNICZ_TPU_LRN_POOL", "fused") != "split"
 
 
+def lrn_pool_split_conv() -> bool:
+    """Phase-2 (opt-in, ZNICZ_TPU_LRN_POOL=fused2): the conv feeding a
+    folded pair emits the column-parity halves DIRECTLY (two
+    stride-doubled convs) and consumes the pair's split gradient halves
+    — removing the pair forward's split pass and the backward's
+    interleave.  Off by default: the parity convs are only allclose
+    (not bit-equal) to the plain conv, so the merged-vs-split
+    bit-equality contract keeps the default conservative until the
+    on-chip A/B (--ablate row lrn_pool_fused2) justifies flipping it."""
+    return os.environ.get("ZNICZ_TPU_LRN_POOL") == "fused2"
+
+
 def lrn_pool_act_fold() -> bool:
     """Whether the merge also folds the preceding conv's activation
     derivative into the pair backward.  ZNICZ_TPU_LRN_POOL=nofold keeps

@@ -280,11 +280,16 @@ def _merge_lrn_pool(layers, params, vels):
                 if out_l[-1].activation != "linear" \
                         and not act.needs_input:
                     cfg["fold_act"] = out_l[-1].activation
+                    prev_cfg = dict(out_l[-1].config, act_folded=True)
+                    # phase-2 (opt-in): the conv emits the parity
+                    # halves directly and takes split gradients back
+                    if out_l[-1].kind == "conv" \
+                            and tuning.lrn_pool_split_conv():
+                        prev_cfg["split_out"] = True
+                        cfg["emit_split"] = True
                     out_l[-1] = dataclasses.replace(
                         out_l[-1],
-                        config=tuple(sorted(
-                            dict(out_l[-1].config,
-                                 act_folded=True).items())))
+                        config=tuple(sorted(prev_cfg.items())))
                     merged = dataclasses.replace(
                         merged, config=tuple(sorted(cfg.items())))
             idx_map[i] = len(out_l)
@@ -333,7 +338,11 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
     n = len(spec.layers)
     for i, (layer, (w, b)) in enumerate(zip(spec.layers, params)):
         x_in, aux = h, None
-        in_shapes.append(tuple(h.shape))
+        if isinstance(h, tuple):     # split-out conv → pair handoff:
+            b_, h_, we, c_ = h[0].shape          # record logical shape
+            in_shapes.append((b_, h_, we + h[1].shape[2], c_))
+        else:
+            in_shapes.append(tuple(h.shape))
         cfg = layer.cfg
         is_last = i == n - 1
         if layer.kind == "fc":
@@ -347,12 +356,23 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
             else:
                 h = spec.act(i).fwd(pre, jnp)
         elif layer.kind == "conv":
-            pre = conv_ops.conv2d(h.astype(cdt), w.astype(cdt),
-                                  cfg["stride"], cfg["padding"],
-                                  out_dtype=jnp.float32)
-            if b is not None:
-                pre = pre + b
-            h = spec.act(i).fwd(pre, jnp)
+            if cfg.get("split_out"):
+                # phase-2: emit the column-parity halves the merged
+                # pair consumes — the split pass over the conv output
+                # never exists (ops/conv.py parity decomposition)
+                pe, po = conv_ops.xla_conv2d_split(
+                    h.astype(cdt), w.astype(cdt), cfg["stride"],
+                    cfg["padding"], out_dtype=jnp.float32)
+                if b is not None:
+                    pe, po = pe + b, po + b
+                h = (spec.act(i).fwd(pe, jnp), spec.act(i).fwd(po, jnp))
+            else:
+                pre = conv_ops.conv2d(h.astype(cdt), w.astype(cdt),
+                                      cfg["stride"], cfg["padding"],
+                                      out_dtype=jnp.float32)
+                if b is not None:
+                    pre = pre + b
+                h = spec.act(i).fwd(pre, jnp)
         elif layer.kind == "deconv":
             wt = w if w is not None else params[cfg["tie"]][0]
             pre = deconv_ops.deconv2d(h.astype(cdt), wt.astype(cdt),
@@ -408,7 +428,8 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
             # so the cache keeps the column-parity halves the kernel
             # consumed — the backward never re-splits x
             if "fold_act" in cfg:
-                xe, xo = lrn_pool_ops.split_cols(h)
+                xe, xo = (h if isinstance(h, tuple)   # split-out conv
+                          else lrn_pool_ops.split_cols(h))
                 x_in = (xe, xo)
                 h, aux = lrn_pool_ops.lrn_maxpool_split(
                     xe, xo, cfg["n"], cfg["alpha"], cfg["beta"],
@@ -440,7 +461,8 @@ def forward(spec: ModelSpec, params, x, *, want_caches: bool,
             # its backward cache) live in sdt; the last layer's output
             # stays f32 so the loss head and its error are full
             # precision
-            h = h.astype(sdt)
+            h = (tuple(t.astype(sdt) for t in h)
+                 if isinstance(h, tuple) else h.astype(sdt))
         auxes.append(aux)
         if want_caches:
             caches.append((x_in, aux))
@@ -518,14 +540,28 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
             elif layer.kind == "conv":
                 # grads accumulate in f32 (preferred_element_type inside
                 # the conv ops); cdt only feeds the MXU operands
-                gw = conv_ops.conv2d_grad_weights(
-                    x_in.astype(cdt), err_pre.astype(cdt), w.shape,
-                    cfg["stride"], cfg["padding"])
-                gb = (jnp.sum(err_pre, axis=(0, 1, 2))
-                      if b is not None else None)
-                err = conv_ops.conv2d_grad_input(
-                    err_pre.astype(cdt), w.astype(cdt), x_in.shape,
-                    cfg["stride"], cfg["padding"])
+                if cfg.get("split_out"):
+                    # phase-2: err arrives as the pair's parity halves
+                    # (never interleaved) — parity-decomposed grads
+                    ee, eo = (e.astype(cdt) for e in err_pre)
+                    gw = conv_ops.xla_conv2d_grad_weights_split(
+                        x_in.astype(cdt), ee, eo, w.shape,
+                        cfg["stride"], cfg["padding"])
+                    gb = (jnp.sum(err_pre[0], axis=(0, 1, 2))
+                          + jnp.sum(err_pre[1], axis=(0, 1, 2))
+                          if b is not None else None)
+                    err = conv_ops.xla_conv2d_grad_input_split(
+                        ee, eo, w.astype(cdt), x_in.shape,
+                        cfg["stride"], cfg["padding"])
+                else:
+                    gw = conv_ops.conv2d_grad_weights(
+                        x_in.astype(cdt), err_pre.astype(cdt), w.shape,
+                        cfg["stride"], cfg["padding"])
+                    gb = (jnp.sum(err_pre, axis=(0, 1, 2))
+                          if b is not None else None)
+                    err = conv_ops.conv2d_grad_input(
+                        err_pre.astype(cdt), w.astype(cdt), x_in.shape,
+                        cfg["stride"], cfg["padding"])
             else:                                         # deconv
                 gw = deconv_ops.deconv2d_grad_weights(
                     err_pre.astype(cdt), x_in.astype(cdt), w.shape,
@@ -559,7 +595,8 @@ def backward(spec: ModelSpec, params, caches, out, err, epoch=0, ctr=0,
                     err.reshape(y_i.shape), aux, x_in[0], x_in[1],
                     cfg["n"], cfg["alpha"], cfg["beta"], cfg["k"],
                     cfg["ksize"], cfg["stride"], cfg["padding"],
-                    cfg.get("fold_act"))
+                    cfg.get("fold_act"),
+                    return_split=bool(cfg.get("emit_split")))
             else:
                 err = lrn_pool_ops.gd_lrn_maxpool(
                     err.reshape(y_i.shape), aux, x_in, cfg["n"],
