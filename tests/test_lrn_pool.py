@@ -293,6 +293,54 @@ class TestPhase2SplitConv:
                                            rtol=2e-4, atol=2e-5)
 
 
+    @pytest.mark.parametrize("mode", ["mesh_dp", "mesh_tp", "bf16",
+                                      "accum"])
+    def test_fused2_under_training_modes(self, monkeypatch, mode):
+        """The phase-2 path must compile and train under every shipped
+        training mode: data/tensor-parallel meshes, bf16 activation
+        storage, gradient accumulation."""
+        import dataclasses
+
+        from znicz_tpu.backends import Device
+        from znicz_tpu.config import root
+        from znicz_tpu.models import alexnet
+        from znicz_tpu.parallel import FusedTrainer, fused, make_mesh
+
+        saved = root.alexnet.to_dict()
+        try:
+            root.alexnet.synthetic.update({"n_train": 64, "n_valid": 0,
+                                           "n_test": 0})
+            root.alexnet.update({"minibatch_size": 32, "size": 67,
+                                 "n_classes": 8})
+            root.alexnet.layers = alexnet.make_layers(
+                n_classes=8, widths=(8, 16, 8, 8, 8, 32, 16))
+            prng.seed_all(13)
+            wf = alexnet.AlexNetWorkflow()
+            wf.initialize(device=Device.create("xla"))
+        finally:
+            root.alexnet.update(saved)
+        monkeypatch.setenv("ZNICZ_TPU_LRN_POOL", "fused2")
+        spec, params, vels = fused.extract_model(wf)
+        monkeypatch.delenv("ZNICZ_TPU_LRN_POOL")
+        assert any(la.cfg.get("split_out") for la in spec.layers)
+
+        kw = {}
+        if mode == "mesh_dp":
+            kw["mesh"] = make_mesh(n_data=8, n_model=1)
+        elif mode == "mesh_tp":
+            kw["mesh"] = make_mesh(n_data=4, n_model=2)
+        elif mode == "bf16":
+            spec = dataclasses.replace(spec, storage_dtype="bfloat16")
+        elif mode == "accum":
+            kw["accum_steps"] = 2
+        tr = FusedTrainer(spec=spec, params=params, vels=vels, **kw)
+        ld = wf.loader
+        m = tr.train_epoch(np.asarray(ld.original_data.mem),
+                           np.asarray(ld.original_labels.mem),
+                           np.arange(64), 32)
+        assert np.isfinite(np.asarray(m["loss"])).all()
+
+
 class TestWriteBack:
     def test_write_back_lands_on_the_right_units(self):
         """Review r3: the merge makes spec rows FEWER than forward
