@@ -85,6 +85,19 @@ class TestGradSplit:
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_output_width_one_empty_odd_half():
+    """ow == 1: the odd half is empty — must not crash (review r3
+    fuzz finding)."""
+    x = jnp.asarray(_x((1, 8, 6, 2)))
+    wt = jnp.asarray(_x((2, 3, 2, 3), "w"))
+    ye, yo = conv_ops.xla_conv2d_split(x, wt, (3, 4), (1, 0))
+    y = conv_ops.xla_conv2d(x, wt, (3, 4), (1, 0))
+    assert y.shape[2] == 1
+    assert yo.shape[2] == 0
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_interleave_round_trip():
     x = jnp.asarray(_x((2, 5, 9, 4)))
     xe, xo = split_cols(x)

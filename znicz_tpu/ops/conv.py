@@ -158,11 +158,19 @@ def _parity_out_w(w: int, kw: int, sw: int, pw: int) -> tuple[int, int]:
 
 def xla_conv2d_split(x, w, stride=1, padding=0, out_dtype=None):
     """→ (y_even, y_odd): the column-parity halves of xla_conv2d."""
-    kh, kw, _, _ = w.shape
+    kh, kw, _, oc = w.shape
     (sh, sw), (ph, pw) = _norm2(stride), _norm2(padding)
-    _, _, w_in, _ = x.shape
+    _, h_in, w_in, _ = x.shape
+    oh = out_size(h_in, kh, sh, ph)
     halves = []
     for p, target in zip((0, 1), _parity_out_w(w_in, kw, sw, pw)):
+        if target == 0:
+            # output width 1: the odd half is empty — mirror the
+            # gradient twins' guard instead of building an impossible
+            # negative-padding conv
+            halves.append(jnp.zeros(
+                (x.shape[0], oh, 0, oc), out_dtype or x.dtype))
+            continue
         pl = pw - p * sw
         pr = (target - 1) * 2 * sw + kw - w_in - pl
         y = lax.conv_general_dilated(
