@@ -131,6 +131,14 @@ class LMDBReader:
             oflags = struct.unpack_from("<H", self._buf, ooff + 10)[0]
             if not oflags & _P_OVERFLOW:
                 raise ValueError(f"page {ovpg}: expected overflow page")
+            # a multi-page value can run past EOF on a truncated file;
+            # an mmap slice would silently shorten it and surface later
+            # as a confusing reshape error — diagnose it here instead
+            if ooff + _PAGE_HDR + dsize > len(self._buf):
+                raise ValueError(
+                    f"page {ovpg}: overflow value of {dsize} bytes for "
+                    f"key {bytes(key)!r} runs past EOF — truncated or "
+                    "corrupt LMDB")
             data = self._buf[ooff + _PAGE_HDR:ooff + _PAGE_HDR + dsize]
         else:
             data = self._buf[dstart:dstart + dsize]
@@ -191,14 +199,51 @@ def parse_datum(blob: bytes) -> dict:
     return out
 
 
-def datum_to_arrays(d: dict) -> tuple[np.ndarray, int]:
+def _resize_float(img: np.ndarray, hw: tuple[int, int]) -> np.ndarray:
+    """Bilinear resize of an HWC float32 array with NO dtype round-trip
+    — float_data Datums hold arbitrary ranges (mean-subtracted etc.)
+    that a uint8 detour would silently wrap."""
+    from PIL import Image
+    h, w = hw
+    chans = [np.asarray(Image.fromarray(img[:, :, c], mode="F")
+                        .resize((w, h), Image.BILINEAR), np.float32)
+             for c in range(img.shape[2])]
+    return np.stack(chans, axis=2)
+
+
+def datum_to_arrays(d: dict, decode_encoded: bool = True,
+                    size: tuple[int, int] | None = None
+                    ) -> tuple[np.ndarray, int]:
     """Datum → (HWC float32 image, label).  Raw ``data`` bytes are CHW
     uint8 (the Caffe convention) → transposed HWC, scaled to [0, 1];
-    ``float_data`` is already float CHW."""
+    ``float_data`` is already float CHW.  ``encoded`` Datum values
+    (the reference's flagship ImageNet LMDBs store JPEG/PNG bytes) are
+    decoded with PIL — the same backend ``loader/image.py`` already
+    trusts; pass ``decode_encoded=False`` to refuse them instead.
+    ``size=(H, W)`` resizes (bilinear) — on the still-open PIL image
+    for encoded values, float-safe for raw/float_data ones."""
     if d["encoded"]:
-        raise NotImplementedError(
-            "encoded (JPEG) Datum values need an image decoder; re-export"
-            " the dataset unencoded")
+        if not decode_encoded:
+            raise NotImplementedError(
+                "encoded (JPEG) Datum values refused by "
+                "decode_encoded=False; re-export the dataset unencoded "
+                "or drop the flag")
+        from PIL import Image
+        with Image.open(io.BytesIO(d["data"])) as im:
+            # Caffe's convert_imageset -encoded leaves channels unset
+            # (0) — fall back to the image's own mode then
+            if d["channels"] == 1 or (d["channels"] == 0
+                                      and im.mode in ("1", "L", "I",
+                                                      "I;16", "F")):
+                im = im.convert("L")
+            else:
+                im = im.convert("RGB")
+            if size is not None and im.size != (size[1], size[0]):
+                im = im.resize((size[1], size[0]), Image.BILINEAR)
+            arr = np.asarray(im, np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr, int(d["label"])
     c, h, w = d["channels"], d["height"], d["width"]
     if d["data"]:
         arr = np.frombuffer(d["data"], np.uint8).astype(np.float32)
@@ -206,12 +251,20 @@ def datum_to_arrays(d: dict) -> tuple[np.ndarray, int]:
     else:
         arr = np.asarray(d["float_data"], np.float32
                          ).reshape(c, h, w).transpose(1, 2, 0)
+    if size is not None and arr.shape[:2] != tuple(size):
+        arr = _resize_float(arr, size)
     return arr, int(d["label"])
 
 
 def import_lmdb(path: str, out_path: str,
-                shard_size: int | None = None) -> list[str]:
-    """Convert a Caffe-style LMDB dataset into ``.znr`` shard(s)."""
+                shard_size: int | None = None,
+                size: tuple[int, int] | None = None,
+                decode_encoded: bool = True) -> list[str]:
+    """Convert a Caffe-style LMDB dataset into ``.znr`` shard(s).
+
+    ``size=(H, W)`` resizes every image (PIL bilinear) — required when
+    an encoded LMDB stores variable-sized JPEGs, since ``.znr`` shards
+    hold one static sample shape."""
     reader = LMDBReader(path)
     writer = None
     paths: list[str] = []
@@ -224,18 +277,40 @@ def import_lmdb(path: str, out_path: str,
         base, ext = os.path.splitext(out_path)
         return f"{base}-{shard_idx:05d}{ext}"
 
-    for _key, blob in reader:
-        img, label = datum_to_arrays(parse_datum(blob))
-        if writer is None:
-            writer = RecordWriter(shard_name(), img.shape, np.float32,
-                                  (), np.int32)
-            paths.append(writer.path)
-        writer.write(img, label)
-        count += 1
-        if shard_size is not None and writer.n >= shard_size:
+    ds_shape = None                        # one geometry across ALL shards
+    try:
+        for key, blob in reader:
+            img, label = datum_to_arrays(parse_datum(blob),
+                                         decode_encoded=decode_encoded,
+                                         size=size)
+            if ds_shape is None:
+                ds_shape = img.shape
+            elif img.shape != ds_shape:
+                raise ValueError(
+                    f"{path}: record {key!r} has shape {img.shape} but "
+                    f"the dataset opened at {ds_shape}; pass "
+                    "size=(H, W) to resize a variable-sized dataset")
+            if writer is None:
+                writer = RecordWriter(shard_name(), ds_shape,
+                                      np.float32, (), np.int32)
+                paths.append(writer.path)
+            writer.write(img, label)
+            count += 1
+            if shard_size is not None and writer.n >= shard_size:
+                writer.close()
+                writer = None
+                shard_idx += 1
+    except BaseException:
+        # don't leave partial/placeholder-header shards for a later
+        # glob to feed into RecordLoader
+        if writer is not None:
             writer.close()
-            writer = None
-            shard_idx += 1
+        for p in paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        raise
     if writer is not None:
         writer.close()
     if count == 0:
@@ -321,9 +396,23 @@ def main(argv=None) -> int:
     p.add_argument("src")
     p.add_argument("dst")
     p.add_argument("--shard-size", type=int, default=None)
+    p.add_argument("--size", type=int, nargs=2, metavar=("H", "W"),
+                   default=None,
+                   help="resize images (needed for variable-sized "
+                        "encoded LMDBs)")
+    p.add_argument("--no-decode", action="store_true",
+                   help="refuse JPEG/PNG-encoded Datum values instead "
+                        "of decoding them with PIL")
     args = p.parse_args(argv)
-    fn = import_lmdb if args.format == "lmdb" else import_pickle
-    for path in fn(args.src, args.dst, shard_size=args.shard_size):
+    if args.format == "lmdb":
+        paths = import_lmdb(args.src, args.dst,
+                            shard_size=args.shard_size,
+                            size=tuple(args.size) if args.size else None,
+                            decode_encoded=not args.no_decode)
+    else:
+        paths = import_pickle(args.src, args.dst,
+                              shard_size=args.shard_size)
+    for path in paths:
         print(path)
     return 0
 

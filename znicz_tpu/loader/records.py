@@ -278,6 +278,13 @@ class RecordFile:
                     and (labels_out.dtype != self.label_dtype
                          or not labels_out.flags.c_contiguous)):
             return False
+        # the C++ scatter trusts row widths blindly — refuse any
+        # geometry mismatch here rather than corrupt the heap
+        if tuple(data_out.shape[1:]) != tuple(self.data_shape):
+            return False
+        if labels_out is not None and \
+                tuple(labels_out.shape[1:]) != tuple(self.label_shape):
+            return False
         idx64 = np.ascontiguousarray(nidx, np.int64)
         pos64 = np.ascontiguousarray(positions, np.int64)
         workers = int(os.environ.get("ZNICZ_TPU_IO_WORKERS", 0)) \

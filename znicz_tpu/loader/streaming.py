@@ -160,6 +160,18 @@ class RecordLoader(StreamingLoader):
         if len(shapes) != 1:
             raise ValueError(f"{self.name}: shards disagree on sample "
                              f"shape: {shapes}")
+        # label geometry must match too: read_batch_into scatters each
+        # shard's own label_row_bytes into a buffer sized from
+        # files[0], so a divergent shard would corrupt the heap rather
+        # than raise like the numpy assignment path did
+        lshapes = {f.label_shape for f in self._files}
+        if len(lshapes) != 1:
+            raise ValueError(f"{self.name}: shards disagree on label "
+                             f"shape: {lshapes}")
+        ldtypes = {np.dtype(f.label_dtype) for f in self._files}
+        if len(ldtypes) != 1:
+            raise ValueError(f"{self.name}: shards disagree on label "
+                             f"dtype: {ldtypes}")
         self.class_lengths = lengths
         self.sample_shape = self._files[0].data_shape
         self.label_shape = self._files[0].label_shape

@@ -39,7 +39,18 @@ def _mxu_cast(dtype):
     for f32 convs/dots, so the Pallas tier competes (and agrees
     numerically) with the XLA tier it is benchmarked against.  On CPU
     (interpret mode) there is no MXU and the golden-path tests expect
-    full f32 — no cast."""
+    full f32 — no cast.
+
+    Consequence for callers of the dispatching ``matmul()``: on TPU,
+    f32 inputs are NOT multiplied in full f32 precision on the Pallas
+    tier (accumulation stays f32).  ``ZNICZ_TPU_MXU=f32`` disables the
+    cast for on-chip A/B and precision triage — set it BEFORE the first
+    matmul of the process: the value is read at trace time, so a jitted
+    shape that already compiled keeps its cast decision (A/B runs
+    therefore use separate processes, as bench.py does)."""
+    import os
+    if os.environ.get("ZNICZ_TPU_MXU", "").lower() == "f32":
+        return None
     if tuning.on_tpu() and jnp.dtype(dtype) == jnp.float32:
         return jnp.bfloat16
     return None

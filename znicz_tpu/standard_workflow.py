@@ -282,10 +282,12 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                                     # bit-identical pixels to the host
                                     # application, but the crop rides
                                     # the device step instead of the
-                                    # loader-bound host CPU
-                                    device_augment=getattr(
-                                        self.loader, "augment",
-                                        None) is not None)
+                                    # loader-bound host CPU; custom
+                                    # policies without a device twin
+                                    # keep the host prefetch path
+                                    device_augment=hasattr(
+                                        getattr(self.loader, "augment",
+                                                None), "device_apply"))
         else:
             trainer = FusedTrainer(spec=spec, params=params, vels=vels,
                                    mesh=mesh,
