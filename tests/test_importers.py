@@ -365,6 +365,41 @@ class TestLMDBImport:
         assert gl[0] == 2
         rf.close()
 
+    def test_mixed_channels_forced(self, tmp_path):
+        """Review r4: a mixed gray/color encoded LMDB names the channel
+        mismatch (size= can't fix it) and channels= resolves it."""
+        rng = np.random.default_rng(8)
+        gray = rng.integers(0, 256, (6, 6), dtype=np.uint8)
+        color = rng.integers(0, 256, (6, 6, 3), dtype=np.uint8)
+        items = [(b"a", _encode_datum_encoded(gray, 0,
+                                              with_channels=False)),
+                 (b"b", _encode_datum_encoded(color, 1,
+                                              with_channels=False))]
+        mdb = str(tmp_path / "mix.mdb")
+        write_lmdb(mdb, items)
+        with pytest.raises(ValueError, match="channels="):
+            import_lmdb(mdb, str(tmp_path / "mix.znr"))
+        out = str(tmp_path / "rgb.znr")
+        import_lmdb(mdb, out, channels="rgb")
+        rf = rec.RecordFile(out)
+        assert rf.data_shape == (6, 6, 3)
+        rf.close()
+        out2 = str(tmp_path / "gray.znr")
+        import_lmdb(mdb, out2, channels="gray")
+        rf = rec.RecordFile(out2)
+        assert rf.data_shape == (6, 6, 1)
+        rf.close()
+
+    def test_cli_rejects_lmdb_flags_for_pickle(self, tmp_path):
+        from znicz_tpu.loader.importers import main
+        data = np.ones((4, 3), np.float32)
+        p = str(tmp_path / "d.pickle")
+        with open(p, "wb") as f:
+            pickle.dump({"images": data}, f)
+        with pytest.raises(SystemExit):
+            main(["pickle", p, str(tmp_path / "d.znr"), "--size", "2",
+                  "2"])
+
     def test_failed_import_removes_partial_shards(self, tmp_path):
         """Review r4: an import that dies mid-way must not leave
         placeholder-header or partial shards for a later glob."""

@@ -212,7 +212,8 @@ def _resize_float(img: np.ndarray, hw: tuple[int, int]) -> np.ndarray:
 
 
 def datum_to_arrays(d: dict, decode_encoded: bool = True,
-                    size: tuple[int, int] | None = None
+                    size: tuple[int, int] | None = None,
+                    channels: str | None = None
                     ) -> tuple[np.ndarray, int]:
     """Datum → (HWC float32 image, label).  Raw ``data`` bytes are CHW
     uint8 (the Caffe convention) → transposed HWC, scaled to [0, 1];
@@ -221,7 +222,9 @@ def datum_to_arrays(d: dict, decode_encoded: bool = True,
     decoded with PIL — the same backend ``loader/image.py`` already
     trusts; pass ``decode_encoded=False`` to refuse them instead.
     ``size=(H, W)`` resizes (bilinear) — on the still-open PIL image
-    for encoded values, float-safe for raw/float_data ones."""
+    for encoded values, float-safe for raw/float_data ones.
+    ``channels`` ("gray"/"rgb") forces the decoded channel count for
+    encoded values — mixed gray/color LMDBs need one or the other."""
     if d["encoded"]:
         if not decode_encoded:
             raise NotImplementedError(
@@ -231,10 +234,12 @@ def datum_to_arrays(d: dict, decode_encoded: bool = True,
         from PIL import Image
         with Image.open(io.BytesIO(d["data"])) as im:
             # Caffe's convert_imageset -encoded leaves channels unset
-            # (0) — fall back to the image's own mode then
-            if d["channels"] == 1 or (d["channels"] == 0
-                                      and im.mode in ("1", "L", "I",
-                                                      "I;16", "F")):
+            # (0) — fall back to the image's own mode then, unless the
+            # caller forces a channel count
+            if channels == "gray" or (channels is None and (
+                    d["channels"] == 1
+                    or (d["channels"] == 0
+                        and im.mode in ("1", "L", "I", "I;16", "F")))):
                 im = im.convert("L")
             else:
                 im = im.convert("RGB")
@@ -259,12 +264,14 @@ def datum_to_arrays(d: dict, decode_encoded: bool = True,
 def import_lmdb(path: str, out_path: str,
                 shard_size: int | None = None,
                 size: tuple[int, int] | None = None,
-                decode_encoded: bool = True) -> list[str]:
+                decode_encoded: bool = True,
+                channels: str | None = None) -> list[str]:
     """Convert a Caffe-style LMDB dataset into ``.znr`` shard(s).
 
     ``size=(H, W)`` resizes every image (PIL bilinear) — required when
     an encoded LMDB stores variable-sized JPEGs, since ``.znr`` shards
-    hold one static sample shape."""
+    hold one static sample shape.  ``channels`` ("gray"/"rgb") forces
+    the decoded channel count for mixed gray/color encoded LMDBs."""
     reader = LMDBReader(path)
     writer = None
     paths: list[str] = []
@@ -282,14 +289,20 @@ def import_lmdb(path: str, out_path: str,
         for key, blob in reader:
             img, label = datum_to_arrays(parse_datum(blob),
                                          decode_encoded=decode_encoded,
-                                         size=size)
+                                         size=size, channels=channels)
             if ds_shape is None:
                 ds_shape = img.shape
             elif img.shape != ds_shape:
+                hints = []
+                if img.shape[:2] != ds_shape[:2]:
+                    hints.append("pass size=(H, W) to resize")
+                if img.shape[2:] != ds_shape[2:]:
+                    hints.append("pass channels='gray' or 'rgb' to "
+                                 "force one channel count")
                 raise ValueError(
                     f"{path}: record {key!r} has shape {img.shape} but "
-                    f"the dataset opened at {ds_shape}; pass "
-                    "size=(H, W) to resize a variable-sized dataset")
+                    f"the dataset opened at {ds_shape}; "
+                    f"{' and '.join(hints)}")
             if writer is None:
                 writer = RecordWriter(shard_name(), ds_shape,
                                       np.float32, (), np.int32)
@@ -302,9 +315,14 @@ def import_lmdb(path: str, out_path: str,
                 shard_idx += 1
     except BaseException:
         # don't leave partial/placeholder-header shards for a later
-        # glob to feed into RecordLoader
+        # glob to feed into RecordLoader (close may itself fail — e.g.
+        # the full disk that aborted the import — but the unlinks must
+        # still run)
         if writer is not None:
-            writer.close()
+            try:
+                writer.close()
+            except OSError:
+                pass
         for p in paths:
             try:
                 os.unlink(p)
@@ -403,13 +421,23 @@ def main(argv=None) -> int:
     p.add_argument("--no-decode", action="store_true",
                    help="refuse JPEG/PNG-encoded Datum values instead "
                         "of decoding them with PIL")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("--gray", action="store_true",
+                   help="force 1-channel decode of encoded values")
+    g.add_argument("--rgb", action="store_true",
+                   help="force 3-channel decode of encoded values")
     args = p.parse_args(argv)
     if args.format == "lmdb":
+        channels = "gray" if args.gray else "rgb" if args.rgb else None
         paths = import_lmdb(args.src, args.dst,
                             shard_size=args.shard_size,
                             size=tuple(args.size) if args.size else None,
-                            decode_encoded=not args.no_decode)
+                            decode_encoded=not args.no_decode,
+                            channels=channels)
     else:
+        if args.size or args.no_decode or args.gray or args.rgb:
+            p.error("--size/--no-decode/--gray/--rgb apply to "
+                    "format=lmdb only")
         paths = import_pickle(args.src, args.dst,
                               shard_size=args.shard_size)
     for path in paths:
