@@ -390,6 +390,28 @@ class TestLMDBImport:
         assert rf.data_shape == (6, 6, 1)
         rf.close()
 
+    def test_raw_datum_channel_forcing(self):
+        """Review r4: channels= must work for RAW datums too (the hint
+        names it for any mixed dataset), and bad values must be loud."""
+        from znicz_tpu.loader.importers import datum_to_arrays
+        rgb = np.arange(3 * 2 * 2, dtype=np.uint8)
+        d3 = {"channels": 3, "height": 2, "width": 2,
+              "data": rgb.tobytes(), "label": 0, "float_data": [],
+              "encoded": False}
+        g, _ = datum_to_arrays(d3, channels="gray")
+        assert g.shape == (2, 2, 1)
+        chw = rgb.reshape(3, 2, 2).astype(np.float32) / 255.0
+        lum = (0.299 * chw[0] + 0.587 * chw[1] + 0.114 * chw[2])
+        np.testing.assert_allclose(g[:, :, 0], lum, rtol=1e-6)
+        d1 = {"channels": 1, "height": 2, "width": 2,
+              "data": bytes(range(4)), "label": 0, "float_data": [],
+              "encoded": False}
+        r, _ = datum_to_arrays(d1, channels="rgb")
+        assert r.shape == (2, 2, 3)
+        np.testing.assert_array_equal(r[:, :, 0], r[:, :, 2])
+        with pytest.raises(ValueError, match="channels="):
+            datum_to_arrays(d1, channels="grey")
+
     def test_cli_rejects_lmdb_flags_for_pickle(self, tmp_path):
         from znicz_tpu.loader.importers import main
         data = np.ones((4, 3), np.float32)

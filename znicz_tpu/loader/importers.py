@@ -223,8 +223,12 @@ def datum_to_arrays(d: dict, decode_encoded: bool = True,
     trusts; pass ``decode_encoded=False`` to refuse them instead.
     ``size=(H, W)`` resizes (bilinear) — on the still-open PIL image
     for encoded values, float-safe for raw/float_data ones.
-    ``channels`` ("gray"/"rgb") forces the decoded channel count for
-    encoded values — mixed gray/color LMDBs need one or the other."""
+    ``channels`` ("gray"/"rgb") forces the channel count — mixed
+    gray/color LMDBs need one or the other; raw values convert with
+    the same ITU-R 601 luma PIL's "L" mode uses, so mixed raw/encoded
+    datasets stay consistent."""
+    if channels not in (None, "gray", "rgb"):
+        raise ValueError(f"channels={channels!r}: use 'gray' or 'rgb'")
     if d["encoded"]:
         if not decode_encoded:
             raise NotImplementedError(
@@ -256,6 +260,11 @@ def datum_to_arrays(d: dict, decode_encoded: bool = True,
     else:
         arr = np.asarray(d["float_data"], np.float32
                          ).reshape(c, h, w).transpose(1, 2, 0)
+    if channels == "gray" and arr.shape[2] == 3:
+        arr = (arr @ np.asarray([0.299, 0.587, 0.114], np.float32)
+               )[:, :, None]
+    elif channels == "rgb" and arr.shape[2] == 1:
+        arr = np.repeat(arr, 3, axis=2)
     if size is not None and arr.shape[:2] != tuple(size):
         arr = _resize_float(arr, size)
     return arr, int(d["label"])

@@ -49,9 +49,13 @@ def _mxu_cast(dtype):
     shape that already compiled keeps its cast decision (A/B runs
     therefore use separate processes, as bench.py does)."""
     import os
-    if os.environ.get("ZNICZ_TPU_MXU", "").lower() == "f32":
+    lever = os.environ.get("ZNICZ_TPU_MXU", "").lower()
+    if lever == "f32":
         return None
-    if tuning.on_tpu() and jnp.dtype(dtype) == jnp.float32:
+    if jnp.dtype(dtype) == jnp.float32 and (lever == "bf16"
+                                            or tuning.on_tpu()):
+        # =bf16 forces the cast anywhere (interpret-mode CI executes
+        # the exact astype path the chip runs)
         return jnp.bfloat16
     return None
 

@@ -13,8 +13,6 @@ import os
 import jax
 import jax.numpy as jnp
 
-#: Force-disable Pallas kernels (fall back to pure-XLA formulations).
-_DISABLE = os.environ.get("ZNICZ_TPU_NO_PALLAS", "0") == "1"
 #: Force interpret-mode Pallas (CPU testing of kernel logic).
 _INTERPRET = os.environ.get("ZNICZ_TPU_PALLAS_INTERPRET", "0") == "1"
 
@@ -25,8 +23,12 @@ def on_tpu() -> bool:
 
 
 def use_pallas() -> bool:
-    """Pallas kernels run on real TPU, or anywhere under interpret mode."""
-    if _DISABLE:
+    """Pallas kernels run on real TPU, or anywhere under interpret mode.
+
+    The ZNICZ_TPU_NO_PALLAS kill-switch is re-read per call (not at
+    import) so the bench preflight can disable a misbehaving kernel
+    tier in-process before the headline run."""
+    if os.environ.get("ZNICZ_TPU_NO_PALLAS", "0") == "1":
         return False
     return on_tpu() or _INTERPRET
 
