@@ -1,5 +1,6 @@
-"""Worker for the true multi-process distributed test (run via
-``subprocess`` from tests/test_distributed.py, 2 processes on CPU).
+"""Worker for the true multi-process distributed tests (run via
+``subprocess`` from tests/test_distributed.py, N processes × 2 CPU
+devices each).
 
 Each process bootstraps through ``parallel.distributed`` exactly the way
 a real multi-host deployment would (SURVEY.md §3.2 job-loop redesign):
@@ -9,7 +10,10 @@ process-local rows → fused train steps whose gradient all-reduce rides
 XLA collectives.  Process 0 saves the final weights for the parent test
 to compare against a single-process run of the identical math.
 
-Usage: python _distributed_worker.py PORT PROC_ID NUM_PROCS OUT.npy
+Usage: python _distributed_worker.py PORT PROC_ID NUM_PROCS OUT.npy \
+           [plain|phase1|phase2]
+(phase1/phase2 select the combined accumulation+bf16+coordinator-restart
+scenario; the default "plain" mode runs 5 replicated full-batch steps.)
 """
 
 import sys
@@ -20,15 +24,16 @@ import jax
 
 
 def combined(out: str, phase: str) -> None:
-    """The round-3 combined scenario (VERDICT r2 items 5 + 6): 2
-    processes × 2 devices each (4-device global mesh), micro-batch
-    gradient ACCUMULATION + BF16 activation storage, with a TRUE
-    COORDINATOR RESTART between epochs — phase1 trains epoch 0,
-    checkpoints, and every process (including the jax.distributed
-    coordinator) EXITS; phase2 is a fresh process pair on a fresh
-    coordinator port that rebuilds from the checkpoint and trains epoch
-    1.  Process 0 writes the final weights for the parent to compare
-    against a single-process run of the identical math."""
+    """The combined scenario (VERDICT r2 items 5 + 6; widened to 4
+    processes by VERDICT r3 item 9): N processes × 2 devices each
+    (2N-device global mesh), micro-batch gradient ACCUMULATION + BF16
+    activation storage, with a TRUE COORDINATOR RESTART between epochs
+    — phase1 trains epoch 0, checkpoints, and every process (including
+    the jax.distributed coordinator) EXITS; phase2 is a fresh process
+    set on a fresh coordinator port that rebuilds from the checkpoint
+    and trains epoch 1.  Process 0 writes the final weights for the
+    parent to compare against a single-process run of the identical
+    math."""
     import dataclasses
 
     from znicz_tpu.parallel import FusedTrainer, distributed
@@ -45,7 +50,10 @@ def combined(out: str, phase: str) -> None:
         hypers_bias=(0.05, 0.0, 0.0, 0.9)),), "softmax")
     spec = dataclasses.replace(spec, storage_dtype="bfloat16")
     mesh = distributed.global_mesh()
-    assert dict(mesh.shape)["data"] * dict(mesh.shape)["model"] == 4
+    # each process must expose exactly 2 local devices (the parent's
+    # XLA_FLAGS contract) — device_count() alone would be tautological
+    assert dict(mesh.shape)["data"] * dict(mesh.shape)["model"] \
+        == 2 * jax.process_count()
 
     ckpt = out + ".ckpt.npz"
     if phase == "phase1":

@@ -90,16 +90,18 @@ class TestTrueMultiProcess:
                                    rtol=1e-5, atol=1e-6)
 
 
-class TestTwoProcessCombined:
-    """VERDICT r2 items 5 + 6: 2 processes × 2 devices each (4-device
-    global mesh) with gradient accumulation + bf16 activation storage +
-    a TRUE COORDINATOR RESTART (fresh process pair and coordinator port
-    between the two epochs, rebuilt from the checkpoint) — compared
-    against a single-process run of the identical math."""
+class TestMultiProcessCombined:
+    """VERDICT r2 items 5 + 6 (nproc=2), widened per VERDICT r3 item 9
+    (nproc=4): N processes × 2 devices each (2N-device global mesh)
+    with gradient accumulation + bf16 activation storage + a TRUE
+    COORDINATOR RESTART (fresh process set and coordinator port between
+    the two epochs, rebuilt from the checkpoint) — compared against a
+    single-process run of the identical math."""
 
+    @pytest.mark.parametrize("nproc", [2, 4])
     def test_accum_bf16_coordinator_restart_matches_single(self,
-                                                           tmp_path):
-        import dataclasses
+                                                           tmp_path,
+                                                           nproc):
         import os
         import socket
         import subprocess
@@ -119,10 +121,10 @@ class TestTwoProcessCombined:
                 s.bind(("127.0.0.1", 0))
                 port = s.getsockname()[1]
             procs = [subprocess.Popen(
-                [sys.executable, worker, str(port), str(i), "2",
+                [sys.executable, worker, str(port), str(i), str(nproc),
                  str(out), phase],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True) for i in range(2)]
+                text=True) for i in range(nproc)]
             outs = [p.communicate(timeout=300) for p in procs]
             for p, (so, se) in zip(procs, outs):
                 assert p.returncode == 0, \
@@ -133,34 +135,45 @@ class TestTwoProcessCombined:
         run_round("phase2")                # fresh coordinator: epoch 1
         w_multi = np.load(out)
 
-        # single-process reference: identical math (accum 2, bf16
-        # storage, checkpoint round-trip is an exact no-op here)
-        from znicz_tpu.parallel import FusedTrainer
-        from znicz_tpu.parallel.fused import LayerSpec, ModelSpec
-        n, feats, classes = 64, 32, 5
-        rng = np.random.default_rng(3)
-        data = rng.standard_normal((n, feats)).astype(np.float32)
-        labels = rng.integers(0, classes, n).astype(np.int32)
-        w0 = (rng.standard_normal((feats, classes)) * 0.1
-              ).astype(np.float32)
-        spec = dataclasses.replace(ModelSpec((LayerSpec(
-            kind="fc", activation="linear", include_bias=True,
-            hypers=(0.05, 0.0, 0.0, 0.9),
-            hypers_bias=(0.05, 0.0, 0.0, 0.9)),), "softmax"),
-            storage_dtype="bfloat16")
-        params = [(w0, np.zeros(classes, np.float32))]
-        vels = [(np.zeros_like(w0), np.zeros(classes, np.float32))]
-        tr = FusedTrainer(spec=spec, params=params, vels=vels,
-                          accum_steps=2)
-        idx = np.arange(n)
-        tr.train_epoch(data, labels, idx, 16, epoch=0)
-        # checkpoint round-trip (host copies), rebuild, second epoch
-        p2 = [(np.asarray(w), np.asarray(b)) for w, b in tr.params]
-        v2 = [(np.asarray(w), np.asarray(b)) for w, b in tr.vels]
-        tr2 = FusedTrainer(spec=spec, params=p2, vels=v2, accum_steps=2)
-        tr2.train_epoch(data, labels, idx, 16, epoch=1)
-        np.testing.assert_allclose(w_multi, np.asarray(tr2.params[0][0]),
+        np.testing.assert_allclose(w_multi, _combined_reference(),
                                    rtol=1e-5, atol=1e-6)
+
+
+def _combined_reference():
+    """Single-process reference weights for the combined scenario:
+    identical math (accum 2, bf16 storage, checkpoint round-trip is an
+    exact no-op here).  nproc-independent, so computed once across the
+    parametrized runs."""
+    if "w" in _combined_reference.__dict__:
+        return _combined_reference.w
+    import dataclasses
+
+    from znicz_tpu.parallel import FusedTrainer
+    from znicz_tpu.parallel.fused import LayerSpec, ModelSpec
+    n, feats, classes = 64, 32, 5
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((n, feats)).astype(np.float32)
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    w0 = (rng.standard_normal((feats, classes)) * 0.1
+          ).astype(np.float32)
+    spec = dataclasses.replace(ModelSpec((LayerSpec(
+        kind="fc", activation="linear", include_bias=True,
+        hypers=(0.05, 0.0, 0.0, 0.9),
+        hypers_bias=(0.05, 0.0, 0.0, 0.9)),), "softmax"),
+        storage_dtype="bfloat16")
+    params = [(w0, np.zeros(classes, np.float32))]
+    vels = [(np.zeros_like(w0), np.zeros(classes, np.float32))]
+    tr = FusedTrainer(spec=spec, params=params, vels=vels,
+                      accum_steps=2)
+    idx = np.arange(n)
+    tr.train_epoch(data, labels, idx, 16, epoch=0)
+    # checkpoint round-trip (host copies), rebuild, second epoch
+    p2 = [(np.asarray(w), np.asarray(b)) for w, b in tr.params]
+    v2 = [(np.asarray(w), np.asarray(b)) for w, b in tr.vels]
+    tr2 = FusedTrainer(spec=spec, params=p2, vels=v2, accum_steps=2)
+    tr2.train_epoch(data, labels, idx, 16, epoch=1)
+    _combined_reference.w = np.asarray(tr2.params[0][0])
+    return _combined_reference.w
 
 
 class TestRecovery:
