@@ -885,26 +885,47 @@ def bench_ablate(args) -> int:
         # every downstream activation shape, so it has no variant);
         # no_lrn strips LRN from the SPLIT spec, where it is standalone
         variants = [
-            ("full", None, base_spec, None, None),
-            ("lrn_pool_fused2", None, fused2_spec, None, None),
-            ("lrn_pool_nofold", None, nofold_spec, None, None),
+            ("full", None, base_spec, None, None, None),
+            ("lrn_pool_fused2", None, fused2_spec, None, None, None),
+            ("lrn_pool_nofold", None, nofold_spec, None, None, None),
             ("lrn_pool_split", None, split_spec, split_params,
-             split_vels),
+             split_vels, None),
             ("no_lrn", lambda la: la.kind != "lrn", split_spec,
-             split_params, split_vels),
+             split_params, split_vels, None),
             ("no_dropout", lambda la: la.kind != "dropout", base_spec,
-             None, None),
+             None, None, None),
             ("storage_bf16", None,
              dataclasses.replace(base_spec, storage_dtype="bfloat16"),
-             None, None),
+             None, None, None),
+            # conv1 space-to-depth (round 4): same spec, env-routed in
+            # conv2d at trace time — each row's fresh FusedTrainer
+            # re-traces, so the env is honored per row
+            ("conv1_s2d", None, base_spec, None, None,
+             ("ZNICZ_TPU_CONV1", "s2d")),
         ]
         rows = {}
-        for name, keep, spec, ps, vs in variants:
-            try:
-                rows[name] = round(time_spec(spec, keep, ps, vs), 2)
-            except Exception as e:   # a variant may be unbuildable
-                rows[name] = f"error: {e}"[:120]
-            print(f"  {name:14s} {rows[name]} ms/step", file=sys.stderr)
+        # the env-routed rows must own their variable for the WHOLE
+        # table: an ambient ZNICZ_TPU_CONV1=s2d would otherwise make
+        # every baseline row trace with the lever on (A/B delta ~0)
+        row_vars = {env[0] for *_, env in variants if env is not None}
+        saved_env = {v: os.environ.pop(v, None) for v in row_vars}
+        try:
+            for name, keep, spec, ps, vs, env in variants:
+                if env is not None:
+                    os.environ[env[0]] = env[1]
+                try:
+                    rows[name] = round(time_spec(spec, keep, ps, vs), 2)
+                except Exception as e:   # a variant may be unbuildable
+                    rows[name] = f"error: {e}"[:120]
+                finally:
+                    if env is not None:
+                        os.environ.pop(env[0], None)
+                print(f"  {name:14s} {rows[name]} ms/step",
+                      file=sys.stderr)
+        finally:
+            for var, val in saved_env.items():
+                if val is not None:
+                    os.environ[var] = val
         result["value"] = rows.get("full")
         result["rows"] = rows
     except Exception as e:

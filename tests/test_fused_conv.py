@@ -191,6 +191,45 @@ class TestFusedConvEquivalence:
         _drive_graph(wf, idx)
         _assert_params_match(wf, tr)
 
+    def test_conv1_s2d_full_model_matches_default(self, monkeypatch):
+        """ZNICZ_TPU_CONV1=s2d (VERDICT r3 item 8 lever): a model whose
+        first conv qualifies (C=3, stride 2) must train to the same
+        params as the default path to float tolerance."""
+        import jax
+        layers = [
+            {"type": "conv_tanh",
+             "->": {"n_kernels": 8, "kx": 5, "sliding": 2},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 24},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+        ]
+
+        def train(env):
+            if env:
+                monkeypatch.setenv("ZNICZ_TPU_CONV1", env)
+            else:
+                monkeypatch.delenv("ZNICZ_TPU_CONV1", raising=False)
+            wf = _workflow(layers=layers)
+            spec, params, vels = extract_model(wf)
+            cp = jax.tree_util.tree_map(np.array, (params, vels))
+            tr = FusedTrainer(spec=spec, params=cp[0], vels=cp[1])
+            ld = wf.loader
+            idx = np.arange(ld.total_samples - ld.class_lengths[2],
+                            ld.total_samples)
+            tr.train_epoch(ld.original_data.devmem,
+                           ld.original_labels.devmem, idx,
+                           ld.max_minibatch_size, epoch=0)
+            return [(np.asarray(w), np.asarray(b))
+                    for w, b in tr.params]
+
+        p_def = train(None)
+        p_s2d = train("s2d")
+        for (w1, b1), (w2, b2) in zip(p_def, p_s2d):
+            np.testing.assert_allclose(w2, w1, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(b2, b1, rtol=1e-4, atol=1e-5)
+
     def test_run_fused_bfloat16_converges(self):
         """compute_dtype='bfloat16': MXU operands in bf16, params and
         accumulation f32 — training must still converge (mixed-precision
@@ -292,14 +331,14 @@ class TestFusedWithPallasKernels:
         # XLA-tier reference epoch — force the XLA formulations even if
         # this ever runs on a TPU backend (where use_pallas() is already
         # true and both runs would otherwise compare Pallas to itself)
-        monkeypatch.setattr(tuning, "_DISABLE", True)
+        monkeypatch.setenv("ZNICZ_TPU_NO_PALLAS", "1")
         tr_ref = FusedTrainer(spec=spec, params=cp(params),
                               vels=cp(vels))
         tr_ref.train_epoch(ld.original_data.devmem,
                            ld.original_labels.devmem, idx,
                            ld.max_minibatch_size, epoch=0)
         # Pallas-tier (interpret) epoch over the same inputs
-        monkeypatch.setattr(tuning, "_DISABLE", False)
+        monkeypatch.delenv("ZNICZ_TPU_NO_PALLAS")
         monkeypatch.setattr(tuning, "_INTERPRET", True)
         assert tuning.use_pallas()
         tr = FusedTrainer(spec=spec, params=params, vels=vels)

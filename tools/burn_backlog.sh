@@ -29,6 +29,13 @@ run bench.py --kernels
 # phase-2 split-conv candidate at both batches (opt-in lever)
 ZNICZ_TPU_LRN_POOL=fused2 run bench.py
 ZNICZ_TPU_LRN_POOL=fused2 run bench.py --minibatch 256
+# conv1 space-to-depth candidate (round 4; also an --ablate row)
+ZNICZ_TPU_CONV1=s2d run bench.py
+ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
+# combination probe: NOTE under fused2 the pair-fed convs (conv1
+# included) take the parity-split path, which s2d does not reach —
+# this row isolates s2d's effect on the remaining plain convs only
+ZNICZ_TPU_LRN_POOL=fused2 ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
 # precision / storage variants
 run bench.py --dtype bfloat16
 run bench.py --storage bfloat16 --minibatch 256

@@ -140,6 +140,90 @@ def xla_conv2d_grad_weights(x, err, w_shape, stride=1, padding=0):
     return dw[:kh, :kw].astype(jnp.float32)
 
 
+# -- space-to-depth formulation for tiny-C strided convs (conv1) ----------
+# AlexNet's conv1 (11×11, stride 4, C=3) starves the MXU: 3 input
+# channels occupy 3 of 128 lanes in XLA's native lowering.  The
+# space-to-depth rewrite folds the stride into the channel axis —
+# x (H, W, C) → (⌈H/s⌉, ⌈W/s⌉, s²C), kernel (K, K, C) → (⌈K/s⌉, ⌈K/s⌉,
+# s²C) with structurally-zero taps — turning it into a stride-1 conv
+# with s²× the lane utilization (48 lanes for AlexNet).  The MLPerf-era
+# TPU trick, here as a pure-XLA rewrite (reshapes fuse).  Same math,
+# different contraction order → allclose, not bit-equal: opt-in via
+# ZNICZ_TPU_CONV1=s2d until the on-chip A/B (--ablate row conv1_s2d)
+# justifies a default flip.
+
+def _s2d_input(x, s: int, rows: int, cols: int):
+    """(B, H, W, C) → (B, rows, cols, s²C) phase stack, zero-padded (or
+    trimmed: trailing rows no window reaches) so every phase has
+    ``rows``×``cols`` positions."""
+    b, h, w, c = x.shape
+    hp, wp = rows * s, cols * s
+    if hp < h or wp < w:
+        x = x[:, :min(h, hp), :min(w, wp)]
+    if (hp, wp) != x.shape[1:3]:
+        x = jnp.pad(x, ((0, 0), (0, hp - x.shape[1]),
+                        (0, wp - x.shape[2]), (0, 0)))
+    x = x.reshape(b, rows, s, cols, s, c).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, rows, cols, s * s * c)
+
+
+def _s2d_kernel(w, s: int):
+    """(KH, KW, C, F) → (⌈KH/s⌉, ⌈KW/s⌉, s²C, F); taps past the true
+    support are structurally zero."""
+    kh, kw, c, f = w.shape
+    khp, kwp = -(-kh // s), -(-kw // s)
+    wz = jnp.zeros((khp * s, kwp * s, c, f), w.dtype)
+    wz = wz.at[:kh, :kw].set(w)
+    wz = wz.reshape(khp, s, kwp, s, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return wz.reshape(khp, kwp, s * s * c, f)
+
+
+def s2d_applicable(w_shape, stride, padding) -> bool:
+    """Worthwhile only where XLA's lowering starves the lanes: tiny C,
+    a real stride, equal in both dims (the phase algebra assumes it)."""
+    kh, kw, c, f = w_shape
+    (sh, sw), _ = _norm2(stride), _norm2(padding)
+    return sh == sw and sh >= 2 and c <= 8
+
+
+def _s2d_stack(x, w_shape, stride, padding):
+    """Shared preamble of the s2d forward/weight-grad: apply padding,
+    derive the phase geometry, build the input phase stack."""
+    kh, kw, c, f = w_shape
+    (sh, sw), (ph, pw) = _norm2(stride), _norm2(padding)
+    assert sh == sw and sh >= 2, (stride,)
+    s = sh
+    if (ph, pw) != (0, 0):
+        x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    _, h, w_in, _ = x.shape
+    oh, ow = out_size(h, kh, s, 0), out_size(w_in, kw, s, 0)
+    khp, kwp = -(-kh // s), -(-kw // s)
+    xs = _s2d_input(x, s, oh + khp - 1, ow + kwp - 1)
+    return xs, s, khp, kwp
+
+
+def xla_conv2d_s2d(x, w, stride=1, padding=0, out_dtype=None):
+    """xla_conv2d, computed via space-to-depth (see section comment)."""
+    xs, s, _, _ = _s2d_stack(x, w.shape, stride, padding)
+    y = lax.conv_general_dilated(
+        xs, _s2d_kernel(w, s), window_strides=(1, 1),
+        padding=((0, 0), (0, 0)), dimension_numbers=_DIMNUMS,
+        preferred_element_type=jnp.float32)
+    return y.astype(out_dtype or x.dtype)
+
+
+def xla_conv2d_grad_weights_s2d(x, err, w_shape, stride=1, padding=0):
+    """Weight grad through the same phase algebra: grad of the s²C
+    kernel, rearranged back to (KH, KW, C, F) — taps beyond the true
+    support are structural zeros whose grads are simply dropped."""
+    kh, kw, c, f = w_shape
+    xs, s, khp, kwp = _s2d_stack(x, w_shape, stride, padding)
+    dwp = xla_conv2d_grad_weights(xs, err, (khp, kwp, s * s * c, f),
+                                  1, 0)
+    dwp = dwp.reshape(khp, kwp, s, s, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return dwp.reshape(khp * s, kwp * s, c, f)[:kh, :kw]
+
+
 # -- column-parity variants (phase-2 of the fused LRN+pool pair) ----------
 # A conv whose output feeds a merged LRN+max-pool pair can emit the
 # pair's column-parity halves DIRECTLY: the even/odd output columns of a
@@ -297,9 +381,13 @@ def pallas_conv2d_grad_weights(x, err, w_shape, stride=1, padding=0):
 def conv2d(x, w, stride=1, padding=0, out_dtype=None):
     """Dispatcher: XLA conv is the default production path on TPU (the
     compiler's conv→MXU lowering beats implicit GEMM for most shapes);
-    set ZNICZ_TPU_CONV=pallas to force the Pallas GEMM tier."""
+    set ZNICZ_TPU_CONV=pallas to force the Pallas GEMM tier, or
+    ZNICZ_TPU_CONV1=s2d to route tiny-C strided convs (conv1) through
+    the space-to-depth formulation."""
     if tuning.force_pallas_conv():
         return pallas_conv2d(x, w, stride, padding, out_dtype)
+    if tuning.conv_s2d() and s2d_applicable(w.shape, stride, padding):
+        return xla_conv2d_s2d(x, w, stride, padding, out_dtype)
     return xla_conv2d(x, w, stride, padding, out_dtype)
 
 
@@ -313,4 +401,7 @@ def conv2d_grad_weights(x, err, w_shape, stride=1, padding=0):
     if tuning.force_pallas_conv():
         return pallas_conv2d_grad_weights(x, err, w_shape, stride,
                                           padding)
+    if tuning.conv_s2d() and s2d_applicable(w_shape, stride, padding):
+        return xla_conv2d_grad_weights_s2d(x, err, w_shape, stride,
+                                           padding)
     return xla_conv2d_grad_weights(x, err, w_shape, stride, padding)

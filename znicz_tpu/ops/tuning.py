@@ -66,6 +66,16 @@ def lrn_pool_act_fold() -> bool:
     return os.environ.get("ZNICZ_TPU_LRN_POOL", "fused") != "nofold"
 
 
+def conv_s2d() -> bool:
+    """ZNICZ_TPU_CONV1=s2d routes tiny-C strided convs (AlexNet's
+    conv1) through the space-to-depth formulation (ops/conv.py
+    xla_conv2d_s2d): the stride folds into the channel axis, lifting
+    MXU lane utilization s²× on a layer whose C=3 occupies 3/128 lanes
+    natively.  Opt-in (allclose, not bit-equal, to the plain conv);
+    the --ablate row ``conv1_s2d`` measures it on-chip."""
+    return os.environ.get("ZNICZ_TPU_CONV1") == "s2d"
+
+
 def force_pallas_conv() -> bool:
     """Whether ZNICZ_TPU_CONV=pallas routes the conv/deconv family to
     the implicit-GEMM Pallas tier (default: XLA's native conv lowering,
