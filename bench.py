@@ -532,6 +532,13 @@ def _bring_up(args, result, reduce_on_cpu: bool = True):
     returns the platform string, or None when even the fallback failed
     (caller emits and exits) — the single copy of the resilience
     contract every bench mode relies on (VERDICT r1 item 1)."""
+    # record active lever env vars so A/B transcript lines are
+    # self-describing (the burn's fused2/s2d rows share metric names)
+    levers = {k: v for k, v in sorted(os.environ.items())
+              if k.startswith("ZNICZ_TPU_")}
+    if levers:
+        result["levers"] = levers
+    result["minibatch"] = args.minibatch
     try:
         platform, kind = _await_backend(args.backend_wait)
         result["device"] = kind
@@ -814,6 +821,13 @@ def bench_ablate(args) -> int:
         return _emit(result)
     _preflight_lrn_pool(result)
     _preflight_mxu_kernels(result)
+    # the table owns the routing levers END TO END: an ambient
+    # ZNICZ_TPU_LRN_POOL=fused2 or CONV1=s2d would otherwise leak into
+    # base_spec extraction and the baseline rows, flattening every A/B
+    # delta.  (ZNICZ_TPU_NO_PALLAS stays untouched — the preflight may
+    # have just set it as a safety fallback.)
+    saved_env = {v: os.environ.pop(v, None)
+                 for v in ("ZNICZ_TPU_LRN_POOL", "ZNICZ_TPU_CONV1")}
     try:
         from znicz_tpu.parallel import fused, FusedTrainer
 
@@ -904,34 +918,28 @@ def bench_ablate(args) -> int:
              ("ZNICZ_TPU_CONV1", "s2d")),
         ]
         rows = {}
-        # the env-routed rows must own their variable for the WHOLE
-        # table: an ambient ZNICZ_TPU_CONV1=s2d would otherwise make
-        # every baseline row trace with the lever on (A/B delta ~0)
-        row_vars = {env[0] for *_, env in variants if env is not None}
-        saved_env = {v: os.environ.pop(v, None) for v in row_vars}
-        try:
-            for name, keep, spec, ps, vs, env in variants:
+        for name, keep, spec, ps, vs, env in variants:
+            if env is not None:
+                os.environ[env[0]] = env[1]
+            try:
+                rows[name] = round(time_spec(spec, keep, ps, vs), 2)
+            except Exception as e:   # a variant may be unbuildable
+                rows[name] = f"error: {e}"[:120]
+            finally:
                 if env is not None:
-                    os.environ[env[0]] = env[1]
-                try:
-                    rows[name] = round(time_spec(spec, keep, ps, vs), 2)
-                except Exception as e:   # a variant may be unbuildable
-                    rows[name] = f"error: {e}"[:120]
-                finally:
-                    if env is not None:
-                        os.environ.pop(env[0], None)
-                print(f"  {name:14s} {rows[name]} ms/step",
-                      file=sys.stderr)
-        finally:
-            for var, val in saved_env.items():
-                if val is not None:
-                    os.environ[var] = val
+                    os.environ.pop(env[0], None)
+            print(f"  {name:14s} {rows[name]} ms/step",
+                  file=sys.stderr)
         result["value"] = rows.get("full")
         result["rows"] = rows
     except Exception as e:
         result.setdefault("error", "")
         result["error"] = (result["error"]
                            + f" ablate failed: {e!r}").strip()[:600]
+    finally:
+        for var, val in saved_env.items():
+            if val is not None:
+                os.environ[var] = val
     return _emit(result)
 
 

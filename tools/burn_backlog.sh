@@ -44,4 +44,13 @@ run bench.py --stream
 run bench.py --augment
 run bench.py --loader
 run bench.py --loader --augment
-echo "backlog complete → $OUT" >&2
+# fresh driver-side corroboration outside BASELINE.md (VERDICT r3
+# item 10): kernel table + headline lines, timestamped
+{
+  date -u +"# burn %Y-%m-%dT%H:%M:%SZ"
+  grep -h "pallas_kernel_validation\|images_per_sec" "$OUT"
+} >> kern_r4.log || true
+# lever verdicts from the transcript (VERDICT r3 item 3): fused2 and
+# conv1_s2d defaults get decided by measurement, same session
+python tools/decide_levers.py "$OUT" | tee "$OUT.decisions"
+echo "backlog complete → $OUT (+ .decisions, kern_r4.log)" >&2

@@ -1,0 +1,111 @@
+"""Read a burn-backlog transcript (JSONL) and print the lever verdicts.
+
+VERDICT r3 item 3 requires the round to DECIDE the opt-in levers from
+the measured A/B, not leave them as unmeasured debt.  This tool turns
+``tools/burn_backlog.sh``'s transcript into explicit recommendations:
+
+* ``ZNICZ_TPU_LRN_POOL=fused2`` — flip the default if the fused2
+  headline beats the default merge at BOTH measured batches by more
+  than the chip's observed run-to-run wobble (±15%: require >3% mean
+  win with no loss at either batch).
+* ``ZNICZ_TPU_CONV1=s2d`` — same rule.
+
+Prints one JSON line: {"decisions": {...}, "evidence": {...}} and a
+human table on stderr.  The flip itself stays a one-line change
+(ops/tuning.py default) so the decision and its evidence land in the
+same commit.
+
+Usage: python tools/decide_levers.py backlog_*.jsonl
+"""
+import json
+import sys
+
+
+def load(paths):
+    rows = []
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    print(f"skipping unparseable line in {p}: "
+                          f"{line[:80]}", file=sys.stderr)
+    return rows
+
+
+#: the levers the decision compares; other ZNICZ_TPU_* vars (VMEM
+#: budget, IO workers, interpret mode...) are tuning context, not
+#: routing choices — an ambient one must not break tag matching
+_ROUTING = ("ZNICZ_TPU_LRN_POOL", "ZNICZ_TPU_CONV1", "ZNICZ_TPU_CONV",
+            "ZNICZ_TPU_NO_PALLAS", "ZNICZ_TPU_MXU")
+
+
+def headline(rows):
+    """{(lever_tag, minibatch): images/sec} for AlexNet training rows
+    on a real (non-cpu-fallback) device."""
+    out = {}
+    for r in rows:
+        if r.get("metric") != "alexnet_train_images_per_sec_per_chip" \
+                or r.get("value") is None:
+            continue
+        if "cpu" in str(r.get("device", "")).lower():
+            continue                      # fallback rows decide nothing
+        lv = r.get("levers", {})
+        tag = ",".join(f"{k.replace('ZNICZ_TPU_', '')}={v}"
+                       for k, v in lv.items()
+                       if k in _ROUTING) or "default"
+        out[(tag, r.get("minibatch"))] = r["value"]
+    return out
+
+
+def decide(hl, lever_tag):
+    """(decision, evidence) comparing `lever_tag` rows to default."""
+    pairs = []
+    for (tag, mb), v in hl.items():
+        if tag == lever_tag and ("default", mb) in hl:
+            pairs.append((mb, hl[("default", mb)], v))
+    if not pairs:
+        return "no-data", {"pairs": []}
+    gains = [(v - base) / base for _, base, v in pairs]
+    win = (min(gains) > 0 and sum(gains) / len(gains) > 0.03)
+    ev = {"pairs": [{"minibatch": mb, "default": base, "lever": v,
+                     "gain_pct": round(100 * (v - base) / base, 1)}
+                    for mb, base, v in pairs]}
+    # "both measured batches": one surviving pair (the other bench run
+    # timed out) is not enough evidence to flip a default
+    if len(pairs) < 2:
+        return ("insufficient-data (re-run the missing batch)"
+                if win else "keep-off"), ev
+    return ("flip-default" if win else "keep-off"), ev
+
+
+def main(argv):
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    rows = load(argv)
+    hl = headline(rows)
+    if not hl:
+        print(json.dumps({"decisions": {},
+                          "error": "no on-device headline rows in "
+                                   "transcript"}))
+        return 1
+    decisions, evidence = {}, {}
+    for lever, tag in (("ZNICZ_TPU_LRN_POOL=fused2",
+                        "LRN_POOL=fused2"),
+                       ("ZNICZ_TPU_CONV1=s2d", "CONV1=s2d")):
+        decisions[lever], evidence[lever] = decide(hl, tag)
+    for (tag, mb), v in sorted(hl.items()):
+        print(f"  {tag:24s} b{mb}: {v} img/s", file=sys.stderr)
+    for lever, d in decisions.items():
+        print(f"  {lever}: {d}", file=sys.stderr)
+    print(json.dumps({"decisions": decisions, "evidence": evidence}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
