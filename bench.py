@@ -526,19 +526,27 @@ def _append_note(result, note: str) -> None:
                       if "note" in result else note)
 
 
+def _record_run_config(args, result) -> None:
+    """Stamp the transcript row with what ACTUALLY ran: the active
+    routing levers and the (possibly CPU-reduced) minibatch.  Callers
+    invoke this after backend bring-up / env fixups, not before — a
+    row claiming levers the run stripped, or the pre-reduction batch
+    size, would mislead decide_levers.py's readers."""
+    levers = {k: v for k, v in sorted(os.environ.items())
+              if k.startswith("ZNICZ_TPU_")}
+    if levers:
+        result["levers"] = levers
+    else:
+        result.pop("levers", None)
+    result["minibatch"] = args.minibatch
+
+
 def _bring_up(args, result, reduce_on_cpu: bool = True):
     """Shared backend bring-up: await the TPU, else labeled CPU
     fallback.  Mutates ``result`` (device/note/error fields) and
     returns the platform string, or None when even the fallback failed
     (caller emits and exits) — the single copy of the resilience
     contract every bench mode relies on (VERDICT r1 item 1)."""
-    # record active lever env vars so A/B transcript lines are
-    # self-describing (the burn's fused2/s2d rows share metric names)
-    levers = {k: v for k, v in sorted(os.environ.items())
-              if k.startswith("ZNICZ_TPU_")}
-    if levers:
-        result["levers"] = levers
-    result["minibatch"] = args.minibatch
     try:
         platform, kind = _await_backend(args.backend_wait)
         result["device"] = kind
@@ -577,6 +585,7 @@ def bench_training(args) -> int:
         return _emit(result)
     _preflight_lrn_pool(result)
     _preflight_mxu_kernels(result)
+    _record_run_config(args, result)
     try:
         from znicz_tpu.ops import flops as flops_mod
 
@@ -828,6 +837,7 @@ def bench_ablate(args) -> int:
     # have just set it as a safety fallback.)
     saved_env = {v: os.environ.pop(v, None)
                  for v in ("ZNICZ_TPU_LRN_POOL", "ZNICZ_TPU_CONV1")}
+    _record_run_config(args, result)
     try:
         from znicz_tpu.parallel import fused, FusedTrainer
 
@@ -965,6 +975,7 @@ def bench_kernels(args) -> int:
     platform = _bring_up(args, result, reduce_on_cpu=False)
     if platform is None:
         return _emit(result)
+    _record_run_config(args, result)
     from znicz_tpu.ops import tuning
     if not tuning.use_pallas():
         result["error"] = (f"platform {platform!r}: Pallas disabled and "

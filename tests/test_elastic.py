@@ -1,0 +1,98 @@
+"""ElasticRunner end-to-end (SURVEY.md §5 failure row — the reference's
+slave rejoin, redesigned as supervised coordinated restart): a 2-process
+fleet loses a worker mid-training, the supervisor restarts the fleet on
+a fresh coordinator, workers resume from the newest checkpoint, and the
+final weights match an uninterrupted single-process run of the same
+math."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from znicz_tpu.parallel.elastic import ElasticRunner, free_port
+
+
+def _env():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return dict(os.environ,
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                PYTHONPATH=repo + os.pathsep
+                + os.environ.get("PYTHONPATH", ""))
+
+
+def _make_argv(out, marker, epochs=2):
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_elastic_worker.py")
+
+    def make(coord, pid, nproc):
+        argv = [sys.executable, worker, "--coordinator", coord,
+                "--process-id", pid, "--num-processes", nproc,
+                "--out", out, "--epochs", epochs]
+        if marker:
+            argv += ["--crash-marker", marker]
+        return argv
+    return make
+
+
+def _reference(epochs=2):
+    """Uninterrupted single-process run of the identical math."""
+    from znicz_tpu.parallel import FusedTrainer
+    from znicz_tpu.parallel.fused import LayerSpec, ModelSpec
+    n, feats, classes = 64, 32, 5
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((n, feats)).astype(np.float32)
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    w0 = (rng.standard_normal((feats, classes)) * 0.1
+          ).astype(np.float32)
+    spec = ModelSpec((LayerSpec(
+        kind="fc", activation="linear", include_bias=True,
+        hypers=(0.05, 0.0, 0.0, 0.9),
+        hypers_bias=(0.05, 0.0, 0.0, 0.9)),), "softmax")
+    params = [(w0, np.zeros(classes, np.float32))]
+    vels = [(np.zeros_like(w0), np.zeros(classes, np.float32))]
+    tr = FusedTrainer(spec=spec, params=params, vels=vels)
+    for epoch in range(epochs):
+        tr.train_epoch(data, labels, np.arange(n), 16, epoch=epoch)
+    return np.asarray(tr.params[0][0])
+
+
+class TestElasticRunner:
+    def test_worker_loss_restart_resumes_and_matches(self, tmp_path):
+        out = str(tmp_path / "final.npy")
+        marker = str(tmp_path / "crashed.marker")
+        runner = ElasticRunner(_make_argv(out, marker), 2,
+                               max_restarts=2, round_timeout=240,
+                               env=_env())
+        restarts = runner.run()
+        assert restarts == 1               # exactly one fleet restart
+        assert os.path.exists(marker)      # the crash really happened
+        w = np.load(out)
+        np.testing.assert_allclose(w, _reference(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_clean_run_no_restarts(self, tmp_path):
+        out = str(tmp_path / "clean.npy")
+        runner = ElasticRunner(_make_argv(out, None), 2,
+                               max_restarts=0, round_timeout=240,
+                               env=_env())
+        assert runner.run() == 0
+        np.testing.assert_allclose(np.load(out), _reference(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gives_up_after_max_restarts(self, tmp_path):
+        def always_crash(coord, pid, nproc):
+            return [sys.executable, "-c", "import sys; sys.exit(3)"]
+        runner = ElasticRunner(always_crash, 2, max_restarts=1,
+                               env=_env(), poll_interval=0.05)
+        with pytest.raises(RuntimeError, match="max_restarts"):
+            runner.run()
+        assert runner.restarts == 2
+
+    def test_free_port_is_bindable(self):
+        import socket
+        port = free_port()
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", port))

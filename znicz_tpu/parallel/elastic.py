@@ -1,0 +1,196 @@
+"""Elastic multi-process training via supervised coordinated restart.
+
+Parity target: the reference's master/slave elasticity (SURVEY.md §5
+failure row) — slaves could drop off and REJOIN mid-training, receiving
+the current weights over the wire from the Twisted master.
+
+TPU-native redesign: under SPMD there is no wire protocol to rejoin
+through — `jax.distributed` fixes the process set at initialization,
+and that is the right trade (collectives ride ICI with zero
+coordination overhead in the hot loop).  Elasticity therefore lives
+ABOVE the job: this supervisor launches the fleet, watches it, and on
+any member's death restarts ALL processes on a fresh coordinator port;
+workers resume from the newest checkpoint (`CheckpointRecovery` /
+`Snapshotter`, both crash-safe and resume-bit-exact — see
+tests/test_failure_recovery.py).  A replacement worker "receives
+current weights" by loading the checkpoint — the same contract the
+reference implemented over the wire, at checkpoint rather than packet
+granularity.
+
+This is the module the operator actually runs on a multi-host pod
+(`python -m znicz_tpu.parallel.elastic -- worker.py args...`); the
+2-process kill/restart scenario is exercised end-to-end in
+tests/test_elastic.py.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+from ..logger import Logger
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ElasticRunner(Logger):
+    """Launch ``num_processes`` workers; coordinated-restart on death.
+
+    ``make_argv(coordinator, process_id, num_processes)`` returns the
+    argv for one worker.  Workers are expected to (a) bootstrap through
+    ``parallel.distributed.initialize`` with those coordinates, (b)
+    checkpoint at their own granularity, (c) resume from the newest
+    checkpoint when one exists, and (d) exit 0 when training completes.
+
+    The supervisor restarts the WHOLE fleet when any member exits
+    nonzero, or when a round exceeds ``round_timeout`` (the stall
+    guard — OFF unless set: a hung collective can only be detected by
+    a deadline the caller chooses) — partial fleets cannot make
+    progress under SPMD, and a full restart from the last checkpoint
+    is the coordination-free equivalent of the reference's per-slave
+    rejoin.
+
+    Worker stdout/stderr stream to per-worker files under ``log_dir``
+    (a pipe would deadlock a chatty worker once the OS buffer fills —
+    real runs emit plenty of JAX/XLA output)."""
+
+    def __init__(self, make_argv, num_processes: int,
+                 max_restarts: int = 5, round_timeout: float | None = None,
+                 env: dict | None = None, poll_interval: float = 0.2,
+                 log_dir: str | None = None):
+        super().__init__()
+        self.make_argv = make_argv
+        self.num_processes = int(num_processes)
+        self.max_restarts = int(max_restarts)
+        self.round_timeout = round_timeout
+        self.env = env
+        self.poll_interval = poll_interval
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="elastic_")
+        #: restarts actually performed (observable for tests/metrics)
+        self.restarts = 0
+
+    # -- one fleet round ---------------------------------------------------
+    def _log_path(self, pid: int) -> str:
+        return os.path.join(self.log_dir,
+                            f"worker{pid}.round{self.restarts}.log")
+
+    def _launch(self) -> list[subprocess.Popen]:
+        coord = f"127.0.0.1:{free_port()}"
+        os.makedirs(self.log_dir, exist_ok=True)
+        procs = []
+        for pid in range(self.num_processes):
+            argv = self.make_argv(coord, pid, self.num_processes)
+            with open(self._log_path(pid), "w") as log:
+                procs.append(subprocess.Popen(
+                    [str(a) for a in argv], env=self.env,
+                    stdout=log, stderr=subprocess.STDOUT))
+        self.info("fleet up: %d workers on %s (logs: %s)", len(procs),
+                  coord, self.log_dir)
+        return procs
+
+    @staticmethod
+    def _reap(procs) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def _log_tail(self, pid: int, nbytes: int = 400) -> str:
+        try:
+            with open(self._log_path(pid), "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - nbytes))
+                return f.read().decode("utf-8", "replace").strip()
+        except OSError:
+            return "<no log>"
+
+    def _watch(self, procs) -> bool:
+        """True = every worker exited 0 (training complete); False =
+        somebody died or timed out (caller restarts the fleet)."""
+        deadline = (time.monotonic() + self.round_timeout
+                    if self.round_timeout else None)
+        while True:
+            codes = [p.poll() for p in procs]
+            if all(c == 0 for c in codes):
+                return True
+            dead = [(i, c) for i, c in enumerate(codes)
+                    if c not in (None, 0)]
+            if dead:
+                i, c = dead[0]
+                self.warning("worker %d died rc=%s: %s", i, c,
+                             self._log_tail(i)[-300:])
+                self._reap(procs)
+                return False
+            if deadline is not None and time.monotonic() > deadline:
+                self.warning("fleet round timed out after %.0fs",
+                             self.round_timeout)
+                self._reap(procs)
+                return False
+            time.sleep(self.poll_interval)
+
+    # -- public ------------------------------------------------------------
+    def run(self) -> int:
+        """Supervise until completion.  Returns the restart count;
+        raises RuntimeError when ``max_restarts`` is exhausted."""
+        while True:
+            procs = self._launch()
+            try:
+                if self._watch(procs):
+                    self.info("training complete after %d restart(s)",
+                              self.restarts)
+                    return self.restarts
+            finally:
+                self._reap(procs)
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                raise RuntimeError(
+                    f"fleet failed {self.restarts} times; giving up "
+                    f"(max_restarts={self.max_restarts})")
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m znicz_tpu.parallel.elastic -n N [--max-restarts R]
+    -- worker.py ARGS...`` — the worker receives
+    ``--coordinator HOST:PORT --process-id I --num-processes N``
+    appended to its argv."""
+    import argparse
+    p = argparse.ArgumentParser(
+        description="supervised coordinated-restart training fleet")
+    p.add_argument("-n", "--num-processes", type=int, required=True)
+    p.add_argument("--max-restarts", type=int, default=5)
+    p.add_argument("--round-timeout", type=float, default=None)
+    p.add_argument("worker", nargs=argparse.REMAINDER,
+                   help="-- worker.py args...")
+    args = p.parse_args(argv)
+    worker = list(args.worker)
+    if worker and worker[0] == "--":     # only the SEPARATOR; a later
+        worker.pop(0)                    # literal -- belongs to the
+    if not worker:                       # worker's own argv
+        p.error("worker command required after --")
+
+    def make_argv(coord, pid, nproc):
+        return [sys.executable, *worker,
+                "--coordinator", coord, "--process-id", str(pid),
+                "--num-processes", str(nproc)]
+
+    runner = ElasticRunner(make_argv, args.num_processes,
+                           max_restarts=args.max_restarts,
+                           round_timeout=args.round_timeout)
+    runner.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
