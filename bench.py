@@ -169,8 +169,17 @@ def _await_backend(total_wait: float):
     can't be interrupted, so each probe runs in a subprocess that can be
     killed on timeout; this process only touches JAX once a probe has
     confirmed the backend is healthy (by then the tunnel is warm and the
-    in-process init is fast)."""
+    in-process init is fast).
+
+    Round-4 refinement: the axon plugin reaches the chip through a
+    local gRPC relay; when the relay is down its port REFUSES in
+    milliseconds while PJRT retries forever.  A TCP pre-check
+    (znicz_tpu.tpu_liveness — no-op when no relay is configured) turns
+    a dead-tunnel wait from N×180 s hangs into a 10 s poll loop — and
+    catches a mid-wait tunnel restoration almost immediately."""
     import subprocess
+
+    from znicz_tpu.tpu_liveness import relay_endpoint, relay_ok
 
     deadline = time.monotonic() + total_wait
     delay, last = 5.0, "no probe ran"
@@ -179,6 +188,12 @@ def _await_backend(total_wait: float):
         if left <= 0:
             raise RuntimeError(f"backend not up after {total_wait:.0f}s: "
                                f"{last}")
+        if not relay_ok():
+            last = ("relay port %s:%d refused (tunnel down)"
+                    % relay_endpoint())
+            time.sleep(min(10.0, max(0.0,
+                                     deadline - time.monotonic())))
+            continue
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", _PROBE], capture_output=True,

@@ -17,10 +17,13 @@ current weights" by loading the checkpoint — the same contract the
 reference implemented over the wire, at checkpoint rather than packet
 granularity.
 
-This is the module the operator actually runs on a multi-host pod
-(`python -m znicz_tpu.parallel.elastic -- worker.py args...`); the
-2-process kill/restart scenario is exercised end-to-end in
-tests/test_elastic.py.
+Scope: SINGLE-HOST multi-process supervision (the supervisor Popens
+every worker locally against a loopback coordinator).  On a multi-host
+pod, run the fleet under the pod scheduler's restart policy and give
+workers the same resume-from-newest-checkpoint contract — the
+restart-all-from-checkpoint recovery itself is host-count-agnostic
+(see docs/distributed.md).  The 2-process kill/restart scenario is
+exercised end-to-end in tests/test_elastic.py.
 """
 
 from __future__ import annotations

@@ -1,0 +1,40 @@
+"""TPU tunnel liveness: the one copy of the relay pre-check logic.
+
+The axon PJRT plugin reaches the chip through a local gRPC relay
+(`PALLAS_AXON_POOL_IPS`, `jax.devices()` traffic on :8083).  When the
+relay is down the port REFUSES in milliseconds while PJRT's channel
+retries forever — so a TCP connect is the cheap liveness signal, and
+both `bench.py`'s backend wait and `tools/tpu_probe.py` gate their
+heavyweight subprocess probes on it.  The pre-check only applies when
+the relay env var is explicitly present: on a host with a
+directly-attached TPU (no relay), gating on a port nobody listens on
+would block probing forever.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+
+def relay_endpoint() -> tuple[str, int] | None:
+    """(ip, port) of the relay, or None when no relay is configured
+    (direct-attached TPU — skip the pre-check entirely)."""
+    ips = os.environ.get("PALLAS_AXON_POOL_IPS")
+    if not ips:
+        return None
+    return (ips.split(",")[0],
+            int(os.environ.get("TPU_PROBE_RELAY_PORT", 8083)))
+
+
+def relay_ok(timeout: float = 2.0) -> bool:
+    """True when probing is worth attempting: either no relay is
+    configured (direct TPU), or the relay port accepts."""
+    ep = relay_endpoint()
+    if ep is None:
+        return True
+    try:
+        with socket.create_connection(ep, timeout):
+            return True
+    except OSError:
+        return False
