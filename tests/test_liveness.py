@@ -1,0 +1,39 @@
+"""znicz_tpu.tpu_liveness: the relay pre-check must be a no-op without
+relay config, refuse-fast on a dead port, and accept a listening one."""
+
+import socket
+import threading
+
+from znicz_tpu.tpu_liveness import relay_endpoint, relay_ok
+
+
+def test_no_relay_configured_means_probe(monkeypatch):
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    assert relay_endpoint() is None
+    assert relay_ok() is True          # direct-attached TPU: go probe
+
+
+def test_dead_relay_refuses(monkeypatch):
+    # bound-but-NOT-listening socket held open: connects are refused
+    # on Linux, and nobody else can grab the port meanwhile
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+        monkeypatch.setenv("TPU_PROBE_RELAY_PORT", str(port))
+        assert relay_endpoint() == ("127.0.0.1", port)
+        assert relay_ok(timeout=0.5) is False
+
+
+def test_live_relay_accepts(monkeypatch):
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    t = threading.Thread(target=lambda: (srv.accept(), srv.close()),
+                         daemon=True)
+    t.start()
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1,10.0.0.2")
+    monkeypatch.setenv("TPU_PROBE_RELAY_PORT", str(port))
+    assert relay_endpoint() == ("127.0.0.1", port)   # first IP wins
+    assert relay_ok() is True

@@ -69,20 +69,22 @@ def write_status(up, attempt, detail, info=None):
 
 def main():
     deadline = time.time() + float(os.environ.get("TPU_PROBE_DEADLINE_S", 11 * 3600))
-    attempt = 0
+    attempt = 0                 # REAL PJRT probes only — the cheap
+    port_checks = 0             # port checks count separately
     backoff = 60.0
     last_port_note = 0.0
     while time.time() < deadline:
         if not relay_ok():
-            attempt += 1
+            port_checks += 1
             # cheap loop: note the closed port at most once a minute,
             # recheck every 20 s — a restoration is caught in seconds
             # (relay_ok() is True when no relay is configured, so a
             # direct-attached TPU skips straight to the PJRT probe)
             if time.time() - last_port_note > 60:
                 write_status(False, attempt,
-                             "relay port %s:%d refused (tunnel down)"
-                             % relay_endpoint())
+                             "relay port %s:%d refused (tunnel down; "
+                             "%d port checks)"
+                             % (*relay_endpoint(), port_checks))
                 last_port_note = time.time()
             time.sleep(20)
             continue
