@@ -125,8 +125,18 @@ def interleave_cols(xe, xo, w: int):
     return jnp.stack([xe, xo], axis=3).reshape(b, h, 2 * we, c)[:, :, :w]
 
 
-def _batch_block(b: int, bytes_per_b: int, budget: int = 6 << 20) -> int:
-    """Largest divisor of B whose working set fits the VMEM budget."""
+def _batch_block(b: int, bytes_per_b: int, budget: int = 3 << 20) -> int:
+    """Largest divisor of B whose working set fits the VMEM budget.
+
+    ``bytes_per_b`` models the block's HBM-facing buffers only; Mosaic's
+    scoped-VMEM footprint is larger — every in/out block is
+    double-buffered for the grid pipeline and the kernel body's
+    temporaries (LRN window sums, tap-select where-chains) live on the
+    VMEM stack.  Measured on a v5e: the AlexNet pair-1 geometry
+    (b=128, 55×55×96, kh=kw=3) at a 32-batch block needs 16.54 MB
+    scoped VMEM — past the 16 MB/core limit.  A 3 MB budget halves the
+    block (bb=16 ⇒ ~8.3 MB) and leaves ~2× headroom at every shipped
+    geometry."""
     cap = max(1, budget // max(1, bytes_per_b))
     best = 1
     for d in range(1, b + 1):
