@@ -634,6 +634,15 @@ def bench_training(args) -> int:
                     # trying when a merged pair was actually in play.
                     if attempt:
                         raise
+                    # only a compile-class failure implicates the merged
+                    # kernels; a transient runtime/tunnel error must not
+                    # get misattributed to them (and must not publish a
+                    # silently-downgraded split number)
+                    sig = str(e)
+                    if not any(m in sig for m in (
+                            "vmem", "Mosaic", "mosaic", "remote_compile",
+                            "RESOURCE_EXHAUSTED", "tpu_compile_helper")):
+                        raise
                     from znicz_tpu.ops import tuning as _tuning
                     from znicz_tpu.parallel import fused as _fused
                     try:

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Part-2 backlog: the rows the 2026-07-31 tunnel drop cut out of
+# tools/burn_backlog.sh (the headline b128/b256/b512 sweep and the
+# b128 --ablate landed before the relay died; everything below did
+# not).  Append to the SAME transcript family so decide_levers.py can
+# average across files: python tools/decide_levers.py backlog_r4*.jsonl
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-backlog_r4b.jsonl}"
+run() {
+  echo "### $*" >&2
+  if ! timeout 3000 python "$@" 2> >(tail -5 >&2) \
+      | tail -1 | tee -a "$OUT"; then
+    echo "{\"error\": \"bench failed/timed out\", \"cmd\": \"$*\"}" \
+      | tee -a "$OUT"
+  fi
+}
+
+# the lever A/B rows decide_levers needs (both batches, each lever)
+ZNICZ_TPU_LRN_POOL=fused2 run bench.py
+ZNICZ_TPU_LRN_POOL=fused2 run bench.py --minibatch 256
+ZNICZ_TPU_CONV1=s2d run bench.py
+ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
+ZNICZ_TPU_LRN_POOL=fused2 ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
+# the lost ablation at b256
+run bench.py --ablate --minibatch 256
+# kernel table (24 rows incl. retiled convs + fused pair)
+run bench.py --kernels
+# precision / storage variants
+run bench.py --dtype bfloat16
+run bench.py --storage bfloat16 --minibatch 256
+# data-plane: stream + on-device augment + loader-only
+run bench.py --stream
+run bench.py --augment
+run bench.py --loader
+run bench.py --loader --augment
+# driver-side corroboration + lever verdicts over BOTH transcripts
+{
+  date -u +"# burn2 %Y-%m-%dT%H:%M:%SZ"
+  grep -h "pallas_kernel_validation\|images_per_sec" "$OUT"
+} >> kern_r4.log || true
+python tools/decide_levers.py backlog_r4.jsonl "$OUT" | tee "$OUT.decisions"
+echo "backlog part 2 complete → $OUT (+ .decisions, kern_r4.log)" >&2
