@@ -442,3 +442,32 @@ class TestTrainEquivalence:
         assert len(flat_m) == len(flat_s)
         for a, b in zip(flat_m, flat_s):
             np.testing.assert_array_equal(a, b)
+
+
+class TestBatchBlockVmem:
+    """Scoped-VMEM regression (round-4 chip session 1): the merged pair
+    kernel OOM'd Mosaic's 16 MB/core limit at the real AlexNet pair-1
+    geometry because a 32-batch block's true footprint (double-buffered
+    blocks + kernel-stack temporaries) is ~2x the block-buffer model.
+    Pin the block choice at both shipped geometries so a budget bump
+    can't silently reintroduce the blowup."""
+
+    def test_fwd_blocks_fit_measured_vmem(self):
+        from znicz_tpu.ops.lrn_pool import _batch_block
+
+        # pair 1: b=128, 55x55x96, kh=kw=3 -> measured 16.54 MB at
+        # bb=32 on a v5e; bb must stay <= 16
+        c, kh, we, wo, ow = 96, 3, 28, 27, 27
+        bytes_per_b = 4 * c * (kh * (we + wo) + 4 * we + 2 * ow)
+        assert _batch_block(128, bytes_per_b) <= 16
+        # pair 2: b=128, 27x27x256 -> denser channels, same bound
+        c, we, wo, ow = 256, 14, 13, 13
+        bytes_per_b = 4 * c * (kh * (we + wo) + 4 * we + 2 * ow)
+        assert _batch_block(128, bytes_per_b) <= 16
+
+    def test_block_divides_batch(self):
+        from znicz_tpu.ops.lrn_pool import _batch_block
+
+        for b in (1, 2, 32, 128, 256, 512):
+            bb = _batch_block(b, 127104)
+            assert b % bb == 0 and bb >= 1
