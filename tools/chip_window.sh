@@ -1,0 +1,40 @@
+#!/bin/bash
+# Exploit the next TPU window automatically: wait for the persistent
+# probe (tools/tpu_probe.py) to flip .tpu_status.json to up, pause any
+# CPU-hogging background job (this host has ONE core — a convergence
+# run starves the axon compile-helper), run the bf16-storage kernel
+# diagnostic, then burn the part-2 backlog.  Resumes the paused job
+# when done or on exit.
+#
+# Usage: bash tools/chip_window.sh [pause_pid]
+set -u
+cd "$(dirname "$0")/.."
+PAUSE_PID="${1:-}"
+
+resume() {
+  if [ -n "$PAUSE_PID" ] && kill -0 "$PAUSE_PID" 2>/dev/null; then
+    kill -CONT "$PAUSE_PID" 2>/dev/null && echo "resumed $PAUSE_PID" >&2
+  fi
+}
+trap resume EXIT
+
+echo "waiting for tunnel (probe writes .tpu_status.json)..." >&2
+while true; do
+  up=$(python -c "
+import json
+try: print(json.load(open('.tpu_status.json'))['up'])
+except Exception: print(False)" 2>/dev/null)
+  [ "$up" = "True" ] && break
+  sleep 15
+done
+echo "tunnel UP at $(date -u +%H:%M:%SZ)" >&2
+
+if [ -n "$PAUSE_PID" ] && kill -0 "$PAUSE_PID" 2>/dev/null; then
+  kill -STOP "$PAUSE_PID" 2>/dev/null && echo "paused $PAUSE_PID" >&2
+fi
+
+# name the bf16-storage Mosaic failure first (cheap, informs the
+# --storage row's interpretation), then burn the decision-critical rows
+timeout 1200 python tools/diag_bf16_storage.py > diag_bf16.out 2>&1
+echo "diag done (rc=$?) → diag_bf16.out" >&2
+bash tools/burn_backlog2.sh backlog_r4b.jsonl
