@@ -141,9 +141,12 @@ class TestFusedConvEquivalence:
             {"type": "softmax", "->": {"output_sample_shape": 10},
              "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
         ])
-        spec_m, params, vels = extract_model(wf)
-        os.environ["ZNICZ_TPU_LRN_POOL"] = "split"
+        # fused1 pins phase-1 (bit-equality contract; fused2 is
+        # allclose-only), keeping the test default-independent
+        os.environ["ZNICZ_TPU_LRN_POOL"] = "fused1"
         try:
+            spec_m, params, vels = extract_model(wf)
+            os.environ["ZNICZ_TPU_LRN_POOL"] = "split"
             spec_s, params_s, vels_s = extract_model(wf)
         finally:
             os.environ.pop("ZNICZ_TPU_LRN_POOL", None)

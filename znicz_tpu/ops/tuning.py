@@ -52,7 +52,9 @@ def lrn_pool_split_conv() -> bool:
     interleave.  Off by default: the parity convs are only allclose
     (not bit-equal) to the plain conv, so the merged-vs-split
     bit-equality contract keeps the default conservative until the
-    on-chip A/B (--ablate row lrn_pool_fused2) justifies flipping it."""
+    on-chip A/B (--ablate row lrn_pool_fused2) justifies flipping it.
+    ``fused1`` names phase-1 explicitly (merge + fold, plain convs) so
+    bit-equality tests stay pinned to it if the default ever changes."""
     return os.environ.get("ZNICZ_TPU_LRN_POOL") == "fused2"
 
 
