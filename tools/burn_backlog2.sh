@@ -34,6 +34,12 @@ run bench.py --stream
 run bench.py --augment
 run bench.py --loader
 run bench.py --loader --augment
+# non-alexnet config refresh (round-2 numbers are stale for the
+# round-3/4 surface: merged pair kind, conv retile, VMEM block fix)
+run bench.py --config mnist
+run bench.py --config cifar
+run bench.py --config autoencoder
+run bench.py --config kohonen
 # driver-side corroboration + lever verdicts over BOTH transcripts
 {
   date -u +"# burn2 %Y-%m-%dT%H:%M:%SZ"
