@@ -1,0 +1,70 @@
+"""bench.py transcript-provenance helpers (VERDICT r4 item 3) and the
+resolved-routing stamp that keeps transcript rows meaningful across
+default flips."""
+
+import importlib.util
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(_REPO, "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+class TestLastOnchip:
+    def test_row_is_real_tpu_headline_with_provenance(self):
+        """The freshest on-chip row: a real-device HEADLINE number
+        (never a cpu fallback, never an ms/step ablation or loader
+        row), carrying the transcript it came from and a timestamp."""
+        row = bench._last_onchip_row()
+        if row is None:
+            pytest.skip("no backlog_r*.jsonl with on-chip rows here")
+        assert "cpu" not in str(row["device"]).lower()
+        # exact flagship metric — a newer on-chip mnist/cifar row must
+        # never impersonate the AlexNet headline
+        assert row["metric"] == "alexnet_train_images_per_sec_per_chip"
+        assert isinstance(row["value"], (int, float)) and row["value"] > 0
+        assert row["transcript"].startswith("backlog_r")
+        assert "ts" in row or "measured_at" in row
+
+    def test_attach_labels_the_field_as_provenance(self):
+        result = {}
+        bench._attach_last_onchip(result)
+        if "last_onchip" not in result:
+            pytest.skip("no backlog_r*.jsonl with on-chip rows here")
+        # the provenance row must never leak into device/value
+        assert "device" not in result and "value" not in result
+        assert "last_onchip" in result["note"]
+
+
+class TestResolvedRouting:
+    def test_default_is_fused2_since_round5(self, monkeypatch):
+        from znicz_tpu.ops import tuning
+        monkeypatch.delenv("ZNICZ_TPU_LRN_POOL", raising=False)
+        monkeypatch.delenv("ZNICZ_TPU_CONV1", raising=False)
+        res = tuning.resolved_routing()
+        assert res["LRN_POOL"] == "fused2"
+        assert res["CONV1"] == "direct"
+
+    @pytest.mark.parametrize("env,want", [
+        # explicit "fused" keeps its historical phase-1 meaning —
+        # recorded round-4 lever lines must reproduce their rows
+        ("fused1", "fused1"), ("fused2", "fused2"), ("fused", "fused1"),
+        ("split", "split"), ("nofold", "nofold")])
+    def test_lrn_pool_env_values(self, monkeypatch, env, want):
+        from znicz_tpu.ops import tuning
+        monkeypatch.setenv("ZNICZ_TPU_LRN_POOL", env)
+        assert tuning.resolved_routing()["LRN_POOL"] == want
+
+    def test_split_conv_requires_merge_and_fold(self, monkeypatch):
+        """fused2 = merge + fold + parity convs; split/nofold disable
+        the prerequisite, so split_conv must be off there."""
+        from znicz_tpu.ops import tuning
+        for env in ("split", "nofold", "fused1"):
+            monkeypatch.setenv("ZNICZ_TPU_LRN_POOL", env)
+            assert not tuning.lrn_pool_split_conv(), env
+        monkeypatch.delenv("ZNICZ_TPU_LRN_POOL")
+        assert tuning.lrn_pool_split_conv()

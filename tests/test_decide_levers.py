@@ -1,0 +1,196 @@
+"""tools/decide_levers.py — the codified lever-decision rule.
+
+Round 5 flipped the fused2 default, which silently re-aims any
+transcript row tagged only by explicit env levers; the tool now
+compares rows by resolved routing, canonicalizing pre-round-5 rows
+against the round-4 defaults they actually ran under.  These tests pin
+that canonicalization and the verdict rules, because a wrong verdict
+here flips (or fails to revert) a shipped default."""
+
+import importlib.util
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "decide_levers.py")
+_spec = importlib.util.spec_from_file_location("decide_levers", _TOOLS)
+dl = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(dl)
+
+
+def _row(value, mb, resolved=None, levers=None, device="TPU v5 lite"):
+    r = {"metric": "alexnet_train_images_per_sec_per_chip",
+         "value": value, "minibatch": mb, "device": device}
+    if resolved is not None:
+        base = {"LRN_POOL": "fused2", "CONV1": "direct", "CONV": "xla",
+                "PALLAS": "on", "MXU": "bf16"}
+        base.update(resolved)
+        r["resolved"] = base
+    if levers is not None:
+        r["levers"] = levers
+    return r
+
+
+class TestCanonical:
+    def test_legacy_default_rows_mean_fused1(self):
+        """Pre-round-5 rows with no levers ran under the fused1
+        default — they must NOT be read as today's fused2 default."""
+        cfg = dict(dl.canonical({"value": 1.0}))
+        assert cfg["LRN_POOL"] == "fused1"
+        assert cfg["CONV1"] == "direct"
+
+    def test_legacy_fused_alias(self):
+        cfg = dict(dl.canonical(
+            {"levers": {"ZNICZ_TPU_LRN_POOL": "fused"}}))
+        assert cfg["LRN_POOL"] == "fused1"
+
+    def test_resolved_field_wins(self):
+        cfg = dict(dl.canonical(_row(1.0, 128,
+                                     resolved={"LRN_POOL": "fused2"})))
+        assert cfg["LRN_POOL"] == "fused2"
+
+    def test_cpu_fallback_rows_decide_nothing(self):
+        hl = dl.headline([_row(9.9, 128, device="cpu-fallback (cpu)")])
+        assert hl == {}
+
+
+class TestVerdicts:
+    def _hl(self, rows):
+        return dl.headline(rows)
+
+    def test_fused2_confirmed(self):
+        hl = self._hl([
+            _row(3700.0, 128, resolved={"LRN_POOL": "fused1"}),
+            _row(3600.0, 256, resolved={"LRN_POOL": "fused1"}),
+            _row(6500.0, 128, resolved={"LRN_POOL": "fused2"}),
+            _row(6300.0, 256, resolved={"LRN_POOL": "fused2"}),
+        ])
+        pairs = dl.compare(hl, "LRN_POOL", "fused2", "fused1")
+        assert len(pairs) == 2
+        assert dl._win(pairs) is True
+
+    def test_fused2_net_loss_means_revert(self):
+        hl = self._hl([
+            _row(3700.0, 128, resolved={"LRN_POOL": "fused1"}),
+            _row(3600.0, 256, resolved={"LRN_POOL": "fused1"}),
+            _row(3500.0, 128, resolved={"LRN_POOL": "fused2"}),
+            _row(3400.0, 256, resolved={"LRN_POOL": "fused2"}),
+        ])
+        pairs = dl.compare(hl, "LRN_POOL", "fused2", "fused1")
+        assert dl._win(pairs) is False
+        assert sum(p["gain_pct"] for p in pairs) < 0
+
+    def test_one_batch_is_insufficient(self):
+        """One surviving pair (the other bench run timed out) must not
+        confirm a default."""
+        hl = self._hl([
+            _row(3700.0, 128, resolved={"LRN_POOL": "fused1"}),
+            _row(6500.0, 128, resolved={"LRN_POOL": "fused2"}),
+        ])
+        pairs = dl.compare(hl, "LRN_POOL", "fused2", "fused1")
+        assert dl._win(pairs) is None
+
+    def test_repeated_measurements_average(self):
+        hl = self._hl([
+            _row(3000.0, 128, resolved={"LRN_POOL": "fused1"}),
+            _row(4000.0, 128, resolved={"LRN_POOL": "fused1"}),
+        ])
+        key = (dl.canonical(_row(1.0, 128,
+                                 resolved={"LRN_POOL": "fused1"})), 128)
+        assert hl[key] == 3500.0
+
+    def test_s2d_compared_within_each_pair_context(self):
+        """s2d rows only pair with a twin differing ONLY in CONV1 —
+        the fused1 and fused2 contexts get separate evidence rows."""
+        hl = self._hl([
+            _row(6500.0, 128, resolved={"LRN_POOL": "fused2"}),
+            _row(6700.0, 128, resolved={"LRN_POOL": "fused2",
+                                        "CONV1": "s2d"}),
+            _row(3700.0, 128, resolved={"LRN_POOL": "fused1"}),
+            _row(3900.0, 128, resolved={"LRN_POOL": "fused1",
+                                        "CONV1": "s2d"}),
+        ])
+        pairs = dl.compare(hl, "CONV1", "s2d", "direct")
+        assert len(pairs) == 2
+        contexts = {p["context"] for p in pairs}
+        assert contexts == {"default", "LRN_POOL=fused1"}
+
+
+class TestVerdictRules:
+    """The verdict branch ORDER matters: a single-batch loss must read
+    insufficient-data (wobble), not trigger a revert; a both-batch
+    mixed result with any loss must revert per the shipped default's
+    risk note, even when the mean is positive."""
+
+    def _pairs(self, *mb_gain):
+        return [{"minibatch": mb, "context": "default",
+                 "shipped_context": True,
+                 "baseline": 1000.0, "gain_pct": g,
+                 "challenger": 1000.0 * (1 + g / 100)}
+                for mb, g in mb_gain]
+
+    def test_single_batch_loss_is_insufficient_not_revert(self):
+        v = dl.lrn_pool_verdict(self._pairs((128, -1.0)))
+        assert v.startswith("insufficient-data")
+
+    def test_loss_at_either_batch_reverts_even_with_positive_mean(self):
+        v = dl.lrn_pool_verdict(self._pairs((128, 10.0), (256, -2.0)))
+        assert v.startswith("revert-to-fused1")
+        assert "b256" in v
+
+    def test_small_gains_no_loss_is_marginal_keep(self):
+        v = dl.lrn_pool_verdict(self._pairs((128, 1.0), (256, 2.0)))
+        assert v.startswith("marginal-keep")
+
+    def test_s2d_context_loss_cannot_veto_shipped_default(self):
+        """The burn measures fused2-vs-fused1 under CONV1=s2d too; a
+        loss in that opt-in context must not revert a default that
+        wins in the context it actually ships in."""
+        pairs = self._pairs((128, 10.0), (256, 9.0)) + [
+            {"minibatch": 256, "context": "CONV1=s2d",
+             "shipped_context": False,
+             "baseline": 1000.0, "challenger": 980.0, "gain_pct": -2.0}]
+        assert dl.lrn_pool_verdict(pairs).startswith(
+            "keep-default-fused2")
+
+    def test_conv1_contexts_get_separate_verdicts(self):
+        pairs = (
+            [{"minibatch": mb, "context": "LRN_POOL=fused1",
+              "baseline": 1000.0, "challenger": 1110.0,
+              "gain_pct": 11.0} for mb in (128, 256)]
+            + [{"minibatch": mb, "context": "default",
+                "baseline": 1000.0, "challenger": 950.0,
+                "gain_pct": -5.0} for mb in (128, 256)])
+        v = dl.conv1_verdicts(pairs)
+        assert v["LRN_POOL=fused1"] == "flip-default"
+        assert v["default"] == "keep-off"
+
+
+class TestShippedDefaultsSync:
+    def test_shipped_dict_mirrors_tuning_resolved_routing(self,
+                                                          monkeypatch):
+        """decide_levers cannot import tuning (jax init hangs on a
+        dead tunnel), so it carries its own copy of the shipped
+        routing defaults — this pin is what keeps the two in sync
+        across future default flips."""
+        from znicz_tpu.ops import tuning
+        for var in ("ZNICZ_TPU_LRN_POOL", "ZNICZ_TPU_CONV1",
+                    "ZNICZ_TPU_CONV", "ZNICZ_TPU_NO_PALLAS",
+                    "ZNICZ_TPU_MXU"):
+            monkeypatch.delenv(var, raising=False)
+        assert dl._SHIPPED == tuning.resolved_routing()
+
+
+class TestMixedTranscripts:
+    def test_legacy_and_new_rows_compare(self):
+        """A round-4 default row (legacy, = fused1) pairs with a
+        round-5 resolved fused2 row at the same batch."""
+        hl = dl.headline([
+            _row(3688.6, 128),                     # legacy r4 headline
+            _row(3576.1, 256),
+            _row(6500.0, 128, resolved={"LRN_POOL": "fused2"}),
+            _row(6300.0, 256, resolved={"LRN_POOL": "fused2"}),
+        ])
+        pairs = dl.compare(hl, "LRN_POOL", "fused2", "fused1")
+        assert len(pairs) == 2
+        assert dl._win(pairs) is True

@@ -16,13 +16,22 @@ run() {
   fi
 }
 
-# the lever A/B rows decide_levers needs (both batches, each lever)
+# the lever A/B rows decide_levers needs (both batches, each lever).
+# Every row pins LRN_POOL EXPLICITLY so the transcript stays
+# self-describing across default flips (round 5 shipped fused2 as the
+# default; rows also carry bench.py's "resolved" routing field).
+ZNICZ_TPU_LRN_POOL=fused1 run bench.py
+ZNICZ_TPU_LRN_POOL=fused1 run bench.py --minibatch 256
 ZNICZ_TPU_LRN_POOL=fused2 run bench.py
 ZNICZ_TPU_LRN_POOL=fused2 run bench.py --minibatch 256
-ZNICZ_TPU_CONV1=s2d run bench.py
-ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
+# s2d under BOTH pair contexts: under fused2 only conv1 can take s2d;
+# under fused1 the pair-fed convs can too — separate verdicts
+ZNICZ_TPU_LRN_POOL=fused2 ZNICZ_TPU_CONV1=s2d run bench.py
 ZNICZ_TPU_LRN_POOL=fused2 ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
-# the lost ablation at b256
+ZNICZ_TPU_LRN_POOL=fused1 ZNICZ_TPU_CONV1=s2d run bench.py
+ZNICZ_TPU_LRN_POOL=fused1 ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
+# the lost ablation at b256 (under the new fused2 default; the A/B
+# variant row is now lrn_pool_fused1)
 run bench.py --ablate --minibatch 256
 # kernel table (24 rows incl. retiled convs + fused pair)
 run bench.py --kernels

@@ -36,5 +36,14 @@ fi
 # name the bf16-storage Mosaic failure first (cheap, informs the
 # --storage row's interpretation), then burn the decision-critical rows
 timeout 1200 python tools/diag_bf16_storage.py > diag_bf16.out 2>&1
-echo "diag done (rc=$?) → diag_bf16.out" >&2
+diag_rc=$?
+echo "diag done (rc=$diag_rc) → diag_bf16.out" >&2
+if [ "$diag_rc" -ne 0 ]; then
+  # the burn still runs (the A/B rows are the scarcer evidence), but
+  # the window's transcript must record loudly that the bf16
+  # diagnostic did not complete — rc 124 is the 1200 s timeout
+  marker="### DIAG FAILED rc=$diag_rc ($(date -u +%H:%M:%SZ)) — bf16-storage kernel family NOT isolated this window"
+  echo "$marker" >&2
+  echo "$marker" | tee -a diag_bf16.out >> kern_r4.log
+fi
 bash tools/burn_backlog2.sh backlog_r4b.jsonl

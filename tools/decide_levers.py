@@ -1,24 +1,46 @@
-"""Read a burn-backlog transcript (JSONL) and print the lever verdicts.
+"""Read burn-backlog transcripts (JSONL) and print the lever verdicts.
 
 VERDICT r3 item 3 requires the round to DECIDE the opt-in levers from
 the measured A/B, not leave them as unmeasured debt.  This tool turns
-``tools/burn_backlog.sh``'s transcript into explicit recommendations:
+``tools/burn_backlog*.sh`` transcripts into explicit recommendations.
 
-* ``ZNICZ_TPU_LRN_POOL=fused2`` — flip the default if the fused2
-  headline beats the default merge at BOTH measured batches by more
-  than the chip's observed run-to-run wobble (±15%: require >3% mean
-  win with no loss at either batch).
-* ``ZNICZ_TPU_CONV1=s2d`` — same rule.
+Round-5 semantics (the fused2 default FLIPPED this round, per VERDICT
+r4 item 1, on the 1.78× on-chip b128 ablation):
+
+* ``LRN_POOL fused2 vs fused1`` — fused2 is now the DEFAULT.  With
+  both batches measured, the verdict is ``keep-default-fused2`` if
+  fused2 beats fused1 by >3% mean with no loss at either batch (the
+  original flip rule, now confirming the flip), ``revert-to-fused1``
+  on a loss at EITHER batch (the symmetric promise in the shipped
+  default's risk note), else ``marginal-keep``.  One surviving batch
+  is ``insufficient-data`` — it can neither confirm nor revert.
+* ``CONV1 s2d vs direct`` — still opt-in: ``flip-default`` on a >3%
+  mean win with no loss at both batches, else ``keep-off``.  s2d is
+  evaluated separately under each LRN_POOL context it was measured in
+  (under fused2 only conv1 can take s2d; under fused1 the pair-fed
+  convs can too), because the verdict may differ.
+
+Rows are compared by their **resolved routing** (the ``resolved``
+field bench.py stamps since round 5 — env levers + defaults already
+applied).  Pre-round-5 rows carry only explicit env levers; they are
+canonicalized against the ROUND-4 defaults they actually ran under
+(LRN_POOL=fused1, CONV1=direct, CONV=xla, PALLAS=on, MXU=bf16), so
+"no levers" rows from backlog_r4.jsonl keep meaning fused1 even though
+today's default is fused2.
 
 Prints one JSON line: {"decisions": {...}, "evidence": {...}} and a
-human table on stderr.  The flip itself stays a one-line change
-(ops/tuning.py default) so the decision and its evidence land in the
-same commit.
+human table on stderr.
 
 Usage: python tools/decide_levers.py backlog_*.jsonl
 """
 import json
 import sys
+
+#: defaults pre-round-5 transcript rows (no ``resolved`` field) ran
+#: under — the canonicalization target for legacy "levers"-only rows
+_LEGACY_DEFAULTS = {"LRN_POOL": "fused1", "CONV1": "direct",
+                    "CONV": "xla", "PALLAS": "on", "MXU": "bf16"}
+_ROUTING_KEYS = tuple(_LEGACY_DEFAULTS)
 
 
 def load(paths):
@@ -37,19 +59,35 @@ def load(paths):
     return rows
 
 
-#: the levers the decision compares; other ZNICZ_TPU_* vars (VMEM
-#: budget, IO workers, interpret mode...) are tuning context, not
-#: routing choices — an ambient one must not break tag matching
-_ROUTING = ("ZNICZ_TPU_LRN_POOL", "ZNICZ_TPU_CONV1", "ZNICZ_TPU_CONV",
-            "ZNICZ_TPU_NO_PALLAS", "ZNICZ_TPU_MXU")
+def canonical(row):
+    """Resolved routing config for a transcript row, as a hashable
+    sorted-items tuple."""
+    res = row.get("resolved")
+    if not isinstance(res, dict):
+        res = dict(_LEGACY_DEFAULTS)
+        lv = row.get("levers", {})
+        if "ZNICZ_TPU_LRN_POOL" in lv:
+            val = lv["ZNICZ_TPU_LRN_POOL"]
+            # legacy "fused" meant the then-default merge+fold phase-1
+            res["LRN_POOL"] = "fused1" if val == "fused" else val
+        if lv.get("ZNICZ_TPU_CONV1") == "s2d":
+            res["CONV1"] = "s2d"
+        if lv.get("ZNICZ_TPU_CONV") == "pallas":
+            res["CONV"] = "pallas"
+        if lv.get("ZNICZ_TPU_NO_PALLAS") == "1":
+            res["PALLAS"] = "off"
+        if lv.get("ZNICZ_TPU_MXU"):
+            res["MXU"] = lv["ZNICZ_TPU_MXU"].lower()
+    cfg = {k: res.get(k, _LEGACY_DEFAULTS[k]) for k in _ROUTING_KEYS}
+    return tuple(sorted(cfg.items()))
 
 
 def headline(rows):
-    """{(lever_tag, minibatch): mean images/sec} for AlexNet training
-    rows on a real (non-cpu-fallback) device.  Repeated measurements
-    of the same configuration (burn re-runs, multiple transcripts)
-    AVERAGE — the ±15%-wobble argument behind the 3% threshold assumes
-    means, not an arbitrary last sample."""
+    """{(config, minibatch): mean images/sec} for AlexNet training rows
+    on a real (non-cpu-fallback) device.  Repeated measurements of the
+    same configuration (burn re-runs, multiple transcripts) AVERAGE —
+    the ±15%-wobble argument behind the 3% threshold assumes means,
+    not an arbitrary last sample."""
     acc = {}
     for r in rows:
         if r.get("metric") != "alexnet_train_images_per_sec_per_chip" \
@@ -57,37 +95,112 @@ def headline(rows):
             continue
         if "cpu" in str(r.get("device", "")).lower():
             continue                      # fallback rows decide nothing
-        lv = r.get("levers", {})
-        tag = ",".join(f"{k.replace('ZNICZ_TPU_', '')}={v}"
-                       for k, v in lv.items()
-                       if k in _ROUTING) or "default"
-        acc.setdefault((tag, r.get("minibatch")), []).append(r["value"])
+        acc.setdefault((canonical(r), r.get("minibatch")),
+                       []).append(r["value"])
     for key, vals in acc.items():
         if len(vals) > 1:
-            print(f"  averaging {len(vals)} samples for {key}",
-                  file=sys.stderr)
+            cfg, mb = key
+            print(f"  averaging {len(vals)} samples for "
+                  f"{_short(cfg)} b{mb}", file=sys.stderr)
     return {k: round(sum(v) / len(v), 1) for k, v in acc.items()}
 
 
-def decide(hl, lever_tag):
-    """(decision, evidence) comparing `lever_tag` rows to default."""
+#: today's SHIPPED routing defaults (fused2 since round 5) — the one
+#: copy in this module; must mirror znicz_tpu/ops/tuning.py
+#: resolved_routing()'s defaults, which cannot be imported here because
+#: importing znicz_tpu triggers jax backend init (hangs on a dead
+#: tunnel).  tests/test_decide_levers.py pins the two in sync.
+_SHIPPED = {"LRN_POOL": "fused2", "CONV1": "direct", "CONV": "xla",
+            "PALLAS": "on", "MXU": "bf16"}
+
+
+def _short(cfg):
+    """Compact human tag: only the keys that differ from the shipped
+    defaults."""
+    parts = [f"{k}={v}" for k, v in sorted(dict(cfg).items())
+             if _SHIPPED.get(k) != v]
+    return ",".join(parts) or "default"
+
+
+def compare(hl, key, challenger, baseline):
+    """All (minibatch, context) pairs where a challenger-config row has
+    a baseline twin differing ONLY in `key`."""
     pairs = []
-    for (tag, mb), v in hl.items():
-        if tag == lever_tag and ("default", mb) in hl:
-            pairs.append((mb, hl[("default", mb)], v))
+    # rows without a minibatch field sort as 0, not TypeError
+    for (cfg, mb), v in sorted(hl.items(),
+                               key=lambda kv: (kv[0][1] or 0,
+                                               kv[0][0])):
+        d = dict(cfg)
+        if d.get(key) != challenger:
+            continue
+        d[key] = baseline
+        bk = (tuple(sorted(d.items())), mb)
+        if bk in hl:
+            ctx = {k: v2 for k, v2 in cfg if k != key}
+            pairs.append({"minibatch": mb, "context": _short(
+                tuple(sorted(ctx.items()))),
+                # decided against the cfg itself, not the display tag
+                "shipped_context": all(
+                    _SHIPPED.get(k) == v2 for k, v2 in ctx.items()),
+                "baseline": hl[bk], "challenger": v,
+                "gain_pct": round(100 * (v - hl[bk]) / hl[bk], 1)})
+    return pairs
+
+
+def _win(pairs):
+    """The codified rule: >3% mean gain with no loss at either batch,
+    and at least two measured batches (one surviving pair — the other
+    bench run timed out — is not enough evidence)."""
+    if len({p["minibatch"] for p in pairs}) < 2:
+        return None
+    gains = [p["gain_pct"] / 100 for p in pairs]
+    return min(gains) > 0 and sum(gains) / len(gains) > 0.03
+
+
+def lrn_pool_verdict(pairs):
+    """Verdict on the SHIPPED default, so only pairs measured in the
+    shipped context (every other routing key at its default, i.e.
+    CONV1=direct) decide it: the burn also measures fused2-vs-fused1
+    under CONV1=s2d, and a loss in that opt-in context must not veto a
+    default that wins where it ships (nor may a b128-s2d pair plus a
+    b256-direct pair masquerade as "both batches measured")."""
+    pairs = [p for p in pairs if p.get("shipped_context")]
     if not pairs:
-        return "no-data", {"pairs": []}
-    gains = [(v - base) / base for _, base, v in pairs]
-    win = (min(gains) > 0 and sum(gains) / len(gains) > 0.03)
-    ev = {"pairs": [{"minibatch": mb, "default": base, "lever": v,
-                     "gain_pct": round(100 * (v - base) / base, 1)}
-                    for mb, base, v in pairs]}
-    # "both measured batches": one surviving pair (the other bench run
-    # timed out) is not enough evidence to flip a default
-    if len(pairs) < 2:
-        return ("insufficient-data (re-run the missing batch)"
-                if win else "keep-off"), ev
-    return ("flip-default" if win else "keep-off"), ev
+        return "no-data (flip stands on the r4 ablation; re-run the " \
+               "A/B)"
+    win = _win(pairs)
+    if win is None:
+        # one surviving batch can neither confirm nor revert a
+        # default — a single noisy pair is exactly the ±15% wobble the
+        # two-batch rule exists to exclude
+        return "insufficient-data (re-run the missing batch)"
+    if win:
+        return "keep-default-fused2 (confirmed)"
+    losses = [p for p in pairs if p["gain_pct"] < 0]
+    if losses:
+        # the shipped default's own risk note (tuning.py
+        # lrn_pool_split_conv) promises a revert on a loss at EITHER
+        # batch — symmetric with the no-loss-both-batches rule that
+        # would have gated the flip
+        return "revert-to-fused1 (loss at " + ", ".join(
+            f"b{p['minibatch']}: {p['gain_pct']}%" for p in losses) + ")"
+    return "marginal-keep (within wobble)"
+
+
+def conv1_verdicts(pairs):
+    """Per-context verdicts: under fused2 only conv1 can take s2d,
+    under fused1 the pair-fed convs can too — pooling the contexts
+    would let one context's loss veto the other's win."""
+    if not pairs:
+        return "no-data"
+    out = {}
+    for ctx in sorted({p["context"] for p in pairs}):
+        cp = [p for p in pairs if p["context"] == ctx]
+        win = _win(cp)
+        out[ctx] = ("flip-default" if win
+                    else "insufficient-data (re-run the missing batch)"
+                    if win is None else "keep-off")
+    return out
 
 
 def main(argv):
@@ -102,12 +215,19 @@ def main(argv):
                                    "transcript"}))
         return 1
     decisions, evidence = {}, {}
-    for lever, tag in (("ZNICZ_TPU_LRN_POOL=fused2",
-                        "LRN_POOL=fused2"),
-                       ("ZNICZ_TPU_CONV1=s2d", "CONV1=s2d")):
-        decisions[lever], evidence[lever] = decide(hl, tag)
-    for (tag, mb), v in sorted(hl.items()):
-        print(f"  {tag:24s} b{mb}: {v} img/s", file=sys.stderr)
+
+    pairs = compare(hl, "LRN_POOL", "fused2", "fused1")
+    evidence["LRN_POOL fused2 vs fused1"] = pairs
+    decisions["LRN_POOL"] = lrn_pool_verdict(pairs)
+
+    pairs = compare(hl, "CONV1", "s2d", "direct")
+    evidence["CONV1 s2d vs direct"] = pairs
+    decisions["CONV1"] = conv1_verdicts(pairs)
+
+    for (cfg, mb), v in sorted(hl.items(),
+                               key=lambda kv: (kv[0][1] or 0,
+                                               _short(kv[0][0]))):
+        print(f"  {_short(cfg):36s} b{mb}: {v} img/s", file=sys.stderr)
     for lever, d in decisions.items():
         print(f"  {lever}: {d}", file=sys.stderr)
     print(json.dumps({"decisions": decisions, "evidence": evidence}))

@@ -18,9 +18,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import numpy as np
 
 if "--tiny" in sys.argv:
-    # CI/signature validation off-chip: the sitecustomize pins the axon
-    # platform regardless of JAX_PLATFORMS, so pin CPU post-import
-    # (conftest pattern) or a dead tunnel hangs device init forever
+    # CI/signature validation off-chip needs interpret-mode Pallas —
+    # tuning._INTERPRET reads the env at import, so set it BEFORE any
+    # znicz_tpu import or every case FAILs with a Pallas-unsupported
+    # error on CPU (ADVICE r4)
+    os.environ["ZNICZ_TPU_PALLAS_INTERPRET"] = "1"
+    # the sitecustomize pins the axon platform regardless of
+    # JAX_PLATFORMS, so pin CPU post-import (conftest pattern) or a
+    # dead tunnel hangs device init forever
     import jax
     jax.config.update("jax_platforms", "cpu")
 

@@ -45,17 +45,50 @@ def lrn_pool_merge() -> bool:
 
 
 def lrn_pool_split_conv() -> bool:
-    """Phase-2 (opt-in, ZNICZ_TPU_LRN_POOL=fused2): the conv feeding a
-    folded pair emits the column-parity halves DIRECTLY (two
-    stride-doubled convs) and consumes the pair's split gradient halves
-    — removing the pair forward's split pass and the backward's
-    interleave.  Off by default: the parity convs are only allclose
-    (not bit-equal) to the plain conv, so the merged-vs-split
-    bit-equality contract keeps the default conservative until the
-    on-chip A/B (--ablate row lrn_pool_fused2) justifies flipping it.
-    ``fused1`` names phase-1 explicitly (merge + fold, plain convs) so
-    bit-equality tests stay pinned to it if the default ever changes."""
-    return os.environ.get("ZNICZ_TPU_LRN_POOL") == "fused2"
+    """Phase-2 (DEFAULT since round 5, ZNICZ_TPU_LRN_POOL=fused2): the
+    conv feeding a folded pair emits the column-parity halves DIRECTLY
+    (two stride-doubled convs) and consumes the pair's split gradient
+    halves — removing the pair forward's split pass and the backward's
+    interleave.
+
+    Default evidence + risk note: the 2026-07-31 on-chip b128 ablation
+    measured fused2 at 19.37 ms/step vs 34.45 for phase-1 — 1.78×
+    (kern_r4.log; BASELINE.md round-4 table).  The codified flip rule
+    (tools/decide_levers.py, >3% mean win at BOTH batches) could not be
+    completed before the tunnel dropped, so the default is flipped on
+    the single-batch ablation evidence alone per VERDICT r4 item 1;
+    risk: the parity convs are allclose (atol 1e-5), not bit-equal, to
+    the plain conv, and the b256 confirmation is outstanding — if the
+    next chip window's A/B shows a loss at either batch,
+    decide_levers.py will say revert-to-fused1 and this default
+    reverts.  ``fused1`` names phase-1 explicitly (merge + fold, plain
+    convs); the bit-equality tests stay pinned to it.  An EXPLICIT
+    ``fused`` keeps its historical phase-1 meaning (pre-flip it
+    selected the merge without the parity convs) so a recorded round-4
+    lever line reproduces the routing its transcript row claims — only
+    the UNSET default moved to fused2."""
+    v = os.environ.get("ZNICZ_TPU_LRN_POOL")
+    return v is None or v == "fused2"
+
+
+def resolved_routing() -> dict:
+    """The EFFECTIVE kernel-routing configuration, independent of which
+    values came from env levers and which from defaults.  bench.py
+    stamps this into every transcript row so tools/decide_levers.py can
+    compare configurations across default flips — a row tagged only
+    with explicit env levers silently changes meaning when a default
+    changes (exactly what round 5's fused2 flip did to "default" rows).
+    """
+    return {
+        "LRN_POOL": ("split" if not lrn_pool_merge() else
+                     "nofold" if not lrn_pool_act_fold() else
+                     "fused2" if lrn_pool_split_conv() else "fused1"),
+        "CONV1": "s2d" if conv_s2d() else "direct",
+        "CONV": "pallas" if force_pallas_conv() else "xla",
+        "PALLAS": ("off" if os.environ.get("ZNICZ_TPU_NO_PALLAS", "0")
+                   == "1" else "on"),
+        "MXU": os.environ.get("ZNICZ_TPU_MXU", "").lower() or "bf16",
+    }
 
 
 def lrn_pool_act_fold() -> bool:
