@@ -40,6 +40,28 @@ class TestLastOnchip:
         assert "last_onchip" in result["note"]
 
 
+class TestCompileClass:
+    """The gate between 'kernel family implicated → downgrade routing'
+    and 'transient error → leave routing alone'."""
+
+    @pytest.mark.parametrize("msg", [
+        "RESOURCE_EXHAUSTED: scoped VMEM limit exceeded",   # uppercase
+        "Mosaic lowering failed",
+        "INTERNAL: http://127.0.0.1:8083/remote_compile: HTTP 500: "
+        "tpu_compile_helper subprocess exit code 1",
+    ])
+    def test_compile_failures_match(self, msg):
+        assert bench._compile_class(RuntimeError(msg))
+
+    @pytest.mark.parametrize("msg", [
+        "DEADLINE_EXCEEDED: channel is in state TRANSIENT_FAILURE",
+        "Connection refused",
+        "some unrelated assertion",
+    ])
+    def test_transient_errors_do_not(self, msg):
+        assert not bench._compile_class(RuntimeError(msg))
+
+
 class TestResolvedRouting:
     def test_default_is_fused2_since_round5(self, monkeypatch):
         from znicz_tpu.ops import tuning

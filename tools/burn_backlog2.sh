@@ -35,9 +35,14 @@ ZNICZ_TPU_LRN_POOL=fused1 ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
 run bench.py --ablate --minibatch 256
 # kernel table (24 rows incl. retiled convs + fused pair)
 run bench.py --kernels
-# precision / storage variants
+# precision / storage variants (storage rows depend on the diag's
+# verdict on the r4 Mosaic failure; cheap to attempt either way)
 run bench.py --dtype bfloat16
+run bench.py --storage bfloat16
 run bench.py --storage bfloat16 --minibatch 256
+# the full-bf16 config — the max-throughput candidate (MXU bf16 peak
+# is 2x f32)
+run bench.py --dtype bfloat16 --storage bfloat16
 # data-plane: stream + on-device augment + loader-only
 run bench.py --stream
 run bench.py --augment
@@ -52,7 +57,7 @@ run bench.py --config kohonen
 # driver-side corroboration + lever verdicts over BOTH transcripts
 {
   date -u +"# burn2 %Y-%m-%dT%H:%M:%SZ"
-  grep -h "pallas_kernel_validation\|images_per_sec" "$OUT"
+  grep -h "pallas_kernel_validation\|images_per_sec\|_ablation" "$OUT"
 } >> kern_r4.log || true
 python tools/decide_levers.py backlog_r4.jsonl "$OUT" | tee "$OUT.decisions"
 echo "backlog part 2 complete → $OUT (+ .decisions, kern_r4.log)" >&2
