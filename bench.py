@@ -43,11 +43,29 @@ def _compile_class(e) -> bool:
     (scoped-VMEM OOM, compile-helper crash) rather than a transient
     tunnel/runtime error — the two must route differently: only the
     former implicates a kernel family.  Case-insensitive: Mosaic
-    spells scoped-VMEM messages 'VMEM' uppercase (ADVICE r4)."""
+    spells scoped-VMEM messages 'VMEM' uppercase (ADVICE r4).
+
+    Subtlety: the axon compile RPC's URL ends in ``/remote_compile``,
+    so a mid-run tunnel FLAP (connection refused / deadline exceeded,
+    with the URL embedded in the channel error) must not read as a
+    compile failure — that would silently downgrade the headline's
+    kernel routing over a network blip.  Explicit failure markers
+    (HTTP 500, helper exit code, VMEM/Mosaic) win over transient
+    markers; a bare URL with neither stays compile-class (the round-4
+    failures carried 'HTTP 500' + 'tpu_compile_helper')."""
     sig = str(e).lower()
-    return any(m in sig for m in (
-        "vmem", "mosaic", "remote_compile", "resource_exhausted",
-        "tpu_compile_helper"))
+    if any(m in sig for m in (
+            "vmem", "mosaic", "resource_exhausted",
+            "tpu_compile_helper", "http 500")):
+        return True
+    if any(m in sig for m in (
+            "connection refused", "connection reset", "timed out",
+            "broken pipe", "deadline_exceeded", "deadline exceeded",
+            "unavailable", "failed to connect", "connect failed",
+            "http 502", "http 504", "bad gateway",
+            "gateway timeout")):
+        return False
+    return "remote_compile" in sig
 
 
 def _preflight_lrn_pool(result, minibatch: int = 2,

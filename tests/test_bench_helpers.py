@@ -57,9 +57,23 @@ class TestCompileClass:
         "DEADLINE_EXCEEDED: channel is in state TRANSIENT_FAILURE",
         "Connection refused",
         "some unrelated assertion",
+        # a tunnel flap embeds the compile RPC's URL in the channel
+        # error — the URL alone must not implicate the kernels
+        "UNAVAILABLE: http://127.0.0.1:8083/remote_compile: "
+        "connection refused",
+        "http://127.0.0.1:8083/remote_compile: Connection reset by "
+        "peer",
+        "http://127.0.0.1:8083/remote_compile: Read timed out",
+        "http://127.0.0.1:8083/remote_compile: HTTP 502 Bad Gateway",
     ])
     def test_transient_errors_do_not(self, msg):
         assert not bench._compile_class(RuntimeError(msg))
+
+    def test_bare_remote_compile_url_stays_compile_class(self):
+        """With neither an explicit failure nor a transient marker,
+        the URL keeps its historical compile-class reading."""
+        assert bench._compile_class(RuntimeError(
+            "INTERNAL: remote_compile failed"))
 
 
 class TestResolvedRouting:

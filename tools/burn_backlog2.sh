@@ -30,11 +30,13 @@ ZNICZ_TPU_LRN_POOL=fused2 ZNICZ_TPU_CONV1=s2d run bench.py
 ZNICZ_TPU_LRN_POOL=fused2 ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
 ZNICZ_TPU_LRN_POOL=fused1 ZNICZ_TPU_CONV1=s2d run bench.py
 ZNICZ_TPU_LRN_POOL=fused1 ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
-# the lost ablation at b256 (under the new fused2 default; the A/B
-# variant row is now lrn_pool_fused1)
-run bench.py --ablate --minibatch 256
-# kernel table (24 rows incl. retiled convs + fused pair)
-run bench.py --kernels
+# verdicts land NOW, not only at burn end — a mid-burn tunnel drop
+# must not eat the flip decision the rows above just bought
+python tools/decide_levers.py backlog_r4.jsonl "$OUT" \
+  | tee "$OUT.decisions.early" || true
+# ORDER = decision value per minute of window: a short window must
+# buy the flip confirmation and the precision headline candidates
+# before the long kernel table / config refresh.
 # precision / storage variants (storage rows depend on the diag's
 # verdict on the r4 Mosaic failure; cheap to attempt either way)
 run bench.py --dtype bfloat16
@@ -43,11 +45,16 @@ run bench.py --storage bfloat16 --minibatch 256
 # the full-bf16 config — the max-throughput candidate (MXU bf16 peak
 # is 2x f32)
 run bench.py --dtype bfloat16 --storage bfloat16
+# the lost ablation at b256 (under the new fused2 default; the A/B
+# variant row is now lrn_pool_fused1)
+run bench.py --ablate --minibatch 256
 # data-plane: stream + on-device augment + loader-only
 run bench.py --stream
 run bench.py --augment
 run bench.py --loader
 run bench.py --loader --augment
+# kernel table (24 rows incl. retiled convs + fused pair)
+run bench.py --kernels
 # non-alexnet config refresh (round-2 numbers are stale for the
 # round-3/4 surface: merged pair kind, conv retile, VMEM block fix)
 run bench.py --config mnist
