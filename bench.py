@@ -50,13 +50,20 @@ def _compile_class(e) -> bool:
     with the URL embedded in the channel error) must not read as a
     compile failure — that would silently downgrade the headline's
     kernel routing over a network blip.  Explicit failure markers
-    (HTTP 500, helper exit code, VMEM/Mosaic) win over transient
-    markers; a bare URL with neither stays compile-class (the round-4
-    failures carried 'HTTP 500' + 'tpu_compile_helper')."""
+    (helper exit code, VMEM/Mosaic) win over transient markers; a bare
+    URL with neither stays compile-class (the round-4 failures carried
+    'HTTP 500' + 'tpu_compile_helper').
+
+    The AMBIGUOUS markers — 'resource_exhausted' (a runtime HBM OOM
+    spells it identically) and 'http 500' (any proxy in the tunnel can
+    emit one) — only read as compile-class WITH compile context
+    (remote_compile / tpu_compile_helper / mosaic / vmem) in the same
+    message; alone they stay runtime/transient (ADVICE r5)."""
     sig = str(e).lower()
-    if any(m in sig for m in (
-            "vmem", "mosaic", "resource_exhausted",
-            "tpu_compile_helper", "http 500")):
+    if any(m in sig for m in ("vmem", "mosaic", "tpu_compile_helper")):
+        return True
+    if any(m in sig for m in ("resource_exhausted", "http 500")) \
+            and "remote_compile" in sig:
         return True
     if any(m in sig for m in (
             "connection refused", "connection reset", "timed out",
@@ -595,12 +602,70 @@ def _append_note(result, note: str) -> None:
                       if "note" in result else note)
 
 
+def _git_rev() -> str | None:
+    """Short git sha of the checkout the bench ran from, suffixed
+    ``-dirty.<hash-of-diff>`` when the CODE has uncommitted edits —
+    two runs straddling an uncommitted kernel tweak are NOT the same
+    code, and two *different* tweaks must not share a stamp either.
+    None when not a repo / no git.  Stamped into every transcript row
+    so decide_levers.py can refuse to average or pair rows measured on
+    different code revisions (ADVICE r5 medium: cross-revision rows
+    contaminate keep/revert verdicts)."""
+    import hashlib
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    # dirtiness is judged over CODE paths only: untracked files and
+    # the tracked burn outputs the harness itself appends to
+    # (kern*.log, BENCH_*.json in the repo root) must not flip the
+    # suffix mid-burn — same code must stamp the same rev across a
+    # burn session
+    # no "tests": a test-only edit cannot change a measurement, and
+    # splitting A/B evidence over one would waste a chip window
+    code_paths = ["bench.py", "__graft_entry__.py", "znicz_tpu",
+                  "native", "tools"]
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=here)
+        rev = proc.stdout.strip()
+        if proc.returncode != 0 or not rev:
+            return None
+        diff = subprocess.run(
+            ["git", "diff", "HEAD", "--"] + code_paths,
+            capture_output=True, timeout=10, cwd=here)
+        h = hashlib.sha1(diff.stdout if diff.returncode == 0 else b"")
+        dirty = bool(diff.returncode == 0 and diff.stdout.strip())
+        # untracked CODE files never appear in `git diff` — hash their
+        # contents too, or two different uncommitted new kernels would
+        # share a stamp
+        others = subprocess.run(
+            ["git", "ls-files", "-z", "--others", "--exclude-standard",
+             "--"] + code_paths,
+            capture_output=True, text=True, timeout=10, cwd=here)
+        # NUL-separated (-z): names with spaces must not split apart
+        for name in sorted(n for n in (others.stdout or "").split("\0")
+                           if n):
+            dirty = True
+            h.update(name.encode())
+            try:
+                with open(os.path.join(here, name), "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                pass
+        if dirty:
+            rev += "-dirty." + h.hexdigest()[:8]
+        return rev
+    except Exception:
+        return None
+
+
 def _record_run_config(args, result) -> None:
     """Stamp the transcript row with what ACTUALLY ran: the active
-    routing levers and the (possibly CPU-reduced) minibatch.  Callers
-    invoke this after backend bring-up / env fixups, not before — a
-    row claiming levers the run stripped, or the pre-reduction batch
-    size, would mislead decide_levers.py's readers."""
+    routing levers, the code revision, and the (possibly CPU-reduced)
+    minibatch.  Callers invoke this after backend bring-up / env
+    fixups, not before — a row claiming levers the run stripped, or
+    the pre-reduction batch size, would mislead decide_levers.py's
+    readers."""
     levers = {k: v for k, v in sorted(os.environ.items())
               if k.startswith("ZNICZ_TPU_")}
     if levers:
@@ -613,6 +678,15 @@ def _record_run_config(args, result) -> None:
     # which silently re-aimed every pre-flip "no levers" row)
     from znicz_tpu.ops import tuning
     result["resolved"] = tuning.resolved_routing()
+    rev = _git_rev()
+    if rev:
+        result["rev"] = rev
+    else:
+        # an unstamped row pools with pre-round-6 legacy history in
+        # decide_levers — that must never happen silently
+        print("warning: no git revision available; transcript row is "
+              "unstamped and will pair with legacy (rev-less) rows",
+              file=sys.stderr)
     result["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     result["minibatch"] = args.minibatch
 
@@ -667,7 +741,8 @@ def _last_onchip_row():
     _, row, path = best
     keep = {k: row[k] for k in ("metric", "value", "unit", "device",
                                 "minibatch", "mfu", "tflops_per_sec",
-                                "levers", "resolved", "ts") if k in row}
+                                "levers", "resolved", "rev", "ts")
+            if k in row}
     keep["transcript"] = os.path.basename(path)
     if "ts" not in keep:            # pre-round-5 rows carry no ts
         keep["measured_at"] = time.strftime(

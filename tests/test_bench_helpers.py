@@ -69,11 +69,76 @@ class TestCompileClass:
     def test_transient_errors_do_not(self, msg):
         assert not bench._compile_class(RuntimeError(msg))
 
+    @pytest.mark.parametrize("msg", [
+        # a RUNTIME HBM OOM spells RESOURCE_EXHAUSTED identically to a
+        # compile-time scoped-VMEM OOM — without compile context it
+        # must not implicate the kernel family (ADVICE r5)
+        "RESOURCE_EXHAUSTED: Out of memory allocating 4294967296 "
+        "bytes in HBM while running the program",
+        # a bare proxy 500 with no compile RPC in sight
+        "HTTP 500 Internal Server Error from upstream proxy",
+    ])
+    def test_ambiguous_markers_without_compile_context(self, msg):
+        assert not bench._compile_class(RuntimeError(msg))
+
+    @pytest.mark.parametrize("msg", [
+        "RESOURCE_EXHAUSTED: http://127.0.0.1:8083/remote_compile "
+        "rejected the program",
+        "http://127.0.0.1:8083/remote_compile: HTTP 500",
+    ])
+    def test_ambiguous_markers_with_compile_context(self, msg):
+        assert bench._compile_class(RuntimeError(msg))
+
     def test_bare_remote_compile_url_stays_compile_class(self):
         """With neither an explicit failure nor a transient marker,
         the URL keeps its historical compile-class reading."""
         assert bench._compile_class(RuntimeError(
             "INTERNAL: remote_compile failed"))
+
+
+class TestRevStamp:
+    def test_git_rev_is_stamped_into_run_config(self, monkeypatch):
+        """Transcript rows carry the code revision so decide_levers
+        can keep cross-revision rows from contaminating verdicts."""
+        rev = bench._git_rev()
+        if rev is None:
+            pytest.skip("not a git checkout")
+        import re
+        import subprocess
+        # uncommitted CODE edits are DIFFERENT code: the stamp must
+        # distinguish them from the bare sha AND from each other (the
+        # suffix carries a hash of the diff itself); tracked burn
+        # outputs (kern*.log etc.) must not flip it — same pathspec
+        # as _git_rev
+        paths = ["bench.py", "__graft_entry__.py", "znicz_tpu",
+                 "native", "tools"]
+        diff = subprocess.run(
+            ["git", "diff", "HEAD", "--"] + paths,
+            capture_output=True, cwd=_REPO).stdout.strip()
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard",
+             "--"] + paths,
+            capture_output=True, text=True, cwd=_REPO).stdout.strip()
+        if diff or untracked:
+            assert re.fullmatch(r"[0-9a-f]{7,40}-dirty\.[0-9a-f]{8}",
+                                rev), rev
+        else:
+            assert re.fullmatch(r"[0-9a-f]{7,40}", rev), rev
+
+        class Args:
+            minibatch = 128
+        result = {}
+        bench._record_run_config(Args(), result)
+        assert result["rev"] == rev
+        assert result["minibatch"] == 128
+
+    def test_git_rev_failure_is_none_not_raise(self, monkeypatch):
+        import subprocess
+
+        def boom(*a, **k):
+            raise OSError("no git")
+        monkeypatch.setattr(subprocess, "run", boom)
+        assert bench._git_rev() is None
 
 
 class TestResolvedRouting:

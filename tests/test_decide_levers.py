@@ -18,7 +18,8 @@ dl = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(dl)
 
 
-def _row(value, mb, resolved=None, levers=None, device="TPU v5 lite"):
+def _row(value, mb, resolved=None, levers=None, device="TPU v5 lite",
+         rev=None):
     r = {"metric": "alexnet_train_images_per_sec_per_chip",
          "value": value, "minibatch": mb, "device": device}
     if resolved is not None:
@@ -28,6 +29,8 @@ def _row(value, mb, resolved=None, levers=None, device="TPU v5 lite"):
         r["resolved"] = base
     if levers is not None:
         r["levers"] = levers
+    if rev is not None:
+        r["rev"] = rev
     return r
 
 
@@ -96,7 +99,8 @@ class TestVerdicts:
             _row(4000.0, 128, resolved={"LRN_POOL": "fused1"}),
         ])
         key = (dl.canonical(_row(1.0, 128,
-                                 resolved={"LRN_POOL": "fused1"})), 128)
+                                 resolved={"LRN_POOL": "fused1"})),
+               128, None)
         assert hl[key] == 3500.0
 
     def test_s2d_compared_within_each_pair_context(self):
@@ -114,6 +118,154 @@ class TestVerdicts:
         assert len(pairs) == 2
         contexts = {p["context"] for p in pairs}
         assert contexts == {"default", "LRN_POOL=fused1"}
+
+
+class TestRevisionDiscipline:
+    """Rows measured on different code revisions neither average nor
+    pair (ADVICE r5 medium): a lever verdict drawn across a code change
+    measures the change, not the lever."""
+
+    def test_cross_revision_rows_do_not_average(self):
+        hl = dl.headline([
+            _row(3000.0, 128, resolved={"LRN_POOL": "fused1"},
+                 rev="aaa111"),
+            _row(4000.0, 128, resolved={"LRN_POOL": "fused1"},
+                 rev="bbb222"),
+        ])
+        cfg = dl.canonical(_row(1.0, 128,
+                                resolved={"LRN_POOL": "fused1"}))
+        assert hl[(cfg, 128, "aaa111")] == 3000.0
+        assert hl[(cfg, 128, "bbb222")] == 4000.0
+
+    def test_cross_revision_rows_do_not_pair(self):
+        hl = dl.headline([
+            _row(3700.0, 128, resolved={"LRN_POOL": "fused1"},
+                 rev="aaa111"),
+            _row(6500.0, 128, resolved={"LRN_POOL": "fused2"},
+                 rev="bbb222"),
+        ])
+        assert dl.compare(hl, "LRN_POOL", "fused2", "fused1") == []
+
+    def test_same_revision_rows_pair(self):
+        hl = dl.headline([
+            _row(3700.0, 128, resolved={"LRN_POOL": "fused1"},
+                 rev="aaa111"),
+            _row(6500.0, 128, resolved={"LRN_POOL": "fused2"},
+                 rev="aaa111"),
+        ])
+        pairs = dl.compare(hl, "LRN_POOL", "fused2", "fused1")
+        assert len(pairs) == 1 and pairs[0]["rev"] == "aaa111"
+
+    def test_two_single_batch_revisions_are_not_both_batches(self):
+        """A b128 pair from rev A plus a b256 pair from rev B must NOT
+        satisfy the two-batch sufficiency rule — each revision only
+        measured one batch."""
+        pairs = [
+            {"minibatch": 128, "rev": "aaa111", "context": "default",
+             "shipped_context": True, "baseline": 1000.0,
+             "challenger": 1100.0, "gain_pct": 10.0},
+            {"minibatch": 256, "rev": "bbb222", "context": "default",
+             "shipped_context": True, "baseline": 1000.0,
+             "challenger": 1100.0, "gain_pct": 10.0},
+        ]
+        assert dl._win(pairs) is None
+        assert dl.lrn_pool_verdict(pairs).startswith(
+            "insufficient-data")
+
+    def test_one_full_revision_decides_despite_partial_other(self):
+        """Rev A measured both batches (wins); rev B's lone extra pair
+        neither blocks nor double-weights the verdict."""
+        pairs = [
+            {"minibatch": mb, "rev": "aaa111", "context": "default",
+             "shipped_context": True, "baseline": 1000.0,
+             "challenger": 1100.0, "gain_pct": 10.0}
+            for mb in (128, 256)
+        ] + [{"minibatch": 128, "rev": "bbb222", "context": "default",
+              "shipped_context": True, "baseline": 1000.0,
+              "challenger": 900.0, "gain_pct": -10.0}]
+        # the single-batch rev B loss is wobble-class evidence, not a
+        # revert trigger
+        assert dl._win(pairs[:2]) is True
+        assert dl.lrn_pool_verdict(pairs).startswith(
+            "keep-default-fused2")
+
+    def test_newest_full_revision_decides_alone(self):
+        """When two revisions each carry a complete A/B, only the
+        newest (by transcript ts) decides — an older revision's loss
+        neither vetoes nor dilutes the current code's verdict."""
+        def pair(mb, gain, rev):
+            return {"minibatch": mb, "rev": rev, "context": "default",
+                    "shipped_context": True, "baseline": 1000.0,
+                    "challenger": 1000.0 * (1 + gain / 100),
+                    "gain_pct": gain}
+        pairs = [pair(128, -2.0, "old111"), pair(256, 1.0, "old111"),
+                 pair(128, 10.0, "new222"), pair(256, 9.0, "new222")]
+        order = {"old111": "2026-07-01T00:00:00Z",
+                 "new222": "2026-08-01T00:00:00Z"}
+        assert dl._win(pairs, order) is True
+        assert dl.lrn_pool_verdict(pairs, order).startswith(
+            "keep-default-fused2")
+        # flipped recency: the old revision's loss now decides
+        order = {"old111": "2026-08-02T00:00:00Z",
+                 "new222": "2026-08-01T00:00:00Z"}
+        assert dl.lrn_pool_verdict(pairs, order).startswith(
+            "revert-to-fused1")
+
+    def test_rev_order_tracks_latest_ts(self):
+        rows = [
+            _row(1.0, 128, resolved={}, rev="aaa"),
+            _row(1.0, 128, resolved={}, rev="aaa"),
+            _row(1.0, 256, resolved={}, rev="bbb"),
+        ]
+        rows[0]["ts"] = "2026-07-01T00:00:00Z"
+        rows[1]["ts"] = "2026-07-03T00:00:00Z"
+        rows[2]["ts"] = "2026-07-02T00:00:00Z"
+        order = dl.rev_order(rows)
+        assert order == {"aaa": "2026-07-03T00:00:00Z",
+                         "bbb": "2026-07-02T00:00:00Z"}
+
+    def test_unstamped_rows_never_outrank_a_stamped_revision(self):
+        """One fresh rev-less row (no-git host) must not promote the
+        legacy (rev=None) pair pool over a cleanly stamped revision:
+        rev_order never records the None pseudo-revision."""
+        fresh_none = _row(1.0, 128, resolved={})
+        fresh_none["ts"] = "2026-08-02T00:00:00Z"
+        stamped = _row(1.0, 128, resolved={}, rev="abc123")
+        stamped["ts"] = "2026-07-30T00:00:00Z"
+        order = dl.rev_order([fresh_none, stamped])
+        assert None not in order
+        assert order == {"abc123": "2026-07-30T00:00:00Z"}
+
+        def pair(mb, gain, rev):
+            return {"minibatch": mb, "rev": rev, "context": "default",
+                    "shipped_context": True, "baseline": 1000.0,
+                    "challenger": 1000.0 * (1 + gain / 100),
+                    "gain_pct": gain}
+        pairs = [pair(128, -12.0, None), pair(256, -10.0, None),
+                 pair(128, 10.0, "abc123"), pair(256, 9.0, "abc123")]
+        assert dl.lrn_pool_verdict(pairs, order).startswith(
+            "keep-default-fused2")
+
+    def test_unstamped_legacy_rows_still_pair_together(self):
+        """Pre-stamp transcripts (rev absent → None) keep pairing among
+        themselves — the discipline must not orphan history."""
+        hl = dl.headline([
+            _row(3700.0, 128, resolved={"LRN_POOL": "fused1"}),
+            _row(6500.0, 128, resolved={"LRN_POOL": "fused2"}),
+        ])
+        assert len(dl.compare(hl, "LRN_POOL", "fused2", "fused1")) == 1
+
+
+class TestLoadMissingFiles:
+    def test_missing_transcript_warns_and_skips(self, tmp_path, capsys):
+        """A fresh checkout without backlog_r4.jsonl must not
+        traceback into an empty .decisions file."""
+        real = tmp_path / "a.jsonl"
+        real.write_text('{"metric": "x", "value": 1}\n')
+        rows = dl.load([str(tmp_path / "missing.jsonl"), str(real)])
+        assert rows == [{"metric": "x", "value": 1}]
+        err = capsys.readouterr().err
+        assert "missing.jsonl" in err and "skipping" in err
 
 
 class TestVerdictRules:

@@ -31,8 +31,12 @@ ZNICZ_TPU_LRN_POOL=fused2 ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
 ZNICZ_TPU_LRN_POOL=fused1 ZNICZ_TPU_CONV1=s2d run bench.py
 ZNICZ_TPU_LRN_POOL=fused1 ZNICZ_TPU_CONV1=s2d run bench.py --minibatch 256
 # verdicts land NOW, not only at burn end — a mid-burn tunnel drop
-# must not eat the flip decision the rows above just bought
-python tools/decide_levers.py backlog_r4.jsonl "$OUT" \
+# must not eat the flip decision the rows above just bought.  On a
+# fresh checkout backlog_r4.jsonl may not exist; only pass transcripts
+# that do (decide_levers also warns-and-skips missing paths itself).
+PRIOR=""
+[ -f backlog_r4.jsonl ] && PRIOR="backlog_r4.jsonl"
+python tools/decide_levers.py $PRIOR "$OUT" \
   | tee "$OUT.decisions.early" || true
 # ORDER = decision value per minute of window: a short window must
 # buy the flip confirmation and the precision headline candidates
@@ -66,5 +70,5 @@ run bench.py --config kohonen
   date -u +"# burn2 %Y-%m-%dT%H:%M:%SZ"
   grep -h "pallas_kernel_validation\|images_per_sec\|_ablation" "$OUT"
 } >> kern_r4.log || true
-python tools/decide_levers.py backlog_r4.jsonl "$OUT" | tee "$OUT.decisions"
+python tools/decide_levers.py $PRIOR "$OUT" | tee "$OUT.decisions"
 echo "backlog part 2 complete → $OUT (+ .decisions, kern_r4.log)" >&2

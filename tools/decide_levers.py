@@ -22,7 +22,10 @@ r4 item 1, on the 1.78× on-chip b128 ablation):
 
 Rows are compared by their **resolved routing** (the ``resolved``
 field bench.py stamps since round 5 — env levers + defaults already
-applied).  Pre-round-5 rows carry only explicit env levers; they are
+applied) and their **code revision** (the ``rev`` sha stamped since
+round 6): rows from different revisions neither average nor pair, so
+a keep/revert verdict never mixes measurements of different code.
+Pre-round-5 rows carry only explicit env levers; they are
 canonicalized against the ROUND-4 defaults they actually ran under
 (LRN_POOL=fused1, CONV1=direct, CONV=xla, PALLAS=on, MXU=bf16), so
 "no levers" rows from backlog_r4.jsonl keep meaning fused1 even though
@@ -44,9 +47,19 @@ _ROUTING_KEYS = tuple(_LEGACY_DEFAULTS)
 
 
 def load(paths):
+    """Rows from every transcript that can be read; a missing or
+    unreadable file (fresh checkout, renamed burn output) warns on
+    stderr and is skipped — it must not traceback into a
+    silently-empty .decisions file."""
     rows = []
     for p in paths:
-        with open(p) as f:
+        try:
+            f = open(p)
+        except OSError as e:
+            print(f"warning: cannot read transcript {p} ({e}), "
+                  f"skipping", file=sys.stderr)
+            continue
+        with f:
             for line in f:
                 line = line.strip()
                 if not line:
@@ -83,11 +96,17 @@ def canonical(row):
 
 
 def headline(rows):
-    """{(config, minibatch): mean images/sec} for AlexNet training rows
-    on a real (non-cpu-fallback) device.  Repeated measurements of the
-    same configuration (burn re-runs, multiple transcripts) AVERAGE —
-    the ±15%-wobble argument behind the 3% threshold assumes means,
-    not an arbitrary last sample."""
+    """{(config, minibatch, rev): mean images/sec} for AlexNet training
+    rows on a real (non-cpu-fallback) device.  Repeated measurements of
+    the same configuration (burn re-runs, multiple transcripts) AVERAGE
+    — the ±15%-wobble argument behind the 3% threshold assumes means,
+    not an arbitrary last sample.
+
+    The code revision (the ``rev`` sha bench.py stamps since round 6)
+    is part of the key: rows measured on different code must neither
+    average together nor pair as an A/B — a lever verdict drawn across
+    a code change measures the change, not the lever (ADVICE r5).
+    Pre-stamp rows carry rev None and keep pairing among themselves."""
     acc = {}
     for r in rows:
         if r.get("metric") != "alexnet_train_images_per_sec_per_chip" \
@@ -95,13 +114,14 @@ def headline(rows):
             continue
         if "cpu" in str(r.get("device", "")).lower():
             continue                      # fallback rows decide nothing
-        acc.setdefault((canonical(r), r.get("minibatch")),
-                       []).append(r["value"])
+        acc.setdefault((canonical(r), r.get("minibatch"),
+                        r.get("rev")), []).append(r["value"])
     for key, vals in acc.items():
         if len(vals) > 1:
-            cfg, mb = key
+            cfg, mb, rev = key
             print(f"  averaging {len(vals)} samples for "
-                  f"{_short(cfg)} b{mb}", file=sys.stderr)
+                  f"{_short(cfg)} b{mb}"
+                  + (f" @{rev}" if rev else ""), file=sys.stderr)
     return {k: round(sum(v) / len(v), 1) for k, v in acc.items()}
 
 
@@ -124,20 +144,23 @@ def _short(cfg):
 
 def compare(hl, key, challenger, baseline):
     """All (minibatch, context) pairs where a challenger-config row has
-    a baseline twin differing ONLY in `key`."""
+    a baseline twin differing ONLY in `key` — same minibatch AND same
+    code revision (a pair straddling a code change measures the code
+    change, not the lever)."""
     pairs = []
     # rows without a minibatch field sort as 0, not TypeError
-    for (cfg, mb), v in sorted(hl.items(),
-                               key=lambda kv: (kv[0][1] or 0,
-                                               kv[0][0])):
+    for (cfg, mb, rev), v in sorted(hl.items(),
+                                    key=lambda kv: (kv[0][1] or 0,
+                                                    kv[0][0],
+                                                    kv[0][2] or "")):
         d = dict(cfg)
         if d.get(key) != challenger:
             continue
         d[key] = baseline
-        bk = (tuple(sorted(d.items())), mb)
+        bk = (tuple(sorted(d.items())), mb, rev)
         if bk in hl:
             ctx = {k: v2 for k, v2 in cfg if k != key}
-            pairs.append({"minibatch": mb, "context": _short(
+            pairs.append({"minibatch": mb, "rev": rev, "context": _short(
                 tuple(sorted(ctx.items()))),
                 # decided against the cfg itself, not the display tag
                 "shipped_context": all(
@@ -147,17 +170,64 @@ def compare(hl, key, challenger, baseline):
     return pairs
 
 
-def _win(pairs):
+def rev_order(rows):
+    """{rev: latest ISO ts} over headline-eligible rows — orders code
+    revisions by when they were last measured (ISO timestamps sort
+    lexicographically).  The rev=None pseudo-revision is never entered:
+    unstamped rows must sort OLDEST regardless of their ts, or one
+    fresh no-git row would let stale legacy pairs outrank a cleanly
+    stamped revision's verdict."""
+    order = {}
+    for r in rows:
+        if r.get("metric") != "alexnet_train_images_per_sec_per_chip" \
+                or r.get("value") is None:
+            continue
+        if "cpu" in str(r.get("device", "")).lower():
+            continue
+        rev = r.get("rev")
+        if rev is None:
+            continue
+        ts = str(r.get("ts") or "")
+        if ts >= order.get(rev, ""):
+            order[rev] = ts
+    return order
+
+
+def _qualified(pairs, order=None):
+    """Pairs from ONE revision that measured BOTH batches: the
+    two-batch sufficiency rule must hold within one code revision (a
+    b128 pair from rev A plus a b256 pair from rev B is two
+    single-batch observations of different code), and when several
+    revisions each carry a complete A/B, only the newest one decides —
+    an older revision's loss must not veto what the current code
+    measures (nor dilute its mean)."""
+    by_rev = {}
+    for p in pairs:
+        by_rev.setdefault(p.get("rev"), set()).add(p["minibatch"])
+    full = [rev for rev, mbs in by_rev.items() if len(mbs) >= 2]
+    if not full:
+        return []
+    order = order or {}
+    winner = max(full, key=lambda r: (
+        order.get(r, ""),
+        sum(1 for p in pairs if p.get("rev") == r),   # deterministic
+        r or ""))                                     # tie-breakers
+    return [p for p in pairs if p.get("rev") == winner]
+
+
+def _win(pairs, order=None):
     """The codified rule: >3% mean gain with no loss at either batch,
     and at least two measured batches (one surviving pair — the other
-    bench run timed out — is not enough evidence)."""
+    bench run timed out — is not enough evidence) — within a single
+    code revision (see _qualified)."""
+    pairs = _qualified(pairs, order)
     if len({p["minibatch"] for p in pairs}) < 2:
         return None
     gains = [p["gain_pct"] / 100 for p in pairs]
     return min(gains) > 0 and sum(gains) / len(gains) > 0.03
 
 
-def lrn_pool_verdict(pairs):
+def lrn_pool_verdict(pairs, order=None):
     """Verdict on the SHIPPED default, so only pairs measured in the
     shipped context (every other routing key at its default, i.e.
     CONV1=direct) decide it: the burn also measures fused2-vs-fused1
@@ -168,7 +238,11 @@ def lrn_pool_verdict(pairs):
     if not pairs:
         return "no-data (flip stands on the r4 ablation; re-run the " \
                "A/B)"
-    win = _win(pairs)
+    # qualify ONCE: the win test and the revert evidence below must be
+    # drawn from the same pair set (_qualified is idempotent, so the
+    # nested call inside _win re-selects the same pairs)
+    pairs = _qualified(pairs, order) or pairs
+    win = _win(pairs, order)
     if win is None:
         # one surviving batch can neither confirm nor revert a
         # default — a single noisy pair is exactly the ±15% wobble the
@@ -176,6 +250,8 @@ def lrn_pool_verdict(pairs):
         return "insufficient-data (re-run the missing batch)"
     if win:
         return "keep-default-fused2 (confirmed)"
+    # the revert is decided by the same evidence set the win rule uses:
+    # the qualified (both-batch, newest-revision) pairs selected above
     losses = [p for p in pairs if p["gain_pct"] < 0]
     if losses:
         # the shipped default's own risk note (tuning.py
@@ -187,7 +263,7 @@ def lrn_pool_verdict(pairs):
     return "marginal-keep (within wobble)"
 
 
-def conv1_verdicts(pairs):
+def conv1_verdicts(pairs, order=None):
     """Per-context verdicts: under fused2 only conv1 can take s2d,
     under fused1 the pair-fed convs can too — pooling the contexts
     would let one context's loss veto the other's win."""
@@ -196,7 +272,7 @@ def conv1_verdicts(pairs):
     out = {}
     for ctx in sorted({p["context"] for p in pairs}):
         cp = [p for p in pairs if p["context"] == ctx]
-        win = _win(cp)
+        win = _win(cp, order)
         out[ctx] = ("flip-default" if win
                     else "insufficient-data (re-run the missing batch)"
                     if win is None else "keep-off")
@@ -215,19 +291,23 @@ def main(argv):
                                    "transcript"}))
         return 1
     decisions, evidence = {}, {}
+    order = rev_order(rows)
 
     pairs = compare(hl, "LRN_POOL", "fused2", "fused1")
     evidence["LRN_POOL fused2 vs fused1"] = pairs
-    decisions["LRN_POOL"] = lrn_pool_verdict(pairs)
+    decisions["LRN_POOL"] = lrn_pool_verdict(pairs, order)
 
     pairs = compare(hl, "CONV1", "s2d", "direct")
     evidence["CONV1 s2d vs direct"] = pairs
-    decisions["CONV1"] = conv1_verdicts(pairs)
+    decisions["CONV1"] = conv1_verdicts(pairs, order)
 
-    for (cfg, mb), v in sorted(hl.items(),
-                               key=lambda kv: (kv[0][1] or 0,
-                                               _short(kv[0][0]))):
-        print(f"  {_short(cfg):36s} b{mb}: {v} img/s", file=sys.stderr)
+    for (cfg, mb, rev), v in sorted(hl.items(),
+                                    key=lambda kv: (kv[0][1] or 0,
+                                                    _short(kv[0][0]),
+                                                    kv[0][2] or "")):
+        print(f"  {_short(cfg):36s} b{mb}"
+              + (f" @{rev}" if rev else "")
+              + f": {v} img/s", file=sys.stderr)
     for lever, d in decisions.items():
         print(f"  {lever}: {d}", file=sys.stderr)
     print(json.dumps({"decisions": decisions, "evidence": evidence}))

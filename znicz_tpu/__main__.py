@@ -12,6 +12,8 @@ Examples::
     python -m znicz_tpu znicz_tpu.models.mnist --snapshot snapshots/s_best.npz
     python -m znicz_tpu wf.py cfg.py --coordinator=host:1234 \
         --num-processes=4 --process-id=0        # multi-host SPMD
+    python -m znicz_tpu serve --model model.znn --port 8100
+        # batched inference serving of a .znn export (znicz_tpu.serving)
 """
 
 from __future__ import annotations
@@ -52,6 +54,12 @@ def make_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "serve":
+        # inference serving is its own sub-CLI (a .znn path, not a
+        # workflow module) — see znicz_tpu/serving/server.py
+        from .serving.server import main as serve_main
+        return serve_main(argv[1:])
     args = make_parser().parse_args(argv)
     launcher = Launcher(
         workflow=args.workflow, config=args.config, backend=args.backend,

@@ -12,6 +12,7 @@ ctypes (no pybind11 in this environment)."""
 from __future__ import annotations
 
 import ctypes
+import dataclasses
 import os
 import struct
 
@@ -24,6 +25,91 @@ KIND = {"fc": 0, "conv": 1, "max_pool": 2, "avg_pool": 3, "lrn": 4,
         "activation": 5, "dropout": 6, "softmax": 7, "deconv": 8,
         "depool": 9, "kohonen": 10}
 ACT = {"linear": 0, "tanh": 1, "relu": 2, "strict_relu": 3, "sigmoid": 4}
+
+
+KIND_NAMES = {v: k for k, v in KIND.items()}
+ACT_NAMES = {v: k for k, v in ACT.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class ZnnLayer:
+    """One parsed .znn layer row (the Python twin of the C++ loader's
+    Layer struct; geometry ``p`` meanings per kind are documented in
+    ``native/znicz_infer.cpp``'s format comment)."""
+
+    kind: str                     # KIND key
+    activation: str               # ACT key
+    p: tuple                      # the 8-int geometry row
+    w: np.ndarray | None          # reshaped per kind (see read_znn)
+    b: np.ndarray | None
+
+
+def _reshape_params(kind: str, p, w, b):
+    """Give the raw blobs their per-kind geometry (and validate sizes
+    like the C++ loader does — a corrupt row must fail at load, not as
+    a shape error mid-jit)."""
+    shapes = {"fc": (p[0], p[1]), "conv": (p[0], p[1], p[2], p[3]),
+              "deconv": (p[0], p[1], p[2], p[3]), "lrn": (3,),
+              "kohonen": (p[0], p[1])}
+    want = shapes.get(kind)
+    if want is None:                     # parameter-less kinds
+        return w, b
+    if w is None or w.size != int(np.prod(want)):
+        raise IOError(f"{kind} layer carries "
+                      f"{0 if w is None else w.size} weights, geometry "
+                      f"says {want}")
+    n_bias = {"fc": p[1], "conv": p[3], "deconv": p[2]}.get(kind)
+    if b is not None and b.size != n_bias:
+        raise IOError(f"{kind} layer carries {b.size} bias values, "
+                      f"geometry says {n_bias}")
+    return w.reshape(want), b
+
+
+def read_znn(path: str) -> list[ZnnLayer]:
+    """Parse a .znn container back into layer rows — the exact inverse
+    of ``export_workflow``'s writer, used by the JAX serving engine
+    (``znicz_tpu.serving``) so both engines consume one format with one
+    authoritative layout comment (``native/znicz_infer.cpp``)."""
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    if blob[:4] != b"ZNN1":
+        raise IOError(f"{path!r} is not a .znn file (bad magic)")
+    if len(blob) < 8:
+        raise IOError(f"{path!r}: header truncated")
+    (n_layers,) = struct.unpack_from("<I", blob, 4)
+    off, layers = 8, []
+    for li in range(n_layers):
+        if off + 40 > len(blob):
+            raise IOError(f"{path!r}: layer {li} header truncated")
+        kind_id, act_id, *p = struct.unpack_from("<II8i", blob, off)
+        off += 40
+        if kind_id not in KIND_NAMES or act_id not in ACT_NAMES:
+            raise IOError(f"{path!r}: layer {li} has unknown "
+                          f"kind/activation ({kind_id}, {act_id})")
+        blobs = []
+        for which in ("weights", "bias"):
+            if off + 8 > len(blob):
+                raise IOError(f"{path!r}: layer {li} {which} size "
+                              f"truncated")
+            (size,) = struct.unpack_from("<Q", blob, off)
+            off += 8
+            if size * 4 > len(blob) - off:   # hostile size: no bad_alloc
+                raise IOError(f"{path!r}: layer {li} {which} blob "
+                              f"overruns the file")
+            blobs.append(np.frombuffer(blob, np.float32, int(size),
+                                       off).copy() if size else None)
+            off += int(size) * 4
+        kind = KIND_NAMES[kind_id]
+        if kind == "depool" and not (
+                0 <= p[2] < li and layers[p[2]].kind == "max_pool"):
+            # a dangling tie must fail HERE, not as a KeyError inside
+            # the first jitted forward (same standard as the blob
+            # checks; the C++ loader enforces the identical rule)
+            raise IOError(f"{path!r}: layer {li} depool ties to "
+                          f"{p[2]}, which is not an earlier max_pool")
+        w, b = _reshape_params(kind, p, *blobs)
+        layers.append(ZnnLayer(kind, ACT_NAMES[act_id], tuple(p), w, b))
+    return layers
 
 
 def _write_header(fh, n_layers: int) -> None:

@@ -1,0 +1,24 @@
+"""Batched inference serving (the inference half of the north star).
+
+The training side of the snapshot→inference story ends at ``export.py``
+(.znn) and ``native/znicz_infer.so``; this package is the part that
+*serves* a trained model under concurrent traffic:
+
+* ``engine``  — forward-only engine over a ``.znn`` file or a live
+  workflow, jit-compiled per shape bucket with an LRU executable cache;
+  falls back to the native CPU engine where JAX has no devices.
+* ``batcher`` — dynamic micro-batcher coalescing concurrent requests
+  into one device call, with a bounded admission queue, backpressure
+  and per-request deadlines.
+* ``server``  — stdlib HTTP front (same idiom as ``web_status.py``):
+  ``POST /predict``, ``GET /healthz``, ``GET /metrics``.
+
+CLI: ``python -m znicz_tpu serve --model path.znn --port N``.
+"""
+
+from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
+from .engine import ServingEngine
+from .server import ServingServer
+
+__all__ = ["DeadlineExceeded", "MicroBatcher", "QueueFull",
+           "ServingEngine", "ServingServer"]

@@ -1,0 +1,290 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into one call.
+
+The serving engine's throughput comes from batching (one device call
+amortizes dispatch and fills the MXU), but requests arrive one at a
+time.  The batcher sits between the HTTP front and the engine:
+
+* a bounded admission queue — when it is full, ``submit`` raises
+  ``QueueFull`` carrying a ``retry_after`` estimate, which the server
+  surfaces as HTTP 429 + ``Retry-After`` (loaded shedding, never a
+  silent drop);
+* a dispatch thread that takes the oldest request and waits up to
+  ``max_wait_ms`` for more (same sample shape/dtype) until ``max_batch``
+  rows are ready, then runs ONE engine forward for the whole group;
+* per-request deadlines — a request that expires in the queue fails
+  with ``DeadlineExceeded`` instead of wasting a device slot.
+
+All latency/batch-size accounting for ``/metrics`` lives here.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+
+import numpy as np
+
+
+class QueueFull(Exception):
+    """Admission queue is full; retry after ``retry_after`` seconds."""
+
+    def __init__(self, retry_after: int):
+        super().__init__(f"admission queue full; retry after "
+                         f"{retry_after}s")
+        self.retry_after = retry_after
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed before a device slot freed up."""
+
+
+class _Request:
+    __slots__ = ("x", "arrival", "deadline", "event", "result", "error",
+                 "done_at")
+
+    def __init__(self, x, deadline):
+        self.x = x
+        self.arrival = time.monotonic()
+        self.deadline = deadline          # absolute monotonic or None
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.done_at = None
+
+    @property
+    def shape_key(self):
+        return (self.x.shape[1:], str(self.x.dtype))
+
+    def finish(self, result=None, error=None):
+        self.result, self.error = result, error
+        self.done_at = time.monotonic()
+        self.event.set()
+
+
+class MicroBatcher:
+    """Coalesce ``submit``-ed requests into batched ``predict`` calls.
+
+    ``predict_fn`` is any callable ``(B, ...) -> (B, F)`` — normally
+    ``ServingEngine.predict``.  ``max_queue`` bounds ADMITTED rows
+    (requests not yet dispatched); the policy knobs are deliberately
+    few: ``max_batch`` rows per device call, ``max_wait_ms`` of
+    coalescing patience from the oldest queued request's arrival.
+    """
+
+    def __init__(self, predict_fn, *, max_batch: int = 32,
+                 max_wait_ms: float = 5.0, max_queue: int = 128):
+        self._predict = (predict_fn.predict
+                         if hasattr(predict_fn, "predict")
+                         else predict_fn)
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait_ms) / 1e3
+        self.max_queue = int(max_queue)
+        self._cond = threading.Condition()
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._closed = False
+        self._stats = collections.Counter()
+        self._batch_hist = collections.Counter()    # rows -> n calls
+        self._latencies = collections.deque(maxlen=1024)   # seconds
+        self._step_times = collections.deque(maxlen=64)    # seconds
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="znicz-microbatcher")
+        self._thread.start()
+
+    # -- client side ------------------------------------------------------
+    def submit(self, x, deadline_ms: float | None = None) -> _Request:
+        """Enqueue one request of 1+ rows; raises QueueFull under
+        backpressure.  Returns the request handle; wait on
+        ``req.event`` or use ``predict`` for the blocking form."""
+        x = np.ascontiguousarray(x, np.float32)
+        if x.ndim < 2 or len(x) == 0:
+            raise ValueError(f"expected a non-empty batched input, "
+                             f"got shape {x.shape}")
+        # deadline_ms=0 means "already due" (immediate-or-fail), not
+        # "no deadline" — only None disables it
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(x, deadline)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            # an oversized request on an IDLE queue is admitted (the
+            # engine chunks arbitrarily large batches through its top
+            # bucket) — rejecting it would 429 the same client forever
+            if self._queue and \
+                    self._queued_rows() + len(x) > self.max_queue:
+                self._stats["rejected"] += 1
+                raise QueueFull(self.retry_after())
+            self._queue.append(req)
+            self._cond.notify_all()
+        return req
+
+    def predict(self, x, deadline_ms: float | None = None,
+                timeout: float = 60.0):
+        """Blocking convenience wrapper around submit.  On timeout the
+        request is cancelled if still queued, so an abandoned client
+        doesn't consume a device slot later."""
+        req = self.submit(x, deadline_ms=deadline_ms)
+        if not req.event.wait(timeout):
+            self.cancel(req)
+            raise TimeoutError("batcher did not answer in time")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def cancel(self, req: _Request) -> bool:
+        """Remove a still-queued request (True) — a request already
+        dispatched (or finished) is left alone (False)."""
+        with self._cond:
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                return False
+            self._stats["cancelled"] += 1
+        req.finish(error=TimeoutError("cancelled by caller"))
+        return True
+
+    def queue_depth(self) -> int:
+        """Waiting request count — O(1), for health probes (metrics()
+        assembles the full payload and is much heavier)."""
+        with self._cond:
+            return len(self._queue)
+
+    def retry_after(self) -> int:
+        """Suggested client back-off: how long the current backlog
+        takes to drain at the observed per-batch service time.
+        Re-entrant under the condition's RLock (submit calls it while
+        holding; HTTP handler threads call it bare)."""
+        with self._cond:
+            step = (sum(self._step_times) / len(self._step_times)
+                    if self._step_times else 0.05)
+            backlog_batches = math.ceil(
+                max(1, self._queued_rows()) / self.max_batch)
+        return max(1, int(math.ceil(backlog_batches * step)))
+
+    # -- dispatch side ----------------------------------------------------
+    def _queued_rows(self) -> int:
+        return sum(len(r.x) for r in self._queue)
+
+    def _matching_rows(self, key) -> int:
+        return sum(len(r.x) for r in self._queue if r.shape_key == key)
+
+    def _take_batch(self):
+        """Under the lock: wait for work, coalesce up to max_batch rows
+        of the oldest request's shape, and pop them (queue order is
+        preserved for non-matching shapes)."""
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait(0.25)
+            if not self._queue:
+                return None
+            first = self._queue[0]
+            key = first.shape_key
+            batch_deadline = first.arrival + self.max_wait
+            while (not self._closed
+                   and self._matching_rows(key) < self.max_batch):
+                # the coalescing window also closes at the EARLIEST
+                # queued deadline (less a dispatch margin, so the
+                # request is served BEFORE it expires): a request with
+                # deadline_ms shorter than max_wait_ms must dispatch
+                # in time, not expire waiting for co-riders that
+                # never come
+                cutoff = min([batch_deadline]
+                             + [r.deadline - 0.05 for r in self._queue
+                                if r.shape_key == key
+                                and r.deadline is not None])
+                left = cutoff - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(left)
+            batch, rows, keep = [], 0, collections.deque()
+            for r in self._queue:
+                if (r.shape_key == key
+                        and (rows + len(r.x) <= self.max_batch
+                             or not batch)):
+                    batch.append(r)
+                    rows += len(r.x)
+                else:
+                    keep.append(r)
+            self._queue = keep
+            return batch
+
+    def _loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            now = time.monotonic()
+            live = []
+            for r in batch:
+                if r.deadline is not None and now > r.deadline:
+                    with self._cond:
+                        self._stats["expired"] += 1
+                    r.finish(error=DeadlineExceeded(
+                        "deadline passed while queued"))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            x = (live[0].x if len(live) == 1
+                 else np.concatenate([r.x for r in live]))
+            t0 = time.monotonic()
+            try:
+                y = self._predict(x)
+            except Exception as e:
+                with self._cond:
+                    self._stats["failed"] += len(live)
+                for r in live:
+                    r.finish(error=e)
+                continue
+            dt = time.monotonic() - t0
+            with self._cond:
+                self._stats["forward_calls"] += 1
+                self._stats["completed"] += len(live)
+                self._batch_hist[len(x)] += 1
+                self._step_times.append(dt)
+            off, lats = 0, []
+            for r in live:
+                r.finish(result=y[off:off + len(r.x)])
+                lats.append(r.done_at - r.arrival)
+                off += len(r.x)
+            with self._cond:      # metrics() iterates the deque
+                self._latencies.extend(lats)
+
+    # -- introspection / lifecycle ---------------------------------------
+    def metrics(self) -> dict:
+        with self._cond:
+            lat = sorted(self._latencies)
+            m = dict(self._stats)
+            m["queue_depth"] = len(self._queue)
+            m["queue_rows"] = self._queued_rows()
+            m["batch_size_histogram"] = {
+                str(k): v for k, v in sorted(self._batch_hist.items())}
+            step = (sum(self._step_times) / len(self._step_times)
+                    if self._step_times else None)
+        for k in ("completed", "rejected", "expired", "failed",
+                  "cancelled", "forward_calls"):
+            m.setdefault(k, 0)
+        m["est_step_ms"] = round(step * 1e3, 3) if step else None
+        if lat:
+            m["latency_p50_ms"] = round(
+                lat[len(lat) // 2] * 1e3, 3)
+            m["latency_p99_ms"] = round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3)
+        else:
+            m["latency_p50_ms"] = m["latency_p99_ms"] = None
+        m["max_batch"] = self.max_batch
+        m["max_wait_ms"] = self.max_wait * 1e3
+        m["max_queue"] = self.max_queue
+        return m
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            pending = list(self._queue)
+            self._queue = collections.deque()
+            self._cond.notify_all()
+        for r in pending:                  # never a silent drop
+            r.finish(error=RuntimeError("batcher closed"))
+        self._thread.join(timeout=5.0)

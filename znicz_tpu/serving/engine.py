@@ -1,0 +1,324 @@
+"""Forward-only inference engine with a shape-bucketed executable cache.
+
+Parity target: the reference's snapshot-inference contract (PAPER.md /
+SURVEY.md §2.3 — load a trained snapshot, serve its forward pass).  The
+training side already exports ``.znn`` and runs it through the C++
+engine; this engine runs the SAME container through JAX so serving gets
+device acceleration and one model format covers both hosts.
+
+Shape bucketing: XLA executables are shape-specialized, so serving raw
+request batch sizes would compile once per distinct size and the cache
+would grow without bound under organic traffic.  Requests are instead
+padded up to a fixed bucket ladder (default 1/8/32/128) and the jitted
+forward for each ``(bucket, sample_shape, dtype, device)`` is kept in a
+bounded LRU — steady-state traffic hits a handful of executables, and
+an evicted bucket simply recompiles on next use.  Oversized batches
+chunk through the largest bucket.
+
+Backend: ``auto`` uses JAX when a backend initializes and falls back to
+``export.NativeEngine`` (the C++ CPU engine) otherwise, so a host with
+no usable JAX devices can still serve.  The JAX forward deliberately
+sticks to the XLA op tier (``ops/*.xla_*``) — serving wants the
+portable, numerically-pinned path, not the Pallas training kernels.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import tempfile
+import threading
+
+import numpy as np
+
+from ..export import ZnnLayer, read_znn
+
+#: default pad-to-bucket ladder for request batch sizes
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+# deliberate local twins of ops/geometry.out_size and
+# ops/deconv.deconv_out_size: importing anything under znicz_tpu.ops
+# pulls in jax (ops/__init__ imports every tier), and output_features
+# must keep working on the JAX-less hosts the native fallback exists
+# for.  tests/test_serving.py pins these against the real ops outputs.
+def _conv_out(size: int, k: int, s: int, p: int) -> int:
+    return (size + 2 * p - k) // s + 1
+
+
+def _deconv_out(size: int, k: int, s: int, p: int) -> int:
+    return s * (size - 1) + k - 2 * p
+
+
+def output_features(layers: list[ZnnLayer], sample_shape) -> int:
+    """Flat output feature count of the forward chain for one sample of
+    ``sample_shape`` ((F,) or (H, W, C)) — pure arithmetic, no JAX, so
+    the native fallback can size its output buffer too."""
+    shape = tuple(int(d) for d in sample_shape)
+    pool_in = {}       # export-stream index -> the pool's input (h, w)
+    for li, lay in enumerate(layers):
+        p = lay.p
+        if lay.kind == "fc":
+            feats = int(np.prod(shape))
+            if feats != p[0]:
+                raise ValueError(f"layer {li}: fc expects {p[0]} "
+                                 f"features, chain carries {feats}")
+            shape = (p[1],)
+        elif lay.kind == "conv":
+            h, w, _ = shape
+            shape = (_conv_out(h, p[0], p[4], p[6]),
+                     _conv_out(w, p[1], p[5], p[7]), p[3])
+        elif lay.kind in ("max_pool", "avg_pool"):
+            h, w, c = shape
+            pool_in[li] = (h, w)
+            shape = (_conv_out(h, p[0], p[4], p[6]),
+                     _conv_out(w, p[1], p[5], p[7]), c)
+        elif lay.kind == "deconv":
+            h, w, _ = shape
+            shape = (_deconv_out(h, p[0], p[4], p[6]),
+                     _deconv_out(w, p[1], p[5], p[7]), p[2])
+        elif lay.kind == "depool":
+            # both engines emit the tied pool's RECORDED input extent,
+            # which differs from the deconv formula whenever the pool
+            # window didn't divide its input evenly
+            h, w = pool_in[p[2]]
+            shape = (h, w, shape[2])
+        elif lay.kind == "kohonen":
+            shape = (p[0],)
+        # lrn / activation / dropout / softmax keep their shape
+    return int(np.prod(shape))
+
+
+def jax_forward(layers: list[ZnnLayer], x, params=None):
+    """The .znn forward chain in jnp ops → (B, out_features) float32.
+
+    Mirrors ``native/znicz_infer.cpp`` layer for layer: dropout is the
+    inference identity, depooling replays the tied max-pool's winner
+    offsets, the kohonen head emits negated squared distances.
+
+    ``params`` (list of per-layer (w, b), e.g. already on device) lets
+    the caller pass the weights as jit ARGUMENTS so every bucket
+    executable shares one device copy instead of baking the full model
+    in as compile-time constants; None falls back to the layers' own
+    arrays.  LRN's 3 hyperparameters always come from the static layer
+    (they parameterize the trace itself)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import conv as conv_ops
+    from ..ops import deconv as deconv_ops
+    from ..ops import normalization as lrn_ops
+    from ..ops import pooling as pool_ops
+    from ..ops.activations import BY_NAME
+
+    h = x
+    pool_ctx = {}        # layer index -> (offsets, input shape, geometry)
+    for li, lay in enumerate(layers):
+        p = lay.p
+        w, b = (params[li] if params is not None else (lay.w, lay.b))
+        if lay.kind == "fc":
+            h2 = h.reshape(h.shape[0], -1)
+            if h2.shape[1] != p[0]:
+                raise ValueError(f"layer {li}: fc expects {p[0]} "
+                                 f"features, got {h2.shape[1]}")
+            pre = h2 @ w
+            if b is not None:
+                pre = pre + b
+            h = BY_NAME[lay.activation].fwd(pre, jnp)
+        elif lay.kind == "conv":
+            y = conv_ops.xla_conv2d(h, jnp.asarray(w),
+                                    (p[4], p[5]), (p[6], p[7]))
+            if b is not None:
+                y = y + b
+            h = BY_NAME[lay.activation].fwd(y, jnp)
+        elif lay.kind == "max_pool":
+            y, off = pool_ops.xla_max_pooling(
+                h, (p[0], p[1]), (p[4], p[5]), (p[6], p[7]))
+            pool_ctx[li] = (off, h.shape,
+                            ((p[0], p[1]), (p[4], p[5]), (p[6], p[7])))
+            h = y
+        elif lay.kind == "avg_pool":
+            h = pool_ops.xla_avg_pooling(
+                h, (p[0], p[1]), (p[4], p[5]), (p[6], p[7]))
+        elif lay.kind == "lrn":
+            alpha, beta, k = (float(v) for v in lay.w)
+            h = lrn_ops.xla_lrn(h, p[0], alpha, beta, k)[0]
+        elif lay.kind == "activation":
+            h = BY_NAME[lay.activation].fwd(h, jnp)
+        elif lay.kind == "dropout":
+            pass                        # inverted dropout: eval identity
+        elif lay.kind == "softmax":
+            h = jax.nn.softmax(h, axis=1)
+        elif lay.kind == "deconv":
+            y = deconv_ops.xla_deconv2d(h, jnp.asarray(w),
+                                        (p[4], p[5]), (p[6], p[7]))
+            if b is not None:
+                y = y + b
+            h = BY_NAME[lay.activation].fwd(y, jnp)
+        elif lay.kind == "depool":
+            off, in_shape, geom = pool_ctx[p[2]]
+            h = pool_ops.xla_depooling(
+                h, off, (h.shape[0],) + tuple(in_shape[1:]), *geom)
+        elif lay.kind == "kohonen":
+            h2 = h.reshape(h.shape[0], -1)
+            d = ((h2[:, None, :] - w[None, :, :]) ** 2).sum(-1)
+            h = -d
+        else:
+            raise NotImplementedError(
+                f"serving does not cover layer kind {lay.kind!r}")
+    return h.reshape(h.shape[0], -1)
+
+
+def _jax_usable() -> bool:
+    """Whether this host has an initializable JAX backend at all —
+    the fallback trigger the engine's ``backend="auto"`` keys on."""
+    try:
+        import jax
+        return len(jax.devices()) > 0
+    except Exception:
+        return False
+
+
+class ServingEngine:
+    """Load a ``.znn`` file or a live trained workflow and serve its
+    forward pass with bucketed batching.
+
+    ``predict(x)`` accepts (B, F) or (B, H, W, C) float arrays, pads B
+    up to the smallest covering bucket (chunking batches larger than
+    the top bucket), runs the per-bucket jitted executable, and returns
+    the un-padded (B, out_features) float32 result.
+    """
+
+    def __init__(self, model, *, backend: str = "auto",
+                 buckets=DEFAULT_BUCKETS, cache_size: int = 8):
+        if not buckets or list(buckets) != sorted(set(int(b)
+                                                      for b in buckets)):
+            raise ValueError(f"buckets must be unique ascending ints, "
+                             f"got {buckets!r}")
+        self.buckets = tuple(int(b) for b in buckets)
+        self.cache_size = int(cache_size)
+        self._tmpdir = None
+        if isinstance(model, (str, os.PathLike)):
+            self.path = os.fspath(model)
+        else:                 # live workflow: one format serves both
+            from ..export import export_workflow
+            self._tmpdir = tempfile.TemporaryDirectory(
+                prefix="znicz_serve_")
+            self.path = os.path.join(self._tmpdir.name, "model.znn")
+            export_workflow(model, self.path)
+        self.layers = read_znn(self.path)
+        if backend == "auto":
+            backend = "jax" if _jax_usable() else "native"
+        if backend not in ("jax", "native"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+        self._native = None
+        if backend == "native":
+            from ..export import NativeEngine
+            self._native = NativeEngine().load(self.path)
+        self._lock = threading.Lock()
+        self._cache = collections.OrderedDict()   # key -> jitted fwd
+        self._dev_params = None     # one device copy, shared by all
+        self._stats = collections.Counter()       # bucket executables
+
+    # -- executable cache -------------------------------------------------
+    def _device_key(self) -> str:
+        import jax
+        d = jax.devices()[0]
+        return f"{d.platform}:{getattr(d, 'id', 0)}"
+
+    def _params(self):
+        """The weights, device-resident ONCE and passed to every
+        bucket executable as jit arguments — N cached executables must
+        not mean N baked-in copies of the model."""
+        if self._dev_params is None:
+            import jax
+            self._dev_params = [
+                (None if la.w is None else jax.device_put(la.w),
+                 None if la.b is None else jax.device_put(la.b))
+                for la in self.layers]
+        return self._dev_params
+
+    def _executable(self, bucket: int, sample_shape, dtype):
+        """The jitted forward for one cache key, LRU-managed.  Each key
+        gets its OWN ``jax.jit`` instance so evicting the entry actually
+        releases the underlying executable."""
+        key = (bucket, tuple(sample_shape), str(dtype),
+               self._device_key())
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self._cache.move_to_end(key)
+                self._stats["cache_hits"] += 1
+                return fn
+            self._stats["cache_misses"] += 1
+            import jax
+            layers = self.layers
+            fn = jax.jit(lambda params, x: jax_forward(layers, x,
+                                                       params))
+            self._cache[key] = fn
+            while len(self._cache) > self.cache_size:
+                self._cache.popitem(last=False)
+                self._stats["cache_evictions"] += 1
+            return fn
+
+    def bucket_for(self, b: int) -> int:
+        for bucket in self.buckets:
+            if b <= bucket:
+                return bucket
+        return self.buckets[-1]
+
+    # -- prediction -------------------------------------------------------
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        if x.ndim < 2:
+            raise ValueError(f"expected a batched input, got {x.shape}")
+        if len(x) == 0:
+            raise ValueError("empty batch")
+        if self.backend == "native":
+            feats = output_features(self.layers, x.shape[1:])
+            with self._lock:
+                self._stats["forward_calls"] += 1
+                self._stats["rows_in"] += len(x)
+            return self._native.infer(x, feats)
+        top = self.buckets[-1]
+        outs = []
+        for start in range(0, len(x), top):
+            chunk = x[start:start + top]
+            bucket = self.bucket_for(len(chunk))
+            if len(chunk) < bucket:
+                pad = np.zeros((bucket - len(chunk),) + chunk.shape[1:],
+                               np.float32)
+                padded = np.concatenate([chunk, pad])
+            else:
+                padded = chunk
+            fn = self._executable(bucket, chunk.shape[1:], chunk.dtype)
+            y = np.asarray(fn(self._params(), padded))
+            with self._lock:
+                self._stats["forward_calls"] += 1
+                self._stats["rows_in"] += len(chunk)
+                self._stats["padded_rows"] += bucket - len(chunk)
+            outs.append(y[:len(chunk)])
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    # -- introspection ----------------------------------------------------
+    def metrics(self) -> dict:
+        with self._lock:
+            m = dict(self._stats)
+        m.setdefault("cache_hits", 0)
+        m.setdefault("cache_misses", 0)
+        m.setdefault("cache_evictions", 0)
+        m.setdefault("forward_calls", 0)
+        m["cached_executables"] = len(self._cache)
+        m["backend"] = self.backend
+        m["buckets"] = list(self.buckets)
+        return m
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layers)
+
+    def close(self) -> None:
+        if self._tmpdir is not None:
+            self._tmpdir.cleanup()
+            self._tmpdir = None

@@ -1,0 +1,233 @@
+"""HTTP serving front: POST /predict, GET /healthz, GET /metrics.
+
+Same stdlib ``ThreadingHTTPServer`` idiom as ``web_status.py`` — no
+tornado/twisted/asgi; each connection gets a thread that blocks on the
+micro-batcher, which is exactly the shape the batcher wants (many
+waiting producers, one dispatching consumer).
+
+Wire protocol (JSON both ways):
+
+* ``POST /predict``  body ``{"inputs": [[...], ...],
+  "deadline_ms": optional}`` → ``{"outputs": [[...], ...]}``.
+  A 1-D ``inputs`` is treated as a single sample.  Errors: 400
+  (malformed), 429 + ``Retry-After`` header (admission queue full),
+  504 (request deadline passed while queued), 503 (engine failure).
+* ``GET /healthz``   liveness + model/backend summary.
+* ``GET /metrics``   batcher counters (queue depth, batch-size
+  histogram, p50/p99 latency, rejected/expired) merged with engine
+  counters (executable-cache hits/misses/evictions, forward calls).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
+from .engine import ServingEngine
+
+
+class ServingServer:
+    """Engine + batcher behind an HTTP front (start()/stop()/url)."""
+
+    def __init__(self, engine: ServingEngine, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 batcher: MicroBatcher | None = None,
+                 max_batch: int | None = None,
+                 max_wait_ms: float | None = None,
+                 max_queue: int | None = None,
+                 default_timeout_s: float = 60.0,
+                 max_body_mb: float = 64.0):
+        knobs = (max_batch, max_wait_ms, max_queue)
+        if batcher is not None and any(k is not None for k in knobs):
+            # silently dropping the knobs would look like they applied
+            raise ValueError("pass batching knobs OR a prebuilt "
+                             "batcher, not both")
+        self.engine = engine
+        self.max_body = int(max_body_mb * 1e6)
+        self._own_batcher = batcher is None
+        self.batcher = batcher or MicroBatcher(
+            engine.predict,
+            max_batch=32 if max_batch is None else max_batch,
+            max_wait_ms=5.0 if max_wait_ms is None else max_wait_ms,
+            max_queue=128 if max_queue is None else max_queue)
+        self.default_timeout_s = default_timeout_s
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):     # keep serving logs clean
+                pass
+
+            def _reply(self, code: int, obj: dict,
+                       headers: dict | None = None):
+                body = json.dumps(obj, default=float).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0].rstrip("/")
+                if path == "/healthz":
+                    self._reply(200, outer.health())
+                elif path == "/metrics":
+                    self._reply(200, outer.metrics())
+                else:
+                    self._reply(404, {"error": f"no route {self.path!r}"})
+
+            def do_POST(self):
+                if self.path.split("?")[0].rstrip("/") != "/predict":
+                    self._reply(404, {"error": f"no route {self.path!r}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    if n > outer.max_body:
+                        # bounded admission extends to the body: a
+                        # huge request must 413, not OOM the server
+                        self._reply(413, {
+                            "error": f"body of {n} bytes exceeds the "
+                                     f"{outer.max_body}-byte limit"})
+                        return
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    x = np.asarray(payload["inputs"], np.float32)
+                    if x.ndim == 1:
+                        x = x[None]
+                    deadline_ms = payload.get("deadline_ms")
+                    if deadline_ms is not None:   # junk → 400, not 503
+                        deadline_ms = float(deadline_ms)
+                except (KeyError, TypeError, ValueError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    y = outer.batcher.predict(
+                        x, deadline_ms=deadline_ms,
+                        timeout=outer.default_timeout_s)
+                except QueueFull as e:
+                    self._reply(429, {"error": str(e),
+                                      "retry_after_s": e.retry_after},
+                                {"Retry-After": str(e.retry_after)})
+                except DeadlineExceeded as e:
+                    self._reply(504, {"error": str(e)})
+                except TimeoutError as e:
+                    # server-side wait timeout (e.g. a slow first jit
+                    # compile): retryable, and NOT an engine failure
+                    ra = outer.batcher.retry_after()
+                    self._reply(503, {"error": f"timed out waiting "
+                                               f"for an answer: {e}",
+                                      "retry_after_s": ra},
+                                {"Retry-After": str(ra)})
+                except ValueError as e:        # bad geometry for model
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:
+                    self._reply(503, {"error": f"inference failed: "
+                                               f"{e!r}"[:300]})
+                else:
+                    y = np.asarray(y)
+                    if not np.isfinite(y).all():
+                        # bare NaN/Infinity tokens are not valid JSON —
+                        # strict clients would choke on a 200 body
+                        self._reply(500, {
+                            "error": "model produced non-finite "
+                                     "outputs (inf/nan) for these "
+                                     "inputs"})
+                    else:
+                        self._reply(200, {"outputs": y.tolist()})
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True,
+                                        name="znicz-serving-http")
+
+    # -- payload builders -------------------------------------------------
+    def health(self) -> dict:
+        return {"status": "ok", "backend": self.engine.backend,
+                "n_layers": self.engine.n_layers,
+                "buckets": list(self.engine.buckets),
+                "queue_depth": self.batcher.queue_depth()}
+
+    def metrics(self) -> dict:
+        m = self.batcher.metrics()
+        m["engine"] = self.engine.metrics()
+        return m
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ServingServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._own_batcher:
+            self.batcher.close()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}/"
+
+
+def main(argv=None) -> int:
+    """CLI entry for ``python -m znicz_tpu serve``."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="znicz_tpu serve",
+        description="serve a trained model (.znn) over HTTP with "
+                    "dynamic micro-batching")
+    p.add_argument("--model", required=True,
+                   help="path to a .znn export (see export_workflow)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--backend", default="auto",
+                   choices=("auto", "jax", "native"))
+    p.add_argument("--buckets", default="1,8,32,128",
+                   help="comma-separated pad-to batch buckets")
+    p.add_argument("--cache-size", type=int, default=8,
+                   help="max cached per-bucket executables (LRU)")
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--max-queue", type=int, default=128,
+                   help="admission-queue bound (rows) before 429s")
+    p.add_argument("--timeout-s", type=float, default=60.0,
+                   help="per-request server-side answer timeout "
+                        "(raise for models whose first jit compile "
+                        "is slow)")
+    p.add_argument("--max-body-mb", type=float, default=64.0,
+                   help="largest accepted /predict body (413 beyond)")
+    args = p.parse_args(argv)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    engine = ServingEngine(args.model, backend=args.backend,
+                           buckets=buckets, cache_size=args.cache_size)
+    server = None
+    try:
+        server = ServingServer(engine, host=args.host, port=args.port,
+                               max_batch=args.max_batch,
+                               max_wait_ms=args.max_wait_ms,
+                               max_queue=args.max_queue,
+                               default_timeout_s=args.timeout_s,
+                               max_body_mb=args.max_body_mb
+                               ).start()
+        print(f"serving {args.model} [{engine.backend}] at "
+              f"{server.url} (POST /predict, GET /healthz, "
+              f"GET /metrics)", flush=True)
+        while True:
+            threading.Event().wait(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if server is not None:
+            server.stop()
+        engine.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
