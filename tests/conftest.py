@@ -28,6 +28,17 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # registered here (no pytest.ini in this repo) so `-m 'not slow'`
+    # tier-1 and `-m chaos` run without unknown-marker warnings
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection tests driven by "
+                   "znicz_tpu.resilience.FaultPlan (deterministic, "
+                   "in-process; part of tier-1)")
+
+
 @pytest.fixture(autouse=True)
 def _seeded():
     """Every test starts from the same global seed (reference StandardTest

@@ -1,0 +1,675 @@
+"""znicz_tpu.resilience: fault injection, retry/backoff, circuit
+breaker, and their wiring through serving and elastic training.
+
+The acceptance contract pinned here (ISSUE 2): with a persistent
+injected ``engine.forward`` fault the server never hangs and never
+returns a raw 500 — every request resolves as a native-fallback 200 or
+a 503 + Retry-After, ``/healthz`` reports degraded/open, and removing
+the fault closes the breaker again via a half-open probe."""
+
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from znicz_tpu.export import ACT, KIND, _pack_layer, _write_header
+from znicz_tpu.resilience import (AttemptTimeout, CircuitBreaker,
+                                  EngineUnavailable, FaultInjected,
+                                  FaultPlan, FaultSpec, RetryPolicy,
+                                  default_transient, faults)
+from znicz_tpu.serving import ServingEngine, ServingServer
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Fault plans are process-global; a failing test must not leak
+    its plan into the rest of the suite."""
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# -- fault plans -----------------------------------------------------------
+class TestFaultPlan:
+    @staticmethod
+    def _pattern(plan, site="s", n=40):
+        out = []
+        for _ in range(n):
+            try:
+                plan.fire(site)
+                out.append(0)
+            except FaultInjected:
+                out.append(1)
+        return out
+
+    def test_seeded_and_deterministic(self):
+        mk = lambda seed: FaultPlan([FaultSpec("s", p=0.5)], seed=seed)
+        pat = self._pattern(mk(11))
+        assert pat == self._pattern(mk(11))       # replayable
+        assert 0 < sum(pat) < 40                  # actually probabilistic
+        assert pat != self._pattern(mk(12))       # seed matters
+
+    def test_after_and_times_script_a_recovery(self):
+        """after=2, times=1: hits 1-2 pass, hit 3 fires, 4+ pass —
+        the fails-then-recovers shape the half-open probe tests need."""
+        plan = FaultPlan([FaultSpec("s", after=2, times=1)])
+        assert self._pattern(plan, n=6) == [0, 0, 1, 0, 0, 0]
+        assert plan.snapshot() == {"s:error": 1}
+
+    def test_sites_are_independent(self):
+        plan = FaultPlan([FaultSpec("a"), FaultSpec("b", times=1)])
+        with pytest.raises(FaultInjected):
+            plan.fire("b")
+        plan.fire("b")                 # b exhausted
+        plan.fire("unknown.site")      # unmatched: no-op
+        with pytest.raises(FaultInjected):
+            plan.fire("a")             # a unlimited
+
+    def test_latency_kind_sleeps(self):
+        plan = FaultPlan([FaultSpec("s", kind="latency",
+                                    latency_s=0.05, times=1)])
+        t0 = time.monotonic()
+        plan.fire("s")
+        assert time.monotonic() - t0 >= 0.04
+        plan.fire("s")                 # exhausted: no delay
+
+    def test_exception_type_mapping(self):
+        plan = FaultPlan([FaultSpec("a", exc="OSError"),
+                          FaultSpec("b", exc="NoSuchBuiltin"),
+                          FaultSpec("c", exc="print")])
+        with pytest.raises(OSError):
+            plan.fire("a")
+        with pytest.raises(FaultInjected):   # unknown name → default
+            plan.fire("b")
+        with pytest.raises(FaultInjected):   # non-exception builtin
+            plan.fire("c")
+
+    def test_context_manager_installs_and_uninstalls(self):
+        with FaultPlan([FaultSpec("x", times=1)]):
+            with pytest.raises(FaultInjected):
+                faults.inject("x")
+        assert faults.active() is None
+        faults.inject("x")             # no plan: no-op
+
+    def test_env_activation(self, monkeypatch, tmp_path):
+        spec = {"seed": 3, "faults": [{"site": "env.site", "times": 1,
+                                       "message": "from env"}]}
+        # inline JSON form
+        monkeypatch.setattr(faults, "_env_checked", False)
+        monkeypatch.setenv("ZNICZ_FAULT_PLAN", json.dumps(spec))
+        with pytest.raises(FaultInjected, match="from env"):
+            faults.inject("env.site")
+        faults.uninstall()
+        # @file form
+        f = tmp_path / "plan.json"
+        f.write_text(json.dumps(spec))
+        monkeypatch.setattr(faults, "_env_checked", False)
+        monkeypatch.setenv("ZNICZ_FAULT_PLAN", f"@{f}")
+        with pytest.raises(FaultInjected, match="from env"):
+            faults.inject("env.site")
+
+    def test_broken_env_plan_is_ignored(self, monkeypatch):
+        monkeypatch.setattr(faults, "_env_checked", False)
+        monkeypatch.setenv("ZNICZ_FAULT_PLAN", "{not json")
+        faults.inject("anything")      # must not raise
+        assert faults.active() is None
+
+
+# -- retry policy ----------------------------------------------------------
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise RuntimeError("transient")
+            return "ok"
+        pol = RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                          max_delay_s=0.002)
+        assert pol.call(flaky) == "ok"
+        assert calls[0] == 3
+
+    def test_exhausted_attempts_raise_last_error(self):
+        calls = [0]
+
+        def always():
+            calls[0] += 1
+            raise RuntimeError(f"boom {calls[0]}")
+        pol = RetryPolicy(max_attempts=2, base_delay_s=0.001)
+        with pytest.raises(RuntimeError, match="boom 2"):
+            pol.call(always)
+        assert calls[0] == 2
+
+    def test_non_retryable_raises_immediately(self):
+        calls = [0]
+
+        def bug():
+            calls[0] += 1
+            raise ValueError("deterministic")
+        pol = RetryPolicy(max_attempts=5, base_delay_s=0.001)
+        with pytest.raises(ValueError):
+            pol.call(bug)
+        assert calls[0] == 1           # retrying a bug hides it
+
+    def test_classifier_defaults(self):
+        assert default_transient(RuntimeError())
+        assert default_transient(OSError())
+        assert default_transient(TimeoutError())
+        assert default_transient(FaultInjected())
+        assert not default_transient(ValueError())
+        assert not default_transient(TypeError())
+        assert not default_transient(NotImplementedError())
+
+    def test_backoff_schedule_bounded_and_jittered(self):
+        sleeps = []
+        pol = RetryPolicy(max_attempts=6, base_delay_s=0.1,
+                          max_delay_s=0.4, jitter=0.5, seed=5,
+                          sleep=sleeps.append)
+        with pytest.raises(RuntimeError):
+            pol.call(lambda: (_ for _ in ()).throw(RuntimeError()))
+        raws = [0.1, 0.2, 0.4, 0.4, 0.4]      # doubling, capped
+        assert len(sleeps) == 5
+        for got, raw in zip(sleeps, raws):
+            assert raw * 0.5 <= got <= raw    # jitter ∈ [1-j, 1]·raw
+        # replayable: same seed → same schedule
+        sleeps2 = []
+        pol2 = RetryPolicy(max_attempts=6, base_delay_s=0.1,
+                           max_delay_s=0.4, jitter=0.5, seed=5,
+                           sleep=sleeps2.append)
+        with pytest.raises(RuntimeError):
+            pol2.call(lambda: (_ for _ in ()).throw(RuntimeError()))
+        assert sleeps == sleeps2
+
+    def test_on_retry_hook_sees_each_failure(self):
+        seen = []
+        pol = RetryPolicy(max_attempts=3, base_delay_s=0.001)
+        with pytest.raises(RuntimeError):
+            pol.call(lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                     on_retry=lambda n, e: seen.append((n, str(e))))
+        assert seen == [(1, "x"), (2, "x")]
+
+    def test_per_attempt_timeout(self):
+        pol = RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                          attempt_timeout_s=0.05)
+        t0 = time.monotonic()
+        with pytest.raises(AttemptTimeout):
+            pol.call(time.sleep, 5.0)
+        assert time.monotonic() - t0 < 2.0    # did NOT wait the 5s out
+        # a fast callee passes its result through
+        assert pol.call(lambda: 42) == 42
+
+
+# -- circuit breaker -------------------------------------------------------
+class TestCircuitBreaker:
+    @staticmethod
+    def _clocked(threshold=2, cooldown=10.0):
+        clock = [0.0]
+        b = CircuitBreaker(failure_threshold=threshold,
+                           cooldown_s=cooldown,
+                           clock=lambda: clock[0])
+        return b, clock
+
+    def test_full_lifecycle(self):
+        b, clock = self._clocked()
+        assert b.state == "closed" and b.allow()
+        b.record_failure()
+        assert b.state == "closed"            # below threshold
+        assert b.allow()
+        b.record_failure()
+        assert b.state == "open"              # tripped
+        assert not b.allow()                  # cooling down
+        clock[0] = 10.5
+        assert b.state == "half_open"
+        assert b.allow()                      # the probe
+        assert not b.allow()                  # ...is exclusive
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+        m = b.metrics()
+        assert m["trips"] == 1 and m["probes"] == 1
+        assert m["consecutive_failures"] == 0
+
+    def test_failed_probe_rearms_cooldown(self):
+        b, clock = self._clocked()
+        b.record_failure(), b.record_failure()
+        clock[0] = 10.5
+        assert b.allow()
+        b.record_failure()                    # probe failed
+        assert b.state == "open" and not b.allow()
+        clock[0] = 20.4                       # 9.9s since re-arm
+        assert not b.allow()
+        clock[0] = 20.6
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed"
+        assert b.metrics()["trips"] == 2
+
+    def test_straggler_failure_while_open_is_ignored(self):
+        """A request admitted pre-trip that fails post-trip must not
+        re-arm the cooldown or double-count the trip."""
+        b, clock = self._clocked()
+        b.record_failure(), b.record_failure()
+        clock[0] = 5.0
+        b.record_failure()                    # straggler
+        m = b.metrics()
+        assert m["trips"] == 1
+        clock[0] = 10.5                       # original cooldown stands
+        assert b.allow()
+
+    def test_abandon_frees_the_probe_slot(self):
+        b, clock = self._clocked()
+        b.record_failure(), b.record_failure()
+        clock[0] = 10.5
+        assert b.allow() and not b.allow()
+        b.abandon()                           # probe never ran the dep
+        assert b.allow()                      # slot available again
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_abandon_from_non_owner_thread_is_a_noop(self):
+        """A straggler admitted pre-trip that errors out must not
+        release another thread's in-flight half-open probe."""
+        b, clock = self._clocked()
+        b.record_failure(), b.record_failure()
+        clock[0] = 10.5
+        assert b.allow()                      # this thread holds probe
+        t = threading.Thread(target=b.abandon)
+        t.start(), t.join()
+        assert not b.allow()                  # probe slot still held
+        b.abandon()                           # owner may free it
+        assert b.allow()
+
+    def test_retry_after_counts_down(self):
+        b, clock = self._clocked(cooldown=8.0)
+        assert b.retry_after() == 1.0         # closed: nominal
+        b.record_failure(), b.record_failure()
+        assert b.retry_after() == 8.0
+        clock[0] = 5.0
+        assert b.retry_after() == pytest.approx(3.0)
+        clock[0] = 7.9
+        assert b.retry_after() == 1.0         # floor for headers
+
+    def test_success_resets_consecutive_count(self):
+        b, _ = self._clocked(threshold=3)
+        b.record_failure(), b.record_failure()
+        b.record_success()
+        b.record_failure(), b.record_failure()
+        assert b.state == "closed"            # never 3 consecutive
+
+
+# -- serving engine under injected faults ----------------------------------
+def _write_mlp(path, fin=4, hidden=3, classes=2, seed=0):
+    gen = np.random.default_rng(seed)
+    w1 = gen.standard_normal((fin, hidden)).astype(np.float32)
+    b1 = gen.standard_normal(hidden).astype(np.float32)
+    w2 = gen.standard_normal((hidden, classes)).astype(np.float32)
+    with open(path, "wb") as fh:
+        _write_header(fh, 3)
+        _pack_layer(fh, KIND["fc"], ACT["tanh"], [fin, hidden], w1, b1)
+        _pack_layer(fh, KIND["fc"], ACT["linear"], [hidden, classes],
+                    w2)
+        _pack_layer(fh, KIND["softmax"], 0, [])
+    return w1, b1, w2
+
+
+def _mlp_reference(x, w1, b1, w2):
+    h = 1.7159 * np.tanh(0.6666 * (x @ w1 + b1))
+    logits = h @ w2
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _engine(path, threshold=2, cooldown=0.3, attempts=2):
+    return ServingEngine(
+        path, backend="jax", buckets=(1, 2),
+        retry=RetryPolicy(max_attempts=attempts, base_delay_s=0.001,
+                          max_delay_s=0.005),
+        breaker=CircuitBreaker(failure_threshold=threshold,
+                               cooldown_s=cooldown))
+
+
+@pytest.mark.chaos
+class TestEngineDegradation:
+    def test_persistent_fault_falls_back_to_native(self, tmp_path):
+        """The tentpole arc at engine level: transient retries, breaker
+        trips, native CPU fallback serves bit-compatible answers."""
+        path = str(tmp_path / "m.znn")
+        w1, b1, w2 = _write_mlp(path)
+        eng = _engine(path, cooldown=60.0)    # no probes mid-test
+        x = np.random.default_rng(1).standard_normal(
+            (2, 4)).astype(np.float32)
+        ref = _mlp_reference(x, w1, b1, w2)
+        try:
+            with FaultPlan([FaultSpec("engine.forward")]):  # persistent
+                for _ in range(3):            # trip (2) + post-trip (1)
+                    y = eng.predict(x)        # never raises: degraded
+                    np.testing.assert_allclose(y, ref, rtol=1e-4,
+                                               atol=1e-5)
+            m = eng.metrics()
+            assert m["breaker"]["state"] == "open"
+            assert m["breaker"]["trips"] == 1
+            assert m["forward_failures"] == 2  # 3rd skipped jax entirely
+            assert m["retries"] == 2           # one retry per failure
+            assert m["fallback_calls"] == 3
+            assert m["forward_calls"] == 0     # jax never succeeded
+            assert eng.resilience_state() == "degraded"
+        finally:
+            eng.close()
+
+    def test_no_fallback_raises_engine_unavailable(self, tmp_path):
+        path = str(tmp_path / "m.znn")
+        _write_mlp(path)
+        eng = _engine(path, cooldown=60.0)
+        eng._native_failed = True             # host without the .so
+        x = np.zeros((1, 4), np.float32)
+        try:
+            with FaultPlan([FaultSpec("engine.forward")]):
+                for _ in range(3):
+                    with pytest.raises(EngineUnavailable) as ei:
+                        eng.predict(x)
+                    assert ei.value.retry_after >= 1
+            assert eng.resilience_state() == "open"
+        finally:
+            eng.close()
+
+    def test_recovery_closes_breaker_via_half_open_probe(self, tmp_path):
+        path = str(tmp_path / "m.znn")
+        w1, b1, w2 = _write_mlp(path)
+        eng = _engine(path, cooldown=0.15)
+        x = np.ones((1, 4), np.float32)
+        ref = _mlp_reference(x, w1, b1, w2)
+        try:
+            # fault burns out exactly when the breaker opens (2 requests
+            # x 2 attempts), so the first probe finds a healthy device
+            with FaultPlan([FaultSpec("engine.forward", times=4)]):
+                eng.predict(x), eng.predict(x)
+                assert eng.breaker.state == "open"
+                time.sleep(0.2)               # cooldown elapses
+                y = eng.predict(x)            # half-open probe: jax
+                np.testing.assert_allclose(y, ref, rtol=1e-4,
+                                           atol=1e-5)
+            assert eng.breaker.state == "closed"
+            assert eng.resilience_state() == "ok"
+            m = eng.metrics()
+            assert m["breaker"]["probes"] == 1
+            assert m["forward_calls"] == 1    # the successful probe
+        finally:
+            eng.close()
+
+    def test_deterministic_errors_bypass_retry_and_breaker(self,
+                                                           tmp_path):
+        """Bad geometry is the CLIENT's bug: no retry, no breaker
+        state, no fallback — the front owes a 400, not a 503."""
+        path = str(tmp_path / "m.znn")
+        _write_mlp(path)                      # expects 4 features
+        eng = _engine(path)
+        try:
+            with pytest.raises(ValueError):
+                eng.predict(np.zeros((1, 7), np.float32))
+            m = eng.metrics()
+            assert m["breaker"]["state"] == "closed"
+            assert m["breaker"]["consecutive_failures"] == 0
+            assert m["retries"] == 0 and m["fallback_calls"] == 0
+            # and the engine still serves fine afterwards
+            assert eng.predict(np.zeros((1, 4), np.float32)).shape \
+                == (1, 2)
+        finally:
+            eng.close()
+
+
+# -- end-to-end serving acceptance -----------------------------------------
+def _post(url, payload, timeout=30.0):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(url + "predict", data=body,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _health(url):
+    with urllib.request.urlopen(url + "healthz", timeout=10) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.chaos
+class TestServerGracefulDegradation:
+    def test_acceptance_no_hang_no_500_then_recovery(self, tmp_path):
+        """ISSUE 2 acceptance: persistent engine.forward fault → every
+        request is a fallback 200 or 503 + Retry-After (never a raw
+        500, never a hang), healthz reports degraded/open, and
+        removing the fault closes the breaker via a half-open probe."""
+        path = str(tmp_path / "m.znn")
+        w1, b1, w2 = _write_mlp(path)
+        eng = _engine(path, threshold=2, cooldown=0.3, attempts=1)
+        server = ServingServer(eng, max_wait_ms=1.0,
+                               default_timeout_s=20.0).start()
+        x = [[0.5, -0.5, 0.25, 1.0]]
+        ref = _mlp_reference(np.asarray(x, np.float32), w1, b1, w2)
+        plan = FaultPlan([FaultSpec("engine.forward")])   # persistent
+        try:
+            faults.install(plan)
+            codes = []
+            for _ in range(6):
+                status, out, headers = _post(server.url, {"inputs": x})
+                codes.append(status)
+                assert status in (200, 503), out
+                if status == 200:     # fallback answers, correctly
+                    np.testing.assert_allclose(
+                        np.asarray(out["outputs"]), ref,
+                        rtol=1e-4, atol=1e-5)
+                else:
+                    assert "Retry-After" in headers
+                    assert out["retry_after_s"] >= 1
+            assert 200 in codes       # native fallback did serve
+            health = _health(server.url)
+            assert health["status"] == "degraded"
+            assert health["breaker"]["trips"] >= 1
+            assert health["retry_after_s"] >= 1
+            m = server.metrics()
+            assert m["engine"]["breaker"]["state"] in ("open",
+                                                       "half_open")
+
+            # fault removed: a half-open probe must close the circuit
+            faults.uninstall(plan)
+            time.sleep(0.35)
+            status, out, _ = _post(server.url, {"inputs": x})
+            assert status == 200
+            np.testing.assert_allclose(np.asarray(out["outputs"]), ref,
+                                       rtol=1e-4, atol=1e-5)
+            assert eng.breaker.state == "closed"
+            assert _health(server.url)["status"] == "ok"
+        finally:
+            faults.uninstall(plan)
+            server.stop()
+            eng.close()
+
+    def test_concurrent_requests_all_resolve_under_fault(self, tmp_path):
+        """No request may hang or 500 even when a whole coalesced batch
+        fails at once."""
+        path = str(tmp_path / "m.znn")
+        _write_mlp(path)
+        eng = _engine(path, threshold=2, cooldown=60.0, attempts=1)
+        server = ServingServer(eng, max_batch=4, max_wait_ms=20.0,
+                               default_timeout_s=20.0).start()
+        n = 8
+        codes = [None] * n
+        try:
+            with FaultPlan([FaultSpec("engine.forward")]):
+                barrier = threading.Barrier(n)
+
+                def worker(i):
+                    barrier.wait()
+                    codes[i], _, _ = _post(
+                        server.url,
+                        {"inputs": [[0.1 * i, 0.0, 0.0, 0.0]]})
+                threads = [threading.Thread(target=worker, args=(i,))
+                           for i in range(n)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(30.0)
+                assert not any(t.is_alive() for t in threads)
+            assert all(c in (200, 503) for c in codes), codes
+        finally:
+            server.stop()
+            eng.close()
+
+    def test_no_fallback_host_answers_503_and_health_open(self,
+                                                          tmp_path):
+        path = str(tmp_path / "m.znn")
+        _write_mlp(path)
+        eng = _engine(path, threshold=1, cooldown=60.0, attempts=1)
+        eng._native_failed = True
+        server = ServingServer(eng, max_wait_ms=1.0,
+                               default_timeout_s=20.0).start()
+        try:
+            with FaultPlan([FaultSpec("engine.forward")]):
+                for _ in range(2):
+                    status, out, headers = _post(
+                        server.url, {"inputs": [[0.0] * 4]})
+                    assert status == 503
+                    assert "Retry-After" in headers
+            assert _health(server.url)["status"] == "open"
+        finally:
+            server.stop()
+            eng.close()
+
+
+# -- checkpoint + dispatch fault sites --------------------------------------
+@pytest.mark.chaos
+class TestCheckpointAndDispatchSites:
+    @staticmethod
+    def _tiny_workflow():
+        from znicz_tpu import prng
+        from znicz_tpu.backends import Device
+        from znicz_tpu.config import root
+        from znicz_tpu.models import mnist
+        saved = root.mnist.synthetic.to_dict()
+        root.mnist.synthetic.update({"n_train": 60, "n_valid": 20,
+                                     "n_test": 0})
+        try:
+            prng.seed_all(9)
+            wf = mnist.MnistWorkflow()
+            wf.initialize(device=Device.create("numpy"))
+        finally:
+            root.mnist.synthetic.update(saved)
+        return wf
+
+    def test_checkpoint_save_retries_through_transient_fault(
+            self, tmp_path):
+        """CheckpointRecovery.save survives a save attempt dying at the
+        checkpoint.save site — the atomic rename means the retry finds
+        clean state, and the snapshot round-trips."""
+        from znicz_tpu.parallel import distributed as dist
+        wf = self._tiny_workflow()
+        rec = dist.CheckpointRecovery(
+            wf, directory=str(tmp_path),
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.001))
+        with FaultPlan([FaultSpec("checkpoint.save", times=1,
+                                  exc="OSError")]):
+            path = rec.save()                 # retried internally
+        assert path.endswith("recovery_current.npz")
+        wf2 = self._tiny_workflow()
+        assert rec.__class__(wf2, directory=str(tmp_path)
+                             ).resume_if_found() is not None
+        # exhausting the retry budget surfaces the failure
+        with FaultPlan([FaultSpec("checkpoint.save", exc="OSError")]):
+            with pytest.raises(OSError):
+                rec.save()
+        # resume path: a transient read blip also retries
+        with FaultPlan([FaultSpec("checkpoint.load", times=1,
+                                  exc="OSError")]):
+            assert rec.resume_if_found() is not None
+
+    def test_batcher_dispatch_latency_site(self):
+        """Injected dispatch latency slows answers without failing
+        them — the deadline/backpressure knobs stay in charge."""
+        from znicz_tpu.serving import MicroBatcher
+        mb = MicroBatcher(lambda x: x.sum(axis=1, keepdims=True),
+                          max_batch=4, max_wait_ms=1.0)
+        try:
+            with FaultPlan([FaultSpec("batcher.dispatch",
+                                      kind="latency", latency_s=0.05,
+                                      times=1)]):
+                t0 = time.monotonic()
+                y = mb.predict(np.ones((1, 3), np.float32),
+                               timeout=10.0)
+            assert time.monotonic() - t0 >= 0.04
+            np.testing.assert_allclose(y, [[3.0]])
+        finally:
+            mb.close()
+
+
+# -- elastic runner resilience ---------------------------------------------
+class TestElasticResilience:
+    @staticmethod
+    def _crasher(msg="boom", rc=3):
+        def make(coord, pid, nproc):
+            return [sys.executable, "-c",
+                    (f"import sys; sys.stderr.write('{msg} p' + "
+                     f"sys.argv[1]); sys.exit({rc})"), str(pid)]
+        return make
+
+    def test_crash_loop_fails_fast_with_aggregated_tails(self):
+        from znicz_tpu.parallel.elastic import ElasticRunner
+        sleeps = []
+        r = ElasticRunner(self._crasher(), 2, max_restarts=10,
+                          poll_interval=0.05, crash_loop_threshold=3,
+                          crash_loop_window_s=60.0, backoff_base_s=0.01,
+                          sleep_fn=sleeps.append)
+        with pytest.raises(RuntimeError, match="crash loop") as ei:
+            r.run()
+        assert "boom" in str(ei.value)       # tails in the message
+        assert r.restarts == 2               # failed fast, not at 10
+        assert len(sleeps) == 2              # backoff between rounds
+        st = r.status()
+        assert st["state"] == "crash_loop"
+        assert st["failure_count"] == 3
+
+    def test_status_reports_every_dead_worker(self, tmp_path):
+        from znicz_tpu.parallel.elastic import ElasticRunner
+        # both workers die instantly; a slow first poll observes both
+        r = ElasticRunner(self._crasher(), 2, max_restarts=0,
+                          poll_interval=0.4, crash_loop_threshold=99,
+                          backoff_base_s=0.01, sleep_fn=lambda s: None,
+                          log_dir=str(tmp_path))
+        with pytest.raises(RuntimeError, match="max_restarts"):
+            r.run()
+        lf = r.status()["last_failure"]
+        assert lf["kind"] == "crash"
+        assert [w["process"] for w in lf["workers"]] == [0, 1]
+        for w in lf["workers"]:
+            assert w["returncode"] == 3
+            assert f"boom p{w['process']}" in w["log_tail"]
+
+    def test_backoff_schedule_bounded(self):
+        from znicz_tpu.parallel.elastic import ElasticRunner
+        r = ElasticRunner(lambda *a: [], 1, backoff_base_s=0.5,
+                          backoff_max_s=4.0)
+        for i in range(1, 12):
+            d = r.backoff_s(i)
+            raw = min(4.0, 0.5 * 2 ** (i - 1))
+            assert raw * 0.5 <= d <= raw     # jittered, capped
+
+    def test_timeout_failure_is_recorded_structured(self):
+        from znicz_tpu.parallel.elastic import ElasticRunner
+
+        def hang(coord, pid, nproc):
+            return [sys.executable, "-c",
+                    "import time; time.sleep(3600)"]
+        r = ElasticRunner(hang, 1, max_restarts=0, round_timeout=1.0,
+                          poll_interval=0.05, backoff_base_s=0.01,
+                          sleep_fn=lambda s: None)
+        with pytest.raises(RuntimeError, match="max_restarts"):
+            r.run()
+        lf = r.status()["last_failure"]
+        assert lf["kind"] == "timeout"
+        assert len(lf["workers"]) == 1

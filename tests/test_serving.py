@@ -535,6 +535,43 @@ class TestServingEndToEnd:
         finally:
             server.stop()
 
+    def test_bad_bodies_get_json_400_never_500(self, wine_engine):
+        """ISSUE 2 satellite pin: malformed JSON / wrong-shape input
+        answers a parseable JSON 400 error body — no case may escape
+        the parse guard and surface as a raw 500."""
+        _, engine = wine_engine
+        server = ServingServer(engine).start()
+        try:
+            # body that is not JSON at all
+            req = urllib.request.Request(server.url + "predict",
+                                         data=b"{definitely not json",
+                                         method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+            assert "error" in json.loads(ei.value.read())
+            # valid JSON whose top level is not an object
+            status, out, _ = _post(server.url, [1, 2, 3])
+            assert status == 400 and "error" in out
+            status, out, _ = _post(server.url, "inputs")
+            assert status == 400 and "error" in out
+            # ragged rows cannot form an array
+            status, out, _ = _post(
+                server.url, {"inputs": [[1.0, 2.0], [3.0]]})
+            assert status == 400 and "error" in out
+            # wrong feature count for THIS model (wine wants 13)
+            status, out, _ = _post(server.url, {"inputs": [[1.0, 2.0]]})
+            assert status == 400 and "error" in out
+            # null inputs
+            status, out, _ = _post(server.url, {"inputs": None})
+            assert status == 400 and "error" in out
+            # the engine's breaker must not have charged any of this
+            assert engine.metrics()["breaker"]["state"] == "closed"
+            assert engine.metrics()["breaker"]["consecutive_failures"] \
+                == 0
+        finally:
+            server.stop()
+
     def test_non_finite_outputs_are_500_not_invalid_json(self,
                                                          wine_engine):
         """NaN/Infinity tokens are not RFC 8259 JSON — a model blowing

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Serving-under-fault smoke (ISSUE 2 acceptance, operator-runnable):
+# boot the HTTP serving stack under a canned engine.forward fault plan
+# and assert graceful degradation end to end — every request resolves
+# as a native-fallback 200 or 503 + Retry-After (never a hang, never a
+# raw 500), /healthz goes degraded while the circuit is open, and the
+# breaker closes again via a half-open probe once the fault clears.
+#
+# Usage:  bash tools/chaos_smoke.sh [chaos-mode args...]
+#         (e.g. --model my.znn --plan @plan.json --requests 20;
+#          see `python -m znicz_tpu chaos --help` / docs/resilience.md)
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m znicz_tpu chaos "$@"

@@ -14,6 +14,9 @@ Examples::
         --num-processes=4 --process-id=0        # multi-host SPMD
     python -m znicz_tpu serve --model model.znn --port 8100
         # batched inference serving of a .znn export (znicz_tpu.serving)
+    python -m znicz_tpu chaos
+        # serving-under-fault smoke: boots the server under a canned
+        # fault plan and checks graceful degradation (resilience.chaos)
 """
 
 from __future__ import annotations
@@ -60,6 +63,11 @@ def main(argv=None) -> int:
         # workflow module) — see znicz_tpu/serving/server.py
         from .serving.server import main as serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "chaos":
+        # fault-injection smoke of the serving stack — see
+        # znicz_tpu/resilience/chaos.py and tools/chaos_smoke.sh
+        from .resilience.chaos import main as chaos_main
+        return chaos_main(argv[1:])
     args = make_parser().parse_args(argv)
     launcher = Launcher(
         workflow=args.workflow, config=args.config, backend=args.backend,

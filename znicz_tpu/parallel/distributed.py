@@ -34,10 +34,19 @@ from . import mesh as mesh_lib
 
 def initialize(coordinator: str | None = None,
                num_processes: int | None = None,
-               process_id: int | None = None) -> None:
+               process_id: int | None = None,
+               retry: "RetryPolicy | None" = None) -> None:
     """Bootstrap multi-host JAX (idempotent).  Arguments may come from
     the environment (JAX_COORDINATOR_ADDRESS / NUM_PROCESSES /
-    PROCESS_ID) — the launcher passes CLI flags through here."""
+    PROCESS_ID) — the launcher passes CLI flags through here.
+
+    The coordinator handshake is the ``relay.connect`` fault site and
+    retries under ``retry`` (default: 3 attempts, 0.5–5 s backoff) —
+    on a preempted pod the coordinator routinely comes up seconds
+    after its workers, and one refused TCP connect must not kill a
+    worker the ElasticRunner would only restart anyway."""
+    from ..resilience import faults
+    from ..resilience.retry import RetryPolicy
     coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if coordinator is None:
         return   # single-process: nothing to negotiate
@@ -46,7 +55,14 @@ def initialize(coordinator: str | None = None,
         kwargs["num_processes"] = int(num_processes)
     if process_id is not None:
         kwargs["process_id"] = int(process_id)
-    jax.distributed.initialize(**kwargs)
+    policy = retry if retry is not None else RetryPolicy(
+        max_attempts=3, base_delay_s=0.5, max_delay_s=5.0)
+
+    def _connect():
+        faults.inject("relay.connect")
+        jax.distributed.initialize(**kwargs)
+
+    policy.call(_connect)
 
 
 def global_mesh(n_model: int = 1) -> "jax.sharding.Mesh":
@@ -111,15 +127,25 @@ def distribute(workflow, mesh) -> dict:
 class CheckpointRecovery:
     """Failure recovery loop: snapshot every N epochs, resume after a
     crash (reference: master requeued a lost slave's job; with SPMD the
-    whole program restarts from the last snapshot — SURVEY.md §5)."""
+    whole program restarts from the last snapshot — SURVEY.md §5).
+
+    Save and resume retry under ``retry`` (default 3 attempts, short
+    backoff): a transient filesystem blip mid-checkpoint is common on
+    network mounts, and the atomic single-rename save makes a retry
+    always safe — a failed attempt can never leave a torn snapshot
+    behind for the retry to trip on."""
 
     def __init__(self, workflow, directory="snapshots",
-                 prefix="recovery", interval=1):
+                 prefix="recovery", interval=1,
+                 retry: "RetryPolicy | None" = None):
+        from ..resilience.retry import RetryPolicy
         from ..snapshotter import SnapshotterToFile
         self.workflow = workflow
         self.snap = SnapshotterToFile(workflow, prefix=prefix,
                                       directory=directory,
                                       interval=interval)
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay_s=0.2, max_delay_s=2.0)
         # standalone use: not linked into the control graph
         workflow.units.remove(self.snap) \
             if self.snap in workflow.units else None
@@ -133,7 +159,7 @@ class CheckpointRecovery:
         """Checkpoint now (call between epochs; process 0 writes)."""
         if jax.process_index() != 0:
             return self.path
-        return self.snap.save("current")
+        return self.retry.call(self.snap.save, "current")
 
     def resume_if_found(self) -> dict | None:
         """Restore the latest checkpoint into the (initialized) workflow;
@@ -141,4 +167,5 @@ class CheckpointRecovery:
         from ..snapshotter import SnapshotterToFile
         if not os.path.exists(self.path):
             return None
-        return SnapshotterToFile.load(self.workflow, self.path)
+        return self.retry.call(SnapshotterToFile.load,
+                               self.workflow, self.path)

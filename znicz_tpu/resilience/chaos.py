@@ -1,0 +1,180 @@
+"""``python -m znicz_tpu chaos`` — serving-under-fault smoke mode.
+
+Boots the real HTTP serving stack (engine + micro-batcher + server)
+under a canned :class:`~.faults.FaultPlan`, drives traffic through the
+whole breaker lifecycle, and verifies the graceful-degradation
+contract end to end:
+
+* with a persistent ``engine.forward`` fault every request still
+  resolves — native-fallback 200 or 503 + Retry-After, never a raw 500
+  and never a hang;
+* ``/healthz`` leaves ``ok`` while the circuit is open (``degraded`` /
+  ``open``);
+* once the fault clears, a half-open probe closes the breaker and
+  ``/healthz`` returns to ``ok``.
+
+Exit code 0 when every invariant holds — tools/chaos_smoke.sh wires
+this into CI-ish usage.  The same ``FaultPlan`` mechanism drives the
+pytest ``chaos`` marker; this mode exists so an operator can smoke a
+REAL server (their model, their knobs) without pytest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from . import faults
+from .breaker import CircuitBreaker
+from .retry import RetryPolicy
+
+
+def _write_demo_znn(path: str, fin: int = 4, hidden: int = 3,
+                    classes: int = 2) -> None:
+    """A tiny deterministic fc(tanh)+fc+softmax model — enough layers
+    to exercise the full forward without slow jit compiles."""
+    from ..export import ACT, KIND, _pack_layer, _write_header
+    gen = np.random.default_rng(7)
+    w1 = gen.standard_normal((fin, hidden)).astype(np.float32)
+    b1 = gen.standard_normal(hidden).astype(np.float32)
+    w2 = gen.standard_normal((hidden, classes)).astype(np.float32)
+    with open(path, "wb") as fh:
+        _write_header(fh, 3)
+        _pack_layer(fh, KIND["fc"], ACT["tanh"], [fin, hidden], w1, b1)
+        _pack_layer(fh, KIND["fc"], ACT["linear"], [hidden, classes], w2)
+        _pack_layer(fh, KIND["softmax"], 0, [])
+
+
+def _post(url: str, payload: dict, timeout: float = 30.0):
+    """(status, body) — errors become their status code, a connection
+    hang becomes the invariant failure it is."""
+    req = urllib.request.Request(
+        url + "predict", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _health(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url + "healthz", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="znicz_tpu chaos",
+        description="smoke the serving stack under an injected "
+                    "engine.forward fault (see docs/resilience.md)")
+    p.add_argument("--model", default=None,
+                   help=".znn to serve (default: a tiny built-in demo "
+                        "model)")
+    p.add_argument("--plan", default=None,
+                   help="fault plan: inline JSON or @file (default: a "
+                        "canned engine.forward fault that exhausts "
+                        "after tripping the breaker)")
+    p.add_argument("--requests", type=int, default=8,
+                   help="requests to fire while the fault is live")
+    p.add_argument("--breaker-threshold", type=int, default=2)
+    p.add_argument("--cooldown-s", type=float, default=1.0)
+    p.add_argument("--retry-attempts", type=int, default=2)
+    args = p.parse_args(argv)
+
+    from ..serving.engine import ServingEngine
+    from ..serving.server import ServingServer
+
+    tmp = None
+    model = args.model
+    if model is None:
+        tmp = tempfile.TemporaryDirectory(prefix="znicz_chaos_")
+        model = os.path.join(tmp.name, "demo.znn")
+        _write_demo_znn(model)
+
+    if args.plan is not None:
+        plan = faults.parse_plan(args.plan)
+    else:
+        # fail exactly long enough to trip the breaker through the
+        # retries, then recover — the full closed→open→half_open→
+        # closed arc (each pre-trip request burns retry_attempts
+        # firings; the half-open probe must find the fault gone)
+        times = args.retry_attempts * args.breaker_threshold
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "engine.forward", times=times,
+            message="chaos: injected transient device fault")], seed=7)
+    faults.install(plan)
+
+    engine = ServingEngine(
+        model, backend="jax", buckets=(1, 2),
+        retry=RetryPolicy(max_attempts=args.retry_attempts,
+                          base_delay_s=0.01, max_delay_s=0.05),
+        breaker=CircuitBreaker(failure_threshold=args.breaker_threshold,
+                               cooldown_s=args.cooldown_s))
+    server = ServingServer(engine, max_wait_ms=1.0).start()
+    x = [[0.1, -0.2, 0.3, 0.4]]
+    codes, bad = [], []
+    try:
+        for i in range(args.requests):
+            status, body, headers = _post(server.url, {"inputs": x})
+            health = _health(server.url)["status"]
+            codes.append(status)
+            if status not in (200, 503):
+                bad.append(f"request {i}: unexpected status {status} "
+                           f"({body.get('error')})")
+            if status == 503 and "Retry-After" not in headers:
+                bad.append(f"request {i}: 503 without Retry-After")
+            print(json.dumps({"request": i, "status": status,
+                              "health": health,
+                              "breaker": engine.breaker.state}))
+        # fault plan exhausted by now: wait out the cooldown, then one
+        # request must probe half-open and close the circuit
+        time.sleep(args.cooldown_s + 0.1)
+        status, body, _ = _post(server.url, {"inputs": x})
+        health = _health(server.url)
+        print(json.dumps({"request": "post-recovery", "status": status,
+                          "health": health["status"],
+                          "breaker": engine.breaker.state}))
+        if status != 200:
+            bad.append(f"post-recovery request got {status}, "
+                       f"expected 200")
+        if engine.breaker.state != "closed":
+            bad.append(f"breaker did not close after recovery "
+                       f"(state={engine.breaker.state})")
+        if health["status"] != "ok":
+            bad.append(f"healthz stuck at {health['status']!r} "
+                       f"after recovery")
+        m = engine.breaker.metrics()
+        summary = {"codes": codes, "fired": plan.snapshot(),
+                   "breaker": m, "engine": {
+                       k: v for k, v in engine.metrics().items()
+                       if k in ("forward_calls", "forward_failures",
+                                "fallback_calls", "retries")},
+                   "ok": not bad, "violations": bad}
+        print(json.dumps(summary))
+    finally:
+        faults.uninstall(plan)
+        server.stop()
+        engine.close()
+        if tmp is not None:
+            tmp.cleanup()
+    if bad:
+        return 1
+    if m["trips"] < 1:
+        print(json.dumps({"ok": False, "violations":
+                          ["fault never tripped the breaker — plan "
+                           "too weak for the configured threshold"]}))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

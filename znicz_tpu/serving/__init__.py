@@ -13,12 +13,19 @@ The training side of the snapshot→inference story ends at ``export.py``
 * ``server``  — stdlib HTTP front (same idiom as ``web_status.py``):
   ``POST /predict``, ``GET /healthz``, ``GET /metrics``.
 
-CLI: ``python -m znicz_tpu serve --model path.znn --port N``.
+Degradation (znicz_tpu.resilience): transient device errors retry,
+persistent ones trip a circuit breaker and predicts route to the
+native CPU fallback or answer 503 + Retry-After — ``/healthz`` turns
+``degraded``/``open`` so balancers can react (docs/resilience.md).
+
+CLI: ``python -m znicz_tpu serve --model path.znn --port N``;
+chaos smoke: ``python -m znicz_tpu chaos`` (tools/chaos_smoke.sh).
 """
 
+from ..resilience.breaker import EngineUnavailable
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import ServingEngine
 from .server import ServingServer
 
-__all__ = ["DeadlineExceeded", "MicroBatcher", "QueueFull",
-           "ServingEngine", "ServingServer"]
+__all__ = ["DeadlineExceeded", "EngineUnavailable", "MicroBatcher",
+           "QueueFull", "ServingEngine", "ServingServer"]

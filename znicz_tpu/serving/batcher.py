@@ -26,6 +26,8 @@ import time
 
 import numpy as np
 
+from ..resilience import faults
+
 
 class QueueFull(Exception):
     """Admission queue is full; retry after ``retry_after`` seconds."""
@@ -231,6 +233,10 @@ class MicroBatcher:
                  else np.concatenate([r.x for r in live]))
             t0 = time.monotonic()
             try:
+                # chaos latency/error site: sits BEFORE the engine so
+                # injected dispatch stalls exercise the deadline and
+                # server-timeout paths without touching device state
+                faults.inject("batcher.dispatch")
                 y = self._predict(x)
             except Exception as e:
                 with self._cond:

@@ -20,6 +20,19 @@ Backend: ``auto`` uses JAX when a backend initializes and falls back to
 no usable JAX devices can still serve.  The JAX forward deliberately
 sticks to the XLA op tier (``ops/*.xla_*``) — serving wants the
 portable, numerically-pinned path, not the Pallas training kernels.
+
+Resilience (znicz_tpu.resilience): every jitted forward runs at the
+``engine.forward`` fault site, transient failures retry under a
+:class:`~znicz_tpu.resilience.RetryPolicy`, and a
+:class:`~znicz_tpu.resilience.CircuitBreaker` guards the JAX engine —
+after K consecutive forward failures it opens and ``predict`` degrades
+to the SAME NativeEngine CPU fallback (one model format, so the
+fallback serves identical semantics), or raises
+:class:`~znicz_tpu.resilience.EngineUnavailable` (→ 503 + Retry-After
+at the HTTP front) when the native engine cannot load.  Half-open
+probes re-try JAX after ``cooldown_s`` and close the breaker on
+success.  Deterministic errors (bad geometry → ValueError) bypass all
+of this: retrying a bug hides it, and the front owes the client a 400.
 """
 
 from __future__ import annotations
@@ -32,6 +45,9 @@ import threading
 import numpy as np
 
 from ..export import ZnnLayer, read_znn
+from ..resilience import faults
+from ..resilience.breaker import CircuitBreaker, EngineUnavailable
+from ..resilience.retry import RetryPolicy
 
 #: default pad-to-bucket ladder for request batch sizes
 DEFAULT_BUCKETS = (1, 8, 32, 128)
@@ -190,7 +206,9 @@ class ServingEngine:
     """
 
     def __init__(self, model, *, backend: str = "auto",
-                 buckets=DEFAULT_BUCKETS, cache_size: int = 8):
+                 buckets=DEFAULT_BUCKETS, cache_size: int = 8,
+                 retry: RetryPolicy | None = None,
+                 breaker: CircuitBreaker | None = None):
         if not buckets or list(buckets) != sorted(set(int(b)
                                                       for b in buckets)):
             raise ValueError(f"buckets must be unique ascending ints, "
@@ -213,9 +231,17 @@ class ServingEngine:
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
         self._native = None
+        self._native_failed = False   # fallback tried and unavailable
         if backend == "native":
             from ..export import NativeEngine
             self._native = NativeEngine().load(self.path)
+        # transient device errors retry briefly (default budget stays
+        # well under the batcher's dispatch cadence); K consecutive
+        # exhausted retries trip the breaker and predict degrades
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay_s=0.02, max_delay_s=0.25)
+        self.breaker = breaker if breaker is not None else \
+            CircuitBreaker(failure_threshold=5, cooldown_s=10.0)
         self._lock = threading.Lock()
         self._cache = collections.OrderedDict()   # key -> jitted fwd
         self._dev_params = None     # one device copy, shared by all
@@ -268,6 +294,58 @@ class ServingEngine:
                 return bucket
         return self.buckets[-1]
 
+    # -- degraded path ----------------------------------------------------
+    def _native_model(self):
+        """The CPU fallback model, lazily loaded; None when this host
+        cannot build/load the native engine (the degraded path is then
+        503, not a crash)."""
+        with self._lock:
+            if self._native is not None:
+                return self._native
+            if self._native_failed:
+                return None
+        try:
+            from ..export import NativeEngine
+            native = NativeEngine().load(self.path)
+        except Exception:
+            with self._lock:
+                self._native_failed = True
+            return None
+        with self._lock:
+            if self._native is None:
+                self._native = native
+            return self._native
+
+    def _fallback_predict(self, x: np.ndarray, cause=None) -> np.ndarray:
+        """Serve ``x`` on the native CPU engine, or raise
+        ``EngineUnavailable`` (→ 503 + Retry-After) — the two graceful
+        outcomes the acceptance contract allows while JAX is down."""
+        feats = output_features(self.layers, x.shape[1:])
+        native = self._native_model()
+        if native is None:
+            raise EngineUnavailable(
+                f"jax engine unavailable "
+                f"({cause or 'circuit open'}) and the native CPU "
+                f"fallback could not load",
+                retry_after=self.breaker.retry_after())
+        with self._lock:
+            self._stats["fallback_calls"] += 1
+            self._stats["rows_in"] += len(x)
+        try:
+            return native.infer(x, feats)
+        except Exception as e:
+            raise EngineUnavailable(
+                f"native fallback failed: {e!r}",
+                retry_after=self.breaker.retry_after())
+
+    def _forward_once(self, fn, padded: np.ndarray) -> np.ndarray:
+        faults.inject("engine.forward")
+        return np.asarray(fn(self._params(), padded))
+
+    def _count_retry(self, attempt, exc) -> None:
+        with self._lock:
+            self._stats["retries"] += 1
+
     # -- prediction -------------------------------------------------------
     def predict(self, x: np.ndarray) -> np.ndarray:
         x = np.ascontiguousarray(x, np.float32)
@@ -281,27 +359,58 @@ class ServingEngine:
                 self._stats["forward_calls"] += 1
                 self._stats["rows_in"] += len(x)
             return self._native.infer(x, feats)
+        if not self.breaker.allow():
+            return self._fallback_predict(x)
         top = self.buckets[-1]
         outs = []
-        for start in range(0, len(x), top):
-            chunk = x[start:start + top]
-            bucket = self.bucket_for(len(chunk))
-            if len(chunk) < bucket:
-                pad = np.zeros((bucket - len(chunk),) + chunk.shape[1:],
-                               np.float32)
-                padded = np.concatenate([chunk, pad])
-            else:
-                padded = chunk
-            fn = self._executable(bucket, chunk.shape[1:], chunk.dtype)
-            y = np.asarray(fn(self._params(), padded))
+        try:
+            for start in range(0, len(x), top):
+                chunk = x[start:start + top]
+                bucket = self.bucket_for(len(chunk))
+                if len(chunk) < bucket:
+                    pad = np.zeros(
+                        (bucket - len(chunk),) + chunk.shape[1:],
+                        np.float32)
+                    padded = np.concatenate([chunk, pad])
+                else:
+                    padded = chunk
+                fn = self._executable(bucket, chunk.shape[1:],
+                                      chunk.dtype)
+                y = self.retry.call(self._forward_once, fn, padded,
+                                    on_retry=self._count_retry)
+                with self._lock:
+                    self._stats["forward_calls"] += 1
+                    self._stats["rows_in"] += len(chunk)
+                    self._stats["padded_rows"] += bucket - len(chunk)
+                outs.append(y[:len(chunk)])
+        except Exception as e:
+            if not self.retry.retryable(e):
+                # deterministic error (bad geometry, dtype bug): the
+                # device is fine — free any probe slot and surface it
+                self.breaker.abandon()
+                raise
             with self._lock:
-                self._stats["forward_calls"] += 1
-                self._stats["rows_in"] += len(chunk)
-                self._stats["padded_rows"] += bucket - len(chunk)
-            outs.append(y[:len(chunk)])
+                self._stats["forward_failures"] += 1
+            self.breaker.record_failure()
+            return self._fallback_predict(x, cause=e)
+        self.breaker.record_success()
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
 
     # -- introspection ----------------------------------------------------
+    def resilience_state(self) -> str:
+        """``ok`` (circuit closed) | ``degraded`` (open, native CPU
+        fallback serving) | ``open`` (open and no fallback — requests
+        get 503 + Retry-After).  /healthz surfaces this verbatim.
+
+        ``degraded`` is only reported once the fallback has actually
+        loaded — a balancer keeps a ``degraded`` replica in rotation,
+        so the promise that it still serves 200s must be PROVEN, not
+        assumed; the lazy load is attempted (and cached) here if no
+        request has triggered it yet."""
+        if self.backend == "native" or self.breaker.state == "closed":
+            return "ok"
+        return "degraded" if self._native_model() is not None else "open"
+
     def metrics(self) -> dict:
         with self._lock:
             m = dict(self._stats)
@@ -309,9 +418,14 @@ class ServingEngine:
         m.setdefault("cache_misses", 0)
         m.setdefault("cache_evictions", 0)
         m.setdefault("forward_calls", 0)
+        m.setdefault("forward_failures", 0)
+        m.setdefault("fallback_calls", 0)
+        m.setdefault("retries", 0)
         m["cached_executables"] = len(self._cache)
         m["backend"] = self.backend
         m["buckets"] = list(self.buckets)
+        m["breaker"] = self.breaker.metrics()
+        m["resilience_state"] = self.resilience_state()
         return m
 
     @property

@@ -12,10 +12,19 @@ Wire protocol (JSON both ways):
   A 1-D ``inputs`` is treated as a single sample.  Errors: 400
   (malformed), 429 + ``Retry-After`` header (admission queue full),
   504 (request deadline passed while queued), 503 (engine failure).
-* ``GET /healthz``   liveness + model/backend summary.
+* ``GET /healthz``   liveness + model/backend summary.  ``status`` is
+  the engine's resilience state — ``ok`` | ``degraded`` (circuit open,
+  native CPU fallback serving) | ``open`` (circuit open, no fallback:
+  predicts answer 503 + Retry-After) — so a load balancer can rotate a
+  degraded replica out BEFORE clients see 503s.
 * ``GET /metrics``   batcher counters (queue depth, batch-size
   histogram, p50/p99 latency, rejected/expired) merged with engine
-  counters (executable-cache hits/misses/evictions, forward calls).
+  counters (executable-cache hits/misses/evictions, forward calls,
+  breaker state/trips/probes, retry and fallback counts).
+
+Degradation contract (pinned by the chaos tests): a persistent engine
+fault must never surface as a hang or a raw 500 — every request
+resolves as a native-fallback 200 or a 503 carrying Retry-After.
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..resilience.breaker import EngineUnavailable
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import ServingEngine
 
@@ -101,7 +111,11 @@ class ServingServer:
                     deadline_ms = payload.get("deadline_ms")
                     if deadline_ms is not None:   # junk → 400, not 503
                         deadline_ms = float(deadline_ms)
-                except (KeyError, TypeError, ValueError) as e:
+                except Exception as e:
+                    # ANY parse/shape failure is the client's error: a
+                    # JSON 400 body, never a raw 500 traceback (ragged
+                    # rows, non-dict payloads, unparseable JSON, junk
+                    # Content-Length all land here)
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
                 try:
@@ -124,6 +138,12 @@ class ServingServer:
                                 {"Retry-After": str(ra)})
                 except ValueError as e:        # bad geometry for model
                     self._reply(400, {"error": str(e)})
+                except EngineUnavailable as e:
+                    # circuit open / fallback missing: graceful refusal
+                    # with an honest come-back time, never a hang
+                    self._reply(503, {"error": str(e),
+                                      "retry_after_s": e.retry_after},
+                                {"Retry-After": str(e.retry_after)})
                 except Exception as e:
                     self._reply(503, {"error": f"inference failed: "
                                                f"{e!r}"[:300]})
@@ -147,10 +167,15 @@ class ServingServer:
 
     # -- payload builders -------------------------------------------------
     def health(self) -> dict:
-        return {"status": "ok", "backend": self.engine.backend,
-                "n_layers": self.engine.n_layers,
-                "buckets": list(self.engine.buckets),
-                "queue_depth": self.batcher.queue_depth()}
+        state = self.engine.resilience_state()
+        out = {"status": state, "backend": self.engine.backend,
+               "n_layers": self.engine.n_layers,
+               "buckets": list(self.engine.buckets),
+               "queue_depth": self.batcher.queue_depth()}
+        if state != "ok":      # give probers the why + the come-back
+            out["breaker"] = self.engine.breaker.metrics()
+            out["retry_after_s"] = int(self.engine.breaker.retry_after())
+        return out
 
     def metrics(self) -> dict:
         m = self.batcher.metrics()
@@ -202,10 +227,35 @@ def main(argv=None) -> int:
                         "is slow)")
     p.add_argument("--max-body-mb", type=float, default=64.0,
                    help="largest accepted /predict body (413 beyond)")
+    p.add_argument("--retry-attempts", type=int, default=3,
+                   help="attempts per forward for transient device "
+                        "errors (1 disables retries)")
+    p.add_argument("--breaker-threshold", type=int, default=5,
+                   help="consecutive forward failures before the "
+                        "circuit opens and serving degrades")
+    p.add_argument("--breaker-cooldown-s", type=float, default=10.0,
+                   help="seconds the circuit stays open before a "
+                        "half-open probe retries the jax engine")
+    p.add_argument("--fault-plan", default=None,
+                   help="chaos: install a fault plan (inline JSON or "
+                        "@file; see znicz_tpu.resilience.faults)")
     args = p.parse_args(argv)
+    if args.fault_plan is not None:
+        from ..resilience import faults as _faults
+        _faults.install(_faults.parse_plan(args.fault_plan))
+    from ..resilience.breaker import CircuitBreaker
+    from ..resilience.retry import RetryPolicy
     buckets = tuple(int(b) for b in args.buckets.split(","))
-    engine = ServingEngine(args.model, backend=args.backend,
-                           buckets=buckets, cache_size=args.cache_size)
+    engine = ServingEngine(
+        args.model, backend=args.backend,
+        buckets=buckets, cache_size=args.cache_size,
+        # same delay budget as the engine's own default: the retry
+        # sleeps ride the single dispatch thread, so they must stay
+        # well under the batcher's cadence even at high --retry-attempts
+        retry=RetryPolicy(max_attempts=args.retry_attempts,
+                          base_delay_s=0.02, max_delay_s=0.25),
+        breaker=CircuitBreaker(failure_threshold=args.breaker_threshold,
+                               cooldown_s=args.breaker_cooldown_s))
     server = None
     try:
         server = ServingServer(engine, host=args.host, port=args.port,

@@ -23,6 +23,7 @@ import time
 
 import numpy as np
 
+from .resilience import faults
 from .units import Unit
 
 #: external compressors (reference parity: gz/bz2/xz snapshot files);
@@ -165,7 +166,13 @@ class SnapshotterToFile(SnapshotterBase):
         death (SIGKILL, preemption — the very case restart-from-snapshot
         exists for) can never pair save-N arrays with save-N±1 meta.
         A ``.json`` sidecar is still written for human inspection, but
-        load() never reads it."""
+        load() never reads it.
+
+        ``checkpoint.save`` fault site: chaos tests kill the save here
+        — BEFORE any filesystem mutation, like a preemption landing at
+        the worst moment — and assert the retry/atomic-rename story
+        holds (see CheckpointRecovery)."""
+        faults.inject("checkpoint.save")
         os.makedirs(self.directory, exist_ok=True)
         arrays, meta = collect_state(self.workflow)
         meta_blob = np.frombuffer(
@@ -194,7 +201,9 @@ class SnapshotterToFile(SnapshotterBase):
     def load(workflow, path: str) -> dict:
         """Restore a snapshot into an *initialized* workflow; returns
         meta.  Compression is detected from the extension
-        (``.npz[.gz|.bz2|.xz]`` — the reference's CLI-resume UX)."""
+        (``.npz[.gz|.bz2|.xz]`` — the reference's CLI-resume UX).
+        ``checkpoint.load`` is the matching chaos fault site."""
+        faults.inject("checkpoint.load")
         ext = path.rsplit(".", 1)[-1]
         if ext in _OPENERS:
             with _OPENERS[ext](path, "rb") as fh:
