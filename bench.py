@@ -610,53 +610,16 @@ def _git_rev() -> str | None:
     None when not a repo / no git.  Stamped into every transcript row
     so decide_levers.py can refuse to average or pair rows measured on
     different code revisions (ADVICE r5 medium: cross-revision rows
-    contaminate keep/revert verdicts)."""
-    import hashlib
-    import subprocess
-    here = os.path.dirname(os.path.abspath(__file__))
-    # dirtiness is judged over CODE paths only: untracked files and
-    # the tracked burn outputs the harness itself appends to
-    # (kern*.log, BENCH_*.json in the repo root) must not flip the
-    # suffix mid-burn — same code must stamp the same rev across a
-    # burn session
-    # no "tests": a test-only edit cannot change a measurement, and
-    # splitting A/B evidence over one would waste a chip window
-    code_paths = ["bench.py", "__graft_entry__.py", "znicz_tpu",
-                  "native", "tools"]
-    try:
-        proc = subprocess.run(
-            ["git", "rev-parse", "--short=12", "HEAD"],
-            capture_output=True, text=True, timeout=10, cwd=here)
-        rev = proc.stdout.strip()
-        if proc.returncode != 0 or not rev:
-            return None
-        diff = subprocess.run(
-            ["git", "diff", "HEAD", "--"] + code_paths,
-            capture_output=True, timeout=10, cwd=here)
-        h = hashlib.sha1(diff.stdout if diff.returncode == 0 else b"")
-        dirty = bool(diff.returncode == 0 and diff.stdout.strip())
-        # untracked CODE files never appear in `git diff` — hash their
-        # contents too, or two different uncommitted new kernels would
-        # share a stamp
-        others = subprocess.run(
-            ["git", "ls-files", "-z", "--others", "--exclude-standard",
-             "--"] + code_paths,
-            capture_output=True, text=True, timeout=10, cwd=here)
-        # NUL-separated (-z): names with spaces must not split apart
-        for name in sorted(n for n in (others.stdout or "").split("\0")
-                           if n):
-            dirty = True
-            h.update(name.encode())
-            try:
-                with open(os.path.join(here, name), "rb") as fh:
-                    h.update(fh.read())
-            except OSError:
-                pass
-        if dirty:
-            rev += "-dirty." + h.hexdigest()[:8]
-        return rev
-    except Exception:
-        return None
+    contaminate keep/revert verdicts).
+
+    The implementation lives in ``znicz_tpu.telemetry.buildinfo`` so
+    the serving ``/metrics`` endpoint stamps the identical ``rev``
+    (scraped metrics and transcript rows must attribute to the same
+    build string).  The CODE-paths rule (no ``tests``: a test-only
+    edit cannot change a measurement) is the shared default there."""
+    from znicz_tpu.telemetry import buildinfo
+    return buildinfo.git_rev(
+        root=os.path.dirname(os.path.abspath(__file__)))
 
 
 def _record_run_config(args, result) -> None:

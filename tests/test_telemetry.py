@@ -1,0 +1,481 @@
+"""Telemetry tests (znicz_tpu/telemetry/): registry instruments and
+the Prometheus text exposition (parser round-trip pinning name/label/
+value formatting, histogram bucket monotonicity, JSON/text counter
+identity), request-id propagation through server → batcher → engine
+spans, structured JSON log lines, the resilience/elastic registry
+events, and the windowed profiler hook."""
+
+import json
+import logging
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from znicz_tpu.telemetry import tracing
+from znicz_tpu.telemetry.registry import (REGISTRY, MetricsRegistry,
+                                          PROMETHEUS_CONTENT_TYPE)
+
+
+# -- helpers ---------------------------------------------------------------
+_SERIES_RE = re.compile(
+    r'([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})? '
+    r'([0-9.eE+-]+|\+Inf|-Inf|NaN)')
+
+
+def parse_exposition(text):
+    """Strict v0.0.4 parser: {series: value}, {name: type}.  Raises on
+    any line a real scraper would reject — the round-trip pin."""
+    series, types = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        if line.startswith("# HELP "):
+            continue
+        assert not line.startswith("#"), f"unknown comment {line!r}"
+        m = _SERIES_RE.fullmatch(line)
+        assert m is not None, f"unparseable exposition line: {line!r}"
+        key = m.group(1) + (m.group(2) or "")
+        assert key not in series, f"duplicate series {key}"
+        series[key] = float(m.group(3).replace("Inf", "inf"))
+    return series, types
+
+
+def _get(url, headers=None, timeout=10):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def _post(url, payload, headers=None, timeout=30):
+    body = (payload if isinstance(payload, bytes)
+            else json.dumps(payload).encode())
+    req = urllib.request.Request(
+        url + "predict", body,
+        {"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+# -- registry --------------------------------------------------------------
+class TestRegistry:
+    def test_counter_labels_total_and_reregistration(self):
+        r = MetricsRegistry()
+        c = r.counter("hits_total", "hits")
+        c.inc(route="/a")
+        c.inc(2, route="/b")
+        c.inc()
+        assert c.value(route="/a") == 1
+        assert c.value(route="/b") == 2
+        assert c.total() == 4
+        assert r.counter("hits_total") is c     # get-or-create
+        with pytest.raises(ValueError):
+            r.gauge("hits_total")               # one name, one meaning
+        with pytest.raises(ValueError):
+            c.inc(-1)                           # counters are monotonic
+
+    def test_histogram_buckets_monotone_and_bounded(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_ms", "lat", buckets=(1, 5, 25))
+        for v in (0.2, 0.9, 3.0, 24.9, 25.0, 1e9):
+            h.observe(v)
+        d = h.as_dict()
+        cum = list(d["buckets"].values())
+        assert cum == sorted(cum), "bucket counts must be cumulative"
+        assert d["buckets"]["+Inf"] == d["count"] == 6
+        assert d["buckets"]["1"] == 2 and d["buckets"]["25"] == 5
+        assert d["sum"] == pytest.approx(1e9 + 54.0)
+        with pytest.raises(ValueError):
+            r.histogram("bad", buckets=(5, 1))  # must ascend
+
+    def test_prometheus_round_trip_pins_formatting(self):
+        """Name/label/value formatting survives a strict parse, label
+        values escape quotes/backslashes/newlines, and histogram
+        series carry _bucket/_sum/_count."""
+        r = MetricsRegistry()
+        c = r.counter("requests_total", 'counts "requests"\nby route')
+        c.inc(3, route="/predict", code="200")
+        c.inc(route='we"ird\\pa\nth', code="400")
+        r.gauge("depth").set(2.5)
+        h = r.histogram("lat_ms", buckets=(1, 10))
+        h.observe(0.5)
+        h.observe(100.0)
+        text = r.render_prometheus()
+        series, types = parse_exposition(text)
+        assert types == {"requests_total": "counter", "depth": "gauge",
+                         "lat_ms": "histogram"}
+        assert series[
+            'requests_total{code="200",route="/predict"}'] == 3
+        # escaped label value round-trips as written
+        assert ('requests_total{code="400",'
+                'route="we\\"ird\\\\pa\\nth"}') in series
+        assert series["depth"] == 2.5
+        assert series['lat_ms_bucket{le="1"}'] == 1
+        assert series['lat_ms_bucket{le="+Inf"}'] == 2
+        assert series["lat_ms_sum"] == 100.5
+        assert series["lat_ms_count"] == 2
+
+    def test_json_and_text_views_report_identical_values(self):
+        r = MetricsRegistry()
+        c = r.counter("events_total")
+        c.inc(5, kind="a")
+        c.inc(7, kind="b")
+        r.gauge("temperature").set(36.6)
+        series, _ = parse_exposition(r.render_prometheus())
+        d = r.as_dict()
+        assert d["events_total"]["kind=a"] == \
+            series['events_total{kind="a"}'] == 5
+        assert d["events_total"]["kind=b"] == \
+            series['events_total{kind="b"}'] == 7
+        assert d["temperature"] == series["temperature"] == 36.6
+
+    def test_collector_families_render_and_survive_errors(self):
+        r = MetricsRegistry()
+
+        def good():
+            return [("gauge", "component_depth", "queue depth",
+                     [(None, 4.0), ({"shard": "1"}, 2.0)])]
+
+        def broken():
+            raise RuntimeError("wedged component")
+        r.register_collector(good)
+        r.register_collector(broken)
+        series, types = parse_exposition(r.render_prometheus())
+        assert types["component_depth"] == "gauge"
+        assert series["component_depth"] == 4.0
+        assert series['component_depth{shard="1"}'] == 2.0
+        r.unregister_collector(good)
+        assert "component_depth" not in r.render_prometheus()
+
+
+# -- tracing ---------------------------------------------------------------
+class TestTracing:
+    def test_accept_request_id_sanitizes(self):
+        assert tracing.accept_request_id(" abc-123 ") == "abc-123"
+        # newlines must never reach a header or log line
+        assert "\n" not in tracing.accept_request_id("a\nb\r\nc")
+        assert len(tracing.accept_request_id("x" * 500)) == 120
+        generated = tracing.accept_request_id(None)
+        assert re.fullmatch(r"[0-9a-f]{16}", generated)
+        assert tracing.accept_request_id("\n\r") != ""
+
+    def test_span_records_and_correlates(self):
+        tracing.clear()
+        with tracing.request("req-1") as rid:
+            assert rid == "req-1"
+            assert tracing.current_request_id() == "req-1"
+            with tracing.span("unit.test", rows=3):
+                pass
+        assert tracing.current_request_id() is None
+        (sp,) = tracing.recent_spans(name="unit.test",
+                                     request_id="req-1")
+        assert sp.status == "ok" and sp.duration_ms >= 0
+        assert sp.attrs == {"rows": 3}
+        assert sp.to_dict()["request_ids"] == ["req-1"]
+
+    def test_span_error_status_propagates_exception(self):
+        tracing.clear()
+        with pytest.raises(KeyError):
+            with tracing.span("unit.boom"):
+                raise KeyError("x")
+        (sp,) = tracing.recent_spans(name="unit.boom")
+        assert sp.status == "error" and "KeyError" in sp.error
+
+    def test_request_ids_cross_thread_reinstall(self):
+        """The batcher pattern: a worker thread re-installs the ids it
+        was handed and spans opened there stay correlated."""
+        tracing.clear()
+        seen = []
+
+        def worker():
+            token = tracing.set_request_ids(("r1", "r2"))
+            try:
+                with tracing.span("worker.stage"):
+                    seen.append(tracing.current_request_ids())
+            finally:
+                tracing.reset_request_ids(token)
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(10)
+        assert seen == [("r1", "r2")]
+        (sp,) = tracing.recent_spans(name="worker.stage",
+                                     request_id="r2")
+        assert sp.request_ids == ("r1", "r2")
+
+
+# -- structured logs -------------------------------------------------------
+class TestJsonLogs:
+    def test_json_lines_carry_request_id(self, tmp_path):
+        from znicz_tpu import logger as zlog
+        path = str(tmp_path / "log.jsonl")
+        zlog.configure(level=logging.INFO, filename=path,
+                       json_lines=True)
+        try:
+            log = logging.getLogger("telemetry.test")
+            with tracing.request("rid-42"):
+                log.info("inside %s", "request")
+            log.info("outside")
+        finally:
+            zlog.configure()       # restore the plain default
+        lines = [json.loads(ln) for ln in
+                 open(path).read().strip().splitlines()]
+        assert [ln["msg"] for ln in lines] == ["inside request",
+                                               "outside"]
+        assert lines[0]["request_id"] == "rid-42"
+        assert lines[1]["request_id"] is None
+        assert all(ln["logger"] == "telemetry.test" and
+                   ln["level"] == "INFO" and
+                   isinstance(ln["ts"], float) for ln in lines)
+
+    def test_plain_format_stays_default(self, tmp_path, monkeypatch):
+        from znicz_tpu import logger as zlog
+        monkeypatch.delenv("ZNICZ_LOG_JSON", raising=False)
+        path = str(tmp_path / "plain.log")
+        zlog.configure(filename=path)
+        try:
+            logging.getLogger("telemetry.plain").warning("hello")
+        finally:
+            zlog.configure()
+        line = open(path).read().strip()
+        with pytest.raises(json.JSONDecodeError):
+            json.loads(line)
+        assert "hello" in line and "telemetry.plain" in line
+
+
+# -- resilience / elastic registry events ---------------------------------
+class TestResilienceEvents:
+    def test_breaker_transitions_counted(self):
+        from znicz_tpu.resilience.breaker import CircuitBreaker
+        c = REGISTRY.counter("breaker_transitions_total")
+        trip0 = c.value(**{"from": "closed", "to": "open"})
+        recover0 = c.value(**{"from": "half_open", "to": "closed"})
+        probe0 = c.value(**{"from": "open", "to": "half_open"})
+        t = [0.0]
+        br = CircuitBreaker(failure_threshold=2, cooldown_s=5.0,
+                            clock=lambda: t[0])
+        for _ in range(2):
+            assert br.allow()
+            br.record_failure()                     # → open
+        t[0] = 6.0
+        assert br.allow()                           # → half_open probe
+        br.record_success()                         # → closed
+        assert c.value(**{"from": "closed", "to": "open"}) == trip0 + 1
+        assert c.value(**{"from": "open", "to": "half_open"}) \
+            == probe0 + 1
+        assert c.value(**{"from": "half_open", "to": "closed"}) \
+            == recover0 + 1
+
+    def test_retry_attempts_counted(self):
+        from znicz_tpu.resilience.retry import RetryPolicy
+        c = REGISTRY.counter("retry_attempts_total")
+        before = c.value(fn="flaky")
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise RuntimeError("transient")
+            return "ok"
+        flaky.__name__ = "flaky"
+        pol = RetryPolicy(max_attempts=3, sleep=lambda s: None)
+        assert pol.call(flaky) == "ok"
+        assert c.value(fn="flaky") == before + 2
+
+    def test_fault_activations_counted(self):
+        from znicz_tpu.resilience.faults import FaultPlan, FaultSpec
+        c = REGISTRY.counter("faults_injected_total")
+        before = c.value(site="unit.site", kind="error")
+        plan = FaultPlan([FaultSpec("unit.site", times=2)])
+        for _ in range(4):                  # fires twice, then exhausts
+            try:
+                plan.fire("unit.site")
+            except RuntimeError:
+                pass
+        assert c.value(site="unit.site", kind="error") == before + 2
+
+    def test_elastic_failures_counted(self, tmp_path):
+        from znicz_tpu.parallel.elastic import ElasticRunner
+        c = REGISTRY.counter("elastic_failures_total")
+        before = c.value(kind="crash")
+        runner = ElasticRunner(lambda *a: ["true"], num_processes=1,
+                               log_dir=str(tmp_path))
+        runner._record_failure("crash", [{"process": 0,
+                                          "returncode": 1,
+                                          "log_tail": "", "log": ""}])
+        assert c.value(kind="crash") == before + 1
+
+
+# -- profiler --------------------------------------------------------------
+class TestStepTraceHook:
+    def test_windowed_capture_schedule(self):
+        from znicz_tpu.telemetry.profiler import StepTraceHook
+        events = []
+        hook = StepTraceHook(
+            "/tmp/prof", every=4, duration=2,
+            start=lambda d: events.append(("start", d)) or True,
+            stop=lambda: events.append(("stop",)))
+        for step in range(10):
+            hook.on_step(step)
+        hook.close()
+        assert events == [("start", "/tmp/prof/step0"), ("stop",),
+                          ("start", "/tmp/prof/step4"), ("stop",),
+                          ("start", "/tmp/prof/step8"), ("stop",)]
+        assert hook.captured == ["/tmp/prof/step0", "/tmp/prof/step4",
+                                 "/tmp/prof/step8"]
+
+    def test_failed_start_does_not_wedge_the_schedule(self):
+        from znicz_tpu.telemetry.profiler import StepTraceHook
+        stops = []
+        hook = StepTraceHook("/tmp/prof", every=2,
+                             start=lambda d: False,
+                             stop=lambda: stops.append(1))
+        for step in range(5):
+            hook.on_step(step)
+        hook.close()
+        assert hook.captured == [] and stops == []
+
+    def test_validation(self):
+        from znicz_tpu.telemetry.profiler import StepTraceHook
+        with pytest.raises(ValueError):
+            StepTraceHook("/tmp/p", every=0)
+
+
+# -- serving end-to-end ----------------------------------------------------
+@pytest.fixture(scope="module")
+def telemetry_server(tmp_path_factory):
+    """A tiny jax-backed serving stack shared by the e2e tests."""
+    from znicz_tpu.resilience.chaos import _write_demo_znn
+    from znicz_tpu.serving import ServingEngine, ServingServer
+    path = str(tmp_path_factory.mktemp("telem") / "demo.znn")
+    _write_demo_znn(path)
+    engine = ServingEngine(path, backend="jax", buckets=(1, 2))
+    server = ServingServer(engine, max_wait_ms=1.0).start()
+    yield server
+    server.stop()
+    engine.close()
+
+
+class TestServingTelemetry:
+    X = {"inputs": [[0.1, -0.2, 0.3, 0.4]]}
+
+    def test_request_id_echoed_and_in_spans(self, telemetry_server):
+        """Acceptance: the response's X-Request-Id appears in the
+        matching batcher AND engine span records."""
+        tracing.clear()
+        rid = "pin-" + tracing.new_request_id()
+        status, _, headers = _post(telemetry_server.url, self.X,
+                                   headers={"X-Request-Id": rid})
+        assert status == 200
+        assert headers.get("X-Request-Id") == rid
+        for name in ("server.predict", "batcher.dispatch",
+                     "engine.forward"):
+            spans = tracing.recent_spans(name=name, request_id=rid)
+            assert spans, f"no {name} span carries {rid}"
+            assert all(s.status == "ok" and s.duration_ms >= 0
+                       for s in spans)
+
+    def test_request_id_generated_when_absent(self, telemetry_server):
+        status, _, headers = _post(telemetry_server.url, self.X)
+        assert status == 200
+        assert re.fullmatch(r"[0-9a-f]{16}",
+                            headers.get("X-Request-Id", ""))
+
+    def test_bad_request_counted_and_stamped(self, telemetry_server):
+        c = REGISTRY.counter("errors_total")
+        before = c.value(route="/predict", code="400")
+        status, body, headers = _post(telemetry_server.url,
+                                      b"not json at all")
+        assert status == 400 and "error" in body
+        assert headers.get("X-Request-Id")
+        assert c.value(route="/predict", code="400") == before + 1
+
+    def test_metrics_json_view_back_compat_plus_rev(self,
+                                                    telemetry_server):
+        _post(telemetry_server.url, self.X)
+        status, body, headers = _get(telemetry_server.url + "metrics")
+        assert status == 200
+        assert headers["Content-Type"] == "application/json"
+        m = json.loads(body)
+        # the PR-1 shape is still there …
+        assert m["completed"] >= 1 and "engine" in m
+        assert m["engine"]["breaker"]["state"] == "closed"
+        # … plus build attribution and the registry request totals
+        assert "rev" in m
+        assert m["requests"]["requests_total"] >= \
+            m["requests"]["errors_total"]
+        assert m["requests"]["requests_by_route_code"][
+            "code=200,route=/predict"] >= 1
+
+    def test_metrics_text_view_negotiated_and_consistent(
+            self, telemetry_server):
+        """Acceptance: Accept: text/plain yields valid exposition with
+        predict_latency_ms buckets + breaker state, reporting the same
+        counter values as the JSON view."""
+        _post(telemetry_server.url, self.X)
+        status, body, _ = _get(telemetry_server.url + "metrics")
+        m = json.loads(body)
+        status, text, headers = _get(telemetry_server.url + "metrics",
+                                     headers={"Accept": "text/plain"})
+        assert status == 200
+        assert headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+        series, types = parse_exposition(text.decode())
+        assert types["predict_latency_ms"] == "histogram"
+        infb = series['predict_latency_ms_bucket{le="+Inf"}']
+        assert infb == series["predict_latency_ms_count"] >= 1
+        assert series['breaker_state{state="closed"}'] == 1.0
+        assert series['breaker_state{state="open"}'] == 0.0
+        # identical counter values across the two views (predict route:
+        # scrapes themselves only bump the /metrics route)
+        jr = m["requests"]["requests_by_route_code"]
+        assert series.get(
+            'requests_total{code="200",route="/predict"}') \
+            == jr.get("code=200,route=/predict")
+        assert series["serving_batcher_completed"] == m["completed"]
+        assert series["serving_engine_forward_calls"] \
+            == m["engine"]["forward_calls"]
+        # ?format=prometheus works without the header; format=json
+        # overrides Accept
+        _, text2, h2 = _get(telemetry_server.url
+                            + "metrics?format=prometheus")
+        assert h2["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+        parse_exposition(text2.decode())
+        _, body3, h3 = _get(telemetry_server.url
+                            + "metrics?format=json",
+                            headers={"Accept": "text/plain"})
+        assert h3["Content-Type"] == "application/json"
+        json.loads(body3)
+
+
+# -- training status server ------------------------------------------------
+class TestStatusServerTelemetry:
+    def test_snapshot_and_prometheus_endpoint(self):
+        from znicz_tpu.web_status import StatusServer
+
+        class FakeWF:
+            name = "fake"
+            units = []
+
+            def time_table(self):
+                return []
+        REGISTRY.gauge("train_step_time_ms").set(12.5)
+        srv = StatusServer(FakeWF()).start()
+        try:
+            status, body, _ = _get(srv.url + "status.json")
+            snap = json.loads(body)
+            assert snap["telemetry"]["train_step_time_ms"] == 12.5
+            status, text, headers = _get(srv.url + "metrics")
+            assert headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+            series, _ = parse_exposition(text.decode())
+            assert series["train_step_time_ms"] == 12.5
+        finally:
+            srv.stop()

@@ -1,0 +1,164 @@
+#!/bin/bash
+# Telemetry smoke (ISSUE 3 acceptance, operator-runnable): boot the
+# REAL `python -m znicz_tpu serve` CLI on a free port, fire N predicts
+# (some deliberately malformed), then assert the scrape contract:
+#   * GET /metrics with Accept: text/plain parses as Prometheus text
+#     exposition v0.0.4 and includes predict_latency_ms buckets and
+#     breaker_state;
+#   * requests_total / errors_total match exactly what was sent;
+#   * the JSON and text views report identical counter values;
+#   * every POST /predict response carries an X-Request-Id, echoing
+#     the client's when supplied;
+#   * the JSON view carries a `rev` build stamp.
+#
+# Registered beside tools/chaos_smoke.sh; pytest wrapper (marked slow):
+# tests/test_metrics_smoke.py.
+#
+# Usage:  bash tools/metrics_smoke.sh [n_good] [n_bad]
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - "${1:-6}" "${2:-3}" <<'PY'
+import json, os, re, signal, subprocess, sys, tempfile, time
+import urllib.error, urllib.request
+
+n_good, n_bad = int(sys.argv[1]), int(sys.argv[2])
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+def parse_exposition(text):
+    """Minimal v0.0.4 parser: {series-with-labels: float}; raises on a
+    malformed line, which is the point — a scraper would too."""
+    series, typed = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            typed[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = re.fullmatch(
+            r'([a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})? '
+            r'([0-9.eE+-]+|\+Inf|-Inf|NaN)', line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        series[m.group(1) + (m.group(2) or "")] = float(
+            m.group(3).replace("+Inf", "inf").replace("-Inf", "-inf"))
+    return series, typed
+
+
+with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
+    model = os.path.join(tmp, "demo.znn")
+    from znicz_tpu.resilience.chaos import _write_demo_znn
+    _write_demo_znn(model)
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "serve", "--model", model,
+         "--port", str(port), "--max-wait-ms", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}/"
+    try:
+        for _ in range(120):                    # wait for the listener
+            try:
+                urllib.request.urlopen(url + "healthz", timeout=2)
+                break
+            except Exception:
+                if proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    sys.exit(f"serve exited rc={proc.returncode}:\n"
+                             + out[-2000:])
+                time.sleep(0.5)
+        else:
+            sys.exit("serve never answered /healthz")
+
+        rids = []
+        for i in range(n_good):
+            req = urllib.request.Request(
+                url + "predict",
+                json.dumps({"inputs": [[0.1, -0.2, 0.3, 0.4]]}).encode(),
+                {"Content-Type": "application/json",
+                 "X-Request-Id": f"smoke-{i}"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                check(r.status == 200, f"good predict {i} -> 200")
+                rids.append(r.headers.get("X-Request-Id"))
+        check(rids == [f"smoke-{i}" for i in range(n_good)],
+              "client X-Request-Id echoed on every 200")
+        bad_codes = []
+        for i in range(n_bad):                  # raw non-JSON body
+            req = urllib.request.Request(
+                url + "predict", b"this is not json",
+                {"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                bad_codes.append(200)
+            except urllib.error.HTTPError as e:
+                bad_codes.append(e.code)
+                check(e.headers.get("X-Request-Id") is not None,
+                      f"malformed predict {i} still carries a "
+                      f"generated X-Request-Id")
+        check(bad_codes == [400] * n_bad, f"malformed -> 400 {bad_codes}")
+
+        with urllib.request.urlopen(url + "metrics", timeout=10) as r:
+            m = json.loads(r.read())
+        req = urllib.request.Request(url + "metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            check("version=0.0.4" in r.headers.get("Content-Type", ""),
+                  "text view Content-Type is v0.0.4")
+            text = r.read().decode()
+        series, typed = parse_exposition(text)   # raises if malformed
+        check(typed.get("predict_latency_ms") == "histogram",
+              "predict_latency_ms typed histogram")
+        check(any(k.startswith("predict_latency_ms_bucket") for k in
+                  series), "predict_latency_ms buckets present")
+        check(series.get('breaker_state{state="closed"}') == 1.0,
+              "breaker_state enum present (closed)")
+        sent = n_good + n_bad
+        got_pred = sum(v for k, v in series.items()
+                       if k.startswith('requests_total{')
+                       and 'route="/predict"' in k)
+        got_err = sum(v for k, v in series.items()
+                      if k.startswith('errors_total{')
+                      and 'route="/predict"' in k)
+        check(got_pred == sent,
+              f"text requests_total/predict == {sent} (got {got_pred})")
+        check(got_err == n_bad,
+              f"text errors_total/predict == {n_bad} (got {got_err})")
+        check(series.get("predict_latency_ms_count") == sent,
+              "latency histogram count == requests sent")
+        # JSON/text consistency: same Counter objects back both views.
+        # Compare the /predict route (scrapes themselves only bump the
+        # /metrics route, so these children are stable between views).
+        jr = m["requests"]["requests_by_route_code"]
+        check(jr.get("code=200,route=/predict") == n_good
+              and jr.get("code=400,route=/predict") == n_bad,
+              "JSON per-route requests == sent")
+        check(m["requests"]["errors_by_route_code"]
+              .get("code=400,route=/predict") == n_bad
+              and got_err == n_bad,
+              "JSON and text /predict error counters identical")
+        check(series.get('requests_total{code="200",route="/predict"}')
+              == jr.get("code=200,route=/predict"),
+              "JSON and text /predict request counters identical")
+        check(m["completed"] == series.get("serving_batcher_completed"),
+              "JSON batcher completed == text serving_batcher_completed")
+        check("rev" in m, "JSON /metrics carries a rev build stamp")
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+print(json.dumps({"ok": not fails, "violations": fails}))
+sys.exit(1 if fails else 0)
+PY

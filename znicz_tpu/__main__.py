@@ -13,7 +13,10 @@ Examples::
     python -m znicz_tpu wf.py cfg.py --coordinator=host:1234 \
         --num-processes=4 --process-id=0        # multi-host SPMD
     python -m znicz_tpu serve --model model.znn --port 8100
-        # batched inference serving of a .znn export (znicz_tpu.serving)
+        # batched inference serving of a .znn export (znicz_tpu.serving);
+        # GET /metrics speaks JSON or Prometheus text (Accept header),
+        # --profile-dir captures a jax.profiler trace, and every
+        # POST /predict carries an X-Request-Id (docs/observability.md)
     python -m znicz_tpu chaos
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos)

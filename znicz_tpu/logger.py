@@ -7,12 +7,21 @@ methods and file redirection. The reference's optional MongoDB event sink and
 zmq plot stream are replaced TPU-first with a structured JSONL metrics writer
 (:class:`MetricsWriter`) that plotting/decision units append to — trivially
 consumable by TensorBoard-style tooling and by the test-suite.
+
+Structured log lines: ``ZNICZ_LOG_JSON=1`` (or
+``configure(json_lines=True)``) switches every handler to one JSON
+object per line — ``{ts, level, logger, msg, request_id}`` — so
+serving logs are machine-parseable and each line carries the
+``X-Request-Id`` of the request it was emitted for
+(znicz_tpu.telemetry.tracing).  The human-readable plain format stays
+the default.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
 import time
 
@@ -20,18 +29,49 @@ import time
 _configured = False
 
 
-def configure(level=logging.INFO, filename: str | None = None) -> None:
-    """Set up process-wide logging once (reference: Logger.setup_logging)."""
+class JsonLineFormatter(logging.Formatter):
+    """One ``{ts, level, logger, msg, request_id}`` object per line.
+
+    ``request_id`` is resolved at emit time from the calling context
+    (telemetry.tracing) — null outside a request, so training logs and
+    serving logs share one schema."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        from .telemetry import tracing
+        obj = {"ts": record.created,
+               "level": record.levelname,
+               "logger": record.name,
+               "msg": record.getMessage(),
+               "request_id": tracing.current_request_id()}
+        if record.exc_info and record.exc_info[0] is not None:
+            obj["exc"] = self.formatException(record.exc_info)
+        return json.dumps(obj, default=str)
+
+
+def configure(level=logging.INFO, filename: str | None = None,
+              json_lines: bool | None = None) -> None:
+    """Set up process-wide logging once (reference: Logger.setup_logging).
+
+    ``json_lines=None`` defers to ``$ZNICZ_LOG_JSON`` (``"1"`` turns
+    structured lines on); True/False forces it either way."""
     global _configured
+    if json_lines is None:
+        json_lines = os.environ.get("ZNICZ_LOG_JSON", "") == "1"
     handlers = [logging.StreamHandler(sys.stderr)]
     if filename:
         handlers.append(logging.FileHandler(filename))
-    logging.basicConfig(
-        level=level,
-        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
-        handlers=handlers,
-        force=True,
-    )
+    if json_lines:
+        fmt = JsonLineFormatter()
+        for h in handlers:
+            h.setFormatter(fmt)
+        logging.basicConfig(level=level, handlers=handlers, force=True)
+    else:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+            handlers=handlers,
+            force=True,
+        )
     _configured = True
 
 

@@ -37,6 +37,17 @@ import time
 
 from ..logger import Logger
 from ..resilience.retry import RetryPolicy
+from ..telemetry.registry import REGISTRY
+
+_restarts = REGISTRY.counter(
+    "elastic_restarts_total",
+    "full-fleet coordinated restarts performed by ElasticRunner")
+_failures = REGISTRY.counter(
+    "elastic_failures_total",
+    "fleet rounds that died, by kind (crash | timeout)")
+_backoff_s = REGISTRY.counter(
+    "elastic_backoff_seconds_total",
+    "seconds spent sleeping between fleet restarts")
 
 
 def free_port() -> int:
@@ -155,6 +166,7 @@ class ElasticRunner(Logger):
         self.failures.append(rec)
         del self.failures[:-20]            # bound the history
         self.last_failure = rec
+        _failures.inc(kind=kind)
 
     def _watch(self, procs) -> bool:
         """True = every worker exited 0 (training complete); False =
@@ -171,6 +183,20 @@ class ElasticRunner(Logger):
             dead = [(i, c) for i, c in enumerate(codes)
                     if c not in (None, 0)]
             if dead:
+                # co-dying workers get a short grace to exit on their
+                # own before the reap: under SPMD the first observed
+                # death is usually a symptom, and a sibling's OWN exit
+                # code + tail beats the -SIGKILL the reap would stamp
+                # on it milliseconds later (also de-flakes the
+                # both-die-instantly case: a worker still in
+                # interpreter startup at the poll gets to finish
+                # crashing)
+                grace = time.monotonic() + max(self.poll_interval, 1.0)
+                while (any(p.poll() is None for p in procs)
+                       and time.monotonic() < grace):
+                    time.sleep(min(0.05, self.poll_interval))
+                dead = [(i, p.poll()) for i, p in enumerate(procs)
+                        if p.poll() not in (None, 0)]
                 # record only exits observed BEFORE the reap: workers
                 # the supervisor kills below are victims, and their
                 # -SIGKILL codes would bury the real tails
@@ -262,6 +288,7 @@ class ElasticRunner(Logger):
                     f"budget; last tails:\n"
                     + self._aggregate_tails(self.crash_loop_threshold))
             self.restarts += 1
+            _restarts.inc()
             if self.restarts > self.max_restarts:
                 self._state = "failed"
                 raise RuntimeError(
@@ -269,6 +296,7 @@ class ElasticRunner(Logger):
                     f"(max_restarts={self.max_restarts}); last "
                     f"failure tails:\n" + self._aggregate_tails(2))
             delay = self.backoff_s(self.restarts)
+            _backoff_s.inc(delay)
             self._state = "backoff"
             self.info("restart %d/%d in %.2fs (%s)", self.restarts,
                       self.max_restarts, delay,

@@ -20,7 +20,21 @@ from __future__ import annotations
 import threading
 import time
 
+from ..telemetry.registry import REGISTRY
+
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+_transitions = REGISTRY.counter(
+    "breaker_transitions_total",
+    "circuit breaker state transitions (closed→open is a trip, "
+    "open→half_open a probe grant, half_open→closed a recovery)")
+
+
+def _note_transition(old: str, new: str) -> None:
+    """Registry event for one state change — called OUTSIDE the
+    breaker's lock (the registry has its own; never nest them)."""
+    if old != new:
+        _transitions.inc(**{"from": old, "to": new})
 
 
 class EngineUnavailable(RuntimeError):
@@ -67,27 +81,33 @@ class CircuitBreaker:
 
     # -- protocol ---------------------------------------------------------
     def allow(self) -> bool:
+        old = None
         with self._lock:
             if self._state == CLOSED:
                 return True
             if self._state == OPEN:
                 if self._clock() - self._opened_at < self.cooldown_s:
                     return False
+                old = self._state
                 self._state = HALF_OPEN       # cooldown over: probe time
             if self._probe_inflight:
                 return False
             self._probe_inflight = True
             self._probe_owner = threading.get_ident()
             self._probes += 1
-            return True
+        if old is not None:
+            _note_transition(old, HALF_OPEN)
+        return True
 
     def record_success(self) -> None:
         with self._lock:
+            old = self._state
             self._state = CLOSED
             self._consecutive = 0
             self._probe_inflight = False
             self._probe_owner = None
             self._opened_at = None
+        _note_transition(old, CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:
@@ -99,11 +119,13 @@ class CircuitBreaker:
                 self._consecutive += 1
                 if self._consecutive < self.failure_threshold:
                     return
+            old = self._state
             self._state = OPEN               # trip, or failed probe
             self._opened_at = self._clock()
             self._probe_inflight = False
             self._probe_owner = None
             self._trips += 1
+        _note_transition(old, OPEN)
 
     def abandon(self) -> None:
         with self._lock:

@@ -47,6 +47,13 @@ import time
 import zlib
 from dataclasses import dataclass, field
 
+from ..telemetry.registry import REGISTRY
+
+_injected = REGISTRY.counter(
+    "faults_injected_total",
+    "chaos faults actually fired, by site and kind (a fault plan's "
+    "specs that skip/exhaust do not count)")
+
 
 class FaultInjected(RuntimeError):
     """Default exception type raised by an ``error`` fault."""
@@ -132,7 +139,7 @@ class FaultPlan:
     def fire(self, site: str) -> None:
         """Apply every matching spec for one hit of ``site`` — sleeps
         for latency faults, raises for error faults."""
-        delay, boom = 0.0, None
+        delay, boom, fired = 0.0, None, []
         with self._lock:
             for spec, rng in zip(self.faults, self._rngs):
                 if spec.site != site:
@@ -146,10 +153,13 @@ class FaultPlan:
                     continue
                 spec.fired += 1
                 self.stats[f"{site}:{spec.kind}"] += 1
+                fired.append(spec.kind)
                 if spec.kind == "latency":
                     delay += spec.latency_s
                 elif boom is None:        # first error spec wins
                     boom = spec.exception()
+        for kind in fired:       # registry event, outside the plan lock
+            _injected.inc(site=site, kind=kind)
         if delay > 0.0:
             time.sleep(delay)
         if boom is not None:

@@ -15,6 +15,13 @@ import random
 import threading
 import time
 
+from ..telemetry.registry import REGISTRY
+
+_retry_attempts = REGISTRY.counter(
+    "retry_attempts_total",
+    "retries performed by RetryPolicy.call (first attempts are not "
+    "counted), labeled by the retried callable")
+
 
 class AttemptTimeout(TimeoutError):
     """A single attempt exceeded the policy's per-attempt budget."""
@@ -109,6 +116,7 @@ class RetryPolicy:
                 #                        always propagate unretried
                 if attempt >= self.max_attempts or not self.retryable(e):
                     raise
+                _retry_attempts.inc(fn=getattr(fn, "__name__", "?"))
                 if on_retry is not None:
                     on_retry(attempt, e)
                 self._sleep(self.backoff_s(attempt))

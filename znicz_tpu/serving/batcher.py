@@ -27,6 +27,7 @@ import time
 import numpy as np
 
 from ..resilience import faults
+from ..telemetry import tracing
 
 
 class QueueFull(Exception):
@@ -44,7 +45,7 @@ class DeadlineExceeded(Exception):
 
 class _Request:
     __slots__ = ("x", "arrival", "deadline", "event", "result", "error",
-                 "done_at")
+                 "done_at", "request_id")
 
     def __init__(self, x, deadline):
         self.x = x
@@ -54,6 +55,10 @@ class _Request:
         self.result = None
         self.error = None
         self.done_at = None
+        # captured at submit: the dispatch thread re-installs the whole
+        # batch's ids so downstream spans (engine.forward) stay
+        # correlated across the thread hop
+        self.request_id = tracing.current_request_id()
 
     @property
     def shape_key(self):
@@ -232,18 +237,25 @@ class MicroBatcher:
             x = (live[0].x if len(live) == 1
                  else np.concatenate([r.x for r in live]))
             t0 = time.monotonic()
+            token = tracing.set_request_ids(
+                [r.request_id for r in live if r.request_id])
             try:
-                # chaos latency/error site: sits BEFORE the engine so
-                # injected dispatch stalls exercise the deadline and
-                # server-timeout paths without touching device state
-                faults.inject("batcher.dispatch")
-                y = self._predict(x)
+                with tracing.span("batcher.dispatch",
+                                  rows=int(len(x)), requests=len(live)):
+                    # chaos latency/error site: sits BEFORE the engine
+                    # so injected dispatch stalls exercise the deadline
+                    # and server-timeout paths without touching device
+                    # state
+                    faults.inject("batcher.dispatch")
+                    y = self._predict(x)
             except Exception as e:
                 with self._cond:
                     self._stats["failed"] += len(live)
                 for r in live:
                     r.finish(error=e)
                 continue
+            finally:
+                tracing.reset_request_ids(token)
             dt = time.monotonic() - t0
             with self._cond:
                 self._stats["forward_calls"] += 1

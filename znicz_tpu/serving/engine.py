@@ -48,6 +48,7 @@ from ..export import ZnnLayer, read_znn
 from ..resilience import faults
 from ..resilience.breaker import CircuitBreaker, EngineUnavailable
 from ..resilience.retry import RetryPolicy
+from ..telemetry import tracing
 
 #: default pad-to-bucket ladder for request batch sizes
 DEFAULT_BUCKETS = (1, 8, 32, 128)
@@ -332,7 +333,9 @@ class ServingEngine:
             self._stats["fallback_calls"] += 1
             self._stats["rows_in"] += len(x)
         try:
-            return native.infer(x, feats)
+            with tracing.span("engine.forward", backend="fallback",
+                              rows=int(len(x))):
+                return native.infer(x, feats)
         except Exception as e:
             raise EngineUnavailable(
                 f"native fallback failed: {e!r}",
@@ -358,7 +361,9 @@ class ServingEngine:
             with self._lock:
                 self._stats["forward_calls"] += 1
                 self._stats["rows_in"] += len(x)
-            return self._native.infer(x, feats)
+            with tracing.span("engine.forward", backend="native",
+                              rows=int(len(x))):
+                return self._native.infer(x, feats)
         if not self.breaker.allow():
             return self._fallback_predict(x)
         top = self.buckets[-1]
@@ -376,8 +381,10 @@ class ServingEngine:
                     padded = chunk
                 fn = self._executable(bucket, chunk.shape[1:],
                                       chunk.dtype)
-                y = self.retry.call(self._forward_once, fn, padded,
-                                    on_retry=self._count_retry)
+                with tracing.span("engine.forward", backend="jax",
+                                  bucket=bucket, rows=int(len(chunk))):
+                    y = self.retry.call(self._forward_once, fn, padded,
+                                        on_retry=self._count_retry)
                 with self._lock:
                     self._stats["forward_calls"] += 1
                     self._stats["rows_in"] += len(chunk)

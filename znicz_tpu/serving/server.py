@@ -17,10 +17,21 @@ Wire protocol (JSON both ways):
   native CPU fallback serving) | ``open`` (circuit open, no fallback:
   predicts answer 503 + Retry-After) — so a load balancer can rotate a
   degraded replica out BEFORE clients see 503s.
-* ``GET /metrics``   batcher counters (queue depth, batch-size
-  histogram, p50/p99 latency, rejected/expired) merged with engine
-  counters (executable-cache hits/misses/evictions, forward calls,
-  breaker state/trips/probes, retry and fallback counts).
+* ``GET /metrics``   content-negotiated (znicz_tpu.telemetry): the
+  default JSON view is the PR-1 shape — batcher counters (queue depth,
+  batch-size histogram, p50/p99 latency, rejected/expired) merged with
+  engine counters (executable-cache hits/misses/evictions, forward
+  calls, breaker state/trips/probes, retry and fallback counts) — plus
+  a ``rev`` build stamp and the registry's request totals;
+  ``Accept: text/plain`` (or ``?format=prometheus``) answers the SAME
+  numbers as Prometheus text exposition v0.0.4, including the
+  ``predict_latency_ms`` histogram and ``breaker_state``.
+
+Request correlation: every ``POST /predict`` carries an
+``X-Request-Id`` (client-supplied or generated) echoed in the response
+and threaded through the batcher/engine spans
+(``telemetry.tracing.recent_spans``) and structured log lines — "where
+did this 503 come from" is answerable from the id alone.
 
 Degradation contract (pinned by the chaos tests): a persistent engine
 fault must never surface as a hang or a raw 500 — every request
@@ -31,13 +42,22 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from ..resilience.breaker import EngineUnavailable
+from ..telemetry import buildinfo, tracing
+from ..telemetry.registry import (PROMETHEUS_CONTENT_TYPE, REGISTRY,
+                                  DEFAULT_LATENCY_BUCKETS_MS)
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import ServingEngine
+
+#: routes with their own label value in requests_total/errors_total —
+#: anything else pools under "other" (label cardinality stays bounded
+#: no matter what paths clients probe)
+_ROUTES = ("/predict", "/healthz", "/metrics")
 
 
 class ServingServer:
@@ -65,29 +85,75 @@ class ServingServer:
             max_wait_ms=5.0 if max_wait_ms is None else max_wait_ms,
             max_queue=128 if max_queue is None else max_queue)
         self.default_timeout_s = default_timeout_s
+        #: build stamp for scraped metrics (same rule as bench.py's
+        #: transcript rows); computed once — forking git per scrape
+        #: would make /metrics the hottest endpoint on the box
+        self.rev = buildinfo.cached_rev()
+        self._requests = REGISTRY.counter(
+            "requests_total",
+            "HTTP requests answered, by route and status code")
+        self._errors = REGISTRY.counter(
+            "errors_total",
+            "HTTP responses with status >= 400, by route and status "
+            "code")
+        self._latency = REGISTRY.histogram(
+            "predict_latency_ms",
+            "POST /predict wall time at the HTTP front (parse + queue "
+            "+ batch + forward), milliseconds",
+            buckets=DEFAULT_LATENCY_BUCKETS_MS)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):     # keep serving logs clean
                 pass
 
-            def _reply(self, code: int, obj: dict,
-                       headers: dict | None = None):
-                body = json.dumps(obj, default=float).encode()
+            def _route(self) -> str:
+                path = self.path.split("?")[0].rstrip("/")
+                return path if path in _ROUTES else "other"
+
+            def _send(self, code: int, body: bytes, ctype: str,
+                      headers: dict | None = None):
+                route = self._route()
+                outer._requests.inc(route=route, code=str(code))
+                if code >= 400:
+                    outer._errors.inc(route=route, code=str(code))
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                rid = tracing.current_request_id()
+                if rid is not None:
+                    self.send_header("X-Request-Id", rid)
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _reply(self, code: int, obj: dict,
+                       headers: dict | None = None):
+                self._send(code, json.dumps(obj, default=float).encode(),
+                           "application/json", headers)
 
             def do_GET(self):
                 path = self.path.split("?")[0].rstrip("/")
                 if path == "/healthz":
                     self._reply(200, outer.health())
                 elif path == "/metrics":
-                    self._reply(200, outer.metrics())
+                    # content negotiation: Prometheus scrapers send
+                    # Accept: text/plain (and curl can force either
+                    # view with ?format=...); JSON stays the default
+                    # for the PR-1 consumers
+                    query = (self.path.split("?", 1)[1]
+                             if "?" in self.path else "")
+                    accept = self.headers.get("Accept", "")
+                    want_text = ("format=prometheus" in query
+                                 or ("text/plain" in accept
+                                     and "format=json" not in query))
+                    if want_text:
+                        self._send(200,
+                                   outer.prometheus_metrics().encode(),
+                                   PROMETHEUS_CONTENT_TYPE)
+                    else:
+                        self._reply(200, outer.metrics())
                 else:
                     self._reply(404, {"error": f"no route {self.path!r}"})
 
@@ -95,6 +161,19 @@ class ServingServer:
                 if self.path.split("?")[0].rstrip("/") != "/predict":
                     self._reply(404, {"error": f"no route {self.path!r}"})
                     return
+                # the request id lives in a contextvar for the rest of
+                # this handler thread's work: _reply echoes it, spans
+                # record it, and the batcher carries it across the
+                # dispatch-thread hop
+                rid = tracing.accept_request_id(
+                    self.headers.get("X-Request-Id"))
+                t0 = time.monotonic()
+                with tracing.request(rid):
+                    with tracing.span("server.predict"):
+                        self._predict()
+                outer._latency.observe((time.monotonic() - t0) * 1e3)
+
+            def _predict(self):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     if n > outer.max_body:
@@ -160,6 +239,11 @@ class ServingServer:
                         self._reply(200, {"outputs": y.tolist()})
 
         self.server = ThreadingHTTPServer((host, port), Handler)
+        # collector registration comes AFTER the bind: if the socket
+        # constructor raises (port in use), __init__ unwinds and
+        # stop() — the only unregister site — never runs, which would
+        # leak a dead server's families into every later scrape
+        REGISTRY.register_collector(self._collect_components)
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         daemon=True,
@@ -180,7 +264,59 @@ class ServingServer:
     def metrics(self) -> dict:
         m = self.batcher.metrics()
         m["engine"] = self.engine.metrics()
+        # build attribution + the registry's request totals: the same
+        # Counter objects back the Prometheus text view, so the two
+        # formats can never disagree
+        m["rev"] = self.rev
+        # NOTE: these are PROCESS totals (the registry counters are
+        # process-wide by design) — with several servers in one
+        # process they aggregate across all of them
+        m["requests"] = {
+            "requests_total": int(self._requests.total()),
+            "errors_total": int(self._errors.total()),
+            # per-route/code children, same label keys as the text
+            # view — comparing the views on a specific route sidesteps
+            # the one-off skew the scrape requests themselves introduce
+            "requests_by_route_code": self._requests.as_dict(),
+            "errors_by_route_code": self._errors.as_dict()}
         return m
+
+    def prometheus_metrics(self) -> str:
+        """The registry (first-class instruments + this server's
+        component collector) as Prometheus text exposition v0.0.4."""
+        return REGISTRY.render_prometheus()
+
+    def _collect_components(self):
+        """Registry collector: flatten the batcher/engine JSON scalars
+        into ``serving_batcher_*`` / ``serving_engine_*`` gauges and
+        the breaker into a state enum + trip/probe counters — sampled
+        at scrape time from the SAME dicts the JSON view serves."""
+        fams = []
+        em = self.engine.metrics()
+        for prefix, d in (("serving_batcher_", self.batcher.metrics()),
+                          ("serving_engine_", em)):
+            for k, v in sorted(d.items()):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue              # dicts/strings/None stay JSON
+                fams.append(("gauge", prefix + k,
+                             f"mirror of the /metrics JSON field {k!r}",
+                             [(None, float(v))]))
+        breaker = em.get("breaker") or {}
+        state = breaker.get("state")
+        if state:
+            fams.append((
+                "gauge", "breaker_state",
+                "circuit breaker state (the sample valued 1 is "
+                "current)",
+                [({"state": s}, 1.0 if s == state else 0.0)
+                 for s in ("closed", "open", "half_open")]))
+            fams.append(("counter", "breaker_trips_total",
+                         "closed/half_open -> open transitions",
+                         [(None, float(breaker.get("trips", 0)))]))
+            fams.append(("counter", "breaker_probes_total",
+                         "half-open probe attempts granted",
+                         [(None, float(breaker.get("probes", 0)))]))
+        return fams
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ServingServer":
@@ -188,6 +324,7 @@ class ServingServer:
         return self
 
     def stop(self) -> None:
+        REGISTRY.unregister_collector(self._collect_components)
         self.server.shutdown()
         self.server.server_close()
         if self._own_batcher:
@@ -239,6 +376,16 @@ def main(argv=None) -> int:
     p.add_argument("--fault-plan", default=None,
                    help="chaos: install a fault plan (inline JSON or "
                         "@file; see znicz_tpu.resilience.faults)")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the serving "
+                        "process into DIR (also: $ZNICZ_PROFILE_DIR; "
+                        "view with TensorBoard/xprof)")
+    p.add_argument("--profile-secs", type=float, default=60.0,
+                   help="bound the --profile-dir capture to this many "
+                        "seconds after startup (0 = until shutdown; "
+                        "bounded is the default because an unbounded "
+                        "trace of a long-lived server grows without "
+                        "limit and is only written out at stop)")
     args = p.parse_args(argv)
     if args.fault_plan is not None:
         from ..resilience import faults as _faults
@@ -256,23 +403,68 @@ def main(argv=None) -> int:
                           base_delay_s=0.02, max_delay_s=0.25),
         breaker=CircuitBreaker(failure_threshold=args.breaker_threshold,
                                cooldown_s=args.breaker_cooldown_s))
+    from ..telemetry import profiler
+    profile_dir = args.profile_dir or profiler.dir_from_env()
     server = None
     try:
+        # the trace starts BEFORE the server exists: the profiler's
+        # session hooks every live Python thread, and hooking a
+        # request-handler thread that is mid-flight at that instant
+        # has been observed to wedge the hook (and with it, external
+        # signal delivery).  Pre-server there is nothing to race.
+        profile_deadline = None
+        if profile_dir and profiler.start_trace(profile_dir):
+            if args.profile_secs > 0:
+                profile_deadline = time.monotonic() + args.profile_secs
+            print(f"profiling into {profile_dir} (jax.profiler; view "
+                  f"with TensorBoard/xprof)", flush=True)
+        # construct THEN start: if start() unwinds (KeyboardInterrupt),
+        # `server` must already be bound so the finally below can stop
+        # it — a skipped stop() leaks the registry collector
         server = ServingServer(engine, host=args.host, port=args.port,
                                max_batch=args.max_batch,
                                max_wait_ms=args.max_wait_ms,
                                max_queue=args.max_queue,
                                default_timeout_s=args.timeout_s,
-                               max_body_mb=args.max_body_mb
-                               ).start()
+                               max_body_mb=args.max_body_mb)
+        server.start()
         print(f"serving {args.model} [{engine.backend}] at "
               f"{server.url} (POST /predict, GET /healthz, "
               f"GET /metrics)", flush=True)
-        while True:
-            threading.Event().wait(3600)
+        # explicit shutdown signaling with a short-tick wait: Python
+        # runs signal handlers on the main thread only when it next
+        # executes bytecode, and the OS may deliver the C-level signal
+        # to ANY thread (observed here: with jax.profiler's extra
+        # threads live, a SIGINT lands on a worker and a main thread
+        # parked in one long wait never wakes to see it).  The 0.5s
+        # tick bounds shutdown latency; SIGTERM gets the same clean
+        # path as Ctrl-C for container runtimes.
+        import signal as _signal
+        stop = threading.Event()
+
+        def _arm():
+            for _sig in (_signal.SIGINT, _signal.SIGTERM):
+                _signal.signal(_sig, lambda *_: stop.set())
+        _arm()
+        while not stop.is_set():
+            stop.wait(0.5)
+            _arm()    # native libs (XLA's profiler) can clobber the
+            #           process sigaction; re-arming each tick keeps
+            #           Ctrl-C/SIGTERM working for the whole lifetime
+            if profile_deadline is not None \
+                    and time.monotonic() >= profile_deadline:
+                # windowed capture complete: write the trace NOW (an
+                # operator profiling a live replica should not have to
+                # stop it to read the trace) and let the profiler's
+                # worker threads wind down
+                profile_deadline = None
+                print(f"profile capture complete: "
+                      f"{profiler.stop_trace()}", flush=True)
     except KeyboardInterrupt:
         pass
     finally:
+        if profile_dir:
+            profiler.stop_trace()
         if server is not None:
             server.stop()
         engine.close()

@@ -24,10 +24,14 @@ Python overhead the reference suffered (SURVEY.md §3.1 hot-loop note)."""
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from .accelerated_units import AcceleratedWorkflow
 from .logger import MetricsWriter
+from .telemetry import profiler as _profiler
+from .telemetry.registry import REGISTRY
 from .mutable import DerivedBool
 from .loader.base import TRAIN
 from .nn import all2all, gd
@@ -185,6 +189,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
               compute_dtype: str | None = None,
               storage_dtype: str | None = None,
               profile_dir: str | None = None,
+              profile_every: int | None = None,
               mse_target: str | None = None):
         """One entry point over both execution paths (the samples' and
         launcher's ``--fused`` plumbing): the compiled fused step when
@@ -194,18 +199,29 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         ``compute_dtype``/``storage_dtype`` default from the config
         tree (``root.common.compute_dtype``/``storage_dtype``) so every
         sample and the two-file CLI reach the mixed-precision knobs via
-        config files or ``--set`` without per-sample plumbing."""
+        config files or ``--set`` without per-sample plumbing.
+
+        Profiling (znicz_tpu.telemetry.profiler): ``profile_dir`` alone
+        captures the whole run; with ``profile_every=N`` it captures a
+        one-step window every N steps instead (long runs).  Both
+        default from ``$ZNICZ_PROFILE_DIR`` / ``$ZNICZ_PROFILE_EVERY``
+        so a deployed run can be profiled without code changes."""
         from .config import root
         if compute_dtype is None:
             compute_dtype = root.common.get("compute_dtype")
         if storage_dtype is None:
             storage_dtype = root.common.get("storage_dtype")
+        if profile_dir is None:
+            profile_dir = _profiler.dir_from_env()
+        if profile_every is None:
+            profile_every = _profiler.every_from_env()
         if fused:
             if self.device.is_xla:
                 return self.run_fused(mesh=mesh, max_epochs=max_epochs,
                                       compute_dtype=compute_dtype,
                                       storage_dtype=storage_dtype,
                                       profile_dir=profile_dir,
+                                      profile_every=profile_every,
                                       mse_target=mse_target)
             self.warning("fused path needs an XLA device; falling back "
                          "to the unit-graph tick loop")
@@ -217,6 +233,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                   compute_dtype: str | None = None,
                   storage_dtype: str | None = None,
                   profile_dir: str | None = None,
+                  profile_every: int | None = None,
                   mse_target: str | None = None,
                   step_callback=None):
         """Train via the compiled fused step instead of the unit-graph
@@ -226,22 +243,35 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         the unit Vectors afterwards, so snapshotting/inspection work
         unchanged.  ``profile_dir`` wraps the run in a ``jax.profiler``
         trace (SURVEY.md §5 tracing row — the device-level complement to
-        ``time_table()``), landing next to the JSONL metrics.  Returns
-        the FusedTrainer (kept for further use)."""
+        ``time_table()``), landing next to the JSONL metrics; with
+        ``profile_every=N`` the capture is instead a windowed
+        :class:`~znicz_tpu.telemetry.profiler.StepTraceHook` firing
+        every N host steps (= epochs here: the whole epoch is one
+        device-side scan).  Returns the FusedTrainer (kept for further
+        use)."""
         import contextlib
-        if profile_dir is not None:
-            import jax
-            ctx = jax.profiler.trace(profile_dir)
+        hook = None
+        if profile_dir is not None and profile_every:
+            hook = _profiler.StepTraceHook(profile_dir,
+                                           every=int(profile_every))
+            ctx = contextlib.nullcontext()
+        elif profile_dir is not None:
+            ctx = _profiler.trace(profile_dir)
         else:
             ctx = contextlib.nullcontext()
-        with ctx:
-            return self._run_fused_body(mesh, max_epochs, compute_dtype,
-                                        storage_dtype, mse_target,
-                                        step_callback)
+        try:
+            with ctx:
+                return self._run_fused_body(mesh, max_epochs,
+                                            compute_dtype,
+                                            storage_dtype, mse_target,
+                                            step_callback, hook)
+        finally:
+            if hook is not None:
+                hook.close()
 
     def _run_fused_body(self, mesh, max_epochs, compute_dtype,
                         storage_dtype=None, mse_target=None,
-                        step_callback=None):
+                        step_callback=None, profile_hook=None):
         import dataclasses
 
         from .config import root
@@ -330,7 +360,22 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         # minibatch update until it knows training continues.
         pending = None   # (tail_idx, epoch, lr_scale, ctr_base,
         #            lr_scale_bias)
+        # training throughput gauges (telemetry): one registry, so the
+        # web status page and any /metrics scraper see live step time
+        # and examples/sec next to the serving numbers
+        g_step_ms = REGISTRY.gauge(
+            "train_step_time_ms",
+            "mean per-minibatch wall time over the last epoch, "
+            "milliseconds (fused loop: epoch wall / steps)")
+        g_eps = REGISTRY.gauge(
+            "train_examples_per_sec",
+            "training examples consumed per second over the last epoch")
+        g_epoch = REGISTRY.gauge("train_epoch",
+                                 "last completed training epoch index")
         for epoch in range(loader.epoch_number, epochs):
+            if profile_hook is not None:
+                profile_hook.on_step(epoch)
+            t_epoch0 = time.monotonic()
             loader.epoch_number = epoch
             if not first:   # initialize() already built epoch 0's plan —
                 loader._build_epoch_plan()   # reuse the loader's shuffle
@@ -403,6 +448,13 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                     metrics["validation_mse"] = metrics["validation_loss"]
             decision.epoch_metrics.append(metrics)
             loader.epoch_number = epoch + 1
+            epoch_s = time.monotonic() - t_epoch0
+            if epoch_s > 0:
+                # gauges only — the metrics dict stays timing-free so
+                # fused-vs-tick parity comparisons keep holding
+                g_step_ms.set(epoch_s / steps_per_epoch * 1e3)
+                g_eps.set(n_train / epoch_s)
+            g_epoch.set(epoch)
             self.metrics_writer.write(kind="epoch", **metrics)
             if self.lr_adjuster is not None:
                 # keep the tick-path iteration counter current so

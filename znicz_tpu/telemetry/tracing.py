@@ -1,0 +1,178 @@
+"""Request tracing: propagated request ids + lightweight spans.
+
+The serving path spans three threads — HTTP handler → micro-batcher
+dispatch → engine forward — and before this module there was no way to
+answer "where did this 503 come from": the handler knew the client, the
+batcher knew the coalesced batch, the engine knew the device error, and
+nothing tied them together.
+
+* Every ``POST /predict`` gets a **request id**: taken from the
+  client's ``X-Request-Id`` header when present (so ids propagate
+  across service hops), else generated.  The id is stamped into the
+  response header, every structured log line
+  (``logger.configure`` + ``ZNICZ_LOG_JSON=1``), and every span the
+  request touches.
+* A **span** is a named monotonic timing with attributes — created via
+  the :func:`span` context manager, recorded into a bounded in-process
+  ring (:func:`recent_spans`) and observed into the registry histogram
+  ``span_duration_ms{span=...}`` so p50/p99 per stage fall out of the
+  same ``/metrics`` scrape.
+
+Propagation is ``contextvars``-based, which covers the single-thread
+case for free; the batcher crosses a thread boundary, so the dispatch
+loop re-installs the batch's ids via :func:`set_request_ids` — a span
+opened inside (e.g. ``engine.forward``) then tags itself with every
+request riding the batch.
+
+Deliberately tiny: no sampling, no export protocol, no clock skew —
+an OpenTelemetry pipeline can graft on later; what the repo needs NOW
+is correlation and stage latency, in-process, with zero dependencies.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+
+from .registry import REGISTRY
+
+#: ids of every request the current context is working for — one for a
+#: handler thread, many for a dispatch thread running a coalesced batch
+_request_ids: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "znicz_request_ids", default=())
+
+_MAX_ID_LEN = 120
+
+_lock = threading.Lock()
+_recent: collections.deque = collections.deque(maxlen=512)
+
+_span_hist = REGISTRY.histogram(
+    "span_duration_ms",
+    "span wall time by stage (server.predict / batcher.dispatch / "
+    "engine.forward / ...), milliseconds")
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def accept_request_id(raw) -> str:
+    """A client-supplied ``X-Request-Id`` value, sanitized (printable,
+    bounded length) — or a fresh id when absent/unusable.  Sanitizing
+    matters because the id is echoed into headers and log lines: a
+    hostile header must not smuggle newlines into either."""
+    if raw:
+        rid = "".join(c for c in str(raw).strip() if c.isprintable())
+        if rid:
+            return rid[:_MAX_ID_LEN]
+    return new_request_id()
+
+
+def current_request_ids() -> tuple:
+    return _request_ids.get()
+
+
+def current_request_id() -> str | None:
+    ids = _request_ids.get()
+    return ids[0] if ids else None
+
+
+def set_request_ids(ids) -> contextvars.Token:
+    """Install ``ids`` as the current context's request ids; returns
+    the token for :func:`reset_request_ids`.  Used where propagation
+    crosses a thread boundary (the batcher's dispatch loop)."""
+    return _request_ids.set(tuple(ids))
+
+
+def reset_request_ids(token: contextvars.Token) -> None:
+    _request_ids.reset(token)
+
+
+@contextlib.contextmanager
+def request(request_id: str | None = None):
+    """Scope one request id over the current context (handler-thread
+    form).  Yields the effective id."""
+    rid = request_id or new_request_id()
+    token = _request_ids.set((rid,))
+    try:
+        yield rid
+    finally:
+        _request_ids.reset(token)
+
+
+class Span:
+    """One finished (or in-flight) timing record."""
+
+    __slots__ = ("name", "request_ids", "attrs", "started_at",
+                 "_t0", "duration_ms", "status", "error")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.request_ids = current_request_ids()
+        self.attrs = attrs
+        self.started_at = time.time()
+        self._t0 = time.monotonic()
+        self.duration_ms: float | None = None
+        self.status = "in_flight"
+        self.error: str | None = None
+
+    def finish(self, error: BaseException | None = None) -> "Span":
+        self.duration_ms = (time.monotonic() - self._t0) * 1e3
+        self.status = "error" if error is not None else "ok"
+        if error is not None:
+            self.error = f"{type(error).__name__}: {error}"[:300]
+        return self
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "request_ids": list(self.request_ids),
+                "started_at": self.started_at,
+                "duration_ms": self.duration_ms, "status": self.status,
+                "error": self.error, **self.attrs}
+
+    def __repr__(self):
+        return (f"<Span {self.name} {self.status} "
+                f"{self.duration_ms and round(self.duration_ms, 3)}ms "
+                f"ids={list(self.request_ids)}>")
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Time a stage; record it on exit (status ``error`` when the body
+    raises — the exception itself propagates unchanged)."""
+    sp = Span(name, attrs)
+    try:
+        yield sp
+    except BaseException as e:
+        _record(sp.finish(error=e))
+        raise
+    else:
+        _record(sp.finish())
+
+
+def _record(sp: Span) -> None:
+    with _lock:
+        _recent.append(sp)
+    _span_hist.observe(sp.duration_ms, span=sp.name)
+
+
+def recent_spans(n: int | None = None, name: str | None = None,
+                 request_id: str | None = None) -> list[Span]:
+    """Newest-last slice of the span ring, optionally filtered by span
+    name and/or by a request id appearing in the span's batch."""
+    with _lock:
+        spans = list(_recent)
+    if name is not None:
+        spans = [s for s in spans if s.name == name]
+    if request_id is not None:
+        spans = [s for s in spans if request_id in s.request_ids]
+    return spans[-n:] if n is not None else spans
+
+
+def clear() -> None:
+    """Drop the ring (test isolation)."""
+    with _lock:
+        _recent.clear()

@@ -19,6 +19,8 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .telemetry.registry import PROMETHEUS_CONTENT_TYPE, REGISTRY
+
 _PAGE = """<!doctype html><html><head><title>znicz-tpu status</title>
 <meta http-equiv="refresh" content="3"><style>
 body{font-family:monospace;margin:2em}table{border-collapse:collapse}
@@ -118,6 +120,13 @@ class StatusServer:
                     body = json.dumps(outer.snapshot(),
                                       default=float).encode()
                     ctype = "application/json"
+                elif self.path.endswith("metrics"):
+                    # the training process speaks the same scrape
+                    # format as the serving front (telemetry registry:
+                    # train_step_time_ms, examples/sec, retry/fault
+                    # counters, span histograms)
+                    body = REGISTRY.render_prometheus().encode()
+                    ctype = PROMETHEUS_CONTENT_TYPE
                 elif self.path.endswith("plot.svg"):
                     body = render_plot_svg(
                         outer.snapshot()["metrics"]).encode()
@@ -149,6 +158,11 @@ class StatusServer:
             "n_units": len(wf.units),
             "device": type(device).__name__ if device else None,
             "time_table": wf.time_table()[:10],
+            # the shared registry (step timing/throughput gauges,
+            # retry/fault/breaker counters, span histograms) replaces
+            # any per-server private metric dict — one store, every
+            # view (PR 3 telemetry seam)
+            "telemetry": REGISTRY.as_dict(),
         }
 
     def start(self) -> "StatusServer":
