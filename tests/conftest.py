@@ -37,6 +37,11 @@ def pytest_configure(config):
         "markers", "chaos: seeded fault-injection tests driven by "
                    "znicz_tpu.resilience.FaultPlan (deterministic, "
                    "in-process; part of tier-1)")
+    config.addinivalue_line(
+        "markers", "lint: zlint static-analysis gate "
+                   "(znicz_tpu.analysis over the whole package; part "
+                   "of tier-1, runnable standalone via `pytest -m "
+                   "lint`)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,680 @@
+"""znicz_tpu.analysis ("zlint") — per-rule fixtures + the repo gate.
+
+Each rule family gets a known-bad snippet that must fire and a
+known-good twin that must stay silent (ISSUE 4 acceptance); suppression
+and baseline handling get a full round-trip; and the whole-repo run is
+the tier-1 gate (`pytest -m lint` runs it standalone).
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from znicz_tpu.analysis import (Analyzer, HandlerSafetyRule,
+                                JaxHygieneRule, LockDisciplineRule,
+                                MetricDriftRule, UnseededRandomRule,
+                                load_baseline, run_repo,
+                                write_baseline)
+from znicz_tpu.analysis import cli as zlint_cli
+
+
+def lint(tmp_path, source, rules, rel="pkg/mod.py"):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return Analyzer(rules, root=str(tmp_path)).run([rel])
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- lock discipline -------------------------------------------------------
+
+LOCKED_BAD = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def peek(self):
+            return self._items[-1]        # unguarded read
+"""
+
+LOCKED_GOOD = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self.limit = 8                # config: never mutated
+
+        def add(self, x):
+            with self._lock:
+                if len(self._items) < self.limit:
+                    self._items.append(x)
+
+        def peek(self):
+            with self._lock:
+                return self._items[-1]
+
+        def capacity(self):
+            return self.limit             # config read: not guarded
+"""
+
+
+class TestLockDiscipline:
+    def test_unguarded_read_fires(self, tmp_path):
+        found = lint(tmp_path, LOCKED_BAD, [LockDisciplineRule()])
+        assert rules_of(found) == ["lock-discipline"]
+        assert len(found) == 1
+        assert "_items" in found[0].message
+        assert found[0].path == "pkg/mod.py"
+
+    def test_guarded_class_is_silent(self, tmp_path):
+        assert lint(tmp_path, LOCKED_GOOD, [LockDisciplineRule()]) == []
+
+    def test_unguarded_write_fires(self, tmp_path):
+        src = LOCKED_BAD.replace(
+            "return self._items[-1]        # unguarded read",
+            "self._items = []              # unguarded write")
+        found = lint(tmp_path, src, [LockDisciplineRule()])
+        assert len(found) == 1 and "written" in found[0].message
+
+    def test_init_is_exempt(self, tmp_path):
+        # __init__ builds state before any other thread can see it
+        found = lint(tmp_path, LOCKED_GOOD + """
+    class Box2(Box):
+        def __init__(self):
+            super().__init__()
+            with self._lock:
+                self._items.append(0)
+            self._items.append(1)         # still __init__: exempt
+""", [LockDisciplineRule()])
+        assert found == []
+
+    def test_lock_held_helper_inferred(self, tmp_path):
+        # a private helper only ever called under the lock runs under
+        # it by construction (the MicroBatcher._queued_rows idiom)
+        found = lint(tmp_path, """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rows = []
+
+        def _count(self):
+            return len(self._rows)        # callers hold the lock
+
+        def add(self, r):
+            with self._lock:
+                if self._count() < 10:
+                    self._rows.append(r)
+
+        def size(self):
+            with self._lock:
+                return self._count()
+""", [LockDisciplineRule()])
+        assert found == []
+
+    def test_helper_also_called_bare_is_flagged(self, tmp_path):
+        found = lint(tmp_path, """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rows = []
+
+        def _count(self):
+            return len(self._rows)
+
+        def add(self, r):
+            with self._lock:
+                if self._count() < 10:
+                    self._rows.append(r)
+
+        def size(self):
+            return self._count()          # bare call site
+""", [LockDisciplineRule()])
+        assert rules_of(found) == ["lock-discipline"]
+
+    def test_annotated_assignment_is_a_mutation(self, tmp_path):
+        # `self.x: int = v` must count as a write — an added type
+        # annotation must not disarm the rule
+        found = lint(tmp_path, """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.total = 0
+
+        def bump(self):
+            with self._lock:
+                self.total += 1
+
+        def reset(self):
+            self.total: int = 0           # annotated unguarded write
+""", [LockDisciplineRule()])
+        assert len(found) == 1 and "written" in found[0].message
+
+    def test_condition_counts_as_lock(self, tmp_path):
+        found = lint(tmp_path, """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._jobs = []
+
+        def put(self, j):
+            with self._cond:
+                self._jobs.append(j)
+                self._cond.notify_all()
+
+        def depth(self):
+            return len(self._jobs)        # unguarded
+""", [LockDisciplineRule()])
+        assert len(found) == 1 and "_jobs" in found[0].message
+
+
+# -- JAX hygiene -----------------------------------------------------------
+
+class TestJaxHygiene:
+    def test_item_inside_jit_fires(self, tmp_path):
+        found = lint(tmp_path, """
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x.sum().item()
+""", [JaxHygieneRule()])
+        assert rules_of(found) == ["jit-host-sync"]
+
+    def test_branch_on_traced_param_fires(self, tmp_path):
+        found = lint(tmp_path, """
+    import jax
+
+    @jax.jit
+    def step(x):
+        if x > 0:
+            return x
+        return -x
+""", [JaxHygieneRule()])
+        assert rules_of(found) == ["jit-traced-branch"]
+
+    def test_static_argnames_are_exempt(self, tmp_path):
+        found = lint(tmp_path, """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def tile(x, n):
+        if n > 1:                    # static at trace time
+            return x * n
+        return x
+""", [JaxHygieneRule()])
+        assert found == []
+
+    def test_shape_and_none_tests_are_exempt(self, tmp_path):
+        found = lint(tmp_path, """
+    import jax
+
+    @jax.jit
+    def step(x, mask):
+        if x.shape[0] > 2:
+            x = x[:2]
+        if mask is None:
+            return x
+        if len(x) > 4:
+            return x * 2
+        return x * mask
+""", [JaxHygieneRule()])
+        assert found == []
+
+    def test_wrapped_local_function_is_scanned(self, tmp_path):
+        found = lint(tmp_path, """
+    import jax
+    import numpy as np
+
+    def build():
+        def step(p, x):
+            return p * np.asarray(x)
+        return jax.jit(step, donate_argnums=(0,))
+""", [JaxHygieneRule()])
+        assert rules_of(found) == ["jit-host-sync"]
+
+    def test_host_twin_of_jitted_name_not_scanned(self, tmp_path):
+        # the FusedTrainer shape: a nested jitted `train_epoch` AND a
+        # host-side method of the same name — scope resolution must
+        # pin the jit to the nested def only
+        found = lint(tmp_path, """
+    import jax
+    import numpy as np
+
+    class T:
+        def _build(self):
+            def train_epoch(p, x):
+                return p + x
+            self._fn = jax.jit(train_epoch)
+
+        def train_epoch(self, x):
+            return np.asarray(self._fn(0, x))   # host code: fine
+""", [JaxHygieneRule()])
+        assert found == []
+
+    def test_nested_def_shadows_traced_param(self, tmp_path):
+        # a helper parameter reusing a traced param's name is a
+        # concrete local, not the traced value
+        found = lint(tmp_path, """
+    import jax
+
+    @jax.jit
+    def f(x):
+        def helper(x=3):
+            if x > 0:
+                return 1
+            return 0
+        return x * helper()
+""", [JaxHygieneRule()])
+        assert found == []
+
+    def test_unjitted_function_is_ignored(self, tmp_path):
+        found = lint(tmp_path, """
+    def host(x):
+        return x.sum().item()
+""", [JaxHygieneRule()])
+        assert found == []
+
+
+class TestUnseededRandom:
+    def test_global_numpy_rng_fires(self, tmp_path):
+        found = lint(tmp_path, """
+    import numpy as np
+
+    def jitter():
+        return np.random.uniform(0, 1)
+""", [UnseededRandomRule()])
+        assert rules_of(found) == ["unseeded-random"]
+
+    def test_global_stdlib_rng_fires(self, tmp_path):
+        found = lint(tmp_path, """
+    import random
+
+    def jitter():
+        return random.random()
+""", [UnseededRandomRule()])
+        assert rules_of(found) == ["unseeded-random"]
+
+    def test_seedless_generator_construction_fires(self, tmp_path):
+        # default_rng()/Random() with no seed pulls OS entropy — just
+        # as irreproducible as the global RNG
+        found = lint(tmp_path, """
+    import random
+    import numpy as np
+
+    def make():
+        return np.random.default_rng(), random.Random()
+""", [UnseededRandomRule()])
+        assert len(found) == 2
+        assert all(f.rule == "unseeded-random" for f in found)
+        assert any("default_rng" in f.message for f in found)
+
+    def test_seeded_generators_pass(self, tmp_path):
+        found = lint(tmp_path, """
+    import random
+    import numpy as np
+
+    def make(seed):
+        gen = np.random.default_rng(seed)
+        alt = np.random.Generator(np.random.PCG64(seed))
+        py = random.Random(seed)
+        return gen.uniform(), alt.normal(), py.random()
+""", [UnseededRandomRule()])
+        assert found == []
+
+
+# -- handler safety --------------------------------------------------------
+
+class TestHandlerSafety:
+    def test_sleep_in_do_get_fires(self, tmp_path):
+        found = lint(tmp_path, """
+    import time
+
+    class Handler:
+        def do_GET(self):
+            time.sleep(1.0)
+            self.wfile.write(b"ok")
+""", [HandlerSafetyRule()])
+        assert rules_of(found) == ["handler-blocking"]
+        assert "time.sleep" in found[0].message
+
+    def test_blocking_helper_reachable_from_handler(self, tmp_path):
+        found = lint(tmp_path, """
+    import subprocess
+
+    class Handler:
+        def do_POST(self):
+            self._work()
+
+        def _work(self):
+            subprocess.run(["convert", "img"])
+""", [HandlerSafetyRule()])
+        assert len(found) == 1 and "subprocess" in found[0].message
+
+    def test_handler_file_io_fires(self, tmp_path):
+        found = lint(tmp_path, """
+    class Handler:
+        def do_GET(self):
+            with open("/var/log/x") as fh:
+                self.wfile.write(fh.read().encode())
+""", [HandlerSafetyRule()])
+        assert len(found) == 1 and "file I/O" in found[0].message
+
+    def test_unbounded_join_on_dispatch_thread(self, tmp_path):
+        found = lint(tmp_path, """
+    import threading
+
+    class Pump:
+        def __init__(self, worker):
+            self.worker = worker
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            self.worker.join()            # no timeout
+""", [HandlerSafetyRule()])
+        assert len(found) == 1 and ".join()" in found[0].message
+
+    def test_bounded_waits_pass(self, tmp_path):
+        found = lint(tmp_path, """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            with self._cond:
+                self._cond.wait(0.25)
+
+        def do_GET(self):
+            self.wfile.write(b"ok")
+
+    class Pump2(Pump):
+        def close(self):
+            self._thread.join(timeout=5.0)
+""", [HandlerSafetyRule()])
+        assert found == []
+
+
+# -- metric drift ----------------------------------------------------------
+
+def _drift_repo(tmp_path, doc_names=("foo_total",),
+                registered=("foo_total",), script_names=()):
+    mod = tmp_path / "pkg" / "m.py"
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    lines = ["from telemetry import REGISTRY", ""]
+    for name in registered:
+        lines.append(f'_c = REGISTRY.counter("{name}", "help")')
+    mod.write_text("\n".join(lines) + "\n")
+    doc = tmp_path / "docs" / "obs.md"
+    doc.parent.mkdir(parents=True, exist_ok=True)
+    rows = ["# metrics", "", "| metric | type |", "|---|---|"]
+    rows += [f"| `{n}` | counter |" for n in doc_names]
+    doc.write_text("\n".join(rows) + "\n")
+    sh = tmp_path / "tools" / "smoke.sh"
+    sh.parent.mkdir(parents=True, exist_ok=True)
+    sh.write_text("\n".join(f'grep {n} /tmp/scrape'
+                            for n in script_names) + "\n")
+    rule = MetricDriftRule(doc_paths=("docs/obs.md",),
+                           script_paths=("tools/smoke.sh",))
+    return Analyzer([rule], root=str(tmp_path)).run(["pkg/m.py"])
+
+
+class TestMetricDrift:
+    def test_in_sync_is_silent(self, tmp_path):
+        assert _drift_repo(tmp_path) == []
+
+    def test_doc_reference_without_registration(self, tmp_path):
+        found = _drift_repo(tmp_path,
+                            doc_names=("foo_total", "gone_total"))
+        assert len(found) == 1
+        assert "gone_total" in found[0].message
+        assert found[0].path == "docs/obs.md"
+
+    def test_script_reference_without_registration(self, tmp_path):
+        found = _drift_repo(tmp_path, script_names=("phantom_total",))
+        assert len(found) == 1 and "phantom_total" in found[0].message
+        assert found[0].path == "tools/smoke.sh"
+
+    def test_histogram_suffixes_fold_to_base(self, tmp_path):
+        found = _drift_repo(tmp_path,
+                            doc_names=("lat_ms",),
+                            registered=("lat_ms",),
+                            script_names=("lat_ms_bucket",
+                                          "lat_ms_count"))
+        assert found == []
+
+    def test_orphaned_registration(self, tmp_path):
+        found = _drift_repo(tmp_path,
+                            registered=("foo_total", "secret_total"))
+        assert len(found) == 1
+        assert "secret_total" in found[0].message
+        assert found[0].path == "pkg/m.py"
+
+    def test_collector_family_and_prefix(self, tmp_path):
+        mod = tmp_path / "pkg" / "m.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text(textwrap.dedent("""
+            def collect(self):
+                fams = []
+                for prefix, d in (("eng_", self.metrics()),):
+                    for k, v in d.items():
+                        fams.append(("gauge", prefix + k, "m", []))
+                fams.append(("gauge", "pump_state", "s", []))
+                return fams
+        """))
+        doc = tmp_path / "docs" / "obs.md"
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        doc.write_text("`pump_state` is an enum; `eng_busy_ms` too\n")
+        (tmp_path / "tools").mkdir(exist_ok=True)
+        (tmp_path / "tools" / "smoke.sh").write_text("")
+        rule = MetricDriftRule(doc_paths=("docs/obs.md",),
+                               script_paths=("tools/smoke.sh",))
+        assert Analyzer([rule],
+                        root=str(tmp_path)).run(["pkg/m.py"]) == []
+
+
+# -- suppression + baseline ------------------------------------------------
+
+class TestSuppression:
+    def test_inline_disable(self, tmp_path):
+        src = LOCKED_BAD.replace(
+            "# unguarded read", "# zlint: disable=lock-discipline")
+        assert lint(tmp_path, src, [LockDisciplineRule()]) == []
+
+    def test_inline_disable_all(self, tmp_path):
+        src = LOCKED_BAD.replace(
+            "# unguarded read", "# zlint: disable=all")
+        assert lint(tmp_path, src, [LockDisciplineRule()]) == []
+
+    def test_wrong_rule_name_still_fires(self, tmp_path):
+        src = LOCKED_BAD.replace(
+            "# unguarded read", "# zlint: disable=metric-drift")
+        assert len(lint(tmp_path, src, [LockDisciplineRule()])) == 1
+
+    def test_standalone_comment_covers_next_line(self, tmp_path):
+        src = LOCKED_BAD.replace(
+            "            return self._items[-1]        # unguarded read",
+            "            # zlint: disable=lock-discipline\n"
+            "            return self._items[-1]")
+        assert lint(tmp_path, src, [LockDisciplineRule()]) == []
+
+    def test_def_line_disable_covers_body(self, tmp_path):
+        src = LOCKED_BAD.replace(
+            "def peek(self):",
+            "def peek(self):  # zlint: disable=lock-discipline")
+        assert lint(tmp_path, src, [LockDisciplineRule()]) == []
+
+    def test_baseline_round_trip(self, tmp_path):
+        """add → suppressed → removed-from-baseline → flagged again."""
+        rel = "pkg/mod.py"
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(LOCKED_BAD))
+        bl = tmp_path / "zlint_baseline.json"
+
+        an = Analyzer([LockDisciplineRule()], root=str(tmp_path),
+                      baseline_path=str(bl))
+        found = an.run([rel])
+        assert len(found) == 1 and an.new_findings(found) == found
+
+        write_baseline(str(bl), found)       # add
+        assert len(load_baseline(str(bl))) == 1
+        an2 = Analyzer([LockDisciplineRule()], root=str(tmp_path),
+                       baseline_path=str(bl))
+        found2 = an2.run([rel])
+        assert len(found2) == 1              # still reported raw...
+        assert an2.new_findings(found2) == []   # ...but suppressed
+
+        write_baseline(str(bl), [])          # removed from baseline
+        an3 = Analyzer([LockDisciplineRule()], root=str(tmp_path),
+                       baseline_path=str(bl))
+        found3 = an3.run([rel])
+        assert an3.new_findings(found3) == found3 and len(found3) == 1
+
+    def test_write_baseline_preserves_handwritten_notes(self, tmp_path):
+        """Regenerating must carry forward curated notes for entries
+        that survive, not clobber them back to TODO."""
+        rel = "pkg/mod.py"
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(LOCKED_BAD))
+        bl = tmp_path / "bl.json"
+        an = Analyzer([LockDisciplineRule()], root=str(tmp_path))
+        found = an.run([rel])
+        write_baseline(str(bl), found)
+        data = json.loads(bl.read_text())
+        data["entries"][0]["note"] = "deliberate: snapshot read"
+        bl.write_text(json.dumps(data))
+        write_baseline(str(bl), found)       # regenerate
+        data2 = json.loads(bl.read_text())
+        assert data2["entries"][0]["note"] == "deliberate: snapshot read"
+
+    def test_baseline_invalidated_by_code_change(self, tmp_path):
+        """Baseline entries match on the source line text: editing the
+        flagged line re-arms the finding (no stale suppressions)."""
+        rel = "pkg/mod.py"
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(LOCKED_BAD))
+        bl = tmp_path / "bl.json"
+        an = Analyzer([LockDisciplineRule()], root=str(tmp_path),
+                      baseline_path=str(bl))
+        write_baseline(str(bl), an.run([rel]))
+        path.write_text(textwrap.dedent(LOCKED_BAD.replace(
+            "self._items[-1]", "self._items[0]")))
+        an2 = Analyzer([LockDisciplineRule()], root=str(tmp_path),
+                       baseline_path=str(bl))
+        assert len(an2.new_findings(an2.run([rel]))) == 1
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        found = lint(tmp_path, "def broken(:\n", [LockDisciplineRule()])
+        assert rules_of(found) == ["parse-error"]
+
+    def test_rerun_does_not_duplicate_parse_errors(self, tmp_path):
+        rel = "pkg/mod.py"
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text("def broken(:\n")
+        an = Analyzer([LockDisciplineRule()], root=str(tmp_path))
+        assert len(an.run([rel])) == 1
+        assert len(an.run([rel])) == 1      # reused Analyzer: still 1
+
+
+@pytest.mark.lint
+def test_path_subset_run_has_no_spurious_drift():
+    """Linting ONE file must not turn every out-of-subset metric
+    registration into an 'unregistered reference' — repo rules run
+    over the full walk regardless of the per-module path subset."""
+    findings, new, _ = run_repo(paths=["znicz_tpu/analysis/core.py"])
+    drift = [f for f in new if f.rule == "metric-drift"]
+    assert drift == [], "\n".join(f.render() for f in drift)
+
+
+# -- CLI -------------------------------------------------------------------
+
+class TestCli:
+    def test_json_format_and_exit_codes(self, tmp_path, capsys):
+        rel = "pkg/mod.py"
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(LOCKED_BAD))
+        rc = zlint_cli.main([rel, "--root", str(tmp_path),
+                             "--format", "json", "--no-baseline"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1 and not out["ok"]
+        assert out["findings"][0]["rule"] == "lock-discipline"
+
+        path.write_text(textwrap.dedent(LOCKED_GOOD))
+        rc = zlint_cli.main([rel, "--root", str(tmp_path),
+                             "--format", "json", "--no-baseline"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["ok"] and out["findings"] == []
+
+    def test_write_baseline_refuses_path_subset(self, tmp_path):
+        # a subset's findings would silently drop every entry for
+        # unanalyzed files
+        with pytest.raises(SystemExit) as exc:
+            zlint_cli.main(["pkg/mod.py", "--root", str(tmp_path),
+                            "--write-baseline"])
+        assert exc.value.code == 2
+
+
+# -- the tier-1 gate -------------------------------------------------------
+
+@pytest.mark.lint
+class TestRepoGate:
+    def test_whole_repo_has_no_new_findings(self):
+        """THE gate: zlint over the real package must be clean (inline
+        suppressions and justified baseline entries excepted)."""
+        findings, new, _ = run_repo()
+        assert not new, (
+            "zlint found new issues (fix them, add an inline "
+            "`# zlint: disable=RULE` with a comment, or baseline "
+            "deliberately):\n" + "\n".join(f.render() for f in new))
+
+    def test_baseline_entries_are_justified(self):
+        """Every baseline entry must carry a real note — an
+        unjustified entry is a muted bug, not a decision."""
+        import os
+        from znicz_tpu.analysis.core import default_root
+        path = os.path.join(default_root(), "tools/zlint_baseline.json")
+        with open(path) as fh:
+            data = json.load(fh)
+        for entry in data.get("entries", []):
+            note = entry.get("note", "")
+            assert note and "TODO" not in note, (
+                f"baseline entry for {entry['path']} "
+                f"[{entry['rule']}] has no justification: {entry}")
+
+    def test_cli_gate_exits_zero(self):
+        """`python -m znicz_tpu lint` is what tools/lint.sh and CI
+        call; it must agree with the in-process gate."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "znicz_tpu", "lint"],
+            capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

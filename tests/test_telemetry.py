@@ -9,6 +9,7 @@ import json
 import logging
 import re
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -379,7 +380,17 @@ class TestServingTelemetry:
         assert headers.get("X-Request-Id") == rid
         for name in ("server.predict", "batcher.dispatch",
                      "engine.forward"):
-            spans = tracing.recent_spans(name=name, request_id=rid)
+            # the response bytes hit the socket INSIDE the
+            # server.predict span, so the handler thread records the
+            # span a hair after the client sees the 200 — poll
+            # briefly instead of racing it (observed ~1/6 flaky under
+            # CPU contention)
+            deadline = time.monotonic() + 2.0
+            while True:
+                spans = tracing.recent_spans(name=name, request_id=rid)
+                if spans or time.monotonic() > deadline:
+                    break
+                time.sleep(0.01)
             assert spans, f"no {name} span carries {rid}"
             assert all(s.status == "ok" and s.duration_ms >= 0
                        for s in spans)

@@ -1,0 +1,15 @@
+#!/bin/bash
+# zlint gate (ISSUE 4, operator-runnable): run the project's AST-based
+# concurrency & JAX-hygiene analyzer over znicz_tpu/ and exit non-zero
+# on any NEW finding (inline `# zlint: disable=RULE` suppressions and
+# justified tools/zlint_baseline.json entries pass).
+#
+# The same check gates tier-1 through tests/test_analysis.py (run it
+# standalone with `pytest -m lint`).  Rule docs + suppression syntax:
+# docs/static_analysis.md.
+#
+# Usage:  bash tools/lint.sh [extra zlint args...]
+#         bash tools/lint.sh --format json
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m znicz_tpu lint --format text "$@"

@@ -20,6 +20,10 @@ Examples::
     python -m znicz_tpu chaos
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos)
+    python -m znicz_tpu lint [--format json|text] [--baseline ...]
+        # zlint: AST-based concurrency & JAX-hygiene analyzer over the
+        # package (znicz_tpu.analysis; docs/static_analysis.md); exits
+        # non-zero on new findings — tier-1 gates on it (pytest -m lint)
 """
 
 from __future__ import annotations
@@ -71,6 +75,10 @@ def main(argv=None) -> int:
         # znicz_tpu/resilience/chaos.py and tools/chaos_smoke.sh
         from .resilience.chaos import main as chaos_main
         return chaos_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # static analysis gate — znicz_tpu/analysis, tools/lint.sh
+        from .analysis.cli import main as lint_main
+        return lint_main(argv[1:])
     args = make_parser().parse_args(argv)
     launcher = Launcher(
         workflow=args.workflow, config=args.config, backend=args.backend,

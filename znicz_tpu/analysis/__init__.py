@@ -1,0 +1,37 @@
+"""znicz_tpu.analysis — "zlint", the project's AST-based static
+analyzer (ISSUE 4).
+
+Four rule families over the threaded/jitted surfaces the last three
+PRs grew (serving, resilience, telemetry, elastic):
+
+* ``lock-discipline`` — lock-guarded attributes accessed outside the
+  lock (:mod:`.locks`);
+* ``jit-host-sync`` / ``jit-traced-branch`` — host syncs and Python
+  branches on traced values inside jit-compiled functions, plus
+  ``unseeded-random`` for global-RNG draws (:mod:`.jaxrules`);
+* ``handler-blocking`` — blocking calls on HTTP-handler and
+  dispatch-thread paths (:mod:`.handlers`);
+* ``metric-drift`` — metric names out of sync between code,
+  docs/observability.md and tools/metrics_smoke.sh
+  (:mod:`.metric_drift`).
+
+Run it: ``python -m znicz_tpu lint`` (or ``tools/lint.sh``); gate:
+``pytest -m lint``.  Suppress: ``# zlint: disable=RULE`` inline, or a
+justified entry in ``tools/zlint_baseline.json``.  Full docs:
+``docs/static_analysis.md``.
+"""
+
+from .core import (Analyzer, Finding, ModuleInfo, RepoRule, Rule,
+                   load_baseline, write_baseline)
+from .cli import default_rules, main, run_repo
+from .handlers import HandlerSafetyRule
+from .jaxrules import JaxHygieneRule, UnseededRandomRule
+from .locks import LockDisciplineRule
+from .metric_drift import MetricDriftRule
+
+__all__ = [
+    "Analyzer", "Finding", "ModuleInfo", "Rule", "RepoRule",
+    "load_baseline", "write_baseline", "default_rules", "run_repo",
+    "main", "LockDisciplineRule", "JaxHygieneRule",
+    "UnseededRandomRule", "HandlerSafetyRule", "MetricDriftRule",
+]

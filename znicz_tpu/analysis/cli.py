@@ -1,0 +1,112 @@
+"""``python -m znicz_tpu lint`` — run zlint over the repo.
+
+Exit status is the gate contract ``tools/lint.sh`` and the tier-1 test
+ride on: 0 when every finding is suppressed inline or baselined, 1 when
+anything new fires, 2 on usage errors.  ``--write-baseline`` regenerates
+``tools/zlint_baseline.json`` from the current finding set (then hand-
+edit every entry's ``note`` — an unjustified baseline entry is just a
+muted bug).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import Analyzer, default_root, write_baseline
+from .handlers import HandlerSafetyRule
+from .jaxrules import JaxHygieneRule, UnseededRandomRule
+from .locks import LockDisciplineRule
+from .metric_drift import MetricDriftRule
+
+DEFAULT_BASELINE = "tools/zlint_baseline.json"
+
+
+def default_rules() -> list:
+    return [LockDisciplineRule(), JaxHygieneRule(),
+            UnseededRandomRule(), HandlerSafetyRule(),
+            MetricDriftRule()]
+
+
+def run_repo(root: str | None = None, baseline: str | None = None,
+             paths=None):
+    """(all findings, new findings, analyzer) — the programmatic form
+    tests/test_analysis.py gates on."""
+    root = root or default_root()
+    baseline_path = os.path.join(root, baseline or DEFAULT_BASELINE)
+    an = Analyzer(default_rules(), root=root,
+                  baseline_path=baseline_path)
+    findings = an.run(paths)
+    return findings, an.new_findings(findings), an
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="znicz_tpu lint",
+        description="zlint: AST-based concurrency & JAX-hygiene "
+                    "analyzer (see docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="root-relative .py files to check (default: "
+                        "the whole znicz_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detected)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON, root-relative (default: "
+                        f"{DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the baseline from current findings "
+                        "and exit 0")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            ids = [rule.id] + ([rule.BRANCH_ID]
+                               if hasattr(rule, "BRANCH_ID") else [])
+            for rid in ids:
+                print(f"{rid:20s} {rule.doc}")
+        return 0
+
+    if args.write_baseline and args.paths:
+        # a subset's findings are a subset — regenerating the baseline
+        # from them would silently drop every entry for unanalyzed
+        # files (and their hand-written notes with them)
+        p.error("--write-baseline requires a full run "
+                "(no positional paths)")
+
+    root = args.root or default_root()
+    findings, new, an = run_repo(
+        root=root,
+        baseline=None if args.no_baseline else args.baseline,
+        paths=args.paths or None)
+    if args.no_baseline:
+        new = findings
+
+    if args.write_baseline:
+        path = os.path.join(root, args.baseline)
+        write_baseline(path, findings)
+        print(f"wrote {len(findings)} entries to {path}")
+        return 0
+
+    baselined = len(findings) - len(new)
+    if args.format == "json":
+        print(json.dumps({
+            "root": root,
+            "findings": [f.to_dict() for f in new],
+            "baselined": baselined,
+            "ok": not new}, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        tail = f" ({baselined} baselined)" if baselined else ""
+        print(f"zlint: {len(new)} new finding(s){tail}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,345 @@
+"""zlint rules: JAX hygiene in jitted hot paths + library RNG seeding.
+
+Two bug classes the ROADMAP hot paths keep re-inviting:
+
+* **Host syncs inside jit** (`jit-host-sync`, `jit-traced-branch`): a
+  ``.item()`` / ``np.asarray`` / ``float(x)`` on a traced value, or a
+  Python ``if`` on one, either fails at trace time or — worse — silently
+  forces a device→host transfer per call and serializes the pipeline.
+  The rule finds functions that are jit-compiled (decorated with
+  ``jax.jit`` / ``pjit`` / ``functools.partial(jax.jit, ...)``, or
+  defined locally and wrapped via ``jax.jit(name, ...)`` in the same
+  module) and flags host-sync calls and Python branches on traced
+  parameters inside them.  ``static_argnames`` / ``static_argnums``
+  parameters are concrete at trace time and exempt, as are
+  shape/dtype/ndim attribute tests and ``x is None`` checks (all
+  resolved during tracing).
+* **Unseeded global RNG** (`unseeded-random`): library code drawing
+  from ``np.random.*`` module-level state (or stdlib ``random.*``)
+  breaks the repo-wide reproducibility contract (``prng.seed_all``;
+  every test pins seeds).  Seeded constructions —
+  ``np.random.default_rng(seed)``, ``np.random.Generator/PCG64``,
+  ``random.Random(seed)`` — are the sanctioned idiom and pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, dotted as _dotted
+
+#: attribute calls that force a device→host sync on a traced value
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+#: ``module.attr`` call paths that materialize host arrays
+_SYNC_CALLS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
+               ("numpy", "array"), ("jax", "device_get"),
+               ("np", "save"), ("numpy", "save")}
+
+#: attribute chains on a traced value that are static at trace time
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval",
+                 "sharding", "weak_type"}
+
+#: np.random members that construct seeded generators (allowed)
+_SEEDED_NP = {"default_rng", "Generator", "PCG64", "PCG64DXSM",
+              "Philox", "SFC64", "MT19937", "SeedSequence",
+              "BitGenerator", "RandomState"}
+
+#: stdlib random members that are not global-state draws (allowed)
+_SEEDED_STDLIB = {"Random", "SystemRandom"}
+
+
+def _const_strs(node) -> list:
+    """String constants out of a str / (str, ...) / [str, ...] node."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def _jit_call_info(call: ast.Call):
+    """For a ``jax.jit(...)`` / ``jit`` / ``pjit`` call (or a
+    ``partial(jax.jit, ...)``), return (is_jit, static_names,
+    static_nums); (False, ...) otherwise."""
+    path = _dotted(call.func)
+    if path is None:
+        return False, set(), set()
+    if path[-1] == "partial":
+        if not call.args:
+            return False, set(), set()
+        inner_path = _dotted(call.args[0])
+        if inner_path is None or inner_path[-1] not in ("jit", "pjit"):
+            return False, set(), set()
+    elif path[-1] not in ("jit", "pjit"):
+        return False, set(), set()
+    names, nums = set(), set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names.update(_const_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            if isinstance(kw.value, ast.Constant):
+                nums.add(kw.value.value)
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                nums.update(e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant))
+    return True, names, nums
+
+
+def _traced_params(fn, static_names, static_nums) -> set:
+    args = fn.args
+    ordered = [a.arg for a in args.posonlyargs + args.args]
+    kwonly = [a.arg for a in args.kwonlyargs]
+    params = set(ordered) | set(kwonly)
+    params -= set(static_names)
+    for i in static_nums:
+        if isinstance(i, int) and 0 <= i < len(ordered):
+            params.discard(ordered[i])
+    params.discard("self")
+    return params
+
+
+def find_jitted_functions(tree: ast.AST) -> list:
+    """(fn_node, traced_param_names) for every function the module
+    jit-compiles — by decorator, or by a ``jax.jit(name, ...)`` call
+    naming a function in scope.
+
+    ``jax.jit(step)`` resolves ``step`` with Python's scoping rules —
+    innermost enclosing function scope outward, skipping class scopes
+    — because repos legitimately reuse a name for a jitted nested
+    function AND a host-side driver method (``FusedTrainer._build``'s
+    ``train_epoch`` vs the ``FusedTrainer.train_epoch`` method); a
+    flat by-name match would pin host code to the jit rule."""
+    jitted = []
+
+    def visit(node, scopes):
+        """``scopes``: innermost-last (is_function_scope, bindings)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                scopes[-1][1][child.name] = child
+                for dec in child.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        is_jit, names, nums = _jit_call_info(dec)
+                    else:
+                        path = _dotted(dec)
+                        is_jit = (path is not None
+                                  and path[-1] in ("jit", "pjit"))
+                        names, nums = set(), set()
+                    if is_jit:
+                        jitted.append((child, names, nums))
+                        break
+                visit(child, scopes + [(True, {})])
+            elif isinstance(child, ast.Lambda):
+                visit(child, scopes + [(True, {})])
+            elif isinstance(child, ast.ClassDef):
+                visit(child, scopes + [(False, {})])
+            else:
+                if isinstance(child, ast.Call):
+                    is_jit, names, nums = _jit_call_info(child)
+                    if is_jit and child.args:
+                        target = child.args[0]
+                        if isinstance(target, ast.Lambda):
+                            jitted.append((target, names, nums))
+                        elif isinstance(target, ast.Name):
+                            for is_fn, bindings in reversed(scopes):
+                                if not is_fn:
+                                    continue    # class scopes skipped
+                                fn = bindings.get(target.id)
+                                if fn is not None:
+                                    jitted.append((fn, names, nums))
+                                    break
+                visit(child, scopes)
+
+    visit(tree, [(True, {})])
+    out, seen = [], set()
+    for fn, names, nums in jitted:
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        if isinstance(fn, ast.Lambda):
+            params = {a.arg for a in fn.args.args}
+        else:
+            params = _traced_params(fn, names, nums)
+        out.append((fn, params))
+    return out
+
+
+class _HygieneVisitor(ast.NodeVisitor):
+    def __init__(self, rule, module, params: set):
+        self.rule = rule
+        self.module = module
+        self.params = params
+        self.findings: list = []
+
+    # nested defs keep the outer traced names visible through closure,
+    # so they are scanned too — but their OWN parameters shadow the
+    # traced names for the subtree (a local `def helper(x=3)` must not
+    # inherit the jitted fn's traced `x`)
+    def _visit_nested(self, node) -> None:
+        args = node.args
+        shadowed = {a.arg for a in (args.posonlyargs + args.args
+                                    + args.kwonlyargs)}
+        if args.vararg:
+            shadowed.add(args.vararg.arg)
+        if args.kwarg:
+            shadowed.add(args.kwarg.arg)
+        saved = self.params
+        self.params = self.params - shadowed
+        try:
+            self.generic_visit(node)
+        finally:
+            self.params = saved
+
+    visit_FunctionDef = _visit_nested
+    visit_AsyncFunctionDef = _visit_nested
+    visit_Lambda = _visit_nested
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
+            self.findings.append(self.module.finding(
+                self.rule, node,
+                f"'.{fn.attr}()' inside a jit-compiled function forces "
+                f"a device→host sync (or fails at trace time)"))
+        path = _dotted(fn)
+        if path is not None and len(path) >= 2 \
+                and (path[-2], path[-1]) in _SYNC_CALLS:
+            self.findings.append(self.module.finding(
+                self.rule, node,
+                f"'{'.'.join(path)}(...)' inside a jit-compiled "
+                f"function materializes a host array mid-trace"))
+        if (isinstance(fn, ast.Name) and fn.id in ("float", "int",
+                                                   "bool", "complex")
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in self.params):
+            self.findings.append(self.module.finding(
+                self.rule, node,
+                f"'{fn.id}({node.args[0].id})' on a traced parameter "
+                f"forces a host sync; keep it a jnp array or mark the "
+                f"argument static"))
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, node.test, "while")
+        self.generic_visit(node)
+
+    def _check_branch(self, node, test, kind: str) -> None:
+        name = self._traced_name_in_test(test)
+        if name is not None:
+            self.findings.append(self.module.finding(
+                self.rule, node,
+                f"Python '{kind}' on traced parameter '{name}' inside "
+                f"a jit-compiled function (use jnp.where / lax.cond, "
+                f"or mark the argument static)"))
+
+    def _traced_name_in_test(self, test) -> str | None:
+        """A traced param the test's truth value depends on, or None.
+        Trace-time-static uses (is-None checks, .shape/.dtype/.ndim
+        chains, len()/isinstance()) are skipped."""
+        if isinstance(test, ast.Compare) and \
+                all(isinstance(c, (ast.Is, ast.IsNot))
+                    for c in test.ops):
+            return None
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in _STATIC_ATTRS:
+                # prune: x.shape[...] comparisons are static
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Name):
+                        inner._zlint_static = True        # noqa: SLF001
+            elif isinstance(sub, ast.Call):
+                path = _dotted(sub.func)
+                if path is not None and path[-1] in ("len",
+                                                     "isinstance"):
+                    for inner in ast.walk(sub):
+                        if isinstance(inner, ast.Name):
+                            inner._zlint_static = True    # noqa: SLF001
+        for sub in ast.walk(test):
+            if (isinstance(sub, ast.Name) and sub.id in self.params
+                    and not getattr(sub, "_zlint_static", False)):
+                return sub.id
+        return None
+
+
+class JaxHygieneRule(Rule):
+    id = "jit-host-sync"
+    severity = "error"
+    doc = ("host-sync call or Python branch on a traced value inside a "
+           "jit-compiled function")
+
+    #: branches get their own id so they can be suppressed separately
+    BRANCH_ID = "jit-traced-branch"
+
+    def check(self, module) -> list:
+        findings = []
+        for fn, params in find_jitted_functions(module.tree):
+            visitor = _HygieneVisitor(self, module, params)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                visitor.visit(stmt)
+            findings.extend(visitor.findings)
+        out = []
+        for f in findings:
+            if "Python '" in f.message:
+                f = type(f)(rule=self.BRANCH_ID, path=f.path,
+                            line=f.line, message=f.message,
+                            severity=f.severity, context=f.context)
+            out.append(f)
+        return out
+
+
+class UnseededRandomRule(Rule):
+    id = "unseeded-random"
+    severity = "error"
+    doc = ("draw from the process-global RNG (np.random.* / random.*) "
+           "in library code; use a seeded Generator (prng module)")
+
+    def check(self, module) -> list:
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path = _dotted(node.func)
+            if path is None:
+                continue
+            seedless = not node.args and not node.keywords
+            if len(path) >= 2 and path[-2] == "random" \
+                    and (len(path) >= 3 and path[-3] in ("np", "numpy")
+                         or path[0] == "np" or path[0] == "numpy"):
+                member = path[-1]
+                if member not in _SEEDED_NP:
+                    findings.append(module.finding(
+                        self, node,
+                        f"'{'.'.join(path)}(...)' draws from numpy's "
+                        f"global RNG; use np.random.default_rng(seed) "
+                        f"or znicz_tpu.prng"))
+                elif member != "Generator" and seedless:
+                    # default_rng()/PCG64()/... with NO seed pulls OS
+                    # entropy — just as irreproducible as the global
+                    # RNG (Generator itself always takes a bitgen arg)
+                    findings.append(module.finding(
+                        self, node,
+                        f"'{'.'.join(path)}()' without a seed draws "
+                        f"OS entropy; pass an explicit seed"))
+            elif len(path) == 2 and path[0] == "random":
+                if path[1] not in _SEEDED_STDLIB:
+                    findings.append(module.finding(
+                        self, node,
+                        f"'random.{path[1]}(...)' draws from the "
+                        f"stdlib global RNG; use random.Random(seed)"))
+                elif path[1] == "Random" and seedless:
+                    # SystemRandom is exempt: it CANNOT be seeded and
+                    # exists for entropy, not reproducibility
+                    findings.append(module.finding(
+                        self, node,
+                        "'random.Random()' without a seed is "
+                        "irreproducible; pass an explicit seed"))
+        return findings

@@ -358,12 +358,16 @@ class ServingEngine:
             raise ValueError("empty batch")
         if self.backend == "native":
             feats = output_features(self.layers, x.shape[1:])
+            # zlint lock-discipline: self._native is lock-guarded (the
+            # lazy fallback load mutates it); read it through the
+            # locked accessor instead of bare
+            native = self._native_model()
             with self._lock:
                 self._stats["forward_calls"] += 1
                 self._stats["rows_in"] += len(x)
             with tracing.span("engine.forward", backend="native",
                               rows=int(len(x))):
-                return self._native.infer(x, feats)
+                return native.infer(x, feats)
         if not self.breaker.allow():
             return self._fallback_predict(x)
         top = self.buckets[-1]
@@ -421,6 +425,10 @@ class ServingEngine:
     def metrics(self) -> dict:
         with self._lock:
             m = dict(self._stats)
+            # cache length must be read under the same lock that
+            # guards insert/evict (zlint lock-discipline finding: a
+            # scrape racing an eviction read torn LRU state)
+            m["cached_executables"] = len(self._cache)
         m.setdefault("cache_hits", 0)
         m.setdefault("cache_misses", 0)
         m.setdefault("cache_evictions", 0)
@@ -428,7 +436,6 @@ class ServingEngine:
         m.setdefault("forward_failures", 0)
         m.setdefault("fallback_calls", 0)
         m.setdefault("retries", 0)
-        m["cached_executables"] = len(self._cache)
         m["backend"] = self.backend
         m["buckets"] = list(self.buckets)
         m["breaker"] = self.breaker.metrics()
