@@ -365,7 +365,7 @@ class TestEngineDegradation:
         path = str(tmp_path / "m.znn")
         _write_mlp(path)
         eng = _engine(path, cooldown=60.0)
-        eng._native_failed = True             # host without the .so
+        eng._current()._native_failed = True   # host without the .so
         x = np.zeros((1, 4), np.float32)
         try:
             with FaultPlan([FaultSpec("engine.forward")]):
@@ -527,7 +527,7 @@ class TestServerGracefulDegradation:
         path = str(tmp_path / "m.znn")
         _write_mlp(path)
         eng = _engine(path, threshold=1, cooldown=60.0, attempts=1)
-        eng._native_failed = True
+        eng._current()._native_failed = True   # host without the .so
         server = ServingServer(eng, max_wait_ms=1.0,
                                default_timeout_s=20.0).start()
         try:

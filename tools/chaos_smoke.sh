@@ -6,8 +6,16 @@
 # raw 500), /healthz goes degraded while the circuit is open, and the
 # breaker closes again via a half-open probe once the fault clears.
 #
+# `--scenario reload` (ISSUE 5 acceptance) instead drills the
+# durability layer: a hot reload of a deterministically bit-rotted
+# artifact (the artifact.bitflip fault site) must roll back — verify
+# fails, the generation stays put, the OLD model keeps serving 200s
+# with identical bytes — and a good artifact must then swap with zero
+# downtime (docs/durability.md).
+#
 # Usage:  bash tools/chaos_smoke.sh [chaos-mode args...]
-#         (e.g. --model my.znn --plan @plan.json --requests 20;
+#         (e.g. --model my.znn --plan @plan.json --requests 20,
+#          or --scenario reload;
 #          see `python -m znicz_tpu chaos --help` / docs/resilience.md)
 set -u -o pipefail
 cd "$(dirname "$0")/.."

@@ -152,6 +152,35 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
         check(m["completed"] == series.get("serving_batcher_completed"),
               "JSON batcher completed == text serving_batcher_completed")
         check("rev" in m, "JSON /metrics carries a rev build stamp")
+
+        # hot reload (znicz_tpu.durability): re-read the same artifact
+        # in place, then assert the reload/integrity metrics joined
+        # the scrape contract
+        req = urllib.request.Request(
+            url + "admin/reload", json.dumps({"wait": True}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            rec = json.loads(r.read())
+        check((rec.get("last_reload") or {}).get("outcome") == "ok",
+              "POST /admin/reload (wait) reloads in place")
+        check(rec.get("model_generation") == 2,
+              "healthz generation bumped to 2 after the reload")
+        req = urllib.request.Request(url + "metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            series, typed = parse_exposition(r.read().decode())
+        check(series.get('model_reloads_total{outcome="ok"}') == 1.0,
+              "model_reloads_total{outcome=ok} == 1")
+        check(series.get("model_generation") == 2.0,
+              "model_generation gauge == 2")
+        check(series.get("serving_engine_generation") == 2.0,
+              "serving_engine_generation mirror == 2")
+        check(series.get("artifact_verify_failures_total") == 0.0,
+              "artifact_verify_failures_total present (and clean)")
+        check(series.get("artifacts_quarantined_total") == 0.0,
+              "artifacts_quarantined_total present (and clean)")
+        check(series.get("manifests_healed_total") is not None,
+              "manifests_healed_total present")
     finally:
         proc.send_signal(signal.SIGINT)
         try:

@@ -15,11 +15,14 @@ Examples::
     python -m znicz_tpu serve --model model.znn --port 8100
         # batched inference serving of a .znn export (znicz_tpu.serving);
         # GET /metrics speaks JSON or Prometheus text (Accept header),
-        # --profile-dir captures a jax.profiler trace, and every
-        # POST /predict carries an X-Request-Id (docs/observability.md)
-    python -m znicz_tpu chaos
+        # --profile-dir captures a jax.profiler trace, every
+        # POST /predict carries an X-Request-Id (docs/observability.md),
+        # and POST /admin/reload (or SIGHUP) hot-reloads the model with
+        # verify + canary + rollback (docs/durability.md)
+    python -m znicz_tpu chaos [--scenario reload]
         # serving-under-fault smoke: boots the server under a canned
-        # fault plan and checks graceful degradation (resilience.chaos)
+        # fault plan and checks graceful degradation (resilience.chaos);
+        # --scenario reload drills corrupt-artifact rollback instead
     python -m znicz_tpu lint [--format json|text] [--baseline ...]
         # zlint: AST-based concurrency & JAX-hygiene analyzer over the
         # package (znicz_tpu.analysis; docs/static_analysis.md); exits

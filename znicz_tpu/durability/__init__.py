@@ -1,0 +1,39 @@
+"""Artifact integrity: checksummed manifests, verify-on-load,
+quarantine, and last-good fallback.
+
+The reference veles.znicz treated the Snapshotter as lifecycle
+infrastructure — training was expected to survive interruption and
+resume from the newest snapshot.  Our stack writes crash-safely
+(``snapshotter.py``'s single-rename commit, ``parallel/checkpoint.py``'s
+Orbax layout) and retries transient I/O (``CheckpointRecovery``), but
+until this layer nothing checked what was *read back*: a truncated or
+bit-flipped ``.znn`` / snapshot loaded blindly, crashing resume or
+poisoning serving.
+
+One contract, three producers, three consumers:
+
+* every producer (``export.export_workflow``, ``SnapshotterToFile.save``,
+  ``TrainerCheckpointer.save``) writes a sha256 manifest sidecar beside
+  the artifact (:func:`write_manifest`);
+* every consumer (snapshot resume, Orbax restore,
+  ``ServingEngine`` load/hot-reload) calls :func:`verify` /
+  :func:`verify_or_heal` first and treats :class:`ArtifactCorrupt` as
+  "try the next-newest artifact", never as a crash;
+* corrupt entries are renamed aside (:func:`quarantine`, ``*.corrupt``)
+  with a structured log line and a counter, so operators see rot
+  instead of silently shrinking history.
+
+See docs/durability.md for the manifest format, the quarantine policy,
+and the serving reload/rollback state machine.
+"""
+
+from .integrity import (ArtifactCorrupt, chaos_bitflip, deep_check,
+                        invalidate_manifest, manifest_path,
+                        newest_verified, quarantine, read_manifest,
+                        sha256_file, verify, verify_or_heal,
+                        write_manifest)
+
+__all__ = ["ArtifactCorrupt", "chaos_bitflip", "deep_check",
+           "invalidate_manifest", "manifest_path", "newest_verified",
+           "quarantine", "read_manifest", "sha256_file", "verify",
+           "verify_or_heal", "write_manifest"]

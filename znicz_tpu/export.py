@@ -18,6 +18,8 @@ import struct
 
 import numpy as np
 
+from . import durability
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 
@@ -131,6 +133,20 @@ def _pack_layer(fh, kind: int, act: int, p, w=None, b=None) -> None:
             fh.write(arr.tobytes())
 
 
+def _commit_znn(path: str) -> str:
+    """Atomic publish of a finished ``.znn``: invalidate any old
+    manifest, rename the temp blob into place, then write the new
+    sha256 manifest (the invalidate→blob→manifest protocol pinned in
+    znicz_tpu.durability — a crash can leave a manifest-less blob,
+    never a live manifest over foreign bytes) and give the
+    ``artifact.bitflip`` chaos site its shot at the committed bytes."""
+    durability.invalidate_manifest(path)
+    os.replace(path + ".tmp", path)
+    durability.write_manifest(path, kind="znn")
+    durability.chaos_bitflip(path)
+    return path
+
+
 def export_workflow(workflow, path: str) -> str:
     """Serialize a trained StandardWorkflow's forward chain to .znn.
 
@@ -139,7 +155,13 @@ def export_workflow(workflow, path: str) -> str:
     autoencoders run natively) and trained-SOM serving (a
     KohonenForward head exports as negated squared distances; the RBM
     *trainers* remain training-side constructs with no inference
-    parity to serve)."""
+    parity to serve).
+
+    Writes are crash-safe: the container lands at ``path`` by a single
+    rename only once fully written, with a sha256 manifest sidecar
+    (``path.manifest.json``) committed right after — serving's
+    verify-on-load refuses a truncated or bit-flipped artifact instead
+    of crashing mid-forward (docs/durability.md)."""
     from .nn.all2all import All2All, All2AllSoftmax
     from .nn.kohonen import KohonenForward
 
@@ -148,11 +170,11 @@ def export_workflow(workflow, path: str) -> str:
                                                         KohonenForward):
         # SOM workflows have a single winner-take-all forward, not a
         # layer chain
-        with open(path, "wb") as fh:
+        with open(path + ".tmp", "wb") as fh:
             _write_header(fh, 1)
             w = np.asarray(som.weights.mem, np.float32)
             _pack_layer(fh, KIND["kohonen"], 0, list(w.shape), w)
-        return path
+        return _commit_znn(path)
     from .nn.conv import Conv
     from .nn.deconv import Deconv
     from .nn.depooling import Depooling
@@ -161,7 +183,7 @@ def export_workflow(workflow, path: str) -> str:
     from .nn import activation as act_units
     from .nn import pooling as pool_units
 
-    with open(path, "wb") as fh:
+    with open(path + ".tmp", "wb") as fh:
         _write_header(fh, _count_layers(workflow))
         export_idx = {}   # forward unit -> its EXPORT-stream index
         n_out = 0
@@ -226,7 +248,7 @@ def export_workflow(workflow, path: str) -> str:
             else:
                 raise NotImplementedError(
                     f"export does not cover {type(fwd).__name__}")
-    return path
+    return _commit_znn(path)
 
 
 def _count_layers(workflow) -> int:

@@ -27,6 +27,8 @@ import os
 
 import jax
 
+from .. import durability
+
 
 def _spec_fingerprint(spec) -> str:
     return json.dumps(dataclasses.asdict(spec), sort_keys=True,
@@ -52,11 +54,31 @@ class TrainerCheckpointer:
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True))
+        #: steps saved async whose manifest write waits on the IO
+        self._pending_manifests: set[int] = set()
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _commit_manifests(self) -> None:
+        """Write per-blob sha256 manifests for every finished async
+        save (call only after ``wait_until_finished`` — hashing an
+        in-flight Orbax write would bless half a checkpoint).  Process
+        0 writes, same ownership rule as CheckpointRecovery."""
+        pending, self._pending_manifests = self._pending_manifests, set()
+        for step in sorted(pending):
+            if jax.process_index() == 0 \
+                    and os.path.isdir(self._step_dir(step)):
+                durability.write_manifest(self._step_dir(step),
+                                          kind="checkpoint")
 
     # -- write -------------------------------------------------------------
     def save(self, trainer, step: int, block: bool = True) -> None:
         """Checkpoint the live device state at ``step``; ``block=False``
-        lets device→disk IO overlap subsequent training steps."""
+        lets device→disk IO overlap subsequent training steps (the
+        manifest then lands at the next ``wait()``/``save(block=True)``/
+        ``close()`` — a manifest must only ever describe bytes that
+        finished writing)."""
         ocp = self._ocp
         self._mngr.save(
             step,
@@ -64,25 +86,58 @@ class TrainerCheckpointer:
                 state=ocp.args.StandardSave(_state(trainer)),
                 meta=ocp.args.JsonSave(
                     {"spec": _spec_fingerprint(trainer.spec)})))
+        self._pending_manifests.add(step)
         if block:
             self._mngr.wait_until_finished()
+            self._commit_manifests()
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()
+        self._commit_manifests()
 
     # -- read --------------------------------------------------------------
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
+    def latest_verified_step(self) -> int | None:
+        """Newest step whose directory passes
+        :func:`durability.verify` — corrupt steps are quarantined
+        (renamed ``<step>.corrupt``, which Orbax's integer-named step
+        listing then ignores) and the scan falls back to the
+        next-newest, the same last-good contract as snapshot resume.
+        Steps that predate manifests verify as legacy (existence
+        only).  Quarantine/heal writes are process 0's job — the same
+        ownership rule as the save-side manifests; other processes
+        verify read-only and land on the same answer (they skip the
+        same corrupt steps)."""
+        try:
+            steps = sorted(self._mngr.all_steps(read=True), reverse=True)
+        except TypeError:                  # older orbax: no read kwarg
+            steps = sorted(self._mngr.all_steps(), reverse=True)
+        owner = jax.process_index() == 0
+        found = durability.newest_verified(
+            (self._step_dir(s) for s in steps),
+            on_corrupt="quarantine" if owner else "skip", heal=owner)
+        return int(os.path.basename(found)) if found is not None \
+            else None
+
     def restore(self, trainer, step: int | None = None) -> int:
         """Restore into ``trainer`` (in place), re-applying its current
-        shardings; returns the restored step."""
+        shardings; returns the restored step.  With ``step=None`` the
+        newest *verified* step is restored (corrupt ones quarantined
+        and skipped — see :meth:`latest_verified_step`); an explicitly
+        requested step is verified first and raises
+        :class:`durability.ArtifactCorrupt` rather than feeding Orbax
+        rotten bytes."""
         ocp = self._ocp
         if step is None:
-            step = self._mngr.latest_step()
+            step = self.latest_verified_step()
             if step is None:
                 raise FileNotFoundError(
-                    f"no checkpoints under {self.directory}")
+                    f"no verifiable checkpoints under {self.directory}")
+        else:
+            durability.verify_or_heal(self._step_dir(step),
+                                      heal=jax.process_index() == 0)
         # check the spec fingerprint BEFORE touching the arrays: a
         # different model must fail with this message, not with an
         # opaque Orbax tree/shape mismatch from the state restore
@@ -110,7 +165,8 @@ class TrainerCheckpointer:
         return int(step)
 
     def close(self) -> None:
-        self._mngr.close()
+        self._mngr.close()          # waits for in-flight writes
+        self._commit_manifests()
 
 
 def save_trainer(trainer, directory: str, step: int = 0,

@@ -162,10 +162,23 @@ class CheckpointRecovery:
         return self.retry.call(self.snap.save, "current")
 
     def resume_if_found(self) -> dict | None:
-        """Restore the latest checkpoint into the (initialized) workflow;
-        returns its meta or None when starting fresh."""
+        """Restore the newest *verified* checkpoint into the
+        (initialized) workflow; returns its meta or None when starting
+        fresh.  Corrupt entries (torn write, bit rot — see
+        znicz_tpu.durability) are quarantined to ``*.corrupt`` and the
+        scan falls back to the next-newest verified snapshot: a rotten
+        ``current`` must cost one checkpoint interval of progress, not
+        the whole run.  Transient read blips still retry under
+        ``retry`` as before.  Quarantine/heal writes follow the save
+        ownership rule (process 0); other processes scan read-only and
+        skip the same corrupt entries."""
         from ..snapshotter import SnapshotterToFile
-        if not os.path.exists(self.path):
-            return None
-        return self.retry.call(SnapshotterToFile.load,
-                               self.workflow, self.path)
+
+        def _restore():
+            found = SnapshotterToFile.restore(
+                self.workflow, directory=self.snap.directory,
+                prefix=self.snap.prefix,
+                owner=jax.process_index() == 0)
+            return found[0] if found is not None else None
+
+        return self.retry.call(_restore)

@@ -10,12 +10,15 @@ and that is the right trade (collectives ride ICI with zero
 coordination overhead in the hot loop).  Elasticity therefore lives
 ABOVE the job: this supervisor launches the fleet, watches it, and on
 any member's death restarts ALL processes on a fresh coordinator port;
-workers resume from the newest checkpoint (`CheckpointRecovery` /
-`Snapshotter`, both crash-safe and resume-bit-exact — see
-tests/test_failure_recovery.py).  A replacement worker "receives
-current weights" by loading the checkpoint — the same contract the
-reference implemented over the wire, at checkpoint rather than packet
-granularity.
+workers resume from the newest *verified* checkpoint
+(`CheckpointRecovery` / `Snapshotter`, crash-safe and
+resume-bit-exact — see tests/test_failure_recovery.py; a checkpoint
+the dying fleet tore or rotted is quarantined and the scan falls back
+to the previous verified one, znicz_tpu.durability — so a corrupt
+artifact can never wedge the restart loop).  A replacement worker
+"receives current weights" by loading the checkpoint — the same
+contract the reference implemented over the wire, at checkpoint rather
+than packet granularity.
 
 Scope: SINGLE-HOST multi-process supervision (the supervisor Popens
 every worker locally against a loopback coordinator).  On a multi-host

@@ -13,6 +13,12 @@ contract end to end:
 * once the fault clears, a half-open probe closes the breaker and
   ``/healthz`` returns to ``ok``.
 
+A second drill, ``--scenario reload``, smokes the durability layer
+(docs/durability.md): a hot reload of a deterministically bit-rotted
+artifact must roll back — verify fails, the generation stays put, the
+old model keeps answering 200s with identical bytes — and a subsequent
+good artifact must swap with zero downtime.
+
 Exit code 0 when every invariant holds — tools/chaos_smoke.sh wires
 this into CI-ish usage.  The same ``FaultPlan`` mechanism drives the
 pytest ``chaos`` marker; this mode exists so an operator can smoke a
@@ -36,19 +42,23 @@ from .retry import RetryPolicy
 
 
 def _write_demo_znn(path: str, fin: int = 4, hidden: int = 3,
-                    classes: int = 2) -> None:
+                    classes: int = 2, seed: int = 7) -> None:
     """A tiny deterministic fc(tanh)+fc+softmax model — enough layers
-    to exercise the full forward without slow jit compiles."""
-    from ..export import ACT, KIND, _pack_layer, _write_header
-    gen = np.random.default_rng(7)
+    to exercise the full forward without slow jit compiles.  Committed
+    through the real atomic publish (manifest + ``artifact.bitflip``
+    chaos site), so corruption drills can rot it deterministically."""
+    from ..export import ACT, KIND, _commit_znn, _pack_layer, \
+        _write_header
+    gen = np.random.default_rng(seed)
     w1 = gen.standard_normal((fin, hidden)).astype(np.float32)
     b1 = gen.standard_normal(hidden).astype(np.float32)
     w2 = gen.standard_normal((hidden, classes)).astype(np.float32)
-    with open(path, "wb") as fh:
+    with open(path + ".tmp", "wb") as fh:
         _write_header(fh, 3)
         _pack_layer(fh, KIND["fc"], ACT["tanh"], [fin, hidden], w1, b1)
         _pack_layer(fh, KIND["fc"], ACT["linear"], [hidden, classes], w2)
         _pack_layer(fh, KIND["softmax"], 0, [])
+    _commit_znn(path)
 
 
 def _post(url: str, payload: dict, timeout: float = 30.0):
@@ -67,6 +77,106 @@ def _post(url: str, payload: dict, timeout: float = 30.0):
 def _health(url: str, timeout: float = 10.0) -> dict:
     with urllib.request.urlopen(url + "healthz", timeout=timeout) as r:
         return json.loads(r.read())
+
+
+def _admin_reload(url: str, model: str, timeout: float = 60.0):
+    """(status, body) of a synchronous ``POST /admin/reload``."""
+    req = urllib.request.Request(
+        url + "admin/reload",
+        json.dumps({"model": model, "wait": True}).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _reload_scenario(args) -> int:
+    """``--scenario reload`` — the corruption→rollback drill
+    (docs/durability.md): serve v1, hot-reload a bit-rotted v2 (the
+    ``artifact.bitflip`` fault site fires during its export, so the rot
+    is deterministic) and assert the rollback contract — generation
+    unchanged, the OLD model still answering 200s with identical bytes,
+    ``/healthz`` reporting the failed outcome — then land a good v3 and
+    assert the zero-downtime swap."""
+    from ..serving.engine import ServingEngine
+    from ..serving.server import ServingServer
+
+    bad: list[str] = []
+    x = [[0.1, -0.2, 0.3, 0.4]]
+    with tempfile.TemporaryDirectory(prefix="znicz_chaos_") as tmp:
+        v1 = os.path.join(tmp, "v1.znn")
+        _write_demo_znn(v1)
+        engine = ServingEngine(v1, backend="jax", buckets=(1, 2))
+        server = ServingServer(engine, max_wait_ms=1.0).start()
+        try:
+            status, body, _ = _post(server.url, {"inputs": x})
+            y0 = body.get("outputs")
+            if status != 200:
+                bad.append(f"baseline predict got {status}")
+            # v2 rots as it lands on disk: one flipped byte under a
+            # live manifest — exactly what verify-on-load must catch
+            v2 = os.path.join(tmp, "v2.znn")
+            plan = faults.FaultPlan([faults.FaultSpec(
+                "artifact.bitflip", times=1,
+                message="chaos: storage rot on the new artifact")],
+                seed=7)
+            with plan:
+                _write_demo_znn(v2, seed=11)
+            if plan.snapshot().get("artifact.bitflip:error", 0) != 1:
+                bad.append("bitflip fault never fired — v2 is clean "
+                           "and the drill proves nothing")
+            status, rec = _admin_reload(server.url, v2)
+            last = (rec.get("last_reload") or {})
+            print(json.dumps({"phase": "corrupt-reload",
+                              "status": status, "reload": last,
+                              "generation": rec.get("model_generation")}))
+            if last.get("outcome") != "verify_failed":
+                bad.append(f"corrupt reload outcome "
+                           f"{last.get('outcome')!r}, expected "
+                           f"'verify_failed'")
+            if rec.get("model_generation") != 1:
+                bad.append(f"generation moved to "
+                           f"{rec.get('model_generation')} on a failed "
+                           f"reload")
+            for i in range(args.requests):
+                status, body, _ = _post(server.url, {"inputs": x})
+                if status != 200:
+                    bad.append(f"post-rollback request {i} got {status}")
+                elif body.get("outputs") != y0:
+                    bad.append(f"post-rollback request {i} answered "
+                               f"with different bytes — generations "
+                               f"mixed")
+            health = _health(server.url)
+            if health["status"] != "ok":
+                bad.append(f"healthz {health['status']!r} after a "
+                           f"rolled-back reload, expected 'ok'")
+            if (health.get("last_reload") or {}).get("outcome") \
+                    != "verify_failed":
+                bad.append("healthz does not report the failed reload")
+            # a good artifact swaps with zero downtime
+            v3 = os.path.join(tmp, "v3.znn")
+            _write_demo_znn(v3, seed=23)
+            status, rec = _admin_reload(server.url, v3)
+            last = (rec.get("last_reload") or {})
+            print(json.dumps({"phase": "good-reload", "status": status,
+                              "reload": last,
+                              "generation": rec.get("model_generation")}))
+            if last.get("outcome") != "ok" \
+                    or rec.get("model_generation") != 2:
+                bad.append(f"good reload did not swap: {last}")
+            status, body, _ = _post(server.url, {"inputs": x})
+            if status != 200:
+                bad.append(f"post-swap predict got {status}")
+            elif body.get("outputs") == y0:
+                bad.append("post-swap outputs identical to v1 — the "
+                           "new weights never took")
+            print(json.dumps({
+                "scenario": "reload", "ok": not bad, "violations": bad,
+                "engine": {k: v for k, v in engine.metrics().items()
+                           if k in ("generation", "reloads")}}))
+        finally:
+            server.stop()
+            engine.close()
+    return 1 if bad else 0
 
 
 def main(argv=None) -> int:
@@ -88,7 +198,15 @@ def main(argv=None) -> int:
     p.add_argument("--breaker-threshold", type=int, default=2)
     p.add_argument("--cooldown-s", type=float, default=1.0)
     p.add_argument("--retry-attempts", type=int, default=2)
+    p.add_argument("--scenario", default="breaker",
+                   choices=("breaker", "reload"),
+                   help="breaker: the engine-fault degradation arc "
+                        "(default); reload: hot-reload a corrupted "
+                        "artifact and assert rollback + zero downtime "
+                        "(docs/durability.md)")
     args = p.parse_args(argv)
+    if args.scenario == "reload":
+        return _reload_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

@@ -28,8 +28,19 @@ Instrumented sites (grow this list as subsystems adopt injection):
                        deliberately does NOT pass through this site)
 ``batcher.dispatch``   MicroBatcher just before an engine call (latency
                        injection point for deadline/backpressure tests)
-``checkpoint.save``    SnapshotterToFile.save (crash-during-checkpoint)
+``checkpoint.save``    SnapshotterToFile.save (crash-during-checkpoint,
+                       fired BEFORE any filesystem mutation)
 ``checkpoint.load``    SnapshotterToFile.load (corrupt/unreadable resume)
+``checkpoint.write_torn``  inside SnapshotterToFile.save's torn window,
+                       between the blob rename and the manifest rename
+                       — an error fault dies torn (new blob, stale
+                       manifest), a latency fault holds the window open
+                       for the SIGKILL crash-consistency tests
+``artifact.bitflip``   durability.chaos_bitflip, called on every
+                       just-committed .znn/snapshot blob — an error
+                       fault here is *interpreted*: one mid-file byte
+                       is flipped in place (deterministic storage rot;
+                       verify-on-load must quarantine + fall back)
 ``relay.connect``      parallel.distributed.initialize's coordinator
                        bootstrap (the reference's lost-master case)
 =====================  ====================================================

@@ -33,6 +33,15 @@ at the HTTP front) when the native engine cannot load.  Half-open
 probes re-try JAX after ``cooldown_s`` and close the breaker on
 success.  Deterministic errors (bad geometry → ValueError) bypass all
 of this: retrying a bug hides it, and the front owes the client a 400.
+
+Durability (znicz_tpu.durability): the artifact is verified on load
+(sha256 manifest + deep format parse — a truncated/bit-flipped ``.znn``
+raises ``ArtifactCorrupt`` at startup, never an XLA crash under
+traffic), and weights are **generation-tracked**: :meth:`reload`
+verifies + canaries a new artifact in the background and atomically
+swaps it under the engine lock, rolling back on any failure while the
+previous generation keeps serving (``model_reloads_total{outcome}``,
+``model_generation``; state machine in docs/durability.md).
 """
 
 from __future__ import annotations
@@ -41,17 +50,108 @@ import collections
 import os
 import tempfile
 import threading
+import time
 
 import numpy as np
 
+from .. import durability
 from ..export import ZnnLayer, read_znn
 from ..resilience import faults
 from ..resilience.breaker import CircuitBreaker, EngineUnavailable
 from ..resilience.retry import RetryPolicy
 from ..telemetry import tracing
+from ..telemetry.registry import REGISTRY
 
 #: default pad-to-bucket ladder for request batch sizes
 DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+_reloads = REGISTRY.counter(
+    "model_reloads_total",
+    "hot-reload attempts, by outcome (ok | verify_failed | "
+    "canary_failed | load_failed)")
+_generation = REGISTRY.gauge(
+    "model_generation",
+    "generation number of the model currently serving (bumps on every "
+    "successful hot reload; last engine to swap wins in a "
+    "multi-engine process)")
+
+
+class ReloadInProgress(RuntimeError):
+    """A hot reload is already running — reloads are single-flight
+    (the HTTP front answers 409)."""
+
+
+class CanaryFailed(RuntimeError):
+    """The candidate generation's canary forward produced a wrong
+    shape, non-finite values, or raised — the swap is aborted and the
+    previous generation keeps serving."""
+
+
+class _Generation:
+    """One loaded model generation: verified artifact path + parsed
+    layers + their single device-resident parameter copy + the native
+    CPU engine bound to the SAME artifact.  Immutable once published
+    to the engine — a hot reload installs a NEW instance, and
+    in-flight predicts finish on whichever generation they grabbed
+    (including the degraded fallback leg: feats, layers, and the
+    native model all come from one generation, so a mid-request swap
+    can never mix two models)."""
+
+    def __init__(self, number: int, path: str, layers):
+        self.number = number
+        self.path = path
+        self.layers = layers
+        self._lock = threading.Lock()
+        self._dev_params = None
+        self._native = None
+        self._native_failed = False   # fallback tried and unavailable
+        #: (cache key, jitted fn) compiled by the reload canary —
+        #: seeded into the engine's LRU only if this generation swaps
+        #: in, so a (possibly failing) reload never evicts the LIVE
+        #: generation's executables
+        self.warmed: tuple | None = None
+
+    def params(self):
+        """The weights, device-resident ONCE per generation and passed
+        to every bucket executable as jit arguments — N cached
+        executables must not mean N baked-in copies of the model."""
+        with self._lock:
+            if self._dev_params is None:
+                import jax
+                self._dev_params = [
+                    (None if la.w is None else jax.device_put(la.w),
+                     None if la.b is None else jax.device_put(la.b))
+                    for la in self.layers]
+            return self._dev_params
+
+    def adopt_native(self, native) -> None:
+        """Install an eagerly-loaded native model (backend="native"
+        startup/reload, where a load failure must raise loudly instead
+        of degrading)."""
+        with self._lock:
+            self._native = native
+
+    def native_model(self):
+        """This generation's CPU fallback model, lazily loaded from
+        ITS OWN artifact path; None when the host cannot build/load
+        the native engine (the degraded path is then 503, not a
+        crash)."""
+        with self._lock:
+            if self._native is not None:
+                return self._native
+            if self._native_failed:
+                return None
+        try:
+            from ..export import NativeEngine
+            native = NativeEngine().load(self.path)
+        except Exception:
+            with self._lock:
+                self._native_failed = True
+            return None
+        with self._lock:
+            if self._native is None:
+                self._native = native
+            return self._native
 
 
 # deliberate local twins of ops/geometry.out_size and
@@ -218,24 +318,27 @@ class ServingEngine:
         self.cache_size = int(cache_size)
         self._tmpdir = None
         if isinstance(model, (str, os.PathLike)):
-            self.path = os.fspath(model)
+            path = os.fspath(model)
         else:                 # live workflow: one format serves both
             from ..export import export_workflow
             self._tmpdir = tempfile.TemporaryDirectory(
                 prefix="znicz_serve_")
-            self.path = os.path.join(self._tmpdir.name, "model.znn")
-            export_workflow(model, self.path)
-        self.layers = read_znn(self.path)
+            path = os.path.join(self._tmpdir.name, "model.znn")
+            export_workflow(model, path)
+        # verify-on-load: a truncated/bit-flipped artifact must refuse
+        # to serve HERE, as a typed error at startup — not as an XLA
+        # shape crash under traffic (torn manifests heal, legacy
+        # manifest-less files deep-parse; docs/durability.md)
+        durability.verify_or_heal(path)
+        self._gen = _Generation(1, path, read_znn(path))
         if backend == "auto":
             backend = "jax" if _jax_usable() else "native"
         if backend not in ("jax", "native"):
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
-        self._native = None
-        self._native_failed = False   # fallback tried and unavailable
         if backend == "native":
             from ..export import NativeEngine
-            self._native = NativeEngine().load(self.path)
+            self._gen.adopt_native(NativeEngine().load(path))
         # transient device errors retry briefly (default budget stays
         # well under the batcher's dispatch cadence); K consecutive
         # exhausted retries trip the breaker and predict degrades
@@ -245,8 +348,34 @@ class ServingEngine:
             CircuitBreaker(failure_threshold=5, cooldown_s=10.0)
         self._lock = threading.Lock()
         self._cache = collections.OrderedDict()   # key -> jitted fwd
-        self._dev_params = None     # one device copy, shared by all
         self._stats = collections.Counter()       # bucket executables
+        #: hot-reload bookkeeping: single-flight + last outcome for
+        #: /healthz; the sample shape of live traffic feeds the canary
+        self._reload_lock = threading.Lock()
+        self.last_reload: dict | None = None
+        self._last_sample_shape: tuple | None = None
+        _generation.set(1)
+
+    # -- generation access ------------------------------------------------
+    def _current(self) -> _Generation:
+        """The generation currently serving (locked read: reload swaps
+        it).  Callers grab it once per request and use that object
+        throughout — a mid-request swap must never mix two models'
+        layers and params."""
+        with self._lock:
+            return self._gen
+
+    @property
+    def layers(self) -> list[ZnnLayer]:
+        return self._current().layers
+
+    @property
+    def path(self) -> str:
+        return self._current().path
+
+    @property
+    def generation(self) -> int:
+        return self._current().number
 
     # -- executable cache -------------------------------------------------
     def _device_key(self) -> str:
@@ -254,23 +383,14 @@ class ServingEngine:
         d = jax.devices()[0]
         return f"{d.platform}:{getattr(d, 'id', 0)}"
 
-    def _params(self):
-        """The weights, device-resident ONCE and passed to every
-        bucket executable as jit arguments — N cached executables must
-        not mean N baked-in copies of the model."""
-        if self._dev_params is None:
-            import jax
-            self._dev_params = [
-                (None if la.w is None else jax.device_put(la.w),
-                 None if la.b is None else jax.device_put(la.b))
-                for la in self.layers]
-        return self._dev_params
-
-    def _executable(self, bucket: int, sample_shape, dtype):
+    def _executable(self, gen: _Generation, bucket: int, sample_shape,
+                    dtype):
         """The jitted forward for one cache key, LRU-managed.  Each key
         gets its OWN ``jax.jit`` instance so evicting the entry actually
-        releases the underlying executable."""
-        key = (bucket, tuple(sample_shape), str(dtype),
+        releases the underlying executable.  Keys carry the generation
+        number (and the swap clears the cache anyway): a stale
+        executable from a previous generation must never serve."""
+        key = (gen.number, bucket, tuple(sample_shape), str(dtype),
                self._device_key())
         with self._lock:
             fn = self._cache.get(key)
@@ -280,13 +400,20 @@ class ServingEngine:
                 return fn
             self._stats["cache_misses"] += 1
             import jax
-            layers = self.layers
+            layers = gen.layers
             fn = jax.jit(lambda params, x: jax_forward(layers, x,
                                                        params))
-            self._cache[key] = fn
-            while len(self._cache) > self.cache_size:
-                self._cache.popitem(last=False)
-                self._stats["cache_evictions"] += 1
+            if gen is self._gen:
+                # only the CURRENT generation may occupy cache slots:
+                # an in-flight request pinned to a just-retired
+                # generation would otherwise re-insert a key the
+                # reload prune already removed — a dead entry that
+                # pins the old layers alive and can evict a live
+                # executable
+                self._cache[key] = fn
+                while len(self._cache) > self.cache_size:
+                    self._cache.popitem(last=False)
+                    self._stats["cache_evictions"] += 1
             return fn
 
     def bucket_for(self, b: int) -> int:
@@ -296,33 +423,16 @@ class ServingEngine:
         return self.buckets[-1]
 
     # -- degraded path ----------------------------------------------------
-    def _native_model(self):
-        """The CPU fallback model, lazily loaded; None when this host
-        cannot build/load the native engine (the degraded path is then
-        503, not a crash)."""
-        with self._lock:
-            if self._native is not None:
-                return self._native
-            if self._native_failed:
-                return None
-        try:
-            from ..export import NativeEngine
-            native = NativeEngine().load(self.path)
-        except Exception:
-            with self._lock:
-                self._native_failed = True
-            return None
-        with self._lock:
-            if self._native is None:
-                self._native = native
-            return self._native
-
-    def _fallback_predict(self, x: np.ndarray, cause=None) -> np.ndarray:
+    def _fallback_predict(self, x: np.ndarray, gen: _Generation,
+                          cause=None) -> np.ndarray:
         """Serve ``x`` on the native CPU engine, or raise
         ``EngineUnavailable`` (→ 503 + Retry-After) — the two graceful
-        outcomes the acceptance contract allows while JAX is down."""
-        feats = output_features(self.layers, x.shape[1:])
-        native = self._native_model()
+        outcomes the acceptance contract allows while JAX is down.
+        Feats AND the native model both come from the request's pinned
+        generation — a hot reload mid-request must not pair one
+        model's geometry with the other's weights."""
+        feats = output_features(gen.layers, x.shape[1:])
+        native = gen.native_model()
         if native is None:
             raise EngineUnavailable(
                 f"jax engine unavailable "
@@ -341,9 +451,10 @@ class ServingEngine:
                 f"native fallback failed: {e!r}",
                 retry_after=self.breaker.retry_after())
 
-    def _forward_once(self, fn, padded: np.ndarray) -> np.ndarray:
+    def _forward_once(self, fn, gen: _Generation,
+                      padded: np.ndarray) -> np.ndarray:
         faults.inject("engine.forward")
-        return np.asarray(fn(self._params(), padded))
+        return np.asarray(fn(gen.params(), padded))
 
     def _count_retry(self, attempt, exc) -> None:
         with self._lock:
@@ -356,12 +467,15 @@ class ServingEngine:
             raise ValueError(f"expected a batched input, got {x.shape}")
         if len(x) == 0:
             raise ValueError("empty batch")
+        # one generation per request: a hot reload mid-request must
+        # never mix two models' layers/params (the canary also reuses
+        # live traffic's sample shape, recorded here)
+        with self._lock:
+            gen = self._gen
+            self._last_sample_shape = tuple(int(d) for d in x.shape[1:])
         if self.backend == "native":
-            feats = output_features(self.layers, x.shape[1:])
-            # zlint lock-discipline: self._native is lock-guarded (the
-            # lazy fallback load mutates it); read it through the
-            # locked accessor instead of bare
-            native = self._native_model()
+            feats = output_features(gen.layers, x.shape[1:])
+            native = gen.native_model()
             with self._lock:
                 self._stats["forward_calls"] += 1
                 self._stats["rows_in"] += len(x)
@@ -369,7 +483,7 @@ class ServingEngine:
                               rows=int(len(x))):
                 return native.infer(x, feats)
         if not self.breaker.allow():
-            return self._fallback_predict(x)
+            return self._fallback_predict(x, gen)
         top = self.buckets[-1]
         outs = []
         try:
@@ -383,11 +497,12 @@ class ServingEngine:
                     padded = np.concatenate([chunk, pad])
                 else:
                     padded = chunk
-                fn = self._executable(bucket, chunk.shape[1:],
+                fn = self._executable(gen, bucket, chunk.shape[1:],
                                       chunk.dtype)
                 with tracing.span("engine.forward", backend="jax",
                                   bucket=bucket, rows=int(len(chunk))):
-                    y = self.retry.call(self._forward_once, fn, padded,
+                    y = self.retry.call(self._forward_once, fn, gen,
+                                        padded,
                                         on_retry=self._count_retry)
                 with self._lock:
                     self._stats["forward_calls"] += 1
@@ -403,9 +518,138 @@ class ServingEngine:
             with self._lock:
                 self._stats["forward_failures"] += 1
             self.breaker.record_failure()
-            return self._fallback_predict(x, cause=e)
+            return self._fallback_predict(x, gen, cause=e)
         self.breaker.record_success()
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    # -- hot reload -------------------------------------------------------
+    def _canary_shape(self, layers) -> tuple | None:
+        """Sample shape for the canary batch: live traffic's last seen
+        shape when any, else derived from the first layer for flat
+        models (fc/kohonen carry their input width; a conv chain's
+        H×W cannot be recovered from kernels alone)."""
+        with self._lock:
+            if self._last_sample_shape is not None:
+                return self._last_sample_shape
+        first = layers[0]
+        if first.kind == "fc":
+            return (first.p[0],)
+        if first.kind == "kohonen":
+            return (first.p[1],)
+        return None
+
+    def _canary(self, gen: _Generation, native) -> str:
+        """Run the candidate generation forward on a bucketed dummy
+        batch BEFORE it may serve: a model that raises, returns the
+        wrong feature count, or emits non-finite values must be
+        rejected while the old generation still holds the traffic.
+        Returns ``"ok"`` or ``"skipped"`` (shape underivable and no
+        traffic seen yet); raises :class:`CanaryFailed`."""
+        shape = self._canary_shape(gen.layers)
+        if shape is None:
+            return "skipped"
+        bucket = self.buckets[0]
+        x = np.zeros((bucket,) + tuple(shape), np.float32)
+        try:
+            feats = output_features(gen.layers, shape)
+            if self.backend == "native":
+                y = native.infer(x, feats)
+            else:
+                # compiled candidate-locally (NOT via _executable: an
+                # insert into the shared LRU could evict a LIVE
+                # generation's executable even when this reload rolls
+                # back); a successful swap seeds it into the cache, so
+                # the first post-swap request finds it warm
+                import jax
+                layers = gen.layers
+                fn = jax.jit(lambda params, xx: jax_forward(layers, xx,
+                                                            params))
+                y = np.asarray(fn(gen.params(), x))
+                gen.warmed = ((gen.number, bucket, tuple(shape),
+                               str(x.dtype), self._device_key()), fn)
+        except Exception as e:
+            raise CanaryFailed(f"canary forward raised: {e!r}") from e
+        if y.shape != (bucket, feats):
+            raise CanaryFailed(f"canary produced shape {y.shape}, "
+                               f"expected {(bucket, feats)}")
+        if not np.isfinite(y).all():
+            raise CanaryFailed("canary produced non-finite outputs")
+        return "ok"
+
+    def reload(self, path: str | None = None, *,
+               canary: bool = True) -> dict:
+        """Zero-downtime hot reload: verify → parse → canary → atomic
+        swap under the engine lock.  ``path=None`` re-reads the current
+        artifact path (picking up newly exported weights in place).
+
+        Any failure (verify, parse, canary) ROLLS BACK: nothing is
+        swapped, the previous generation keeps serving, and the outcome
+        lands in :attr:`last_reload` / ``model_reloads_total{outcome}``
+        — the reload/rollback state machine in docs/durability.md.
+        Single-flight; a concurrent attempt raises
+        :class:`ReloadInProgress`."""
+        if not self._reload_lock.acquire(blocking=False):
+            raise ReloadInProgress("a hot reload is already running")
+        try:
+            old = self._current()
+            target = os.fspath(path) if path is not None else old.path
+            t0 = time.monotonic()
+            outcome, error, canary_result = "ok", None, None
+            candidate = native = None
+            try:
+                durability.verify_or_heal(target)
+                candidate = _Generation(old.number + 1, target,
+                                        read_znn(target))
+                if self.backend == "native":
+                    from ..export import NativeEngine
+                    native = NativeEngine().load(target)
+                    candidate.adopt_native(native)
+                if canary:
+                    canary_result = self._canary(candidate, native)
+            except durability.ArtifactCorrupt as e:
+                outcome, error = "verify_failed", str(e)
+            except CanaryFailed as e:
+                outcome, error = "canary_failed", str(e)
+            except Exception as e:
+                outcome, error = "load_failed", repr(e)
+            with self._lock:
+                if outcome == "ok":
+                    self._gen = candidate
+                    self._stats["reloads"] += 1
+                    keep = candidate.number
+                else:
+                    keep = old.number
+                # stale generations' executables must never serve (and
+                # must free their memory) — cache keys carry the
+                # generation number, so this is just a filter
+                for key in [k for k in self._cache if k[0] != keep]:
+                    del self._cache[key]
+                if outcome == "ok" and candidate.warmed is not None:
+                    # seed the canary's compile: the first post-swap
+                    # request must not pay the jit a second time
+                    key, fn = candidate.warmed
+                    self._cache[key] = fn
+            if outcome == "ok":
+                _generation.set(candidate.number)
+            record = {"outcome": outcome, "error": error,
+                      "path": target, "canary": canary_result,
+                      "generation": (candidate.number
+                                     if outcome == "ok" else old.number),
+                      "duration_ms": (time.monotonic() - t0) * 1e3,
+                      "at": time.time()}
+            with self._lock:
+                self.last_reload = record
+            _reloads.inc(outcome=outcome)
+            return record
+        finally:
+            self._reload_lock.release()
+
+    def reload_status(self) -> dict:
+        """Generation + last reload outcome for /healthz."""
+        with self._lock:
+            return {"model_generation": self._gen.number,
+                    "last_reload": dict(self.last_reload)
+                    if self.last_reload else None}
 
     # -- introspection ----------------------------------------------------
     def resilience_state(self) -> str:
@@ -416,11 +660,12 @@ class ServingEngine:
         ``degraded`` is only reported once the fallback has actually
         loaded — a balancer keeps a ``degraded`` replica in rotation,
         so the promise that it still serves 200s must be PROVEN, not
-        assumed; the lazy load is attempted (and cached) here if no
-        request has triggered it yet."""
+        assumed; the lazy load is attempted (and cached on the current
+        generation) here if no request has triggered it yet."""
         if self.backend == "native" or self.breaker.state == "closed":
             return "ok"
-        return "degraded" if self._native_model() is not None else "open"
+        return "degraded" if self._current().native_model() is not None \
+            else "open"
 
     def metrics(self) -> dict:
         with self._lock:
@@ -429,6 +674,8 @@ class ServingEngine:
             # guards insert/evict (zlint lock-discipline finding: a
             # scrape racing an eviction read torn LRU state)
             m["cached_executables"] = len(self._cache)
+            m["generation"] = self._gen.number
+        m.setdefault("reloads", 0)
         m.setdefault("cache_hits", 0)
         m.setdefault("cache_misses", 0)
         m.setdefault("cache_evictions", 0)

@@ -16,7 +16,18 @@ Wire protocol (JSON both ways):
   the engine's resilience state — ``ok`` | ``degraded`` (circuit open,
   native CPU fallback serving) | ``open`` (circuit open, no fallback:
   predicts answer 503 + Retry-After) — so a load balancer can rotate a
-  degraded replica out BEFORE clients see 503s.
+  degraded replica out BEFORE clients see 503s.  Also carries
+  ``model_generation`` and ``last_reload`` (outcome of the most recent
+  hot reload), so a rollout driver can poll whether its swap landed.
+* ``POST /admin/reload``  zero-downtime hot reload: body
+  ``{"model": optional path, "wait": optional bool}``; the new
+  artifact is verified (znicz_tpu.durability) and canaried on a
+  background thread while the old generation keeps serving, then
+  atomically swapped — failure rolls back.  202 started / 200 waited /
+  409 already in flight / 403 bad ``X-Admin-Token`` (required whenever
+  a token is configured via ``--admin-token`` / ``$ZNICZ_ADMIN_TOKEN``
+  — set one on any listener reachable beyond localhost).  ``SIGHUP``
+  triggers the same path from the ``serve`` CLI without a token.
 * ``GET /metrics``   content-negotiated (znicz_tpu.telemetry): the
   default JSON view is the PR-1 shape — batcher counters (queue depth,
   batch-size histogram, p50/p99 latency, rejected/expired) merged with
@@ -40,7 +51,9 @@ resolves as a native-fallback 200 or a 503 carrying Retry-After.
 
 from __future__ import annotations
 
+import hmac
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -57,7 +70,7 @@ from .engine import ServingEngine
 #: routes with their own label value in requests_total/errors_total —
 #: anything else pools under "other" (label cardinality stays bounded
 #: no matter what paths clients probe)
-_ROUTES = ("/predict", "/healthz", "/metrics")
+_ROUTES = ("/predict", "/healthz", "/metrics", "/admin/reload")
 
 
 class ServingServer:
@@ -70,13 +83,22 @@ class ServingServer:
                  max_wait_ms: float | None = None,
                  max_queue: int | None = None,
                  default_timeout_s: float = 60.0,
-                 max_body_mb: float = 64.0):
+                 max_body_mb: float = 64.0,
+                 admin_token: str | None = None):
         knobs = (max_batch, max_wait_ms, max_queue)
         if batcher is not None and any(k is not None for k in knobs):
             # silently dropping the knobs would look like they applied
             raise ValueError("pass batching knobs OR a prebuilt "
                              "batcher, not both")
         self.engine = engine
+        # /admin/reload shares the public listener with /predict, so
+        # it gets its own gate: when a token is configured (flag or
+        # $ZNICZ_ADMIN_TOKEN), reload requests must carry it in
+        # X-Admin-Token or get a 403 — a client that can reach the
+        # predict port must not be able to swap the model.  SIGHUP
+        # remains the token-less local-operator channel.
+        self.admin_token = admin_token if admin_token is not None \
+            else os.environ.get("ZNICZ_ADMIN_TOKEN") or None
         self.max_body = int(max_body_mb * 1e6)
         self._own_batcher = batcher is None
         self.batcher = batcher or MicroBatcher(
@@ -158,7 +180,11 @@ class ServingServer:
                     self._reply(404, {"error": f"no route {self.path!r}"})
 
             def do_POST(self):
-                if self.path.split("?")[0].rstrip("/") != "/predict":
+                route = self.path.split("?")[0].rstrip("/")
+                if route == "/admin/reload":
+                    self._admin_reload()
+                    return
+                if route != "/predict":
                     self._reply(404, {"error": f"no route {self.path!r}"})
                     return
                 # the request id lives in a contextvar for the rest of
@@ -172,6 +198,71 @@ class ServingServer:
                     with tracing.span("server.predict"):
                         self._predict()
                 outer._latency.observe((time.monotonic() - t0) * 1e3)
+
+            def _admin_reload(self):
+                """``POST /admin/reload`` — zero-downtime model swap.
+
+                Body (all optional): ``{"model": "/path/new.znn",
+                "wait": true}``.  The reload itself runs on a
+                background thread (verify + canary can take seconds —
+                a handler thread must not hold a connection hostage for
+                them unless the client asked to ``wait``); traffic
+                keeps flowing on the OLD generation throughout, and a
+                verify/canary failure rolls back (docs/durability.md).
+                202 = started, 200 = waited and finished (see
+                ``outcome``), 409 = one already in flight, 403 =
+                missing/wrong ``X-Admin-Token`` when the server has
+                one configured."""
+                if outer.admin_token is not None:
+                    supplied = self.headers.get("X-Admin-Token", "")
+                    # compare bytes: compare_digest(str, str) raises
+                    # TypeError on non-ASCII input, and header values
+                    # arrive latin-1-decoded — a stray high byte must
+                    # 403, not crash the handler.  supplied.encode
+                    # (latin-1) recovers the client's exact wire bytes;
+                    # the configured token is a Python str whose wire
+                    # form is its UTF-8 encoding, so a non-ASCII token
+                    # still matches the client that sends it.
+                    if not hmac.compare_digest(
+                            supplied.encode("latin-1", "replace"),
+                            outer.admin_token.encode("utf-8")):
+                        self._reply(403, {
+                            "error": "admin token required (supply "
+                                     "X-Admin-Token)"})
+                        return
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    if n > outer.max_body:
+                        self._reply(413, {
+                            "error": f"body of {n} bytes exceeds the "
+                                     f"{outer.max_body}-byte limit"})
+                        return
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError("body must be a JSON object")
+                    model = payload.get("model")
+                    if model is not None and not isinstance(model, str):
+                        raise ValueError("'model' must be a path string")
+                    wait = bool(payload.get("wait", False))
+                except Exception as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                worker = outer.reload_async(model)
+                if worker is None:
+                    self._reply(409, {
+                        "error": "a reload is already in progress",
+                        **outer.engine.reload_status()})
+                    return
+                if wait:
+                    worker.join(outer.default_timeout_s)   # bounded
+                    status = outer.engine.reload_status()
+                    code = 200 if not worker.is_alive() else 202
+                    self._reply(code, {"status": "done"
+                                       if code == 200 else "running",
+                                       **status})
+                else:
+                    self._reply(202, {"status": "reload started",
+                                      **outer.engine.reload_status()})
 
             def _predict(self):
                 try:
@@ -248,6 +339,41 @@ class ServingServer:
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         daemon=True,
                                         name="znicz-serving-http")
+        # hot-reload worker bookkeeping (single-flight at the server
+        # tier too, so /admin/reload can answer 409 without consuming
+        # the engine's own non-blocking lock)
+        self._reload_mu = threading.Lock()
+        self._reload_thread: threading.Thread | None = None
+
+    # -- hot reload -------------------------------------------------------
+    def reload_async(self, model: str | None = None
+                     ) -> threading.Thread | None:
+        """Start a background hot reload of ``model`` (None = re-read
+        the engine's current artifact path).  Returns the worker
+        thread, or None when a reload is already in flight.  The old
+        generation serves throughout; outcomes land in the engine's
+        ``last_reload`` / ``/healthz`` / ``model_reloads_total``."""
+        with self._reload_mu:
+            if self._reload_thread is not None \
+                    and self._reload_thread.is_alive():
+                return None
+            worker = threading.Thread(
+                target=self._reload_worker, args=(model,), daemon=True,
+                name="znicz-model-reload")
+            self._reload_thread = worker
+            worker.start()
+            return worker
+
+    def _reload_worker(self, model: str | None) -> None:
+        # engine.reload never raises for artifact problems (they are
+        # outcomes, not crashes); anything else must not kill the
+        # worker silently either — the server keeps serving regardless
+        try:
+            self.engine.reload(model)
+        except Exception:
+            import logging
+            logging.getLogger("ServingServer").exception(
+                "hot reload worker failed")
 
     # -- payload builders -------------------------------------------------
     def health(self) -> dict:
@@ -256,6 +382,9 @@ class ServingServer:
                "n_layers": self.engine.n_layers,
                "buckets": list(self.engine.buckets),
                "queue_depth": self.batcher.queue_depth()}
+        # generation + last reload outcome: a rollout driver polls
+        # /healthz to learn whether its /admin/reload landed
+        out.update(self.engine.reload_status())
         if state != "ok":      # give probers the why + the come-back
             out["breaker"] = self.engine.breaker.metrics()
             out["retry_after_s"] = int(self.engine.breaker.retry_after())
@@ -373,6 +502,12 @@ def main(argv=None) -> int:
     p.add_argument("--breaker-cooldown-s", type=float, default=10.0,
                    help="seconds the circuit stays open before a "
                         "half-open probe retries the jax engine")
+    p.add_argument("--admin-token", default=None,
+                   help="require this token (X-Admin-Token header) on "
+                        "POST /admin/reload; defaults to "
+                        "$ZNICZ_ADMIN_TOKEN — set one whenever the "
+                        "listener is reachable beyond localhost "
+                        "(SIGHUP stays the token-less local channel)")
     p.add_argument("--fault-plan", default=None,
                    help="chaos: install a fault plan (inline JSON or "
                         "@file; see znicz_tpu.resilience.faults)")
@@ -426,7 +561,8 @@ def main(argv=None) -> int:
                                max_wait_ms=args.max_wait_ms,
                                max_queue=args.max_queue,
                                default_timeout_s=args.timeout_s,
-                               max_body_mb=args.max_body_mb)
+                               max_body_mb=args.max_body_mb,
+                               admin_token=args.admin_token)
         server.start()
         print(f"serving {args.model} [{engine.backend}] at "
               f"{server.url} (POST /predict, GET /healthz, "
@@ -441,16 +577,29 @@ def main(argv=None) -> int:
         # path as Ctrl-C for container runtimes.
         import signal as _signal
         stop = threading.Event()
+        hup = threading.Event()
 
         def _arm():
             for _sig in (_signal.SIGINT, _signal.SIGTERM):
                 _signal.signal(_sig, lambda *_: stop.set())
+            if hasattr(_signal, "SIGHUP"):
+                # operator hot reload: `kill -HUP <pid>` re-reads
+                # --model in place, the config-reload idiom ops tooling
+                # already speaks — same verify/canary/rollback path as
+                # POST /admin/reload
+                _signal.signal(_signal.SIGHUP, lambda *_: hup.set())
         _arm()
         while not stop.is_set():
             stop.wait(0.5)
             _arm()    # native libs (XLA's profiler) can clobber the
             #           process sigaction; re-arming each tick keeps
             #           Ctrl-C/SIGTERM working for the whole lifetime
+            if hup.is_set():
+                hup.clear()
+                if server.reload_async() is not None:
+                    print("SIGHUP: hot reload started "
+                          f"(generation {engine.generation})",
+                          flush=True)
             if profile_deadline is not None \
                     and time.monotonic() >= profile_deadline:
                 # windowed capture complete: write the trace NOW (an

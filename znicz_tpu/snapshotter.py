@@ -14,6 +14,7 @@ code changes, and exactly how Orbax-style TPU checkpointing treats state."""
 from __future__ import annotations
 
 import bz2
+import glob
 import gzip
 import io
 import json
@@ -23,6 +24,7 @@ import time
 
 import numpy as np
 
+from . import durability
 from .resilience import faults
 from .units import Unit
 
@@ -160,18 +162,33 @@ class SnapshotterToFile(SnapshotterBase):
         self.epoch_end(improved)
 
     def save(self, tag: str) -> str:
-        """Crash-safe save, single-rename atomic: the metadata rides
-        INSIDE the .npz (a JSON-bytes array under ``__meta_json__``), so
-        arrays and counters commit in one os.replace() — an unclean
-        death (SIGKILL, preemption — the very case restart-from-snapshot
-        exists for) can never pair save-N arrays with save-N±1 meta.
-        A ``.json`` sidecar is still written for human inspection, but
-        load() never reads it.
+        """Crash-safe save: the metadata rides INSIDE the .npz (a
+        JSON-bytes array under ``__meta_json__``), so arrays and
+        counters commit in one os.replace() — an unclean death (SIGKILL,
+        preemption — the very case restart-from-snapshot exists for)
+        can never pair save-N arrays with save-N±1 meta.  A ``.json``
+        sidecar is still written for human inspection, but load() never
+        reads it.
 
-        ``checkpoint.save`` fault site: chaos tests kill the save here
-        — BEFORE any filesystem mutation, like a preemption landing at
-        the worst moment — and assert the retry/atomic-rename story
-        holds (see CheckpointRecovery)."""
+        Commit ordering is PINNED (tests/test_durability.py):
+        manifest invalidate first, then the blob rename, then the new
+        sha256 manifest (:func:`durability.write_manifest`), then the
+        human sidecar.  A crash anywhere in that window leaves a
+        manifest-LESS blob (old or new, both self-consistent) which
+        verify-on-load deep-parses, loads, and re-blesses; it can never
+        leave a live manifest over bytes it does not describe — which
+        is exactly what lets a digest mismatch mean "rot, quarantine"
+        unambiguously.  The reverse order (manifest before blob) would
+        bless a blob that was never written.
+
+        Fault sites: ``checkpoint.save`` fires BEFORE any filesystem
+        mutation (a preemption landing at the worst moment — the
+        retry/atomic-rename story, see CheckpointRecovery);
+        ``checkpoint.write_torn`` fires INSIDE the torn window between
+        the blob and manifest renames (error = die torn, latency = hold
+        the window open for the SIGKILL crash tests);
+        ``artifact.bitflip`` (durability.chaos_bitflip) rots one byte
+        of the committed blob AFTER its manifest is written."""
         faults.inject("checkpoint.save")
         os.makedirs(self.directory, exist_ok=True)
         arrays, meta = collect_state(self.workflow)
@@ -192,18 +209,32 @@ class SnapshotterToFile(SnapshotterBase):
                 np.savez_compressed(fh, __meta_json__=meta_blob, **arrays)
         with open(path + ".json.tmp", "w") as fh:
             json.dump(meta, fh, default=float)
+        durability.invalidate_manifest(path)
         os.replace(path + ".tmp", path)
+        faults.inject("checkpoint.write_torn")
+        durability.write_manifest(path, kind="snapshot")
+        durability.chaos_bitflip(path)
         os.replace(path + ".json.tmp", path + ".json")
         self.debug("snapshot → %s", path)
         return path
 
     @staticmethod
-    def load(workflow, path: str) -> dict:
+    def load(workflow, path: str, verify: bool = True) -> dict:
         """Restore a snapshot into an *initialized* workflow; returns
         meta.  Compression is detected from the extension
         (``.npz[.gz|.bz2|.xz]`` — the reference's CLI-resume UX).
-        ``checkpoint.load`` is the matching chaos fault site."""
+        ``checkpoint.load`` is the matching chaos fault site.
+
+        ``verify`` (default) runs :func:`durability.verify_or_heal`
+        first: a truncated or bit-flipped snapshot raises the typed
+        :class:`durability.ArtifactCorrupt` instead of an opaque
+        zipfile/CRC error mid-restore, a torn-save stale manifest is
+        healed, and a pre-durability snapshot (no manifest) still gets
+        the deep format parse.  Pass ``verify=False`` only when the
+        caller verified already (:meth:`restore`'s scan)."""
         faults.inject("checkpoint.load")
+        if verify:
+            durability.verify_or_heal(path)
         ext = path.rsplit(".", 1)[-1]
         if ext in _OPENERS:
             with _OPENERS[ext](path, "rb") as fh:
@@ -218,3 +249,45 @@ class SnapshotterToFile(SnapshotterBase):
                 meta = json.load(fh)
         restore_state(workflow, arrays, meta)
         return meta
+
+    @classmethod
+    def restore(cls, workflow, directory: str = "snapshots",
+                prefix: str = "snapshot", owner: bool = True
+                ) -> tuple[dict, str] | None:
+        """Last-good-fallback resume: scan this prefix's snapshots
+        newest→oldest, quarantine corrupt entries (``*.corrupt`` +
+        structured log + ``artifacts_quarantined_total``), and restore
+        the newest one that verifies.  Returns ``(meta, path)`` or None
+        when nothing usable exists — a corrupt ``current`` falls back
+        to ``best`` (or an older tagged save) instead of crashing the
+        resume, the contract ElasticRunner workers rely on.
+        ``owner=False`` (non-zero processes of a fleet) verifies
+        read-only: no quarantine renames, no manifest heals — process
+        0 owns the writes, everyone lands on the same survivor."""
+        path = durability.newest_verified(
+            snapshot_candidates(directory, prefix),
+            on_corrupt="quarantine" if owner else "skip", heal=owner)
+        if path is None:
+            return None
+        return cls.load(workflow, path, verify=False), path
+
+
+def snapshot_candidates(directory: str, prefix: str = "snapshot"
+                        ) -> list[str]:
+    """This prefix's snapshot blobs under ``directory``, newest first
+    (mtime).  Sidecars (``.json``/``.manifest.json``), temporaries, and
+    already-quarantined ``*.corrupt*`` entries are excluded."""
+    out = []
+    for path in glob.glob(os.path.join(
+            directory, glob.escape(prefix) + "_*.npz*")):
+        name = os.path.basename(path)
+        if name.endswith((".json", ".tmp")) or ".corrupt" in name:
+            continue
+        if not (name.endswith(".npz")
+                or name.rsplit(".", 1)[-1] in _OPENERS):
+            continue
+        try:
+            out.append((os.path.getmtime(path), path))
+        except OSError:          # raced a quarantine/cleanup
+            continue
+    return [p for _, p in sorted(out, reverse=True)]
