@@ -1,0 +1,741 @@
+"""Closed-loop promotion controller (ISSUE 6, docs/promotion.md):
+SLO delta math, the persisted ledger + restart replay, candidate
+sources, the controller state machine against fake and real targets —
+including the SLO-breach rollback acceptance (latency injected at
+``engine.forward`` during the watch window → automatic rollback, old
+generation serving identical bytes) and the slow N≥3-promotion
+zero-500 chaos acceptance."""
+
+import json
+import math
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from znicz_tpu import durability
+from znicz_tpu.promotion import (Candidate, CheckpointSource, CrashLoop,
+                                 DirectorySource, EngineTarget,
+                                 PromotionController, PromotionLedger,
+                                 SLOPolicy, SLOSample, delta_quantile,
+                                 prometheus_sample, registry_sample)
+from znicz_tpu.resilience import faults
+from znicz_tpu.resilience.chaos import _write_demo_znn
+from znicz_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+
+
+def _sample(buckets, count=None, req=0.0, err=0.0, breaker="closed"):
+    cum = dict(buckets)
+    cum.setdefault(math.inf, max(cum.values()) if cum else 0.0)
+    return SLOSample(at=time.time(), latency_cum=cum,
+                     latency_count=(count if count is not None
+                                    else cum[math.inf]),
+                     requests=req, errors_5xx=err, breaker_state=breaker)
+
+
+ZERO = _sample({10.0: 0.0, 100.0: 0.0})
+
+
+# -- SLO math ----------------------------------------------------------------
+class TestSLOMath:
+    def test_p99_is_bucket_upper_edge(self):
+        now = _sample({10.0: 99.0, 100.0: 100.0})
+        assert delta_quantile(ZERO, now, 0.99) == 10.0
+        now = _sample({10.0: 90.0, 100.0: 100.0})
+        assert delta_quantile(ZERO, now, 0.99) == 100.0
+
+    def test_quantile_in_overflow_bucket_is_inf(self):
+        now = _sample({10.0: 0.0, 100.0: 0.0, math.inf: 50.0})
+        assert delta_quantile(ZERO, now, 0.99) == math.inf
+
+    def test_delta_cancels_pre_swap_traffic(self):
+        # 1000 slow observations before the swap must not condemn a
+        # fast candidate: only the delta counts
+        start = _sample({10.0: 0.0, 100.0: 1000.0})
+        now = _sample({10.0: 50.0, 100.0: 1050.0})
+        assert delta_quantile(start, now, 0.99) == 10.0
+
+    def test_empty_delta_is_none(self):
+        assert delta_quantile(ZERO, ZERO) is None
+
+    def test_policy_latency_breach_and_min_samples_gate(self):
+        pol = SLOPolicy(max_p99_ms=50.0, min_samples=5)
+        slow = _sample({10.0: 0.0, 100.0: 100.0})
+        assert [b["slo"] for b in pol.evaluate(ZERO, slow)] \
+            == ["p99_latency_ms"]
+        trickle = _sample({10.0: 0.0, 100.0: 3.0})
+        assert pol.evaluate(ZERO, trickle) == []
+
+    def test_policy_error_rate_counts_5xx_share(self):
+        pol = SLOPolicy(max_p99_ms=None, max_error_rate=0.01,
+                        min_samples=5)
+        bad = _sample({10.0: 100.0}, req=100.0, err=5.0)
+        assert [b["slo"] for b in pol.evaluate(ZERO, bad)] \
+            == ["error_rate"]
+        ok = _sample({10.0: 100.0}, req=100.0, err=0.0)
+        assert pol.evaluate(ZERO, ok) == []
+
+    def test_policy_breaker_breach(self):
+        pol = SLOPolicy(max_p99_ms=None, max_error_rate=None)
+        open_ = _sample({}, breaker="open")
+        assert [b["slo"] for b in pol.evaluate(ZERO, open_)] \
+            == ["breaker"]
+        assert pol.evaluate(ZERO, _sample({}, breaker=None)) == []
+
+
+class TestSampleBuilders:
+    def test_registry_and_prometheus_samples_agree(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("predict_latency_ms", "t",
+                          buckets=(10.0, 100.0))
+        for v in (5.0, 5.0, 50.0, 500.0):
+            h.observe(v)
+        reg.counter("requests_total", "t").inc(route="/predict",
+                                               code="200")
+        reg.counter("requests_total").inc(route="/predict", code="503")
+        reg.counter("requests_total").inc(route="/metrics", code="200")
+        reg.counter("errors_total", "t").inc(route="/predict",
+                                             code="503")
+        reg.counter("errors_total").inc(route="/predict", code="400")
+        a = registry_sample(breaker_state="closed", registry=reg)
+        b = prometheus_sample(reg.render_prometheus())
+        assert a.latency_cum == b.latency_cum \
+            == {10.0: 2.0, 100.0: 3.0, math.inf: 4.0}
+        assert a.latency_count == b.latency_count == 4.0
+        assert a.requests == b.requests == 2.0      # /predict only
+        assert a.errors_5xx == b.errors_5xx == 1.0  # 400 not counted
+        assert a.breaker_state == "closed"
+
+    def test_prometheus_sample_reads_breaker_enum(self):
+        text = ('breaker_state{state="closed"} 0\n'
+                'breaker_state{state="open"} 1\n'
+                'breaker_state{state="half_open"} 0\n')
+        assert prometheus_sample(text).breaker_state == "open"
+
+    def test_prometheus_parser_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            prometheus_sample("this is { not exposition")
+
+
+# -- ledger ------------------------------------------------------------------
+class TestLedger:
+    def test_append_read_round_trip(self, tmp_path):
+        led = PromotionLedger(str(tmp_path / "l.jsonl"))
+        led.append("candidate", candidate="a.znn", attempt=1)
+        led.append("outcome", outcome="promoted", candidate="a.znn",
+                   deployed="/d/000001-a.znn", generation=2)
+        entries = led.entries()
+        assert [e["event"] for e in entries] == ["candidate", "outcome"]
+        assert all("ts" in e for e in entries)
+
+    def test_missing_file_is_empty_history(self, tmp_path):
+        led = PromotionLedger(str(tmp_path / "nope.jsonl"))
+        assert led.entries() == []
+        rep = led.replay()
+        assert rep.attempted == set() and rep.consecutive_failures == 0
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        path = str(tmp_path / "l.jsonl")
+        led = PromotionLedger(path)
+        led.append("candidate", candidate="a.znn", attempt=1)
+        with open(path, "a") as fh:
+            fh.write('{"ts": 1, "event": "outc')     # crash mid-append
+        assert [e["event"] for e in led.entries()] == ["candidate"]
+
+    def test_replay_folds_streaks_and_rollback_target(self, tmp_path):
+        led = PromotionLedger(str(tmp_path / "l.jsonl"))
+        led.append("candidate", candidate="a.znn", attempt=1)
+        led.append("outcome", outcome="promoted", candidate="a.znn",
+                   deployed="/d/1-a.znn", generation=2)
+        led.append("candidate", candidate="b.znn", attempt=2)
+        led.append("outcome", outcome="verify_failed",
+                   candidate="b.znn")
+        led.append("candidate", candidate="c.znn", attempt=3)
+        led.append("outcome", outcome="rolled_back", candidate="c.znn")
+        rep = led.replay()
+        assert rep.attempted == {"a.znn", "b.znn", "c.znn"}
+        assert rep.promotions == 1
+        assert rep.consecutive_failures == 2      # since the promote
+        assert rep.last_promoted_path == "/d/1-a.znn"
+        assert rep.last_generation == 2
+        assert rep.last_outcome == "rolled_back"
+        assert rep.attempts == 3
+
+    def test_replay_counts_crashes_and_ignores_aborted(self, tmp_path):
+        """The failure streak must survive a crash-looping
+        controller's own restarts (``attempt_crashed`` events count),
+        while an ``aborted`` outcome — stopped mid-watch, never
+        judged — leaves it alone."""
+        led = PromotionLedger(str(tmp_path / "l.jsonl"))
+        led.append("outcome", outcome="promoted", candidate="a.znn",
+                   deployed="/d/1-a.znn", generation=2)
+        led.append("attempt_crashed")
+        led.append("outcome", outcome="aborted", candidate="b.znn")
+        led.append("attempt_crashed")
+        rep = led.replay()
+        assert rep.consecutive_failures == 2
+        assert rep.promotions == 1
+
+
+# -- sources -----------------------------------------------------------------
+class TestDirectorySource:
+    def _touch(self, path, mtime):
+        with open(path, "wb") as fh:
+            fh.write(b"x")
+        os.utime(path, (mtime, mtime))
+
+    def test_newest_unseen_wins_and_backlog_is_skipped(self, tmp_path):
+        src = DirectorySource(str(tmp_path))
+        self._touch(tmp_path / "a.znn", 100)
+        self._touch(tmp_path / "b.znn", 200)
+        cand, skipped = src.poll()
+        assert cand.name == "b.znn" and skipped == ["a.znn"]
+        assert src.poll() == (None, [])           # both consumed
+        self._touch(tmp_path / "c.znn", 300)
+        cand, skipped = src.poll()
+        assert cand.name == "c.znn" and skipped == []
+
+    def test_non_candidates_ignored(self, tmp_path):
+        self._touch(tmp_path / "a.znn.tmp", 100)
+        self._touch(tmp_path / "a.znn.manifest.json", 100)
+        src = DirectorySource(str(tmp_path))
+        assert src.poll() == (None, [])
+
+    def test_resume_skips_attempted(self, tmp_path):
+        self._touch(tmp_path / "a.znn", 100)
+        src = DirectorySource(str(tmp_path))
+        src.resume({"a.znn"})
+        assert src.poll() == (None, [])
+
+
+class TestCheckpointSource:
+    def test_only_blessed_steps_offered_in_order(self, tmp_path):
+        calls = []
+        src = CheckpointSource(str(tmp_path),
+                               exporter=lambda p, d: calls.append((p,
+                                                                   d)))
+        # step 3 is blessed (manifest'd); step 5 is mid-save (no
+        # manifest, a lone .tmp) — only 3 is a candidate, and 5 stays
+        # eligible for a later poll
+        for step, bless in ((3, True), (5, False)):
+            d = tmp_path / str(step)
+            d.mkdir()
+            (d / "arr.bin").write_bytes(b"\x00" * 8)
+            if bless:
+                durability.write_manifest(str(d), kind="checkpoint")
+            else:
+                (d / "arr.bin.tmp").write_bytes(b"")
+                os.unlink(d / "arr.bin")
+        cand, _ = src.poll()
+        assert cand.name == "step-3"
+        assert src.poll() == (None, [])
+        durability.write_manifest(str(tmp_path / "5"),
+                                  kind="checkpoint")
+        cand, _ = src.poll()
+        assert cand.name == "step-5"
+        src.materialize(cand, "/dev/null")
+        assert calls == [(str(tmp_path / "5"), "/dev/null")]
+
+    def test_resume_from_step_names(self, tmp_path):
+        src = CheckpointSource(str(tmp_path), exporter=None)
+        src.resume({"step-7", "junk"})
+        assert src.last_step == 7
+
+
+# -- controller against a scripted fake target -------------------------------
+class FakeTarget:
+    """Scripted target: records reloads, serves queued reload records
+    and SLO samples (the last entry repeats when the script runs
+    dry)."""
+
+    def __init__(self, samples=None):
+        self.reloads = []
+        self.reload_outcomes = []
+        self.samples = list(samples or [ZERO])
+        self.generation = 1
+        self.attached = None
+
+    def attach(self, fn):
+        self.attached = fn
+
+    def reload(self, path):
+        self.reloads.append(path)
+        if self.reload_outcomes:
+            return self.reload_outcomes.pop(0)
+        self.generation += 1
+        return {"outcome": "ok", "error": None,
+                "generation": self.generation}
+
+    def sample(self):
+        if len(self.samples) > 1:
+            return self.samples.pop(0)
+        return self.samples[0]
+
+
+def _controller(tmp_path, target, **kw):
+    cands = tmp_path / "cands"
+    cands.mkdir(exist_ok=True)
+    kw.setdefault("policy", SLOPolicy(window_s=0.2,
+                                      probe_interval_s=0.05,
+                                      max_p99_ms=50.0,
+                                      max_error_rate=0.5,
+                                      min_samples=3))
+    return cands, PromotionController(
+        DirectorySource(str(cands)), target,
+        deploy_dir=str(tmp_path / "deploy"), **kw)
+
+
+class TestControllerStateMachine:
+    def test_promote_happy_path(self, tmp_path):
+        target = FakeTarget()
+        cands, ctl = _controller(tmp_path, target)
+        assert ctl.run_once() is None            # nothing to do
+        _write_demo_znn(str(cands / "v1.znn"))
+        before = REGISTRY.counter("promotions_total") \
+            .value(outcome="promoted")
+        assert ctl.run_once() == "promoted"
+        assert REGISTRY.counter("promotions_total") \
+            .value(outcome="promoted") == before + 1
+        # the deploy commit is manifest'd and verifiable
+        assert len(target.reloads) == 1
+        deployed = target.reloads[0]
+        assert os.path.dirname(deployed) == str(tmp_path / "deploy")
+        durability.verify(deployed)
+        st = ctl.status()
+        assert st["state"] == "idle" \
+            and st["last_outcome"] == "promoted" \
+            and st["promotions"] == 1 and st["generation"] == 2
+        # status attach happened (the /healthz hook's fake twin)
+        assert callable(target.attached)
+        entries = ctl.ledger.entries()
+        events = [e["event"] for e in entries]
+        assert events[0] == "candidate" and events[-1] == "outcome"
+        states = {e["state"] for e in entries
+                  if e["event"] == "state"}
+        assert {"verifying", "exporting", "canarying",
+                "watching"} <= states
+
+    def test_verify_failed_candidate_never_reloads(self, tmp_path):
+        target = FakeTarget()
+        cands, ctl = _controller(tmp_path, target)
+        path = str(cands / "rot.znn")
+        _write_demo_znn(path)
+        with open(path, "r+b") as fh:            # rot under a live
+            fh.seek(40)                          # manifest = digest
+            fh.write(b"\xff\xff")                # mismatch
+        assert ctl.run_once() == "verify_failed"
+        assert target.reloads == []
+        assert ctl.status()["consecutive_failures"] == 1
+
+    def test_slo_breach_rolls_back_to_previous(self, tmp_path):
+        slow = _sample({10.0: 0.0, 100.0: 100.0})
+        target = FakeTarget(samples=[ZERO])
+        cands, ctl = _controller(tmp_path, target)
+        _write_demo_znn(str(cands / "v1.znn"))
+        assert ctl.run_once() == "promoted"
+        blessed = target.reloads[-1]
+        before = REGISTRY.counter("slo_breaches_total") \
+            .value(slo="p99_latency_ms")
+        target.samples = [ZERO, slow]            # breach on probe 1
+        _write_demo_znn(str(cands / "v2.znn"), seed=11)
+        assert ctl.run_once() == "rolled_back"
+        # second reload swapped v2 in, third rolled back to blessed v1
+        assert len(target.reloads) == 3
+        assert target.reloads[-1] == blessed
+        assert ctl.status()["state"] == "rolled_back"
+        assert REGISTRY.counter("slo_breaches_total") \
+            .value(slo="p99_latency_ms") == before + 1
+        rb = [e for e in ctl.ledger.entries()
+              if e["event"] == "rollback"]
+        assert len(rb) == 1 and rb[0]["to"] == blessed \
+            and rb[0]["breaches"][0]["slo"] == "p99_latency_ms"
+
+    def test_breach_with_no_previous_is_rollback_failed(self, tmp_path):
+        slow = _sample({10.0: 0.0, 100.0: 100.0})
+        target = FakeTarget(samples=[ZERO, slow])
+        cands, ctl = _controller(tmp_path, target)
+        _write_demo_znn(str(cands / "v1.znn"))
+        assert ctl.run_once() == "rollback_failed"
+        assert len(target.reloads) == 1          # nothing to reload to
+
+    def test_canary_failure_reported_and_counted(self, tmp_path):
+        target = FakeTarget()
+        target.reload_outcomes = [{"outcome": "canary_failed",
+                                   "error": "non-finite",
+                                   "generation": 1}]
+        cands, ctl = _controller(tmp_path, target)
+        _write_demo_znn(str(cands / "v1.znn"))
+        assert ctl.run_once() == "canary_failed"
+        last = [e for e in ctl.ledger.entries()
+                if e["event"] == "outcome"][-1]
+        assert "non-finite" in last["reason"]
+
+    def test_crash_loop_fails_fast(self, tmp_path):
+        target = FakeTarget()
+        cands, ctl = _controller(tmp_path, target,
+                                 max_consecutive_failures=2)
+        for i in range(2):
+            path = str(cands / f"rot{i}.znn")
+            _write_demo_znn(path)
+            with open(path, "r+b") as fh:
+                fh.seek(40)
+                fh.write(b"\xff\xff")
+            if i < 1:
+                assert ctl.run_once() == "verify_failed"
+            else:
+                with pytest.raises(CrashLoop):
+                    ctl.run_once()
+        assert ctl.status()["state"] == "crash_loop"
+        assert any(e["event"] == "crash_loop"
+                   for e in ctl.ledger.entries())
+
+    def test_restart_resumes_from_ledger(self, tmp_path):
+        target = FakeTarget()
+        cands, ctl = _controller(tmp_path, target)
+        _write_demo_znn(str(cands / "v1.znn"))
+        assert ctl.run_once() == "promoted"
+        blessed = target.reloads[-1]
+        rot = str(cands / "v2.znn")
+        _write_demo_znn(rot, seed=11)
+        with open(rot, "r+b") as fh:
+            fh.seek(40)
+            fh.write(b"\xff\xff")
+        assert ctl.run_once() == "verify_failed"
+        # a NEW controller over the same ledger/deploy dir: skips both
+        # attempted candidates, keeps the failure streak and the
+        # rollback target
+        _cands, ctl2 = _controller(tmp_path, FakeTarget())
+        assert ctl2.run_once() is None           # nothing re-offered
+        st = ctl2.status()
+        assert st["consecutive_failures"] == 1 \
+            and st["promotions"] == 1
+        with ctl2._lock:
+            assert ctl2._previous == blessed
+
+    def test_export_fault_site_is_retried(self, tmp_path):
+        target = FakeTarget()
+        cands, ctl = _controller(tmp_path, target)
+        _write_demo_znn(str(cands / "v1.znn"))
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "promotion.export", times=1, message="export blip")],
+            seed=3)
+        with plan:
+            assert ctl.run_once() == "promoted"
+        assert plan.snapshot().get("promotion.export:error") == 1
+
+    def test_prune_keeps_rollback_target(self, tmp_path):
+        target = FakeTarget()
+        cands, ctl = _controller(tmp_path, target, keep_deployed=2)
+        for i in range(4):
+            _write_demo_znn(str(cands / f"v{i}.znn"), seed=i + 1)
+            assert ctl.run_once() == "promoted"
+        kept = sorted(f for f in os.listdir(tmp_path / "deploy")
+                      if f.endswith(".znn"))
+        assert len(kept) == 2
+        with ctl._lock:
+            assert os.path.basename(ctl._previous) in kept
+
+    def test_stop_mid_watch_concludes_aborted_not_promoted(self, tmp_path):
+        """A candidate whose watch window never ran its course was
+        never judged: the attempt must conclude ``aborted`` — no
+        promoted count, no rollback-target install, no failure-streak
+        movement (in memory or on replay)."""
+        target = FakeTarget()
+        cands, ctl = _controller(tmp_path, target)
+        _write_demo_znn(str(cands / "v1.znn"))
+        assert ctl.run_once() == "promoted"
+        with ctl._lock:
+            blessed = ctl._previous
+        ctl._stop.set()                      # operator shutdown race
+        _write_demo_znn(str(cands / "v2.znn"), seed=11)
+        assert ctl.run_once() == "aborted"
+        st = ctl.status()
+        assert st["consecutive_failures"] == 0 \
+            and st["promotions"] == 1 and st["state"] == "idle"
+        with ctl._lock:
+            assert ctl._previous == blessed
+        assert ctl.ledger.replay().consecutive_failures == 0
+
+    def test_unjudgeable_watch_rolls_back(self, tmp_path):
+        """Probe retries exhausting mid-watch must not leave the
+        candidate serving unjudged with the controller stuck — the
+        safe verdict is the previous generation."""
+        target = FakeTarget()
+        cands, ctl = _controller(tmp_path, target)
+        _write_demo_znn(str(cands / "v1.znn"))
+        assert ctl.run_once() == "promoted"
+        blessed = target.reloads[-1]
+
+        def _dead_sample():
+            raise RuntimeError("metrics endpoint gone")
+
+        target.sample = _dead_sample
+        _write_demo_znn(str(cands / "v2.znn"), seed=11)
+        assert ctl.run_once() == "rolled_back"
+        assert target.reloads[-1] == blessed
+        last = [e for e in ctl.ledger.entries()
+                if e["event"] == "outcome"][-1]
+        assert "SLO watch failed" in last["reason"]
+        assert ctl.status()["state"] == "rolled_back"
+
+
+# -- real engine/server integration ------------------------------------------
+def _serving_stack(tmp_path):
+    from znicz_tpu.serving.engine import ServingEngine
+    from znicz_tpu.serving.server import ServingServer
+    v1 = str(tmp_path / "v1.znn")
+    _write_demo_znn(v1)
+    engine = ServingEngine(v1, backend="jax", buckets=(1, 2))
+    server = ServingServer(engine, max_wait_ms=1.0).start()
+    return engine, server
+
+
+def _post(url, payload, timeout=30.0):
+    req = urllib.request.Request(
+        url + "predict", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _health(url):
+    with urllib.request.urlopen(url + "healthz", timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestEngineTargetIntegration:
+    def test_slo_breach_rollback_serves_identical_bytes(self, tmp_path):
+        """The satellite acceptance: latency injected at
+        ``engine.forward`` during the watch window → the controller
+        rolls back, and the old generation answers with byte-identical
+        outputs."""
+        engine, server = _serving_stack(tmp_path)
+        cands = tmp_path / "cands"
+        cands.mkdir()
+        x = [[0.1, -0.2, 0.3, 0.4]]
+        stop = threading.Event()
+        served = []
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    _post(server.url, {"inputs": x})
+                    served.append(1)
+                except Exception:
+                    pass
+                stop.wait(0.01)
+
+        thread = threading.Thread(target=traffic, daemon=True)
+        thread.start()
+        try:
+            # let the cold-start jit compile finish OUTSIDE the first
+            # watch window — its multi-second latency lands in the
+            # histogram and would read as a (pre-candidate) breach
+            deadline = time.monotonic() + 60.0
+            while len(served) < 5 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert len(served) >= 5
+            ctl = PromotionController(
+                DirectorySource(str(cands)),
+                EngineTarget(server=server),
+                deploy_dir=str(tmp_path / "deploy"),
+                policy=SLOPolicy(window_s=1.0, probe_interval_s=0.2,
+                                 max_p99_ms=50.0, max_error_rate=0.5,
+                                 min_samples=3))
+            _write_demo_znn(str(cands / "v2.znn"), seed=11)
+            assert ctl.run_once() == "promoted"
+            gen_blessed = engine.generation
+            _st, body = _post(server.url, {"inputs": x})
+            y_blessed = body["outputs"]
+            _write_demo_znn(str(cands / "v3.znn"), seed=23)
+            plan = faults.FaultPlan([faults.FaultSpec(
+                "engine.forward", kind="latency", latency_s=0.08,
+                message="regressed candidate")], seed=7)
+            with plan:
+                assert ctl.run_once() == "rolled_back"
+            # bad swap + rollback swap, and the bytes are the blessed
+            # generation's exactly
+            assert engine.generation == gen_blessed + 2
+            _st, body = _post(server.url, {"inputs": x})
+            assert body["outputs"] == y_blessed
+            # /healthz reports promotion state + last outcome next to
+            # the generation/breaker fields (satellite)
+            health = _health(server.url)
+            assert health["promotion"]["state"] == "rolled_back"
+            assert health["promotion"]["last_outcome"] == "rolled_back"
+            assert "model_generation" in health
+        finally:
+            stop.set()
+            thread.join(5)
+            server.stop()
+            engine.close()
+
+
+class TestHttpTargetIntegration:
+    def test_promote_over_http_admin_surface(self, tmp_path):
+        """The `python -m znicz_tpu promote` shape: the controller
+        drives a server it does not share objects with — reload via
+        POST /admin/reload (token-gated) and SLO probes via the
+        Prometheus /metrics scrape."""
+        from znicz_tpu.promotion import HttpTarget
+        from znicz_tpu.serving.engine import ServingEngine
+        from znicz_tpu.serving.server import ServingServer
+        v1 = str(tmp_path / "v1.znn")
+        _write_demo_znn(v1)
+        engine = ServingEngine(v1, backend="jax", buckets=(1, 2))
+        server = ServingServer(engine, max_wait_ms=1.0,
+                               admin_token="s3cret").start()
+        cands = tmp_path / "cands"
+        cands.mkdir()
+        try:
+            ctl = PromotionController(
+                DirectorySource(str(cands)),
+                HttpTarget(server.url, admin_token="s3cret"),
+                deploy_dir=str(tmp_path / "deploy"),
+                policy=SLOPolicy(window_s=0.3, probe_interval_s=0.1,
+                                 max_p99_ms=50.0, min_samples=3))
+            _write_demo_znn(str(cands / "v2.znn"), seed=11)
+            # no traffic in the window: the min_samples gate means the
+            # candidate promotes on the evidence available
+            assert ctl.run_once() == "promoted"
+            assert engine.generation == 2
+            assert _health(server.url)["last_reload"]["outcome"] == "ok"
+        finally:
+            server.stop()
+            engine.close()
+
+
+class TestHttpTargetStaleRecord:
+    def test_slow_reload_polls_past_previous_record(self):
+        """A reload outlasting the server's bounded wait answers 202
+        with ``last_reload`` still holding the PREVIOUS reload's
+        record — the target must keep polling until a record newer
+        than its pre-reload baseline lands, never adopting the stale
+        outcome as this candidate's canary verdict."""
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        from znicz_tpu.promotion import HttpTarget
+        old = {"outcome": "ok", "error": None, "at": 111.0}
+        new = {"outcome": "verify_failed", "error": "rot", "at": 222.0}
+        seen = {"health": 0}
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                seen["health"] += 1
+                rec = old if seen["health"] <= 2 else new
+                self._send(200, {"model_generation": 5,
+                                 "last_reload": rec})
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                self._send(202, {"model_generation": 5,
+                                 "last_reload": old})
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            target = HttpTarget(
+                f"http://127.0.0.1:{srv.server_port}/", timeout_s=10.0)
+            rec = target.reload("/candidate.znn")
+            assert rec["outcome"] == "verify_failed"
+            assert seen["health"] >= 3       # it really polled past
+        finally:
+            srv.shutdown()
+
+
+class TestAdminReload409RetryAfter:
+    def test_409_carries_retry_after(self, tmp_path):
+        """Satellite: the 409 (ReloadInProgress) answer is consistent
+        with the 429/503 backpressure paths — Retry-After header +
+        retry_after_s body field."""
+        engine, server = _serving_stack(tmp_path)
+        release = threading.Event()
+        blocker = threading.Thread(target=release.wait, daemon=True)
+        blocker.start()
+        try:
+            with server._reload_mu:
+                server._reload_thread = blocker   # reload "in flight"
+            req = urllib.request.Request(
+                server.url + "admin/reload", b"{}",
+                {"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=30)
+            assert exc.value.code == 409
+            ra = exc.value.headers.get("Retry-After")
+            assert ra is not None and int(ra) >= 1
+            body = json.loads(exc.value.read())
+            assert body["retry_after_s"] == int(ra)
+        finally:
+            release.set()
+            server.stop()
+            engine.close()
+
+
+# -- training side: blessed checkpoints feed the watcher ---------------------
+class TestTrainingSideWiring:
+    def test_fused_train_produces_blessed_steps(self, tmp_path):
+        """`train(fused=True, checkpointer=...)` saves the live device
+        state each epoch, `on_blessed` fires as each step's manifest
+        commits, and `CheckpointSource` offers exactly those blessed
+        steps — the training half of the promotion loop."""
+        from znicz_tpu import prng
+        from znicz_tpu.backends import Device
+        from znicz_tpu.config import root
+        from znicz_tpu.models import mnist
+        from znicz_tpu.parallel import TrainerCheckpointer
+        saved = root.mnist.to_dict()
+        root.mnist.update({"minibatch_size": 16})
+        root.mnist.synthetic.update({"n_train": 64, "n_valid": 16,
+                                     "n_test": 0})
+        blessed = []
+        try:
+            prng.seed_all(77)
+            wf = mnist.MnistWorkflow()
+            wf.initialize(device=Device.create("xla"))
+            ck = TrainerCheckpointer(
+                str(tmp_path / "ck"),
+                on_blessed=lambda step, path: blessed.append(
+                    (step, path)))
+            wf.train(fused=True, max_epochs=2, checkpointer=ck,
+                     checkpoint_every=1)
+            ck.close()
+        finally:
+            root.mnist.update(saved)
+        assert [s for s, _ in blessed] == [0, 1]
+        for _step, path in blessed:
+            report = durability.verify(path)
+            assert report["verified"] == "manifest"
+        src = CheckpointSource(str(tmp_path / "ck"), exporter=None)
+        cand, _skipped = src.poll()
+        assert cand.name == "step-1"
+
+
+# -- the chaos acceptance (slow) ---------------------------------------------
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestPromoteChaosAcceptance:
+    def test_n_promotions_zero_500_and_verified_rollback(self):
+        """ISSUE 6 acceptance: ``chaos --scenario promote`` drives
+        train-while-serving through ≥3 promotions with fault injection
+        plus one deliberately-regressed candidate — zero non-200
+        answers, auto-rollback within the SLO window, every transition
+        in the ledger (the scenario exits non-zero on any
+        violation)."""
+        from znicz_tpu.resilience.chaos import main as chaos_main
+        assert chaos_main(["--scenario", "promote",
+                           "--promotions", "3"]) == 0

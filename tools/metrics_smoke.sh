@@ -181,6 +181,15 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
               "artifacts_quarantined_total present (and clean)")
         check(series.get("manifests_healed_total") is not None,
               "manifests_healed_total present")
+        # promotion families (znicz_tpu.promotion): registered by the
+        # serve CLI from process start so dashboards see the series
+        # before any controller drives this replica — zero while idle
+        check(series.get("promotions_total") == 0.0,
+              "promotions_total family present (controller idle)")
+        check(series.get("slo_breaches_total") == 0.0,
+              "slo_breaches_total family present (controller idle)")
+        check(series.get("promotion_generation") == 0.0,
+              "promotion_generation gauge present (no promotion yet)")
     finally:
         proc.send_signal(signal.SIGINT)
         try:

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Closed-loop promotion smoke (ISSUE 6 acceptance, operator-runnable):
+# drive `python -m znicz_tpu chaos --scenario promote` — live traffic
+# flows while a stand-in trainer commits N candidate .znn artifacts
+# through the real atomic export path and the PromotionController
+# promotes each one (verify -> export -> canary reload -> SLO watch)
+# under injected transient faults at engine.forward, promotion.export
+# and promotion.slo_probe; then a deliberately-regressed candidate
+# (canaries clean, latency-regresses under traffic) must be
+# auto-rolled-back within the SLO window.
+#
+# Exit 0 only when: zero non-200 /predict answers across the run, all
+# N promotions landed, the rollback restored the previous generation's
+# exact bytes, /healthz reported the promotion state, and the ledger
+# recorded every transition (docs/promotion.md).
+#
+# Registered beside tools/chaos_smoke.sh and tools/metrics_smoke.sh;
+# pytest wrapper (marked slow): tests/test_promotion.py.
+#
+# Usage:  bash tools/promote_smoke.sh [chaos promote args...]
+#         (e.g. --promotions 5 --watch-s 2 --max-p99-ms 100;
+#          see `python -m znicz_tpu chaos --help`)
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario promote "$@"

@@ -19,10 +19,18 @@ Examples::
         # POST /predict carries an X-Request-Id (docs/observability.md),
         # and POST /admin/reload (or SIGHUP) hot-reloads the model with
         # verify + canary + rollback (docs/durability.md)
-    python -m znicz_tpu chaos [--scenario reload]
+    python -m znicz_tpu chaos [--scenario reload|promote]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
-        # --scenario reload drills corrupt-artifact rollback instead
+        # --scenario reload drills corrupt-artifact rollback;
+        # --scenario promote drives the closed promotion loop (N
+        # train-while-serving promotions + an SLO-breaching candidate
+        # auto-rolled-back, zero dropped requests; docs/promotion.md)
+    python -m znicz_tpu promote --candidates DIR --url http://host:port/
+        # closed-loop promotion controller sidecar: watch a trainer's
+        # export directory, verify + canary-deploy each new candidate
+        # to a running `serve` replica, SLO-watch the live telemetry,
+        # auto-rollback on regression (znicz_tpu.promotion)
     python -m znicz_tpu lint [--format json|text] [--baseline ...]
         # zlint: AST-based concurrency & JAX-hygiene analyzer over the
         # package (znicz_tpu.analysis; docs/static_analysis.md); exits
@@ -78,6 +86,11 @@ def main(argv=None) -> int:
         # znicz_tpu/resilience/chaos.py and tools/chaos_smoke.sh
         from .resilience.chaos import main as chaos_main
         return chaos_main(argv[1:])
+    if argv and argv[0] == "promote":
+        # the closed-loop promotion controller sidecar — see
+        # znicz_tpu/promotion and docs/promotion.md
+        from .promotion.cli import main as promote_main
+        return promote_main(argv[1:])
     if argv and argv[0] == "lint":
         # static analysis gate — znicz_tpu/analysis, tools/lint.sh
         from .analysis.cli import main as lint_main

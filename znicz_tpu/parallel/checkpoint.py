@@ -43,13 +43,23 @@ class TrainerCheckpointer:
     """Save/restore a FusedTrainer's (params, vels) via Orbax.
 
     ``directory`` holds numbered step checkpoints
-    (``<directory>/<step>/``) — keep N with ``max_to_keep``."""
+    (``<directory>/<step>/``) — keep N with ``max_to_keep``.
 
-    def __init__(self, directory: str, max_to_keep: int | None = 3):
+    ``on_blessed(step, step_dir)`` fires right after a step's
+    durability manifest commits (process 0 only — the manifest owner):
+    the step is now *blessed* — verified-restorable by anyone scanning
+    the directory — which is exactly the moment a promotion watcher
+    (``znicz_tpu.promotion.CheckpointSource``) wants to hear about it
+    without polling.  Callback failures are logged, never raised: a
+    broken subscriber must not fail the save."""
+
+    def __init__(self, directory: str, max_to_keep: int | None = 3,
+                 on_blessed=None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
+        self.on_blessed = on_blessed
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -71,6 +81,14 @@ class TrainerCheckpointer:
                     and os.path.isdir(self._step_dir(step)):
                 durability.write_manifest(self._step_dir(step),
                                           kind="checkpoint")
+                if self.on_blessed is not None:
+                    try:
+                        self.on_blessed(step, self._step_dir(step))
+                    except Exception:
+                        import logging
+                        logging.getLogger("TrainerCheckpointer") \
+                            .exception("on_blessed callback failed "
+                                       "for step %d", step)
 
     # -- write -------------------------------------------------------------
     def save(self, trainer, step: int, block: bool = True) -> None:

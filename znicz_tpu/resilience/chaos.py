@@ -19,6 +19,20 @@ artifact must roll back — verify fails, the generation stays put, the
 old model keeps answering 200s with identical bytes — and a subsequent
 good artifact must swap with zero downtime.
 
+The third drill, ``--scenario promote``, is the closed-loop acceptance
+(docs/promotion.md): a stand-in trainer keeps committing fresh
+candidate ``.znn`` artifacts through the real atomic export path while
+live traffic flows, and a :class:`~znicz_tpu.promotion.controller.
+PromotionController` drives each one through verify → export → canary
+reload → SLO watch — under injected transient faults at
+``engine.forward``, ``promotion.export`` and ``promotion.slo_probe``
+— then a deliberately-regressed candidate (it canaries clean but
+latency-regresses under traffic, injected at ``engine.forward``) must
+be auto-rolled-back within the SLO window.  Asserted: zero non-200
+``/predict`` answers across the whole run, ≥N promotions landed, the
+rollback restored the previous generation's exact bytes, and the
+promotion ledger records every transition.
+
 Exit code 0 when every invariant holds — tools/chaos_smoke.sh wires
 this into CI-ish usage.  The same ``FaultPlan`` mechanism drives the
 pytest ``chaos`` marker; this mode exists so an operator can smoke a
@@ -179,6 +193,172 @@ def _reload_scenario(args) -> int:
     return 1 if bad else 0
 
 
+def _promote_scenario(args) -> int:
+    """``--scenario promote`` — train-while-serving through N
+    promotions with fault injection plus one deliberately-regressed
+    candidate; the zero-500 / verified-rollback acceptance of
+    docs/promotion.md."""
+    import collections
+    import threading
+
+    from ..promotion import (DirectorySource, EngineTarget,
+                             PromotionController, SLOPolicy)
+    from ..serving.engine import ServingEngine
+    from ..serving.server import ServingServer
+
+    bad: list[str] = []
+    x = [[0.1, -0.2, 0.3, 0.4]]
+    with tempfile.TemporaryDirectory(prefix="znicz_chaos_") as tmp:
+        cands = os.path.join(tmp, "candidates")
+        deploy = os.path.join(tmp, "deploy")
+        os.makedirs(cands)
+        v0 = os.path.join(tmp, "v0.znn")
+        _write_demo_znn(v0, seed=5)
+        engine = ServingEngine(v0, backend="jax", buckets=(1, 2))
+        server = ServingServer(engine, max_wait_ms=1.0).start()
+        policy = SLOPolicy(
+            window_s=args.watch_s,
+            probe_interval_s=max(0.1, args.watch_s / 6.0),
+            max_p99_ms=args.max_p99_ms, max_error_rate=0.05,
+            min_samples=3)
+        controller = PromotionController(
+            DirectorySource(cands), EngineTarget(server=server),
+            deploy_dir=deploy, policy=policy, poll_interval_s=0.1,
+            max_consecutive_failures=3)
+        stop = threading.Event()
+        codes: list[int] = []
+        mu = threading.Lock()
+
+        def traffic():
+            # continuous live traffic for the whole run — the zero-500
+            # assertion is over every answer this loop collects
+            while not stop.is_set():
+                try:
+                    status, _body, _h = _post(server.url,
+                                              {"inputs": x},
+                                              timeout=30.0)
+                except Exception:
+                    status = -1        # hang/conn drop = the failure
+                with mu:
+                    codes.append(status)
+                stop.wait(0.01)
+
+        thread = threading.Thread(target=traffic, daemon=True)
+        thread.start()
+        try:
+            # let the first jit compile land so the SLO baseline sees
+            # steady-state latency, not the cold start
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                with mu:
+                    if len(codes) >= 5:
+                        break
+                time.sleep(0.05)
+            outcomes = []
+            for k in range(args.promotions):
+                # the stand-in trainer: a fresh candidate through the
+                # real atomic export path, promoted under transient
+                # faults at every new seam (each absorbed by a retry
+                # tier, so the promotion still lands)
+                plan = faults.FaultPlan([
+                    faults.FaultSpec("engine.forward", times=1,
+                                     message="chaos: transient device "
+                                             "fault"),
+                    faults.FaultSpec("promotion.export", times=1,
+                                     message="chaos: export blip"),
+                    faults.FaultSpec("promotion.slo_probe", times=1,
+                                     message="chaos: probe blip")],
+                    seed=100 + k)
+                with plan:
+                    _write_demo_znn(os.path.join(cands,
+                                                 f"cand{k + 1}.znn"),
+                                    seed=30 + k)
+                    outcome = controller.run_once()
+                outcomes.append(outcome)
+                print(json.dumps({"phase": f"promotion-{k + 1}",
+                                  "outcome": outcome,
+                                  "generation": engine.generation,
+                                  "fired": plan.snapshot()}))
+                if outcome != "promoted":
+                    bad.append(f"candidate {k + 1} outcome {outcome!r},"
+                               f" expected 'promoted'")
+            status, body, _ = _post(server.url, {"inputs": x})
+            y_good = body.get("outputs")
+            gen_good = engine.generation
+            if status != 200:
+                bad.append(f"post-promotions probe got {status}")
+            # the regressed candidate: canaries clean (well-formed,
+            # finite) but every live forward slows by bad_latency_s —
+            # the SLO watch must catch it and roll back while the
+            # previous artifact still sits in the deploy dir
+            _write_demo_znn(os.path.join(cands, "cand-bad.znn"),
+                            seed=99)
+            plan = faults.FaultPlan([faults.FaultSpec(
+                "engine.forward", kind="latency",
+                latency_s=args.bad_latency_s,
+                message="chaos: regressed candidate")], seed=7)
+            with plan:
+                outcome = controller.run_once()
+            print(json.dumps({"phase": "bad-candidate",
+                              "outcome": outcome,
+                              "generation": engine.generation,
+                              "fired": plan.snapshot()}))
+            if outcome != "rolled_back":
+                bad.append(f"bad candidate outcome {outcome!r}, "
+                           f"expected 'rolled_back'")
+            status, body, _ = _post(server.url, {"inputs": x})
+            if status != 200:
+                bad.append(f"post-rollback probe got {status}")
+            elif body.get("outputs") != y_good:
+                bad.append("post-rollback outputs differ from the "
+                           "blessed generation — rollback did not "
+                           "restore the previous bytes")
+            if engine.generation != gen_good + 2:
+                bad.append(f"generation {engine.generation} after "
+                           f"rollback, expected {gen_good + 2} "
+                           f"(bad swap + rollback swap)")
+            health = _health(server.url)
+            promo = health.get("promotion") or {}
+            if promo.get("state") != "rolled_back" \
+                    or promo.get("last_outcome") != "rolled_back":
+                bad.append(f"healthz promotion block does not report "
+                           f"the rollback: {promo}")
+        finally:
+            stop.set()
+            thread.join(10.0)
+            server.stop()
+            engine.close()
+        with mu:
+            answered = list(codes)
+        non200 = collections.Counter(c for c in answered if c != 200)
+        if non200:
+            bad.append(f"non-200 answers under promotion chaos: "
+                       f"{dict(non200)} of {len(answered)}")
+        # the ledger is the audit trail: every candidate must show its
+        # state transitions and exactly the expected outcomes
+        entries = controller.ledger.entries()
+        outs = [e for e in entries if e.get("event") == "outcome"]
+        n_promoted = sum(1 for e in outs if e["outcome"] == "promoted")
+        n_rolled = sum(1 for e in outs if e["outcome"] == "rolled_back")
+        if n_promoted != args.promotions or n_rolled != 1:
+            bad.append(f"ledger outcomes: {n_promoted} promoted / "
+                       f"{n_rolled} rolled_back, expected "
+                       f"{args.promotions} / 1")
+        states = {e.get("state") for e in entries
+                  if e.get("event") == "state"}
+        for want in ("verifying", "exporting", "canarying", "watching"):
+            if want not in states:
+                bad.append(f"ledger never recorded the {want!r} state")
+        if not any(e.get("event") == "rollback" for e in entries):
+            bad.append("ledger has no rollback event")
+        print(json.dumps({
+            "scenario": "promote", "ok": not bad, "violations": bad,
+            "requests": len(answered), "outcomes": outcomes + [outcome],
+            "promotion": controller.status(),
+            "ledger_events": len(entries)}))
+    return 1 if bad else 0
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -199,14 +379,30 @@ def main(argv=None) -> int:
     p.add_argument("--cooldown-s", type=float, default=1.0)
     p.add_argument("--retry-attempts", type=int, default=2)
     p.add_argument("--scenario", default="breaker",
-                   choices=("breaker", "reload"),
+                   choices=("breaker", "reload", "promote"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
-                        "(docs/durability.md)")
+                        "(docs/durability.md); promote: the closed "
+                        "loop — N promotions under fault injection "
+                        "plus a regressed candidate auto-rolled-back "
+                        "by the SLO watch (docs/promotion.md)")
+    p.add_argument("--promotions", type=int, default=3,
+                   help="promote: good candidates to drive through "
+                        "the loop before the regressed one")
+    p.add_argument("--watch-s", type=float, default=1.2,
+                   help="promote: SLO watch window per promotion")
+    p.add_argument("--max-p99-ms", type=float, default=50.0,
+                   help="promote: p99 latency objective the regressed "
+                        "candidate must breach")
+    p.add_argument("--bad-latency-s", type=float, default=0.08,
+                   help="promote: per-forward latency injected while "
+                        "the regressed candidate serves")
     args = p.parse_args(argv)
     if args.scenario == "reload":
         return _reload_scenario(args)
+    if args.scenario == "promote":
+        return _promote_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

@@ -43,6 +43,13 @@ Instrumented sites (grow this list as subsystems adopt injection):
                        verify-on-load must quarantine + fall back)
 ``relay.connect``      parallel.distributed.initialize's coordinator
                        bootstrap (the reference's lost-master case)
+``promotion.export``   PromotionController's export step (candidate →
+                       deploy-dir .znn commit), per attempt — the
+                       controller retries it as transient
+``promotion.slo_probe``  each SLO watch-window probe (registry read or
+                       /metrics scrape) in the promotion controller —
+                       a flaky probe must be retried, never counted
+                       as a breach
 =====================  ====================================================
 """
 

@@ -18,13 +18,19 @@ Wire protocol (JSON both ways):
   predicts answer 503 + Retry-After) — so a load balancer can rotate a
   degraded replica out BEFORE clients see 503s.  Also carries
   ``model_generation`` and ``last_reload`` (outcome of the most recent
-  hot reload), so a rollout driver can poll whether its swap landed.
+  hot reload), so a rollout driver can poll whether its swap landed;
+  with an in-process promotion controller attached
+  (:meth:`ServingServer.attach_promotion`, docs/promotion.md) a
+  ``promotion`` block reports its state
+  (``idle|verifying|exporting|canarying|watching|rolled_back|
+  crash_loop``) and last outcome next to those fields.
 * ``POST /admin/reload``  zero-downtime hot reload: body
   ``{"model": optional path, "wait": optional bool}``; the new
   artifact is verified (znicz_tpu.durability) and canaried on a
   background thread while the old generation keeps serving, then
   atomically swapped — failure rolls back.  202 started / 200 waited /
-  409 already in flight / 403 bad ``X-Admin-Token`` (required whenever
+  409 already in flight (with ``Retry-After``, like the 429/503
+  backpressure paths) / 403 bad ``X-Admin-Token`` (required whenever
   a token is configured via ``--admin-token`` / ``$ZNICZ_ADMIN_TOKEN``
   — set one on any listener reachable beyond localhost).  ``SIGHUP``
   triggers the same path from the ``serve`` CLI without a token.
@@ -249,9 +255,17 @@ class ServingServer:
                     return
                 worker = outer.reload_async(model)
                 if worker is None:
+                    # honest come-back time, consistent with the
+                    # 429/503 paths: the in-flight reload should take
+                    # about as long as the last one did
+                    status = outer.engine.reload_status()
+                    last = status.get("last_reload") or {}
+                    dur_ms = float(last.get("duration_ms") or 0.0)
+                    ra = max(1, min(30, int(dur_ms / 1e3) + 1))
                     self._reply(409, {
                         "error": "a reload is already in progress",
-                        **outer.engine.reload_status()})
+                        "retry_after_s": ra, **status},
+                        {"Retry-After": str(ra)})
                     return
                 if wait:
                     worker.join(outer.default_timeout_s)   # bounded
@@ -344,6 +358,16 @@ class ServingServer:
         # the engine's own non-blocking lock)
         self._reload_mu = threading.Lock()
         self._reload_thread: threading.Thread | None = None
+        #: optional status() of an in-process promotion controller
+        #: (znicz_tpu.promotion) — surfaced on /healthz when attached
+        self.promotion_status = None
+
+    def attach_promotion(self, status_fn) -> None:
+        """Surface a promotion controller's ``status()`` on
+        ``/healthz`` (docs/promotion.md) — a rollout driver or load
+        balancer polls one endpoint for breaker, generation, AND
+        promotion state."""
+        self.promotion_status = status_fn
 
     # -- hot reload -------------------------------------------------------
     def reload_async(self, model: str | None = None
@@ -385,6 +409,14 @@ class ServingServer:
         # generation + last reload outcome: a rollout driver polls
         # /healthz to learn whether its /admin/reload landed
         out.update(self.engine.reload_status())
+        ps = self.promotion_status
+        if ps is not None:
+            try:
+                out["promotion"] = ps()
+            except Exception:
+                # a wedged controller must not take /healthz down —
+                # the probe is exactly how you notice it wedged
+                out["promotion"] = {"state": "unknown"}
         if state != "ok":      # give probers the why + the come-back
             out["breaker"] = self.engine.breaker.metrics()
             out["retry_after_s"] = int(self.engine.breaker.retry_after())
@@ -525,6 +557,11 @@ def main(argv=None) -> int:
     if args.fault_plan is not None:
         from ..resilience import faults as _faults
         _faults.install(_faults.parse_plan(args.fault_plan))
+    # register the promotion metric families (promotions_total,
+    # promotion_generation, slo_breaches_total) so every serving
+    # process scrapes them from zero — a dashboard must not see the
+    # series appear only once a controller starts driving this replica
+    from .. import promotion as _promotion  # noqa: F401
     from ..resilience.breaker import CircuitBreaker
     from ..resilience.retry import RetryPolicy
     buckets = tuple(int(b) for b in args.buckets.split(","))

@@ -190,7 +190,10 @@ class StandardWorkflowBase(AcceleratedWorkflow):
               storage_dtype: str | None = None,
               profile_dir: str | None = None,
               profile_every: int | None = None,
-              mse_target: str | None = None):
+              mse_target: str | None = None,
+              checkpoint_dir: str | None = None,
+              checkpoint_every: int | None = None,
+              checkpointer=None):
         """One entry point over both execution paths (the samples' and
         launcher's ``--fused`` plumbing): the compiled fused step when
         requested AND the device supports it, else the unit-graph tick
@@ -205,7 +208,18 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         captures the whole run; with ``profile_every=N`` it captures a
         one-step window every N steps instead (long runs).  Both
         default from ``$ZNICZ_PROFILE_DIR`` / ``$ZNICZ_PROFILE_EVERY``
-        so a deployed run can be profiled without code changes."""
+        so a deployed run can be profiled without code changes.
+
+        Device checkpoints (fused path only): ``checkpoint_dir``
+        creates a :class:`~znicz_tpu.parallel.checkpoint.
+        TrainerCheckpointer` there and saves the live device state
+        every ``checkpoint_every`` epochs (default 1) plus at the end
+        — the asynchronous save overlaps the next epoch, and each
+        step's durability manifest is committed as soon as the IO
+        lands, which is what makes the step *blessed* for a promotion
+        watcher (docs/promotion.md).  Pass an existing
+        ``checkpointer`` (e.g. one with an ``on_blessed`` callback)
+        to keep ownership of its lifecycle."""
         from .config import root
         if compute_dtype is None:
             compute_dtype = root.common.get("compute_dtype")
@@ -222,9 +236,19 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                                       storage_dtype=storage_dtype,
                                       profile_dir=profile_dir,
                                       profile_every=profile_every,
-                                      mse_target=mse_target)
+                                      mse_target=mse_target,
+                                      checkpoint_dir=checkpoint_dir,
+                                      checkpoint_every=checkpoint_every,
+                                      checkpointer=checkpointer)
             self.warning("fused path needs an XLA device; falling back "
                          "to the unit-graph tick loop")
+        if checkpoint_dir is not None or checkpointer is not None:
+            # also reached with fused=False: silently dropping the
+            # training half of the promotion loop would leave a
+            # watcher waiting on blessed steps that never come
+            self.warning("device checkpoints (checkpoint_dir/"
+                         "checkpointer) are a fused-path feature; "
+                         "the tick loop keeps its snapshotter")
         if max_epochs is not None:
             self.decision.max_epochs = max_epochs
         return self.run()
@@ -235,7 +259,10 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                   profile_dir: str | None = None,
                   profile_every: int | None = None,
                   mse_target: str | None = None,
-                  step_callback=None):
+                  step_callback=None,
+                  checkpoint_dir: str | None = None,
+                  checkpoint_every: int | None = None,
+                  checkpointer=None):
         """Train via the compiled fused step instead of the unit-graph
         tick loop: whole epochs run as one device-side ``lax.scan``
         (optionally mesh-sharded), with Decision's improvement/stop logic
@@ -264,14 +291,19 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                 return self._run_fused_body(mesh, max_epochs,
                                             compute_dtype,
                                             storage_dtype, mse_target,
-                                            step_callback, hook)
+                                            step_callback, hook,
+                                            checkpoint_dir,
+                                            checkpoint_every,
+                                            checkpointer)
         finally:
             if hook is not None:
                 hook.close()
 
     def _run_fused_body(self, mesh, max_epochs, compute_dtype,
                         storage_dtype=None, mse_target=None,
-                        step_callback=None, profile_hook=None):
+                        step_callback=None, profile_hook=None,
+                        checkpoint_dir=None, checkpoint_every=None,
+                        checkpointer=None):
         import dataclasses
 
         from .config import root
@@ -325,6 +357,18 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                                        root.common.get("accum_steps")
                                        or 1))
         trainer.workflow = self
+        # device-state checkpoints (parallel/checkpoint.py): the
+        # training half of the promotion loop — every blessed step is
+        # a candidate a promotion watcher may export and canary
+        # (docs/promotion.md).  A caller-provided checkpointer keeps
+        # its own lifecycle (and on_blessed subscribers); a bare
+        # checkpoint_dir gets one owned (and closed) here.
+        ckpt, own_ckpt = checkpointer, False
+        if ckpt is None and checkpoint_dir is not None:
+            from .parallel.checkpoint import TrainerCheckpointer
+            ckpt = TrainerCheckpointer(checkpoint_dir)
+            own_ckpt = True
+        ckpt_every = max(1, int(checkpoint_every or 1))
         loader, decision = self.loader, self.decision
         if isinstance(loader, StreamingLoader):
             data = target = None       # StreamTrainer reads the loader
@@ -470,34 +514,48 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             else:
                 decision._fails += 1
             snap = getattr(self, "snapshotter", None)
+            # Deferred-tail correctness: a mid-training snapshot OR
+            # device checkpoint must include this epoch's tail update
+            # (a continuous run applies it at the next epoch's start;
+            # resume starts with pending=None, so saving without it
+            # would silently drop one update).  On the FINAL epoch the
+            # unit graph's stop tick gate-skips that update, so the
+            # tail stays pending and the save matches the unit path's
+            # final snapshot exactly.
+            is_final = (epoch == epochs - 1
+                        or decision._fails >= decision.fail_iterations)
+
+            def _sync_weights():
+                nonlocal pending
+                if not is_final and pending is not None:
+                    trainer.train_epoch(
+                        data, target, pending[0], batch,
+                        epoch=pending[1], lr_scale=pending[2],
+                        ctr_base=pending[3], sync=False,
+                        lr_scale_bias=pending[4])
+                    pending = None
+                trainer.write_back()
+
             if snap is not None:
-                # Deferred-tail correctness: a mid-training snapshot
-                # must include this epoch's tail update (a continuous
-                # run applies it at the next epoch's start; resume
-                # starts with pending=None, so saving without it would
-                # silently drop one update).  On the FINAL epoch the
-                # unit graph's stop tick gate-skips that update, so the
-                # tail stays pending and the save matches the unit
-                # path's final snapshot exactly.
-                is_final = (epoch == epochs - 1
-                            or decision._fails >= decision.fail_iterations)
-
-                def _sync_weights():
-                    nonlocal pending
-                    if not is_final and pending is not None:
-                        trainer.train_epoch(
-                            data, target, pending[0], batch,
-                            epoch=pending[1], lr_scale=pending[2],
-                            ctr_base=pending[3], sync=False,
-                            lr_scale_bias=pending[4])
-                        pending = None
-                    trainer.write_back()
-
                 snap.epoch_end(improved, before_save=_sync_weights)
+            if ckpt is not None and ((epoch + 1) % ckpt_every == 0
+                                     or is_final):
+                # async device-state save: IO overlaps the next epoch,
+                # and the step's manifest (its bless mark) commits at
+                # the next save/wait/close once the bytes are down
+                _sync_weights()
+                ckpt.save(trainer, epoch, block=False)
             if decision._fails >= decision.fail_iterations:
                 break
         decision.complete.set(True)
         trainer.write_back()
+        if ckpt is not None:
+            # flush in-flight async saves and bless their manifests; a
+            # borrowed checkpointer stays open for its owner
+            if own_ckpt:
+                ckpt.close()
+            else:
+                ckpt.wait()
         return trainer
 
 
