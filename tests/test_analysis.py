@@ -13,11 +13,11 @@ import textwrap
 
 import pytest
 
-from znicz_tpu.analysis import (Analyzer, HandlerSafetyRule,
-                                JaxHygieneRule, LockDisciplineRule,
-                                MetricDriftRule, UnseededRandomRule,
-                                load_baseline, run_repo,
-                                write_baseline)
+from znicz_tpu.analysis import (Analyzer, DurationClockRule,
+                                HandlerSafetyRule, JaxHygieneRule,
+                                LockDisciplineRule, MetricDriftRule,
+                                UnseededRandomRule, load_baseline,
+                                run_repo, write_baseline)
 from znicz_tpu.analysis import cli as zlint_cli
 
 
@@ -496,6 +496,103 @@ class TestMetricDrift:
                                script_paths=("tools/smoke.sh",))
         assert Analyzer([rule],
                         root=str(tmp_path)).run(["pkg/m.py"]) == []
+
+
+# -- duration clock --------------------------------------------------------
+
+CLOCK_BAD_DIRECT = """
+    import time
+
+    def wait_for(pred, deadline_s):
+        deadline = time.time() + deadline_s          # wall deadline
+        while time.time() < deadline:                # wall compare
+            if pred():
+                return True
+        return False
+"""
+
+CLOCK_BAD_DATAFLOW = """
+    import time
+
+    def measure(fn):
+        t0 = time.time()
+        fn()
+        return time.time() - t0
+"""
+
+CLOCK_GOOD = """
+    import time
+
+    def measure(fn):
+        t0 = time.monotonic()
+        fn()
+        dt = time.monotonic() - t0
+        return {"at": time.time(), "duration_s": dt}   # stamp only
+
+    def record(recs):
+        # a wall stamp stored, never entered into arithmetic
+        started = time.time()
+        recs.append(started)
+"""
+
+
+class TestDurationClock:
+    def test_wall_deadline_fires(self, tmp_path):
+        found = lint(tmp_path, CLOCK_BAD_DIRECT, [DurationClockRule()])
+        assert rules_of(found) == ["duration-clock"]
+        assert len(found) == 2          # the + line and the < line
+
+    def test_stamp_subtraction_fires(self, tmp_path):
+        found = lint(tmp_path, CLOCK_BAD_DATAFLOW, [DurationClockRule()])
+        assert rules_of(found) == ["duration-clock"]
+        # the `time.time() - t0` line fires once (direct arithmetic and
+        # the t0 dataflow collapse to one finding per line)
+        assert len(found) == 1
+
+    def test_monotonic_and_bare_stamps_pass(self, tmp_path):
+        assert lint(tmp_path, CLOCK_GOOD, [DurationClockRule()]) == []
+
+    def test_from_import_is_resolved(self, tmp_path):
+        found = lint(tmp_path, """
+    from time import time as now
+
+    def age_of(then):
+        return now() - then
+""", [DurationClockRule()])
+        assert rules_of(found) == ["duration-clock"]
+
+    def test_module_alias_is_resolved(self, tmp_path):
+        found = lint(tmp_path, """
+    import time as t
+
+    def wait(pred):
+        deadline = t.time() + 30
+        while t.time() < deadline:
+            if pred():
+                return True
+        return False
+""", [DurationClockRule()])
+        assert rules_of(found) == ["duration-clock"]
+        assert len(found) == 2
+
+    def test_nested_scope_stamp_does_not_leak(self, tmp_path):
+        found = lint(tmp_path, """
+    import time
+
+    def outer():
+        def stamp():
+            t0 = time.time()
+            return t0
+        t0 = 17                  # outer t0 is NOT a wall stamp
+        return stamp() - t0
+""", [DurationClockRule()])
+        assert found == []
+
+    def test_inline_suppression(self, tmp_path):
+        src = CLOCK_BAD_DATAFLOW.replace(
+            "return time.time() - t0",
+            "return time.time() - t0  # zlint: disable=duration-clock")
+        assert lint(tmp_path, src, [DurationClockRule()]) == []
 
 
 # -- suppression + baseline ------------------------------------------------

@@ -1,0 +1,538 @@
+"""Deep introspection layer (ISSUE 7): compile accounting, the
+request/step flight recorder, and the /statusz + /debug/* surface.
+
+Covers the acceptance contract end to end: after bucket warmup a burst
+of ``POST /predict`` traffic records ZERO
+``compiles_total{cause="new_bucket"}`` increments while a novel batch
+bucket records exactly one — asserted through the new compile metrics
+on a live server whose /statusz and /debug/flightrecorder answer with
+live data during the same run.  Plus the bounded-memory guarantees:
+ring overflow keeps newest + retained-slow entries, a 10k-record
+hammer stays bounded, and concurrent scrape-while-record races are
+clean.
+"""
+
+import io
+import json
+import signal
+import threading
+import time
+import urllib.request
+
+import urllib.error
+
+import numpy as np
+import pytest
+
+from znicz_tpu.serving import ServingEngine, ServingServer
+from znicz_tpu.telemetry import compilestats, debugz, flightrecorder
+from znicz_tpu.telemetry.flightrecorder import (FlightRecorder,
+                                                TimelineWriter,
+                                                stage_breakdown)
+
+from test_serving import _write_mlp_znn
+
+
+# -- flight recorder: bounds + retention -----------------------------------
+
+class TestFlightRecorderBounds:
+    def test_overflow_keeps_newest(self):
+        fr = FlightRecorder(capacity=8)
+        for i in range(20):
+            fr.record("request", duration_ms=1.0, request_id=f"r{i}")
+        snap = fr.snapshot()
+        assert len(snap["recent"]) == 8
+        assert [r["request_id"] for r in snap["recent"]] == \
+            [f"r{i}" for i in range(12, 20)]
+        assert snap["recorded_total"] == 20
+
+    def test_fast_burst_cannot_flush_slow_outlier(self):
+        fr = FlightRecorder(capacity=8, slow_threshold_ms=100.0,
+                            slow_capacity=4)
+        fr.record("request", duration_ms=500.0, request_id="outlier")
+        for i in range(50):                       # fast traffic flood
+            fr.record("request", duration_ms=1.0, request_id=f"f{i}")
+        snap = fr.snapshot()
+        assert all(r["request_id"].startswith("f")
+                   for r in snap["recent"])       # outlier aged out...
+        assert [r["request_id"] for r in snap["slow"]] == ["outlier"]
+        assert fr.slowest(1)[0]["request_id"] == "outlier"
+
+    def test_error_ring_keeps_last_failures(self):
+        fr = FlightRecorder(capacity=4, error_capacity=2)
+        for i in range(5):
+            fr.record("request", duration_ms=1.0, outcome="error",
+                      error=f"boom {i}", request_id=f"e{i}")
+        errs = fr.snapshot()["errors"]
+        assert [r["request_id"] for r in errs] == ["e3", "e4"]
+        assert errs[-1]["error"] == "boom 4"
+
+    def test_error_text_is_capped(self):
+        fr = FlightRecorder()
+        rec = fr.record("request", outcome="error", error="x" * 10000)
+        assert len(rec["error"]) == 4000
+
+    def test_snapshot_n_bounds_recent(self):
+        fr = FlightRecorder(capacity=16)
+        for i in range(10):
+            fr.record("request", duration_ms=1.0)
+        assert len(fr.snapshot(n=3)["recent"]) == 3
+
+    def test_ten_k_hammer_memory_stays_bounded(self):
+        fr = FlightRecorder(capacity=64, slow_threshold_ms=50.0,
+                            slow_capacity=16, error_capacity=8)
+        for i in range(10_000):
+            fr.record("request",
+                      duration_ms=100.0 if i % 97 == 0 else 1.0,
+                      outcome="error" if i % 211 == 0 else "ok",
+                      request_id=f"h{i}", spans=[{"name": "s"}])
+        c = fr.counts()
+        assert c["recorded_total"] == 10_000
+        assert c["recent"] == 64
+        assert c["slow"] == 16
+        assert c["errors"] == 8
+        # the rings hold the NEWEST of each class
+        snap = fr.snapshot()
+        assert snap["recent"][-1]["request_id"] == "h9999"
+
+    def test_concurrent_scrape_while_record_is_clean(self):
+        fr = FlightRecorder(capacity=32, slow_threshold_ms=2.0)
+        stop = threading.Event()
+        failures = []
+
+        def write(k):
+            for i in range(1000):
+                fr.record("request", duration_ms=float(i % 5),
+                          outcome="error" if i % 50 == 0 else "ok",
+                          request_id=f"w{k}-{i}")
+
+        def read():
+            while not stop.is_set():
+                try:
+                    snap = fr.snapshot()
+                    json.dumps(snap)              # JSON-able under race
+                    fr.slowest(5)
+                    fr.counts()
+                    assert len(snap["recent"]) <= 32
+                except Exception as e:            # pragma: no cover
+                    failures.append(repr(e))
+                    return
+        writers = [threading.Thread(target=write, args=(k,))
+                   for k in range(4)]
+        readers = [threading.Thread(target=read) for _ in range(3)]
+        for t in readers + writers:
+            t.start()
+        for t in writers:
+            t.join(30)
+        stop.set()
+        for t in readers:
+            t.join(10)
+        assert not failures
+        assert fr.counts()["recorded_total"] == 4000
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(capacity=0)
+
+
+class TestSpanRingBounds:
+    """The tracing span ring the flight records are built from is
+    itself bounded (ISSUE 7 satellite): a flood can never grow it."""
+
+    def test_span_flood_stays_bounded_and_keeps_newest(self):
+        from znicz_tpu.telemetry import tracing
+        for i in range(2000):
+            with tracing.span("flood.test", i=i):
+                pass
+        spans = tracing.recent_spans(name="flood.test")
+        assert len(spans) <= 512
+        assert spans[-1].attrs["i"] == 1999
+
+    def test_concurrent_span_record_and_scrape(self):
+        from znicz_tpu.telemetry import tracing
+        stop = threading.Event()
+        failures = []
+
+        def write():
+            for i in range(1000):
+                with tracing.span("race.test", i=i):
+                    pass
+
+        def read():
+            while not stop.is_set():
+                try:
+                    for s in tracing.recent_spans(name="race.test"):
+                        s.to_dict()
+                except Exception as e:           # pragma: no cover
+                    failures.append(repr(e))
+                    return
+        writers = [threading.Thread(target=write) for _ in range(3)]
+        readers = [threading.Thread(target=read) for _ in range(2)]
+        for t in readers + writers:
+            t.start()
+        for t in writers:
+            t.join(30)
+        stop.set()
+        for t in readers:
+            t.join(10)
+        assert not failures
+
+    def test_since_excludes_a_prior_attempt_with_the_same_id(self):
+        """Request ids are client-supplied and reusable — a retry
+        echoes its first attempt's id.  The flight record filters by
+        since=handler-start so the retry's span tree (and stage sums)
+        never inherit the first attempt's spans."""
+        from znicz_tpu.telemetry import tracing
+        with tracing.request("retry-me"):
+            with tracing.span("attempt.test", attempt=1):
+                pass
+        cut = time.monotonic()
+        with tracing.request("retry-me"):
+            with tracing.span("attempt.test", attempt=2):
+                pass
+        both = tracing.recent_spans(name="attempt.test",
+                                    request_id="retry-me")
+        only = tracing.recent_spans(name="attempt.test",
+                                    request_id="retry-me", since=cut)
+        assert [s.attrs["attempt"] for s in both] == [1, 2]
+        assert [s.attrs["attempt"] for s in only] == [2]
+
+
+class TestStageBreakdown:
+    def test_stages_from_span_tree(self):
+        spans = [
+            {"name": "server.predict", "duration_ms": 10.0},
+            {"name": "batcher.dispatch", "duration_ms": 6.0},
+            {"name": "engine.forward", "duration_ms": 4.0},
+            {"name": "compile", "duration_ms": 2.5},
+            {"name": "unrelated", "duration_ms": 99.0},
+        ]
+        out = stage_breakdown(spans)
+        assert out == {"forward_ms": 4.0, "compile_ms": 2.5,
+                       "dispatch_ms": 6.0, "queue_ms": 4.0}
+
+    def test_chunked_forwards_sum_and_queue_clamps(self):
+        spans = [
+            {"name": "server.predict", "duration_ms": 5.0},
+            {"name": "batcher.dispatch", "duration_ms": 8.0},  # coalesced
+            {"name": "engine.forward", "duration_ms": 3.0},
+            {"name": "engine.forward", "duration_ms": 3.5},
+        ]
+        out = stage_breakdown(spans)
+        assert out["forward_ms"] == 6.5
+        assert out["queue_ms"] == 0.0          # negative residue clamps
+
+    def test_unfinished_spans_are_skipped(self):
+        assert stage_breakdown(
+            [{"name": "engine.forward", "duration_ms": None}]) == {}
+
+
+class TestTimelineWriter:
+    def test_rows_append_and_bad_rows_are_skipped(self, tmp_path):
+        path = tmp_path / "t.jsonl"
+        w = TimelineWriter(path)
+        w.write({"epoch": 0, "wall_ms": 12.5})
+        w.write({"bad": object()})             # unserializable: skipped
+        w.write({"epoch": 1, "wall_ms": 13.5})
+        w.close()
+        w.write({"epoch": 2})                  # after close: no-op
+        rows = [json.loads(line) for line in
+                path.read_text().splitlines()]
+        assert [r["epoch"] for r in rows] == [0, 1]
+
+
+# -- compile accounting ----------------------------------------------------
+
+def _site_compiles(site):
+    return dict(compilestats.snapshot()["compiles"].get(site, {}))
+
+
+class TestCompileStats:
+    def test_timed_context_records_on_clean_exit_only(self):
+        before = _site_compiles("test.site.timed")
+        with compilestats.timed("test.site.timed", "cold"):
+            pass
+        with pytest.raises(RuntimeError):
+            with compilestats.timed("test.site.timed", "cold"):
+                raise RuntimeError("build failed")
+        after = _site_compiles("test.site.timed")
+        assert after.get("cold", 0) - before.get("cold", 0) == 1
+
+    def test_first_call_timed_accounts_exactly_once(self):
+        calls = []
+
+        def fake_jit(x):
+            calls.append(x)
+            time.sleep(0.002)
+            return x * 2
+
+        fn = compilestats.first_call_timed(fake_jit,
+                                           site="test.site.once",
+                                           cause="new_bucket")
+        barrier = threading.Barrier(4)
+        results = []
+
+        def racer():
+            barrier.wait()
+            results.append(fn(21))
+        threads = [threading.Thread(target=racer) for _ in range(4)]
+        before = _site_compiles("test.site.once")
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert results == [42] * 4 and len(calls) == 4
+        after = _site_compiles("test.site.once")
+        # two racing first calls account exactly once
+        assert after.get("new_bucket", 0) \
+            - before.get("new_bucket", 0) == 1
+
+    def test_raising_first_call_stays_armed(self):
+        state = {"fail": True}
+
+        def flaky(x):
+            if state["fail"]:
+                raise ValueError("fault injected")
+            return x
+
+        fn = compilestats.first_call_timed(flaky, site="test.site.flaky",
+                                           cause="fallback")
+        before = _site_compiles("test.site.flaky")
+        with pytest.raises(ValueError):
+            fn(1)
+        assert _site_compiles("test.site.flaky") == before
+        state["fail"] = False
+        assert fn(7) == 7
+        after = _site_compiles("test.site.flaky")
+        assert after.get("fallback", 0) - before.get("fallback", 0) == 1
+
+    def test_unknown_cause_is_rejected(self):
+        with pytest.raises(ValueError):
+            compilestats.first_call_timed(lambda: None,
+                                          site="s", cause="because")
+
+    def test_snapshot_sums_request_path_compiles(self):
+        base = compilestats.snapshot()["request_path_compiles"]
+        compilestats.record_compile("test.site.rp", "new_bucket", 1.0)
+        compilestats.record_compile("test.site.rp", "fallback", 1.0)
+        compilestats.record_compile("test.site.rp", "cold", 1.0)
+        snap = compilestats.snapshot()
+        assert snap["request_path_compiles"] - base == 2
+        assert snap["compile_cost"]["test.site.rp"]["count"] == 3
+
+
+# -- debugz ----------------------------------------------------------------
+
+class TestDebugz:
+    def test_threadz_sees_this_thread(self):
+        snap = debugz.threadz()
+        me = threading.current_thread()
+        names = [t["name"] for t in snap["threads"]]
+        assert me.name in names
+        mine = next(t for t in snap["threads"] if t["name"] == me.name)
+        assert any("test_threadz_sees_this_thread" in line
+                   for line in mine["stack"])
+        assert snap["count"] == len(snap["threads"]) >= 1
+
+    def test_format_threadz_renders(self):
+        text = debugz.format_threadz()
+        assert "znicz-tpu thread dump" in text
+        assert threading.current_thread().name in text
+
+    def test_sigusr1_dump_to_stream(self):
+        buf = io.StringIO()
+        prev = debugz.install_stack_dump(stream=buf)
+        try:
+            signal.raise_signal(signal.SIGUSR1)
+            assert "znicz-tpu thread dump" in buf.getvalue()
+        finally:
+            signal.signal(signal.SIGUSR1, prev or signal.SIG_DFL)
+
+    def test_uptime_is_monotonic_positive(self):
+        u1 = debugz.process_uptime_s()
+        u2 = debugz.process_uptime_s()
+        assert 0 < u1 <= u2
+        assert debugz.started_at() > 0
+
+    def test_statusz_without_server_renders_process_sections(self):
+        page = debugz.statusz_text(None)
+        assert "znicz-tpu /statusz" in page
+        assert "uptime_s:" in page
+        assert "compile accounting" in page
+        assert "flight recorder" in page
+
+
+# -- the acceptance e2e ----------------------------------------------------
+
+def _get(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def _predict(url, rows, rid=None):
+    headers = {"Content-Type": "application/json"}
+    if rid is not None:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(
+        url + "predict",
+        json.dumps({"inputs": rows}).encode(), headers)
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestSteadyStateAcceptance:
+    """The ISSUE 7 acceptance: warmed buckets serve a burst with zero
+    request-path compiles, a novel bucket pays exactly one — proven
+    via the compile metrics while /statusz and /debug/flightrecorder
+    answer live on the same run."""
+
+    def test_zero_new_bucket_compiles_after_warmup(self, tmp_path):
+        model = str(tmp_path / "m.znn")
+        _write_mlp_znn(model, fin=4, hidden=5, classes=3)
+        engine = ServingEngine(model, backend="jax", buckets=(1, 8, 32))
+        server = ServingServer(engine, port=0, max_wait_ms=1.0).start()
+        url = server.url
+        try:
+            # warm the buckets traffic will use, off the request path
+            built = engine.warmup((4,), buckets=(1, 8))
+            assert built == 2
+            warm = _site_compiles("serving.engine")
+            assert warm.get("cold", 0) >= 2
+
+            # steady-state burst: batch sizes 1..8 all land in warmed
+            # buckets — ZERO request-path compiles allowed
+            before = _site_compiles("serving.engine")
+            rng = np.random.default_rng(0)
+            for i in range(12):
+                rows = rng.standard_normal(
+                    (1 + i % 8, 4)).astype(float).tolist()
+                status, out = _predict(url, rows, rid=f"steady-{i}")
+                assert status == 200
+                assert len(out["outputs"]) == 1 + i % 8
+            after = _site_compiles("serving.engine")
+            assert after.get("new_bucket", 0) == \
+                before.get("new_bucket", 0), \
+                "steady-state traffic triggered a request-path compile"
+            assert after.get("fallback", 0) == before.get("fallback", 0)
+
+            # novel bucket: 16 rows pads to the cold 32-bucket —
+            # exactly ONE new_bucket compile
+            status, out = _predict(
+                url, rng.standard_normal((16, 4)).astype(float).tolist(),
+                rid="novel-0")
+            assert status == 200 and len(out["outputs"]) == 16
+            novel = _site_compiles("serving.engine")
+            assert novel.get("new_bucket", 0) == \
+                after.get("new_bucket", 0) + 1
+
+            # /statusz answers with live data mid-run
+            status, body, headers = _get(url + "statusz")
+            page = body.decode()
+            assert status == 200
+            assert headers["Content-Type"].startswith("text/plain")
+            assert "compile accounting" in page
+            assert "site=serving.engine" in page
+            assert "generation=1" in page
+
+            # /debug/flightrecorder holds the burst's records with
+            # span trees and stage timings (records land just after
+            # the response bytes — poll briefly for the last one)
+            mine, deadline = [], time.monotonic() + 2.0
+            while len(mine) < 13 and time.monotonic() < deadline:
+                status, body, _ = _get(url + "debug/flightrecorder")
+                assert status == 200
+                snap = json.loads(body)
+                mine = [r for r in snap["recent"]
+                        if r.get("kind") == "request"
+                        and str(r.get("request_id", "")).startswith(
+                            ("steady-", "novel-"))]
+                if len(mine) < 13:
+                    time.sleep(0.02)
+            assert len(mine) == 13
+            assert all(r["outcome"] == "ok" and r["code"] == 200
+                       for r in mine)
+            assert all(r["shape"] == [4] for r in mine)
+            novel_rec = next(r for r in mine
+                             if r["request_id"] == "novel-0")
+            assert novel_rec["rows"] == 16
+            assert any(s.get("name") == "engine.forward"
+                       for s in novel_rec["spans"])
+            assert "forward_ms" in novel_rec["stages"]
+
+            # /debug/threadz sees the server's own threads
+            status, body, _ = _get(url + "debug/threadz")
+            tz = json.loads(body)
+            assert status == 200
+            assert any("microbatcher" in t["name"]
+                       for t in tz["threads"])
+
+            # /healthz: rev + uptime for fleet tooling (satellite)
+            status, body, _ = _get(url + "healthz")
+            h = json.loads(body)
+            assert h["rev"] == server.rev and h["rev"]
+            assert isinstance(h["uptime_s"], float)
+        finally:
+            server.stop()
+            engine.close()
+
+    def test_debug_surface_honors_admin_token(self, tmp_path):
+        """With an admin token configured, /statusz and /debug/* 403
+        without the X-Admin-Token that /admin/reload already requires
+        (stack dumps and tracebacks are operator data); /healthz and
+        /metrics stay open for probes and scrapers."""
+        model = str(tmp_path / "m.znn")
+        _write_mlp_znn(model, fin=4)
+        engine = ServingEngine(model, backend="jax", buckets=(1, 8))
+        server = ServingServer(engine, port=0, max_wait_ms=1.0,
+                               admin_token="sekrit").start()
+        try:
+            for route in ("statusz", "debug/flightrecorder",
+                          "debug/threadz"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(server.url + route,
+                                           timeout=30)
+                assert err.value.code == 403, route
+                req = urllib.request.Request(
+                    server.url + route,
+                    headers={"X-Admin-Token": "sekrit"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    assert r.status == 200, route
+                    assert r.read()
+            for route in ("healthz", "metrics"):
+                status, body, _ = _get(server.url + route)
+                assert status == 200 and body, route
+        finally:
+            server.stop()
+            engine.close()
+
+    def test_error_request_lands_in_error_ring_with_text(self, tmp_path):
+        model = str(tmp_path / "m.znn")
+        _write_mlp_znn(model, fin=4)
+        engine = ServingEngine(model, backend="jax", buckets=(1, 8))
+        server = ServingServer(engine, port=0, max_wait_ms=1.0).start()
+        try:
+            req = urllib.request.Request(
+                server.url + "predict", b"not json at all",
+                {"Content-Type": "application/json",
+                 "X-Request-Id": "bad-req-1"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=30)
+            assert err.value.code == 400
+            # the record is taken after the response bytes land (the
+            # handler span must close first) — poll briefly, like the
+            # span-correlation test
+            mine = []
+            deadline = time.monotonic() + 2.0
+            while not mine and time.monotonic() < deadline:
+                snap = flightrecorder.RECORDER.snapshot()
+                mine = [r for r in snap["errors"]
+                        if r.get("request_id") == "bad-req-1"]
+                if not mine:
+                    time.sleep(0.02)
+            assert len(mine) == 1
+            assert mine[0]["outcome"] == "error"
+            assert "bad request" in mine[0]["error"]
+            assert mine[0]["code"] == 400
+        finally:
+            server.stop()
+            engine.close()

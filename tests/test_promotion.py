@@ -518,16 +518,37 @@ class TestEngineTargetIntegration:
         cands.mkdir()
         x = [[0.1, -0.2, 0.3, 0.4]]
         stop = threading.Event()
+        pause = threading.Event()
         served = []
 
         def traffic():
             while not stop.is_set():
-                try:
-                    _post(server.url, {"inputs": x})
-                    served.append(1)
-                except Exception:
-                    pass
+                if not pause.is_set():
+                    try:
+                        _post(server.url, {"inputs": x})
+                        served.append(1)
+                    except Exception:
+                        pass
                 stop.wait(0.01)
+
+        def quiesced_predict():
+            """One /predict with the background traffic paused and the
+            queue drained: the byte-compare rides the SAME batch-1
+            bucket both times.  Coalescing with a background rider
+            would pad to bucket 2, whose executable differs in
+            low-order bits (XLA vectorizes the two batch shapes
+            differently) — that is bucket policy, not a reload bug."""
+            pause.set()
+            deadline = time.monotonic() + 5.0
+            while (server.batcher.queue_depth() > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            time.sleep(0.05)          # let an in-flight dispatch land
+            try:
+                _st, body = _post(server.url, {"inputs": x})
+                return body["outputs"]
+            finally:
+                pause.clear()
 
         thread = threading.Thread(target=traffic, daemon=True)
         thread.start()
@@ -549,8 +570,7 @@ class TestEngineTargetIntegration:
             _write_demo_znn(str(cands / "v2.znn"), seed=11)
             assert ctl.run_once() == "promoted"
             gen_blessed = engine.generation
-            _st, body = _post(server.url, {"inputs": x})
-            y_blessed = body["outputs"]
+            y_blessed = quiesced_predict()
             _write_demo_znn(str(cands / "v3.znn"), seed=23)
             plan = faults.FaultPlan([faults.FaultSpec(
                 "engine.forward", kind="latency", latency_s=0.08,
@@ -560,8 +580,7 @@ class TestEngineTargetIntegration:
             # bad swap + rollback swap, and the bytes are the blessed
             # generation's exactly
             assert engine.generation == gen_blessed + 2
-            _st, body = _post(server.url, {"inputs": x})
-            assert body["outputs"] == y_blessed
+            assert quiesced_predict() == y_blessed
             # /healthz reports promotion state + last outcome next to
             # the generation/breaker fields (satellite)
             health = _health(server.url)

@@ -1,0 +1,27 @@
+"""Pytest wrapper for tools/statusz_smoke.sh (ISSUE 7 satellite).
+
+Marked ``slow`` — it boots the real ``python -m znicz_tpu serve`` CLI
+in a subprocess (full jax import) and exercises /statusz, /debug/*,
+and the SIGUSR1 thread dump — so it rides the nightly/`-m slow` tier
+beside the metrics smoke, not tier-1.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_statusz_smoke_script_passes():
+    proc = subprocess.run(
+        ["bash", os.path.join(_REPO, "tools", "statusz_smoke.sh"), "4"],
+        capture_output=True, text=True, timeout=300, cwd=_REPO)
+    sys.stdout.write(proc.stdout[-4000:])
+    assert proc.returncode == 0, (
+        f"statusz smoke failed rc={proc.returncode}:\n"
+        f"{proc.stdout[-3000:]}\n{proc.stderr[-1000:]}")
+    assert '"ok": true' in proc.stdout

@@ -9,7 +9,12 @@
 #   * the JSON and text views report identical counter values;
 #   * every POST /predict response carries an X-Request-Id, echoing
 #     the client's when supplied;
-#   * the JSON view carries a `rev` build stamp.
+#   * the JSON view carries a `rev` build stamp;
+#   * compile accounting (telemetry.compilestats): --warmup-shape
+#     precompiles every bucket as cause=cold, the predict burst adds
+#     ZERO request-path compiles (no new_bucket/fallback samples),
+#     the hot reload's canary compile records cause=reload, and the
+#     executable cache hit/miss counters match the traffic.
 #
 # Registered beside tools/chaos_smoke.sh; pytest wrapper (marked slow):
 # tests/test_metrics_smoke.py.
@@ -63,7 +68,8 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
         port = s.getsockname()[1]
     proc = subprocess.Popen(
         [sys.executable, "-m", "znicz_tpu", "serve", "--model", model,
-         "--port", str(port), "--max-wait-ms", "1"],
+         "--port", str(port), "--max-wait-ms", "1",
+         "--warmup-shape", "4"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     url = f"http://127.0.0.1:{port}/"
     try:
@@ -190,6 +196,30 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
               "slo_breaches_total family present (controller idle)")
         check(series.get("promotion_generation") == 0.0,
               "promotion_generation gauge present (no promotion yet)")
+        # compile accounting (telemetry.compilestats): --warmup-shape 4
+        # precompiled all 4 default buckets off the request path, so
+        # the whole predict burst must have added ZERO request-path
+        # compiles, and the reload's canary compile records its own
+        # cause — the steady-state contract, as metrics
+        check(series.get('compiles_total{cause="cold",'
+                         'site="serving.engine"}') == 4.0,
+              "warmup compiled 4 bucket executables (cause=cold)")
+        check(not any('cause="new_bucket"' in k or 'cause="fallback"' in k
+                      for k in series),
+              "zero request-path compiles (no new_bucket/fallback "
+              "samples)")
+        check(series.get('compiles_total{cause="reload",'
+                         'site="serving.canary"}') == 1.0,
+              "reload canary compile recorded (cause=reload)")
+        check(series.get('compile_time_ms_count{site="serving.engine"}')
+              == 4.0,
+              "compile_time_ms histogram counted the 4 warmup builds")
+        check(series.get('executable_cache_misses_total'
+                         '{site="serving.engine"}') == 4.0,
+              "cache misses == warmup builds")
+        check(series.get('executable_cache_hits_total'
+                         '{site="serving.engine"}') == float(n_good),
+              f"cache hits == {n_good} good predicts")
     finally:
         proc.send_signal(signal.SIGINT)
         try:

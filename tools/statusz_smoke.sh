@@ -1,0 +1,154 @@
+#!/bin/bash
+# Debug-surface smoke (ISSUE 7 satellite, operator-runnable): boot the
+# REAL `python -m znicz_tpu serve` CLI on a free port with warmup, fire
+# a few predicts (one malformed), then assert the introspection
+# contract:
+#   * GET /statusz is a non-empty text one-pager carrying the rev,
+#     uptime, serving/breaker state, compile accounting and the flight
+#     recorder section;
+#   * GET /debug/flightrecorder is well-formed JSON whose recent ring
+#     holds the requests just sent (with span trees + stage timings)
+#     and whose error ring holds the malformed one;
+#   * GET /debug/threadz is well-formed JSON listing live threads with
+#     Python stacks;
+#   * GET /healthz carries rev + uptime_s;
+#   * `kill -USR1 <pid>` dumps a thread stack listing to stderr.
+#
+# Registered beside tools/metrics_smoke.sh; pytest wrapper (marked
+# slow): tests/test_statusz_smoke.py.
+#
+# Usage:  bash tools/statusz_smoke.sh [n_requests]
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - "${1:-4}" <<'PY'
+import json, os, signal, subprocess, sys, tempfile, time
+import urllib.error, urllib.request
+
+n_req = int(sys.argv[1])
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+with tempfile.TemporaryDirectory(prefix="znicz_statusz_smoke_") as tmp:
+    model = os.path.join(tmp, "demo.znn")
+    from znicz_tpu.resilience.chaos import _write_demo_znn
+    _write_demo_znn(model)
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    err_path = os.path.join(tmp, "serve.stderr")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "serve", "--model", model,
+         "--port", str(port), "--max-wait-ms", "1",
+         "--warmup-shape", "4"],
+        stdout=subprocess.PIPE, stderr=open(err_path, "wb"))
+    url = f"http://127.0.0.1:{port}/"
+    try:
+        for _ in range(120):                    # wait for the listener
+            try:
+                urllib.request.urlopen(url + "healthz", timeout=2)
+                break
+            except Exception:
+                if proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    sys.exit(f"serve exited rc={proc.returncode}:\n"
+                             + out[-2000:])
+                time.sleep(0.5)
+        else:
+            sys.exit("serve never answered /healthz")
+
+        for i in range(n_req):
+            req = urllib.request.Request(
+                url + "predict",
+                json.dumps({"inputs": [[0.1, -0.2, 0.3, 0.4]]}).encode(),
+                {"Content-Type": "application/json",
+                 "X-Request-Id": f"statusz-{i}"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+        try:                                    # one malformed → 400
+            urllib.request.urlopen(urllib.request.Request(
+                url + "predict", b"not json",
+                {"Content-Type": "application/json"}), timeout=30)
+        except urllib.error.HTTPError as e:
+            check(e.code == 400, "malformed predict -> 400")
+
+        # healthz: rev + uptime for fleet tooling
+        with urllib.request.urlopen(url + "healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        check(bool(h.get("rev")), "healthz carries a rev build stamp")
+        check(isinstance(h.get("uptime_s"), (int, float))
+              and h["uptime_s"] >= 0, "healthz carries uptime_s")
+
+        # /statusz: the human one-pager
+        with urllib.request.urlopen(url + "statusz", timeout=10) as r:
+            check(r.headers.get("Content-Type", "")
+                  .startswith("text/plain"), "/statusz is text/plain")
+            page = r.read().decode()
+        check(len(page) > 200, "/statusz is non-empty")
+        for needle in ("znicz-tpu /statusz", "rev:", "uptime_s:",
+                       "serving", "breaker:", "compile accounting",
+                       "flight recorder"):
+            check(needle in page, f"/statusz shows {needle!r}")
+        check("request_path_compiles: 0" in page,
+              "/statusz proves zero request-path compiles")
+
+        # /debug/flightrecorder: the rings as JSON
+        with urllib.request.urlopen(url + "debug/flightrecorder",
+                                    timeout=10) as r:
+            fr = json.loads(r.read())
+        check(len(fr.get("recent", [])) >= n_req,
+              f"flight recorder retains the {n_req} requests")
+        reqs = [rec for rec in fr["recent"]
+                if rec.get("kind") == "request"]
+        check(all(rec.get("request_id") for rec in reqs),
+              "request records carry request ids")
+        check(any(rec.get("spans") for rec in reqs),
+              "request records carry span trees")
+        check(any("forward_ms" in (rec.get("stages") or {})
+                  for rec in reqs),
+              "stage breakdown includes forward_ms")
+        check(any(rec.get("outcome") == "error"
+                  for rec in fr.get("errors", [])),
+              "the malformed request landed in the error ring")
+        with urllib.request.urlopen(url + "debug/flightrecorder?n=2",
+                                    timeout=10) as r:
+            check(len(json.loads(r.read())["recent"]) == 2,
+                  "?n= bounds the recent slice")
+
+        # /debug/threadz: live threads with stacks
+        with urllib.request.urlopen(url + "debug/threadz",
+                                    timeout=10) as r:
+            tz = json.loads(r.read())
+        check(tz.get("count", 0) >= 2, "threadz lists live threads")
+        check(all(t.get("stack") for t in tz.get("threads", [])),
+              "every thread carries a Python stack")
+
+        # SIGUSR1: the stderr thread dump for wedged replicas
+        proc.send_signal(signal.SIGUSR1)
+        dumped = False
+        for _ in range(20):
+            time.sleep(0.25)
+            with open(err_path, "rb") as fh:
+                if b"znicz-tpu thread dump" in fh.read():
+                    dumped = True
+                    break
+        check(dumped, "SIGUSR1 dumps a thread listing to stderr")
+        # and the process is still serving afterwards
+        with urllib.request.urlopen(url + "healthz", timeout=10) as r:
+            check(r.status == 200, "replica still serves after SIGUSR1")
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+print(json.dumps({"ok": not fails, "violations": fails}))
+sys.exit(1 if fails else 0)
+PY

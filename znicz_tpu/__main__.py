@@ -67,6 +67,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="dump a jax.profiler trace of the run into DIR "
                         "(view with TensorBoard / xprof)")
+    p.add_argument("--timeline-jsonl", default=None, metavar="PATH",
+                   help="append one JSON line per fused host step with "
+                        "the wall/device/host time split (also: "
+                        "$ZNICZ_TIMELINE_JSONL; docs/observability.md)")
     p.add_argument("--coordinator", default=None,
                    help="host:port of process 0 (multi-host SPMD)")
     p.add_argument("--num-processes", type=int, default=1)
@@ -101,7 +105,8 @@ def main(argv=None) -> int:
         snapshot=args.snapshot, epochs=args.epochs, fused=args.fused,
         seed=args.seed, overrides=args.overrides,
         coordinator=args.coordinator, num_processes=args.num_processes,
-        process_id=args.process_id, profile=args.profile)
+        process_id=args.process_id, profile=args.profile,
+        timeline_jsonl=args.timeline_jsonl)
     wf = launcher.run()
     decision = getattr(wf, "decision", None)
     if decision is not None and decision.epoch_metrics:

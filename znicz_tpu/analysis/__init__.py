@@ -13,7 +13,10 @@ PRs grew (serving, resilience, telemetry, elastic):
   dispatch-thread paths (:mod:`.handlers`);
 * ``metric-drift`` — metric names out of sync between code,
   docs/observability.md and tools/metrics_smoke.sh
-  (:mod:`.metric_drift`).
+  (:mod:`.metric_drift`);
+* ``duration-clock`` — durations computed from the wall clock
+  (``time.time()`` arithmetic) instead of ``time.monotonic()`` /
+  ``perf_counter`` (:mod:`.clocks`).
 
 Run it: ``python -m znicz_tpu lint`` (or ``tools/lint.sh``); gate:
 ``pytest -m lint``.  Suppress: ``# zlint: disable=RULE`` inline, or a
@@ -21,6 +24,7 @@ justified entry in ``tools/zlint_baseline.json``.  Full docs:
 ``docs/static_analysis.md``.
 """
 
+from .clocks import DurationClockRule
 from .core import (Analyzer, Finding, ModuleInfo, RepoRule, Rule,
                    load_baseline, write_baseline)
 from .cli import default_rules, main, run_repo
@@ -34,4 +38,5 @@ __all__ = [
     "load_baseline", "write_baseline", "default_rules", "run_repo",
     "main", "LockDisciplineRule", "JaxHygieneRule",
     "UnseededRandomRule", "HandlerSafetyRule", "MetricDriftRule",
+    "DurationClockRule",
 ]

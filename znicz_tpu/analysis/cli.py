@@ -15,6 +15,7 @@ import json
 import os
 import sys
 
+from .clocks import DurationClockRule
 from .core import Analyzer, default_root, write_baseline
 from .handlers import HandlerSafetyRule
 from .jaxrules import JaxHygieneRule, UnseededRandomRule
@@ -27,7 +28,7 @@ DEFAULT_BASELINE = "tools/zlint_baseline.json"
 def default_rules() -> list:
     return [LockDisciplineRule(), JaxHygieneRule(),
             UnseededRandomRule(), HandlerSafetyRule(),
-            MetricDriftRule()]
+            MetricDriftRule(), DurationClockRule()]
 
 
 def run_repo(root: str | None = None, baseline: str | None = None,

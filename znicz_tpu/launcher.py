@@ -16,6 +16,7 @@ role split."""
 
 from __future__ import annotations
 
+import contextlib
 import importlib
 import importlib.util
 import inspect
@@ -54,7 +55,8 @@ class Launcher:
                  epochs: int | None = None, fused: bool = False,
                  seed: int | None = None, overrides=(),
                  coordinator: str | None = None, num_processes: int = 1,
-                 process_id: int = 0, profile: str | None = None):
+                 process_id: int = 0, profile: str | None = None,
+                 timeline_jsonl: str | None = None):
         self.workflow_spec = workflow
         self.config_path = config
         self.backend = backend
@@ -67,14 +69,35 @@ class Launcher:
         self.num_processes = num_processes
         self.process_id = process_id
         self.profile = profile
+        self.timeline_jsonl = timeline_jsonl
         self.workflow = None
+
+    @contextlib.contextmanager
+    def _timeline_env(self):
+        """``--timeline-jsonl`` scoped to THIS run: the env var is the
+        channel StandardWorkflowBase.train defaults from (module.run()
+        signatures stay untouched, same pattern as $ZNICZ_PROFILE_DIR),
+        but it must not outlive the run — a later in-process Launcher
+        without the flag would silently append its steps to the first
+        run's file."""
+        if not self.timeline_jsonl:
+            yield
+            return
+        prev = os.environ.get("ZNICZ_TIMELINE_JSONL")
+        os.environ["ZNICZ_TIMELINE_JSONL"] = self.timeline_jsonl
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop("ZNICZ_TIMELINE_JSONL", None)
+            else:
+                os.environ["ZNICZ_TIMELINE_JSONL"] = prev
 
     def _trace_ctx(self):
         """``jax.profiler.trace`` around the whole run when --profile DIR
         is set (SURVEY.md §5 tracing row: the TPU-level complement to the
         per-unit wall-clock time table, which is kept)."""
         if not self.profile:
-            import contextlib
             return contextlib.nullcontext()
         import jax
         return jax.profiler.trace(self.profile)
@@ -113,6 +136,10 @@ class Launcher:
 
     def run(self):
         """Execute end-to-end; returns the finished workflow."""
+        with self._timeline_env():
+            return self._run()
+
+    def _run(self):
         module = self.build()
         device = Device.create(self.backend)
         sig = inspect.signature(module.run)

@@ -52,8 +52,11 @@ def ensure_built(so: str, srcs: list[str], make_dir: str, target: str,
     try:
         got = fd is None                # no lock file → best-effort bare
         if fd is not None:
-            deadline = time.time() + deadline_s
-            while time.time() < deadline:
+            # monotonic deadline: an NTP step mid-wait must not turn a
+            # 180 s build lock into an instant give-up (or a forever
+            # wait) — zlint duration-clock
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
                 try:
                     fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
                     got = True

@@ -893,8 +893,17 @@ class FusedTrainer:
             _, ms = jax.lax.scan(body, None, (idx, mask))
             return ms
 
-        self._train_epoch_fn = jax.jit(train_epoch, donate_argnums=(0, 1))
-        self._eval_epoch_fn = jax.jit(eval_epoch)
+        # compile accounting (telemetry.compilestats): jit compiles
+        # lazily, so the first train/eval call of a run is where the
+        # whole-epoch XLA compile actually lands — time it into
+        # compile_time_ms{site="train.fused"} so the MFU work can
+        # subtract compile from measured step time
+        from ..telemetry import compilestats
+        self._train_epoch_fn = compilestats.first_call_timed(
+            jax.jit(train_epoch, donate_argnums=(0, 1)),
+            site="train.fused", cause="cold")
+        self._eval_epoch_fn = compilestats.first_call_timed(
+            jax.jit(eval_epoch), site="train.fused", cause="cold")
 
     @staticmethod
     def _step_scales(lr_scale, lr_scale_bias, n_steps: int):

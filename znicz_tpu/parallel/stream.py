@@ -109,8 +109,16 @@ class StreamTrainer(FusedTrainer):
             return eval_minibatch(spec, params, x,
                                   x if x_is_target else t, mask)
 
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
-        self._eval_fn = jax.jit(estep)
+        # compile accounting: same contract as FusedTrainer._build —
+        # the first streamed step call pays the XLA compile, recorded
+        # under its own site so resident and streaming runs are
+        # separable in compile_time_ms
+        from ..telemetry import compilestats
+        self._step_fn = compilestats.first_call_timed(
+            jax.jit(step, donate_argnums=(0, 1)),
+            site="train.stream", cause="cold")
+        self._eval_fn = compilestats.first_call_timed(
+            jax.jit(estep), site="train.stream", cause="cold")
         if self.accum_steps > 1:
             # gradient accumulation over the streamed step loop: grads
             # per micro-batch, one update per group — the host-loop

@@ -240,8 +240,16 @@ class MicroBatcher:
             token = tracing.set_request_ids(
                 [r.request_id for r in live if r.request_id])
             try:
+                # queue_wait_ms: the oldest rider's time from submit to
+                # dispatch — the flight recorder's request records get
+                # a measured queue figure instead of only the
+                # handler-minus-dispatch residual
                 with tracing.span("batcher.dispatch",
-                                  rows=int(len(x)), requests=len(live)):
+                                  rows=int(len(x)), requests=len(live),
+                                  queue_wait_ms=round(
+                                      (t0 - min(r.arrival
+                                                for r in live)) * 1e3,
+                                      3)):
                     # chaos latency/error site: sits BEFORE the engine
                     # so injected dispatch stalls exercise the deadline
                     # and server-timeout paths without touching device

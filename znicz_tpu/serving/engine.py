@@ -59,7 +59,7 @@ from ..export import ZnnLayer, read_znn
 from ..resilience import faults
 from ..resilience.breaker import CircuitBreaker, EngineUnavailable
 from ..resilience.retry import RetryPolicy
-from ..telemetry import tracing
+from ..telemetry import compilestats, tracing
 from ..telemetry.registry import REGISTRY
 
 #: default pad-to-bucket ladder for request batch sizes
@@ -349,6 +349,19 @@ class ServingEngine:
         self._lock = threading.Lock()
         self._cache = collections.OrderedDict()   # key -> jitted fwd
         self._stats = collections.Counter()       # bucket executables
+        #: generation-independent (bucket, shape, dtype, device) keys
+        #: whose executable COMPLETED a compile — classifies a
+        #: request-path compile as "new_bucket" (never built) vs
+        #: "fallback" (built before: LRU eviction or a generation swap
+        #: re-exposed a cold executable).  Keys are added only once the
+        #: first invocation succeeds (a build whose first call raised
+        #: produced no executable), and the set is bounded: shape keys
+        #: derive from client-controlled request shapes, so a public
+        #: replica must not accrete one entry per adversarial shape
+        #: forever.  Past the cap, novel shapes classify as new_bucket
+        #: permanently — the conservative (stricter) cause.
+        self._compiled_shapes: set = set()
+        self._compiled_shapes_cap = 4096
         #: hot-reload bookkeeping: single-flight + last outcome for
         #: /healthz; the sample shape of live traffic feeds the canary
         self._reload_lock = threading.Lock()
@@ -383,26 +396,50 @@ class ServingEngine:
         d = jax.devices()[0]
         return f"{d.platform}:{getattr(d, 'id', 0)}"
 
+    def _shape_key(self, bucket, sample_shape, dtype) -> tuple:
+        """The generation-independent part of an executable-cache key
+        — the ONE place the key layout lives: _executable, warmup and
+        the reload canary must all build byte-identical keys or a
+        'already warm' / seed-the-swap check silently never matches.
+        The full cache key is ``(gen.number,) + _shape_key(...)``."""
+        return (int(bucket), tuple(sample_shape), str(dtype),
+                self._device_key())
+
     def _executable(self, gen: _Generation, bucket: int, sample_shape,
-                    dtype):
+                    dtype, cause: str | None = None):
         """The jitted forward for one cache key, LRU-managed.  Each key
         gets its OWN ``jax.jit`` instance so evicting the entry actually
         releases the underlying executable.  Keys carry the generation
         number (and the swap clears the cache anyway): a stale
-        executable from a previous generation must never serve."""
-        key = (gen.number, bucket, tuple(sample_shape), str(dtype),
-               self._device_key())
+        executable from a previous generation must never serve.
+
+        Compile accounting (telemetry.compilestats): every miss builds
+        a fresh executable whose first invocation is timed into
+        ``compile_time_ms{site="serving.engine"}``; ``cause`` defaults
+        to the request-path classification (``new_bucket`` for a shape
+        key never compiled, ``fallback`` for a re-compile after
+        eviction / generation swap) — warmup passes ``cold``."""
+        shape_key = self._shape_key(bucket, sample_shape, dtype)
+        key = (gen.number,) + shape_key
         with self._lock:
             fn = self._cache.get(key)
             if fn is not None:
                 self._cache.move_to_end(key)
                 self._stats["cache_hits"] += 1
+                compilestats.record_cache("serving.engine", hit=True)
                 return fn
             self._stats["cache_misses"] += 1
+            compilestats.record_cache("serving.engine", hit=False)
+            if cause is None:
+                cause = ("fallback" if shape_key in self._compiled_shapes
+                         else "new_bucket")
             import jax
             layers = gen.layers
-            fn = jax.jit(lambda params, x: jax_forward(layers, x,
-                                                       params))
+            fn = compilestats.first_call_timed(
+                jax.jit(lambda params, x: jax_forward(layers, x,
+                                                      params)),
+                site="serving.engine", cause=cause,
+                on_first=lambda: self._mark_compiled(shape_key))
             if gen is self._gen:
                 # only the CURRENT generation may occupy cache slots:
                 # an in-flight request pinned to a just-retired
@@ -416,11 +453,50 @@ class ServingEngine:
                     self._stats["cache_evictions"] += 1
             return fn
 
+    def _mark_compiled(self, shape_key) -> None:
+        """A shape key's executable finished its first successful call
+        (the FirstCallTimed hook — fires outside the engine lock)."""
+        with self._lock:
+            self._mark_compiled_locked(shape_key)
+
+    def _mark_compiled_locked(self, shape_key) -> None:
+        if len(self._compiled_shapes) < self._compiled_shapes_cap:
+            self._compiled_shapes.add(shape_key)
+
     def bucket_for(self, b: int) -> int:
         for bucket in self.buckets:
             if b <= bucket:
                 return bucket
         return self.buckets[-1]
+
+    def warmup(self, sample_shape, dtype=np.float32,
+               buckets=None) -> int:
+        """Precompile the bucket executables for ``sample_shape``
+        BEFORE traffic arrives, off the request path — the compiles
+        record ``compiles_total{site="serving.engine", cause="cold"}``
+        instead of ambushing the first request of each batch size as a
+        ``new_bucket`` latency spike.  Returns the number of
+        executables built (0 on the native backend, which has nothing
+        to compile).  Serve CLI: ``--warmup-shape``."""
+        if self.backend != "jax":
+            return 0
+        shape = tuple(int(d) for d in sample_shape)
+        gen = self._current()
+        built = 0
+        for bucket in (buckets if buckets is not None else self.buckets):
+            key = (gen.number,) + self._shape_key(bucket, shape,
+                                                  np.dtype(dtype))
+            with self._lock:
+                if key in self._cache:
+                    continue            # already warm: nothing to build
+            fn = self._executable(gen, int(bucket), shape,
+                                  np.dtype(dtype), cause="cold")
+            x = np.zeros((int(bucket),) + shape, np.dtype(dtype))
+            # force the lazy jit NOW — an un-invoked executable would
+            # still pay its compile on the first request
+            fn(gen.params(), x)
+            built += 1
+        return built
 
     # -- degraded path ----------------------------------------------------
     def _fallback_predict(self, x: np.ndarray, gen: _Generation,
@@ -564,9 +640,14 @@ class ServingEngine:
                 layers = gen.layers
                 fn = jax.jit(lambda params, xx: jax_forward(layers, xx,
                                                             params))
-                y = np.asarray(fn(gen.params(), x))
-                gen.warmed = ((gen.number, bucket, tuple(shape),
-                               str(x.dtype), self._device_key()), fn)
+                # compile accounting: a reload pays its compile HERE,
+                # off the request path — cause="reload", and the swap
+                # seeds the executable so traffic never re-pays it
+                with compilestats.timed("serving.canary", "reload"):
+                    y = np.asarray(fn(gen.params(), x))
+                gen.warmed = ((gen.number,)
+                              + self._shape_key(bucket, shape, x.dtype),
+                              fn)
         except Exception as e:
             raise CanaryFailed(f"canary forward raised: {e!r}") from e
         if y.shape != (bucket, feats):
@@ -626,9 +707,12 @@ class ServingEngine:
                     del self._cache[key]
                 if outcome == "ok" and candidate.warmed is not None:
                     # seed the canary's compile: the first post-swap
-                    # request must not pay the jit a second time
+                    # request must not pay the jit a second time (the
+                    # shape key counts as compiled, so an eviction of
+                    # this entry later classifies as "fallback")
                     key, fn = candidate.warmed
                     self._cache[key] = fn
+                    self._mark_compiled_locked(key[1:])
             if outcome == "ok":
                 _generation.set(candidate.number)
             record = {"outcome": outcome, "error": error,

@@ -34,6 +34,20 @@ Wire protocol (JSON both ways):
   a token is configured via ``--admin-token`` / ``$ZNICZ_ADMIN_TOKEN``
   — set one on any listener reachable beyond localhost).  ``SIGHUP``
   triggers the same path from the ``serve`` CLI without a token.
+* ``GET /statusz``   the human-readable one-pager (text/plain): build
+  rev, uptime, backend/breaker/generation, promotion state, compile
+  accounting, the flight recorder's slow-request table — it exists to
+  be curl'd by a human mid-incident (telemetry.debugz).  When an admin
+  token is configured, ``/statusz`` and both ``/debug/*`` routes
+  require the same ``X-Admin-Token`` as ``/admin/reload`` — stack
+  dumps, request shapes and error tracebacks are operator data.
+* ``GET /debug/flightrecorder``  the bounded ring of recent request /
+  train-step records as JSON (``?n=`` bounds the recent slice) —
+  per-request span trees, stage timings, retained slow outliers, last
+  errors with tracebacks (telemetry.flightrecorder).
+* ``GET /debug/threadz``  every live thread with its current Python
+  stack (JSON) — diagnosing a live hang; ``kill -USR1 <pid>`` dumps
+  the same to stderr when the HTTP threads themselves are what hung.
 * ``GET /metrics``   content-negotiated (znicz_tpu.telemetry): the
   default JSON view is the PR-1 shape — batcher counters (queue depth,
   batch-size histogram, p50/p99 latency, rejected/expired) merged with
@@ -62,12 +76,13 @@ import json
 import os
 import threading
 import time
+import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from ..resilience.breaker import EngineUnavailable
-from ..telemetry import buildinfo, tracing
+from ..telemetry import buildinfo, debugz, flightrecorder, tracing
 from ..telemetry.registry import (PROMETHEUS_CONTENT_TYPE, REGISTRY,
                                   DEFAULT_LATENCY_BUCKETS_MS)
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
@@ -76,7 +91,8 @@ from .engine import ServingEngine
 #: routes with their own label value in requests_total/errors_total —
 #: anything else pools under "other" (label cardinality stays bounded
 #: no matter what paths clients probe)
-_ROUTES = ("/predict", "/healthz", "/metrics", "/admin/reload")
+_ROUTES = ("/predict", "/healthz", "/metrics", "/admin/reload",
+           "/statusz", "/debug/flightrecorder", "/debug/threadz")
 
 
 class ServingServer:
@@ -141,6 +157,7 @@ class ServingServer:
 
             def _send(self, code: int, body: bytes, ctype: str,
                       headers: dict | None = None):
+                self._status_code = code    # flight-record outcome
                 route = self._route()
                 outer._requests.inc(route=route, code=str(code))
                 if code >= 400:
@@ -161,10 +178,59 @@ class ServingServer:
                 self._send(code, json.dumps(obj, default=float).encode(),
                            "application/json", headers)
 
+            def _admin_authorized(self) -> bool:
+                """True when no admin token is configured, or the
+                request's ``X-Admin-Token`` matches it.  Shared by
+                ``/admin/reload`` and the introspection surface
+                (``/statusz``, ``/debug/*``): stack dumps, request
+                payloads' shapes and error tracebacks are operator
+                data — a token configured to protect reloads protects
+                reads too."""
+                if outer.admin_token is None:
+                    return True
+                supplied = self.headers.get("X-Admin-Token", "")
+                # compare bytes: compare_digest(str, str) raises
+                # TypeError on non-ASCII input, and header values
+                # arrive latin-1-decoded — a stray high byte must
+                # 403, not crash the handler.  supplied.encode
+                # (latin-1) recovers the client's exact wire bytes;
+                # the configured token is a Python str whose wire
+                # form is its UTF-8 encoding, so a non-ASCII token
+                # still matches the client that sends it.
+                return hmac.compare_digest(
+                    supplied.encode("latin-1", "replace"),
+                    outer.admin_token.encode("utf-8"))
+
             def do_GET(self):
                 path = self.path.split("?")[0].rstrip("/")
+                if (path in ("/statusz", "/debug/flightrecorder",
+                             "/debug/threadz")
+                        and not self._admin_authorized()):
+                    self._reply(403, {
+                        "error": "admin token required (supply "
+                                 "X-Admin-Token)"})
+                    return
                 if path == "/healthz":
                     self._reply(200, outer.health())
+                elif path == "/statusz":
+                    # the human one-pager: text, because it exists to
+                    # be curl'd mid-incident, not parsed
+                    self._send(200, debugz.statusz_text(outer).encode(),
+                               "text/plain; charset=utf-8")
+                elif path == "/debug/flightrecorder":
+                    query = (self.path.split("?", 1)[1]
+                             if "?" in self.path else "")
+                    n = None
+                    for part in query.split("&"):
+                        if part.startswith("n="):
+                            try:
+                                n = max(1, int(part[2:]))
+                            except ValueError:
+                                pass
+                    self._reply(200,
+                                flightrecorder.RECORDER.snapshot(n))
+                elif path == "/debug/threadz":
+                    self._reply(200, debugz.threadz())
                 elif path == "/metrics":
                     # content negotiation: Prometheus scrapers send
                     # Accept: text/plain (and curl can force either
@@ -200,10 +266,32 @@ class ServingServer:
                 rid = tracing.accept_request_id(
                     self.headers.get("X-Request-Id"))
                 t0 = time.monotonic()
+                self._status_code = None
+                self._rec_shape = self._rec_rows = None
+                self._rec_error = None
                 with tracing.request(rid):
                     with tracing.span("server.predict"):
                         self._predict()
-                outer._latency.observe((time.monotonic() - t0) * 1e3)
+                dt_ms = (time.monotonic() - t0) * 1e3
+                outer._latency.observe(dt_ms)
+                # flight record, AFTER the handler span closed so the
+                # record's span tree includes it (telemetry.
+                # flightrecorder; served on /debug/flightrecorder)
+                code = self._status_code or 500
+                # since=t0: a retry reusing its first attempt's
+                # X-Request-Id must not inherit that attempt's spans —
+                # stage timings would double-count
+                spans = [s.to_dict() for s in
+                         tracing.recent_spans(request_id=rid,
+                                              since=t0)]
+                flightrecorder.RECORDER.record(
+                    "request", duration_ms=dt_ms,
+                    outcome="ok" if code < 400 else "error",
+                    error=self._rec_error,
+                    request_id=rid, code=code,
+                    rows=self._rec_rows, shape=self._rec_shape,
+                    stages=flightrecorder.stage_breakdown(spans),
+                    spans=spans)
 
             def _admin_reload(self):
                 """``POST /admin/reload`` — zero-downtime model swap.
@@ -219,23 +307,11 @@ class ServingServer:
                 ``outcome``), 409 = one already in flight, 403 =
                 missing/wrong ``X-Admin-Token`` when the server has
                 one configured."""
-                if outer.admin_token is not None:
-                    supplied = self.headers.get("X-Admin-Token", "")
-                    # compare bytes: compare_digest(str, str) raises
-                    # TypeError on non-ASCII input, and header values
-                    # arrive latin-1-decoded — a stray high byte must
-                    # 403, not crash the handler.  supplied.encode
-                    # (latin-1) recovers the client's exact wire bytes;
-                    # the configured token is a Python str whose wire
-                    # form is its UTF-8 encoding, so a non-ASCII token
-                    # still matches the client that sends it.
-                    if not hmac.compare_digest(
-                            supplied.encode("latin-1", "replace"),
-                            outer.admin_token.encode("utf-8")):
-                        self._reply(403, {
-                            "error": "admin token required (supply "
-                                     "X-Admin-Token)"})
-                        return
+                if not self._admin_authorized():
+                    self._reply(403, {
+                        "error": "admin token required (supply "
+                                 "X-Admin-Token)"})
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", 0) or 0)
                     if n > outer.max_body:
@@ -292,6 +368,8 @@ class ServingServer:
                     x = np.asarray(payload["inputs"], np.float32)
                     if x.ndim == 1:
                         x = x[None]
+                    self._rec_rows = int(len(x))
+                    self._rec_shape = [int(d) for d in x.shape[1:]]
                     deadline_ms = payload.get("deadline_ms")
                     if deadline_ms is not None:   # junk → 400, not 503
                         deadline_ms = float(deadline_ms)
@@ -300,6 +378,7 @@ class ServingServer:
                     # JSON 400 body, never a raw 500 traceback (ragged
                     # rows, non-dict payloads, unparseable JSON, junk
                     # Content-Length all land here)
+                    self._rec_error = f"bad request: {e}"
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
                 try:
@@ -307,28 +386,40 @@ class ServingServer:
                         x, deadline_ms=deadline_ms,
                         timeout=outer.default_timeout_s)
                 except QueueFull as e:
+                    self._rec_error = str(e)
                     self._reply(429, {"error": str(e),
                                       "retry_after_s": e.retry_after},
                                 {"Retry-After": str(e.retry_after)})
                 except DeadlineExceeded as e:
+                    self._rec_error = str(e)
                     self._reply(504, {"error": str(e)})
                 except TimeoutError as e:
                     # server-side wait timeout (e.g. a slow first jit
                     # compile): retryable, and NOT an engine failure
+                    self._rec_error = f"answer timeout: {e}"
                     ra = outer.batcher.retry_after()
                     self._reply(503, {"error": f"timed out waiting "
                                                f"for an answer: {e}",
                                       "retry_after_s": ra},
                                 {"Retry-After": str(ra)})
                 except ValueError as e:        # bad geometry for model
+                    self._rec_error = str(e)
                     self._reply(400, {"error": str(e)})
                 except EngineUnavailable as e:
                     # circuit open / fallback missing: graceful refusal
                     # with an honest come-back time, never a hang
+                    self._rec_error = str(e)
                     self._reply(503, {"error": str(e),
                                       "retry_after_s": e.retry_after},
                                 {"Retry-After": str(e.retry_after)})
                 except Exception as e:
+                    # the one genuinely unexpected leg: keep the FULL
+                    # traceback for the flight recorder's error ring
+                    # (the exception object came back from the batcher
+                    # thread with its original raise site intact)
+                    self._rec_error = "".join(
+                        traceback.format_exception(
+                            type(e), e, e.__traceback__))
                     self._reply(503, {"error": f"inference failed: "
                                                f"{e!r}"[:300]})
                 else:
@@ -336,6 +427,8 @@ class ServingServer:
                     if not np.isfinite(y).all():
                         # bare NaN/Infinity tokens are not valid JSON —
                         # strict clients would choke on a 200 body
+                        self._rec_error = ("model produced non-finite "
+                                           "outputs")
                         self._reply(500, {
                             "error": "model produced non-finite "
                                      "outputs (inf/nan) for these "
@@ -405,7 +498,13 @@ class ServingServer:
         out = {"status": state, "backend": self.engine.backend,
                "n_layers": self.engine.n_layers,
                "buckets": list(self.engine.buckets),
-               "queue_depth": self.batcher.queue_depth()}
+               "queue_depth": self.batcher.queue_depth(),
+               # build + age at the health tier: fleet tooling spots a
+               # stale (wrong rev) or flapping (uptime keeps resetting)
+               # replica from the probe it already makes, without
+               # scraping /metrics
+               "rev": self.rev,
+               "uptime_s": round(debugz.process_uptime_s(), 1)}
         # generation + last reload outcome: a rollout driver polls
         # /healthz to learn whether its /admin/reload landed
         out.update(self.engine.reload_status())
@@ -534,6 +633,12 @@ def main(argv=None) -> int:
     p.add_argument("--breaker-cooldown-s", type=float, default=10.0,
                    help="seconds the circuit stays open before a "
                         "half-open probe retries the jax engine")
+    p.add_argument("--warmup-shape", default=None, metavar="D[,D...]",
+                   help="precompile every bucket executable for this "
+                        "sample shape (e.g. '4' or '28,28,1') BEFORE "
+                        "accepting traffic, so the compiles record as "
+                        "cause=cold instead of ambushing first "
+                        "requests as new_bucket latency spikes")
     p.add_argument("--admin-token", default=None,
                    help="require this token (X-Admin-Token header) on "
                         "POST /admin/reload; defaults to "
@@ -590,6 +695,18 @@ def main(argv=None) -> int:
                 profile_deadline = time.monotonic() + args.profile_secs
             print(f"profiling into {profile_dir} (jax.profiler; view "
                   f"with TensorBoard/xprof)", flush=True)
+        # live-hang escape hatch: `kill -USR1 <pid>` dumps every
+        # thread's Python stack to stderr — works even when the HTTP
+        # threads themselves are what hung (telemetry.debugz; the same
+        # snapshot serves GET /debug/threadz)
+        from ..telemetry import debugz as _debugz
+        _debugz.install_stack_dump()
+        if args.warmup_shape:
+            shape = tuple(int(d) for d in args.warmup_shape.split(","))
+            n = engine.warmup(shape)
+            print(f"warmup: {n} bucket executable(s) compiled for "
+                  f"sample shape {shape} (cause=cold, off the "
+                  f"request path)", flush=True)
         # construct THEN start: if start() unwinds (KeyboardInterrupt),
         # `server` must already be bound so the finally below can stop
         # it — a skipped stop() leaks the registry collector
@@ -603,7 +720,7 @@ def main(argv=None) -> int:
         server.start()
         print(f"serving {args.model} [{engine.backend}] at "
               f"{server.url} (POST /predict, GET /healthz, "
-              f"GET /metrics)", flush=True)
+              f"GET /metrics, GET /statusz, GET /debug/*)", flush=True)
         # explicit shutdown signaling with a short-tick wait: Python
         # runs signal handlers on the main thread only when it next
         # executes bytecode, and the OS may deliver the C-level signal
@@ -619,6 +736,9 @@ def main(argv=None) -> int:
         def _arm():
             for _sig in (_signal.SIGINT, _signal.SIGTERM):
                 _signal.signal(_sig, lambda *_: stop.set())
+            # the thread-dump handler rides the same re-arm loop (the
+            # native-lib sigaction clobbering below hits it too)
+            _debugz.install_stack_dump()
             if hasattr(_signal, "SIGHUP"):
                 # operator hot reload: `kill -HUP <pid>` re-reads
                 # --model in place, the config-reload idiom ops tooling

@@ -30,6 +30,7 @@ import numpy as np
 
 from .accelerated_units import AcceleratedWorkflow
 from .logger import MetricsWriter
+from .telemetry import flightrecorder as _flightrecorder
 from .telemetry import profiler as _profiler
 from .telemetry.registry import REGISTRY
 from .mutable import DerivedBool
@@ -193,7 +194,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
               mse_target: str | None = None,
               checkpoint_dir: str | None = None,
               checkpoint_every: int | None = None,
-              checkpointer=None):
+              checkpointer=None,
+              timeline_jsonl: str | None = None):
         """One entry point over both execution paths (the samples' and
         launcher's ``--fused`` plumbing): the compiled fused step when
         requested AND the device supports it, else the unit-graph tick
@@ -219,7 +221,13 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         lands, which is what makes the step *blessed* for a promotion
         watcher (docs/promotion.md).  Pass an existing
         ``checkpointer`` (e.g. one with an ``on_blessed`` callback)
-        to keep ownership of its lifecycle."""
+        to keep ownership of its lifecycle.
+
+        Timeline (fused path only): ``timeline_jsonl`` (default
+        ``$ZNICZ_TIMELINE_JSONL``, CLI ``--timeline-jsonl``) appends
+        one JSON line per host step with the wall / device / host time
+        split — the host-stall evidence the MFU work reads
+        (docs/observability.md, docs/performance.md)."""
         from .config import root
         if compute_dtype is None:
             compute_dtype = root.common.get("compute_dtype")
@@ -229,6 +237,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             profile_dir = _profiler.dir_from_env()
         if profile_every is None:
             profile_every = _profiler.every_from_env()
+        if timeline_jsonl is None:
+            timeline_jsonl = _flightrecorder.timeline_path_from_env()
         if fused:
             if self.device.is_xla:
                 return self.run_fused(mesh=mesh, max_epochs=max_epochs,
@@ -239,9 +249,14 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                                       mse_target=mse_target,
                                       checkpoint_dir=checkpoint_dir,
                                       checkpoint_every=checkpoint_every,
-                                      checkpointer=checkpointer)
+                                      checkpointer=checkpointer,
+                                      timeline_jsonl=timeline_jsonl)
             self.warning("fused path needs an XLA device; falling back "
                          "to the unit-graph tick loop")
+        if timeline_jsonl is not None:
+            self.warning("the per-step timeline (timeline_jsonl) is a "
+                         "fused-path feature; the tick loop records "
+                         "nothing there")
         if checkpoint_dir is not None or checkpointer is not None:
             # also reached with fused=False: silently dropping the
             # training half of the promotion loop would leave a
@@ -262,7 +277,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                   step_callback=None,
                   checkpoint_dir: str | None = None,
                   checkpoint_every: int | None = None,
-                  checkpointer=None):
+                  checkpointer=None,
+                  timeline_jsonl: str | None = None):
         """Train via the compiled fused step instead of the unit-graph
         tick loop: whole epochs run as one device-side ``lax.scan``
         (optionally mesh-sharded), with Decision's improvement/stop logic
@@ -294,7 +310,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                                             step_callback, hook,
                                             checkpoint_dir,
                                             checkpoint_every,
-                                            checkpointer)
+                                            checkpointer,
+                                            timeline_jsonl)
         finally:
             if hook is not None:
                 hook.close()
@@ -303,7 +320,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                         storage_dtype=None, mse_target=None,
                         step_callback=None, profile_hook=None,
                         checkpoint_dir=None, checkpoint_every=None,
-                        checkpointer=None):
+                        checkpointer=None, timeline_jsonl=None):
         import dataclasses
 
         from .config import root
@@ -357,6 +374,24 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                                        root.common.get("accum_steps")
                                        or 1))
         trainer.workflow = self
+        # host-vs-device time split (telemetry): everything spent
+        # inside trainer.train_epoch/eval_epoch calls is device-bound
+        # work (dispatch + compute + readback; epoch 0 also carries
+        # the XLA compile, separately visible in compile_time_ms);
+        # the rest of the epoch wall is host work — loader shuffle,
+        # metrics, decision, checkpoint admin.  A host-dominated step
+        # is a pipeline problem no profiler trace is needed to see.
+        _dev_acc = [0.0]
+
+        def _on_device(fn, *a, **kw):
+            t0 = time.monotonic()
+            try:
+                return fn(*a, **kw)
+            finally:
+                _dev_acc[0] += time.monotonic() - t0
+
+        timeline = (_flightrecorder.TimelineWriter(timeline_jsonl)
+                    if timeline_jsonl else None)
         # device-state checkpoints (parallel/checkpoint.py): the
         # training half of the promotion loop — every blessed step is
         # a candidate a promotion watcher may export and canary
@@ -416,10 +451,21 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             "training examples consumed per second over the last epoch")
         g_epoch = REGISTRY.gauge("train_epoch",
                                  "last completed training epoch index")
+        g_dev_ms = REGISTRY.gauge(
+            "train_device_ms",
+            "wall time of the last host step spent inside device "
+            "calls (dispatch + compute + readback; the first step "
+            "also carries the XLA compile — see compile_time_ms)")
+        g_host_ms = REGISTRY.gauge(
+            "train_host_ms",
+            "wall time of the last host step NOT inside device calls "
+            "(loader shuffle, metrics, decision, checkpoint admin) — "
+            "host-dominated steps are a pipeline problem")
         for epoch in range(loader.epoch_number, epochs):
             if profile_hook is not None:
                 profile_hook.on_step(epoch)
             t_epoch0 = time.monotonic()
+            dev0 = _dev_acc[0]
             loader.epoch_number = epoch
             if not first:   # initialize() already built epoch 0's plan —
                 loader._build_epoch_plan()   # reuse the loader's shuffle
@@ -449,16 +495,18 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                                      if bias_policy is not None
                                      else (None, None))
             if pending is not None:
-                trainer.train_epoch(data, target, pending[0], batch,
-                                    epoch=pending[1], lr_scale=pending[2],
-                                    ctr_base=pending[3], sync=False,
-                                    lr_scale_bias=pending[4])
+                _on_device(trainer.train_epoch, data, target,
+                           pending[0], batch,
+                           epoch=pending[1], lr_scale=pending[2],
+                           ctr_base=pending[3], sync=False,
+                           lr_scale_bias=pending[4])
             split = ((n_train - 1) // batch) * batch
             head, tail = perm[:split], perm[split:]
             if len(head):
-                tm = trainer.train_epoch(data, target, head, batch,
-                                         epoch=epoch, lr_scale=scale,
-                                         lr_scale_bias=scale_b)
+                tm = _on_device(trainer.train_epoch, data, target,
+                                head, batch,
+                                epoch=epoch, lr_scale=scale,
+                                lr_scale_bias=scale_b)
             else:
                 tm = {"loss": np.zeros((0,)), "n_err": np.zeros((0,))}
             # the tail minibatch's metrics come from a forward pass over
@@ -468,7 +516,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             # stochastic layers (dropout) the tail step's train metrics
             # differ slightly from the unit graph's dropout-active ones;
             # weights stay exactly equal either way
-            em_tail = trainer.eval_epoch(data, target, tail, batch)
+            em_tail = _on_device(trainer.eval_epoch, data, target,
+                                 tail, batch)
             pending = (tail, epoch, tail_scale, split, tail_scale_b)
             metrics["train_loss"] = float(
                 np.concatenate([tm["loss"], em_tail["loss"]]).mean())
@@ -479,7 +528,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             for k in (VALID, TEST):
                 if len(cls_idx[k]) == 0:
                     continue
-                em = trainer.eval_epoch(data, target, cls_idx[k], batch)
+                em = _on_device(trainer.eval_epoch, data, target,
+                                cls_idx[k], batch)
                 name = CLASS_NAMES[k]
                 metrics[f"{name}_loss"] = float(em["loss"].mean())
                 metrics[f"{name}_n_err"] = int(em["n_err"].sum())
@@ -493,12 +543,30 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             decision.epoch_metrics.append(metrics)
             loader.epoch_number = epoch + 1
             epoch_s = time.monotonic() - t_epoch0
+            device_s = _dev_acc[0] - dev0
+            host_s = max(0.0, epoch_s - device_s)
             if epoch_s > 0:
                 # gauges only — the metrics dict stays timing-free so
                 # fused-vs-tick parity comparisons keep holding
                 g_step_ms.set(epoch_s / steps_per_epoch * 1e3)
                 g_eps.set(n_train / epoch_s)
+                g_dev_ms.set(device_s * 1e3)
+                g_host_ms.set(host_s * 1e3)
             g_epoch.set(epoch)
+            # the flight recorder keeps the per-step record a scraper
+            # of aggregate gauges can't reconstruct; the timeline file
+            # is the same split as durable JSONL for the MFU analysis
+            step_row = {"epoch": epoch, "steps": steps_per_epoch,
+                        "examples": n_train,
+                        "wall_ms": round(epoch_s * 1e3, 3),
+                        "device_ms": round(device_s * 1e3, 3),
+                        "host_ms": round(host_s * 1e3, 3),
+                        "examples_per_sec": (round(n_train / epoch_s, 1)
+                                             if epoch_s > 0 else None)}
+            _flightrecorder.RECORDER.record(
+                "train_step", duration_ms=epoch_s * 1e3, **step_row)
+            if timeline is not None:
+                timeline.write({"at": time.time(), **step_row})
             self.metrics_writer.write(kind="epoch", **metrics)
             if self.lr_adjuster is not None:
                 # keep the tick-path iteration counter current so
@@ -549,6 +617,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                 break
         decision.complete.set(True)
         trainer.write_back()
+        if timeline is not None:
+            timeline.close()
         if ckpt is not None:
             # flush in-flight async saves and bless their manifests; a
             # borrowed checkpointer stays open for its owner

@@ -17,6 +17,15 @@ later perf/robustness PR reports through:
   per-N-steps during training (:class:`~profiler.StepTraceHook`).
 * :mod:`buildinfo` — the git-rev stamp (shared with bench.py) that
   makes scraped metrics attributable to a build.
+* :mod:`compilestats` — compile accounting at every executable-creation
+  site (``compile_time_ms{site}``, ``compiles_total{site,cause}``,
+  executable-cache hit/miss counters): "zero request-path compiles in
+  steady state" as a testable metric.
+* :mod:`flightrecorder` — bounded ring of recent request / train-step
+  records with threshold-retained slow outliers and last-N errors;
+  serves ``GET /debug/flightrecorder``.
+* :mod:`debugz` — ``GET /statusz`` (human one-pager), thread/stack
+  introspection (``/debug/threadz``, SIGUSR1 dump), process uptime.
 
 Everything here is stdlib-only (JAX is imported lazily and only by the
 profiler), so resilience/serving/parallel can record unconditionally.
@@ -25,12 +34,14 @@ See docs/observability.md for the metric inventory, span fields,
 profiler knobs, and a scrape example.
 """
 
+from .flightrecorder import RECORDER, FlightRecorder
 from .registry import (REGISTRY, Counter, Gauge, Histogram,
                        MetricsRegistry, PROMETHEUS_CONTENT_TYPE)
 from .tracing import (Span, accept_request_id, current_request_id,
                       new_request_id, recent_spans, span)
 
-__all__ = ["REGISTRY", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry", "PROMETHEUS_CONTENT_TYPE", "Span",
-           "accept_request_id", "current_request_id", "new_request_id",
-           "recent_spans", "span"]
+__all__ = ["RECORDER", "FlightRecorder", "REGISTRY", "Counter",
+           "Gauge", "Histogram", "MetricsRegistry",
+           "PROMETHEUS_CONTENT_TYPE", "Span", "accept_request_id",
+           "current_request_id", "new_request_id", "recent_spans",
+           "span"]

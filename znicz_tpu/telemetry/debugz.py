@@ -1,0 +1,191 @@
+"""Live-process debug surface: /statusz, thread/stack dumps, SIGUSR1.
+
+PR 3's debugging history is the motivation: a profiler-induced
+handler-thread deadlock took a session to diagnose because there was
+no way to ask a RUNNING server "what are your threads doing right
+now".  This module is that introspection, deliberately boring and
+dependency-free:
+
+* :func:`threadz` — every live thread with its current Python stack
+  (``sys._current_frames``), as a JSON-able dict; served on
+  ``GET /debug/threadz`` and dumped to stderr on **SIGUSR1**
+  (:func:`install_stack_dump`) so a wedged replica can be inspected
+  with one ``kill -USR1 <pid>`` even when its HTTP threads are the
+  thing that hung.
+* :func:`statusz_text` — the classic human-readable one-pager: build
+  rev, uptime, backend/breaker/generation state, last reload,
+  promotion state, compile accounting
+  (:mod:`~znicz_tpu.telemetry.compilestats`), and the flight
+  recorder's slow-request table.  Text, not JSON: it exists to be
+  curl'd by a human mid-incident.
+
+Uptime is monotonic-based (wall clocks jump under NTP); the wall stamp
+is reported alongside for correlation with logs.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+
+from . import compilestats, flightrecorder
+
+#: process clock anchors, taken at first import (the serve CLI imports
+#: telemetry at startup, so this is process start for serving replicas)
+_START_MONOTONIC = time.monotonic()
+_START_WALL = time.time()
+
+
+def process_uptime_s() -> float:
+    """Seconds since this module was first imported — monotonic, so an
+    NTP step never makes a replica look freshly flapped (or ancient)."""
+    return time.monotonic() - _START_MONOTONIC
+
+
+def started_at() -> float:
+    """Wall-clock stamp of the uptime anchor (for log correlation)."""
+    return _START_WALL
+
+
+# -- thread introspection ---------------------------------------------------
+
+def threadz() -> dict:
+    """Every live thread with its current Python stack, JSON-able.
+    ``sys._current_frames`` is a point-in-time snapshot taken without
+    stopping the world — exactly what diagnosing a live hang needs
+    (a deadlocked thread's stack shows the lock it is parked on)."""
+    frames = sys._current_frames()
+    by_ident = {t.ident: t for t in threading.enumerate()}
+    threads = []
+    for ident, frame in sorted(frames.items()):
+        t = by_ident.get(ident)
+        stack = [f"{fs.filename}:{fs.lineno} in {fs.name}"
+                 + (f"\n    {fs.line.strip()}" if fs.line else "")
+                 for fs in traceback.extract_stack(frame)]
+        threads.append({
+            "ident": ident,
+            "name": t.name if t is not None else f"<unknown-{ident}>",
+            "daemon": bool(t.daemon) if t is not None else None,
+            "stack": stack})
+    return {"count": len(threads), "at": time.time(),
+            "threads": threads}
+
+
+def format_threadz(snapshot: dict | None = None) -> str:
+    """The thread snapshot as text (the SIGUSR1 dump format)."""
+    snap = snapshot if snapshot is not None else threadz()
+    lines = [f"==== znicz-tpu thread dump: {snap['count']} threads "
+             f"(at {snap['at']:.3f}) ===="]
+    for t in snap["threads"]:
+        flags = " daemon" if t.get("daemon") else ""
+        lines.append(f"-- {t['name']} (ident {t['ident']}{flags})")
+        lines.extend(f"   {entry}" for entry in t["stack"])
+    return "\n".join(lines) + "\n"
+
+
+def install_stack_dump(signum=None, stream=None):
+    """Install a signal handler (default **SIGUSR1**) that writes the
+    thread dump to ``stream`` (default stderr).  Returns the previous
+    handler (None when signals are unavailable — e.g. not the main
+    thread — because a debug aid must never take the process down)."""
+    import signal as _signal
+    sig = signum if signum is not None \
+        else getattr(_signal, "SIGUSR1", None)
+    if sig is None:                      # platform without SIGUSR1
+        return None
+
+    def _dump(_signo, _frame):
+        out = stream if stream is not None else sys.stderr
+        out.write(format_threadz())
+        out.flush()
+
+    try:
+        return _signal.signal(sig, _dump)
+    except (ValueError, OSError):    # non-main thread / exotic platform
+        return None
+
+
+# -- /statusz ---------------------------------------------------------------
+
+def _fmt_kv(d: dict) -> str:
+    return "  ".join(f"{k}={v}" for k, v in d.items())
+
+
+def statusz_text(server=None, *, recorder=None, extra: dict | None = None
+                 ) -> str:
+    """The human-readable status one-pager.  ``server`` is a
+    :class:`~znicz_tpu.serving.server.ServingServer` (engine, batcher,
+    promotion hook all reachable from it); None renders the
+    process-level sections only, so the training side can serve the
+    same page."""
+    from . import buildinfo
+    rec = recorder if recorder is not None else flightrecorder.RECORDER
+    lines = ["znicz-tpu /statusz", "=" * 18, ""]
+    rev = (server.rev if server is not None
+           else buildinfo.cached_rev())
+    lines.append(f"rev: {rev or 'unknown'}")
+    lines.append(f"uptime_s: {process_uptime_s():.1f} "
+                 f"(started at {started_at():.3f})")
+    if extra:
+        lines.append(_fmt_kv(extra))
+    if server is not None:
+        eng = server.engine
+        em = eng.metrics()
+        lines += ["", "serving", "-" * 7]
+        lines.append(_fmt_kv({
+            "backend": eng.backend,
+            "status": em.get("resilience_state"),
+            "generation": em.get("generation"),
+            "buckets": ",".join(str(b) for b in eng.buckets),
+            "cached_executables": em.get("cached_executables")}))
+        breaker = em.get("breaker") or {}
+        lines.append("breaker: " + _fmt_kv(breaker))
+        last = (eng.reload_status() or {}).get("last_reload")
+        lines.append(f"last_reload: {last or 'never'}")
+        ps = server.promotion_status
+        if ps is not None:
+            try:
+                lines.append("promotion: " + _fmt_kv(ps()))
+            except Exception:
+                lines.append("promotion: <status probe failed>")
+        bm = server.batcher.metrics()
+        lines.append("batcher: " + _fmt_kv(
+            {k: bm.get(k) for k in ("queue_depth", "completed",
+                                    "rejected", "expired",
+                                    "latency_p50_ms",
+                                    "latency_p99_ms")}))
+    snap = compilestats.snapshot()
+    lines += ["", "compile accounting", "-" * 18]
+    if not snap["compiles"]:
+        lines.append("no executables built yet")
+    for site, causes in sorted(snap["compiles"].items()):
+        cost = snap["compile_cost"].get(site, {})
+        lines.append(f"site={site}  " + _fmt_kv(causes)
+                     + f"  total_ms={cost.get('total_ms', 0)}")
+    for site, cm in sorted(snap["caches"].items()):
+        lines.append(f"cache site={site}  " + _fmt_kv(cm))
+    lines.append(f"request_path_compiles: "
+                 f"{snap['request_path_compiles']}")
+    counts = rec.counts()
+    lines += ["", "flight recorder", "-" * 15]
+    lines.append(_fmt_kv(counts))
+    slowest = rec.slowest(10)
+    if slowest:
+        lines.append("slowest retained requests/steps:")
+        lines.append(f"  {'seq':>6} {'kind':<11} {'ms':>10} "
+                     f"{'outcome':<8} {'age_s':>8}  detail")
+        for r in slowest:
+            # wall-to-wall difference of stamps, deliberately: record
+            # stamps are wall-clock for cross-process log correlation,
+            # and a human reading the table wants "how long ago"
+            age = time.time() - r["at"]
+            detail = r.get("request_id") or r.get("epoch", "")
+            lines.append(f"  {r['seq']:>6} {r['kind']:<11} "
+                         f"{(r['duration_ms'] or 0):>10.2f} "
+                         f"{r['outcome']:<8} {age:>8.1f}  {detail}")
+    lines += ["", "endpoints: /healthz /metrics /statusz "
+                  "/debug/flightrecorder /debug/threadz "
+                  "(kill -USR1 <pid> dumps threads to stderr)", ""]
+    return "\n".join(lines)

@@ -160,15 +160,22 @@ def _record(sp: Span) -> None:
 
 
 def recent_spans(n: int | None = None, name: str | None = None,
-                 request_id: str | None = None) -> list[Span]:
+                 request_id: str | None = None,
+                 since: float | None = None) -> list[Span]:
     """Newest-last slice of the span ring, optionally filtered by span
-    name and/or by a request id appearing in the span's batch."""
+    name and/or by a request id appearing in the span's batch.
+    ``since`` is a ``time.monotonic()`` stamp: only spans STARTED at or
+    after it match — request ids are client-supplied and reusable (a
+    retry echoes its first attempt's id), so an id filter alone would
+    blend both attempts' spans into one stage breakdown."""
     with _lock:
         spans = list(_recent)
     if name is not None:
         spans = [s for s in spans if s.name == name]
     if request_id is not None:
         spans = [s for s in spans if request_id in s.request_ids]
+    if since is not None:
+        spans = [s for s in spans if s._t0 >= since]
     return spans[-n:] if n is not None else spans
 
 
