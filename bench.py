@@ -325,18 +325,28 @@ def _build(config: str, minibatch, n_train):
 
 
 def measure_fused(wf, epochs: int, warm: int = 2, dtype: str | None = None,
-                  storage: str | None = None):
-    """(images/sec, spec, params) of the fused whole-step path."""
+                  storage: str | None = None, mesh=None):
+    """(images/sec, spec, params) of the fused whole-step path;
+    ``mesh`` (a (dp, tp) shape for parallel.mesh.resolve_mesh) lays
+    the step out over the device mesh.  The returned rate is
+    PER-DEVICE (aggregate / mesh size), so the ``_per_chip`` metric
+    and the MFU/TFLOPs derived from it stay truthful on mesh rows —
+    the sharding stamp keys pairing, it does not excuse the absolute
+    number."""
     import dataclasses
 
     from znicz_tpu.parallel import fused, FusedTrainer
+    from znicz_tpu.parallel.mesh import mesh_shape_of, resolve_mesh
 
     spec, params, vels = fused.extract_model(wf)
     if dtype and dtype != spec.compute_dtype:
         spec = dataclasses.replace(spec, compute_dtype=dtype)
     if storage and storage != spec.storage_dtype:
         spec = dataclasses.replace(spec, storage_dtype=storage)
-    tr = FusedTrainer(spec=spec, params=params, vels=vels)
+    mesh = resolve_mesh(mesh)
+    dp, tp = mesh_shape_of(mesh)
+    n_devices = dp * tp
+    tr = FusedTrainer(spec=spec, params=params, vels=vels, mesh=mesh)
     ld = wf.loader
     data = ld.original_data.devmem
     # MSE heads (autoencoder) regress on target tensors, not labels
@@ -356,7 +366,7 @@ def measure_fused(wf, epochs: int, warm: int = 2, dtype: str | None = None,
         last = tr.train_epoch(data, target, idx, batch, sync=False)
     np.asarray(last["loss"])                     # one sync at the end
     dt = time.perf_counter() - t0
-    return epochs * n / dt, spec, params
+    return epochs * n / dt / n_devices, spec, params
 
 
 def measure_stream(wf, epochs: int, warm: int = 2,
@@ -622,7 +632,7 @@ def _git_rev() -> str | None:
         root=os.path.dirname(os.path.abspath(__file__)))
 
 
-def _record_run_config(args, result) -> None:
+def _record_run_config(args, result, mesh_applies: bool = False) -> None:
     """Stamp the transcript row with what ACTUALLY ran: the active
     routing levers, the code revision, and the (possibly CPU-reduced)
     minibatch.  Callers invoke this after backend bring-up / env
@@ -650,6 +660,22 @@ def _record_run_config(args, result) -> None:
         print("warning: no git revision available; transcript row is "
               "unstamped and will pair with legacy (rev-less) rows",
               file=sys.stderr)
+    # the sharding scheme is part of a measurement's identity exactly
+    # like the minibatch: a "4x2"-mesh row and a single-device "1x1"
+    # row measure different programs, so decide_levers must only pair
+    # like-for-like (its headline key includes this field).  Only the
+    # training path actually lays work over the mesh (mesh_applies);
+    # the kernel/ablate/loader modes measure single-device regardless
+    # of the flag and must say so
+    if mesh_applies and getattr(args, "mesh", None):
+        from znicz_tpu.parallel.mesh import parse_mesh_arg
+        dp, tp = parse_mesh_arg(args.mesh)
+        result["sharding"] = f"{dp}x{tp}"
+    else:
+        result["sharding"] = "1x1"
+        if getattr(args, "mesh", None) and not mesh_applies:
+            _append_note(result, "--mesh does not apply to this bench "
+                                 "mode; measured single-device")
     result["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     result["minibatch"] = args.minibatch
 
@@ -774,7 +800,7 @@ def bench_training(args) -> int:
     _preflight_lrn_pool(result, args.minibatch,
                         real_geometry=args.config == "alexnet")
     _preflight_mxu_kernels(result)
-    _record_run_config(args, result)
+    _record_run_config(args, result, mesh_applies=True)
     try:
         from znicz_tpu.ops import flops as flops_mod
 
@@ -782,6 +808,15 @@ def bench_training(args) -> int:
         if args.config == "kohonen":
             # the SOM has no gradient chain; its fused path is the
             # dedicated epoch scan in parallel.som
+            if result.get("sharding", "1x1") != "1x1":
+                # the SOM scan has no mesh path: measured single-
+                # device, and the row must say so instead of pairing
+                # with genuine mesh rows
+                result["sharding"] = "1x1"
+                _append_note(result,
+                             "--mesh is not implemented for the "
+                             "kohonen SOM path; measured single-"
+                             "device (sharding restamped 1x1)")
             ips, flops_img = measure_som_fused(wf, args.epochs)
             result["value"] = round(ips, 1)
             result["flops_per_image"] = flops_img
@@ -795,7 +830,8 @@ def bench_training(args) -> int:
                 try:
                     fused_ips, spec, params = measure_fused(
                         wf, args.epochs, getattr(args, "warm", 2),
-                        dtype=args.dtype, storage=args.storage)
+                        dtype=args.dtype, storage=args.storage,
+                        mesh=args.mesh)
                     break
                 except NotImplementedError:
                     raise
@@ -832,7 +868,7 @@ def bench_training(args) -> int:
                                  f"({e!r}"[:200] + "); split-layer retry")
                     wf = _build(args.config, args.minibatch, args.n_train)
                     # the row must record the levers that actually ran
-                    _record_run_config(args, result)
+                    _record_run_config(args, result, mesh_applies=True)
             result["path"] = "fused"
             result["compute_dtype"] = (args.dtype or "float32")
             if args.storage:
@@ -844,7 +880,24 @@ def bench_training(args) -> int:
             _append_note(result, f"fused path unavailable: {e}"[:200])
             fused_ips = measure_unit_graph(wf, max(args.ticks, 1))
             spec = params = None
+            if result.get("sharding", "1x1") != "1x1":
+                # the unit-graph fallback ran single-device: the row
+                # must not pair with genuine mesh rows in decide_levers
+                result["sharding"] = "1x1"
+                _append_note(result, "unit-graph fallback is single-"
+                                     "device; sharding restamped 1x1")
         result["value"] = round(fused_ips, 1)
+        # a mesh row records ONLY mesh measurements: the unit-graph /
+        # stream / augment comparators below run meshless, and pairing
+        # a meshless aggregate with a per-device mesh number (or
+        # landing it in a sharding-stamped row) is exactly the
+        # cross-program mixing the sharding key exists to forbid
+        meshed = result.get("sharding", "1x1") != "1x1"
+        if meshed and (args.ticks > 0 or args.stream or args.augment):
+            _append_note(result,
+                         "mesh run: the unit-graph/stream/augment "
+                         "comparators are meshless and were skipped "
+                         "(measure them without --mesh)")
         if spec is not None:
             fl = flops_mod.model_flops(
                 spec, params, wf.loader.original_data.shape[1:])
@@ -869,7 +922,7 @@ def bench_training(args) -> int:
             # MSE heads stream too: StreamTrainer's mse_target="input"
             # default reconstructs x (the AE contract) and skips the
             # label block's IO entirely
-            if args.stream:
+            if args.stream and not meshed:
                 stream_ips = measure_stream(wf, args.epochs,
                                             getattr(args, "warm", 2),
                                             dtype=args.dtype,
@@ -877,7 +930,8 @@ def bench_training(args) -> int:
                 result["stream_value"] = round(stream_ips, 1)
                 result["stream_vs_resident"] = round(
                     stream_ips / fused_ips, 3)
-            if args.augment and args.config == "alexnet":
+            if args.augment and args.config == "alexnet" \
+                    and not meshed:
                 size = int(wf.loader.original_data.shape[1])
                 aug_ips = measure_augmented(
                     spec, params, args.epochs,
@@ -887,16 +941,18 @@ def bench_training(args) -> int:
                 result["augment_value"] = round(aug_ips, 1)
                 result["augment_vs_plain"] = round(
                     aug_ips / fused_ips, 3)
-            if args.ticks > 0:
+            if args.ticks > 0 and not meshed:
                 unit_graph = measure_unit_graph(wf, args.ticks)
                 result["vs_baseline"] = round(fused_ips / unit_graph, 2)
         # a requested measurement must never quietly not run — covers
         # both the non-alexnet --augment case and the unit-graph
         # fallback (spec None) skipping --stream/--augment entirely
-        if args.stream and "stream_value" not in result:
+        # (the meshed skips above carry their own note)
+        if args.stream and "stream_value" not in result and not meshed:
             _append_note(result, "--stream requested but not measured "
                                  "(fused path unavailable)")
-        if args.augment and "augment_value" not in result:
+        if args.augment and "augment_value" not in result \
+                and not meshed:
             _append_note(result,
                          "--augment requested but not measured ("
                          + ("only implemented for the alexnet config"
@@ -1282,6 +1338,11 @@ def main(argv=None) -> int:
     p.add_argument("--augment", action="store_true",
                    help="also measure with on-device RandomCropFlip in"
                         " the scan (alexnet: decode+29 -> crop)")
+    p.add_argument("--mesh", default=None, metavar="DP[,TP]",
+                   help="lay the fused step out over a (data, model) "
+                        "device mesh, e.g. '4,2'; the row stamps the "
+                        "scheme as sharding='dpxtp' so decide_levers "
+                        "pairs like-for-like (omitted = '1x1')")
     args = p.parse_args(argv)
     try:
         if args.kernels:

@@ -19,9 +19,11 @@ _spec.loader.exec_module(dl)
 
 
 def _row(value, mb, resolved=None, levers=None, device="TPU v5 lite",
-         rev=None):
+         rev=None, sharding=None):
     r = {"metric": "alexnet_train_images_per_sec_per_chip",
          "value": value, "minibatch": mb, "device": device}
+    if sharding is not None:
+        r["sharding"] = sharding
     if resolved is not None:
         base = {"LRN_POOL": "fused2", "CONV1": "direct", "CONV": "xla",
                 "PALLAS": "on", "MXU": "bf16"}
@@ -100,7 +102,7 @@ class TestVerdicts:
         ])
         key = (dl.canonical(_row(1.0, 128,
                                  resolved={"LRN_POOL": "fused1"})),
-               128, None)
+               128, None, "1x1")
         assert hl[key] == 3500.0
 
     def test_s2d_compared_within_each_pair_context(self):
@@ -120,6 +122,56 @@ class TestVerdicts:
         assert contexts == {"default", "LRN_POOL=fused1"}
 
 
+class TestShardingDiscipline:
+    """A mesh-sharded row and a single-device row measure different
+    programs: they neither average nor pair, and legacy rows without
+    the stamp canonicalize to single-device '1x1'."""
+
+    def test_cross_sharding_rows_do_not_average(self):
+        hl = dl.headline([
+            _row(3000.0, 128, resolved={"LRN_POOL": "fused1"}),
+            _row(9000.0, 128, resolved={"LRN_POOL": "fused1"},
+                 sharding="4x2"),
+        ])
+        cfg = dl.canonical(_row(1.0, 128,
+                                resolved={"LRN_POOL": "fused1"}))
+        assert hl[(cfg, 128, None, "1x1")] == 3000.0
+        assert hl[(cfg, 128, None, "4x2")] == 9000.0
+
+    def test_cross_sharding_rows_do_not_pair(self):
+        hl = dl.headline([
+            _row(3700.0, 128, resolved={"LRN_POOL": "fused1"}),
+            _row(6500.0, 128, resolved={"LRN_POOL": "fused2"},
+                 sharding="4x2"),
+        ])
+        assert dl.compare(hl, "LRN_POOL", "fused2", "fused1") == []
+
+    def test_same_sharding_rows_pair(self):
+        hl = dl.headline([
+            _row(3700.0, 128, resolved={"LRN_POOL": "fused1"},
+                 sharding="4x2"),
+            _row(6500.0, 128, resolved={"LRN_POOL": "fused2"},
+                 sharding="4x2"),
+        ])
+        pairs = dl.compare(hl, "LRN_POOL", "fused2", "fused1")
+        assert len(pairs) == 1 and pairs[0]["sharding"] == "4x2"
+
+    def test_cross_sharding_pairs_do_not_jointly_qualify(self):
+        """A b128 pair at 1x1 plus a b256 pair at 4x2 is two
+        single-batch observations of different programs — together
+        they must not satisfy the both-batches rule (the same
+        discipline _qualified applies across code revisions)."""
+        pairs = [
+            {"minibatch": 128, "rev": "aaa", "sharding": "1x1",
+             "gain_pct": 5.0},
+            {"minibatch": 256, "rev": "aaa", "sharding": "4x2",
+             "gain_pct": -4.0},
+        ]
+        assert dl._qualified(pairs) == []
+        same = [dict(p, sharding="1x1") for p in pairs]
+        assert dl._qualified(same) == same
+
+
 class TestRevisionDiscipline:
     """Rows measured on different code revisions neither average nor
     pair (ADVICE r5 medium): a lever verdict drawn across a code change
@@ -134,8 +186,8 @@ class TestRevisionDiscipline:
         ])
         cfg = dl.canonical(_row(1.0, 128,
                                 resolved={"LRN_POOL": "fused1"}))
-        assert hl[(cfg, 128, "aaa111")] == 3000.0
-        assert hl[(cfg, 128, "bbb222")] == 4000.0
+        assert hl[(cfg, 128, "aaa111", "1x1")] == 3000.0
+        assert hl[(cfg, 128, "bbb222", "1x1")] == 4000.0
 
     def test_cross_revision_rows_do_not_pair(self):
         hl = dl.headline([

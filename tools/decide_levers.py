@@ -114,13 +114,18 @@ def headline(rows):
             continue
         if "cpu" in str(r.get("device", "")).lower():
             continue                      # fallback rows decide nothing
+        # the sharding scheme keys like the minibatch: a "4x2" mesh row
+        # and a "1x1" row measure different programs and must neither
+        # average nor pair (legacy rows predate the stamp and were all
+        # single-device, so they canonicalize to "1x1")
         acc.setdefault((canonical(r), r.get("minibatch"),
-                        r.get("rev")), []).append(r["value"])
+                        r.get("rev"), r.get("sharding") or "1x1"),
+                       []).append(r["value"])
     for key, vals in acc.items():
         if len(vals) > 1:
-            cfg, mb, rev = key
+            cfg, mb, rev, sharding = key
             print(f"  averaging {len(vals)} samples for "
-                  f"{_short(cfg)} b{mb}"
+                  f"{_short(cfg)} b{mb} s{sharding}"
                   + (f" @{rev}" if rev else ""), file=sys.stderr)
     return {k: round(sum(v) / len(v), 1) for k, v in acc.items()}
 
@@ -144,23 +149,24 @@ def _short(cfg):
 
 def compare(hl, key, challenger, baseline):
     """All (minibatch, context) pairs where a challenger-config row has
-    a baseline twin differing ONLY in `key` — same minibatch AND same
+    a baseline twin differing ONLY in `key` — same minibatch, same
     code revision (a pair straddling a code change measures the code
-    change, not the lever)."""
+    change, not the lever), and same sharding scheme (a mesh row and a
+    single-device row measure different programs)."""
     pairs = []
     # rows without a minibatch field sort as 0, not TypeError
-    for (cfg, mb, rev), v in sorted(hl.items(),
-                                    key=lambda kv: (kv[0][1] or 0,
-                                                    kv[0][0],
-                                                    kv[0][2] or "")):
+    for (cfg, mb, rev, sharding), v in sorted(
+            hl.items(), key=lambda kv: (kv[0][1] or 0, kv[0][0],
+                                        kv[0][2] or "", kv[0][3])):
         d = dict(cfg)
         if d.get(key) != challenger:
             continue
         d[key] = baseline
-        bk = (tuple(sorted(d.items())), mb, rev)
+        bk = (tuple(sorted(d.items())), mb, rev, sharding)
         if bk in hl:
             ctx = {k: v2 for k, v2 in cfg if k != key}
-            pairs.append({"minibatch": mb, "rev": rev, "context": _short(
+            pairs.append({"minibatch": mb, "rev": rev,
+                          "sharding": sharding, "context": _short(
                 tuple(sorted(ctx.items()))),
                 # decided against the cfg itself, not the display tag
                 "shipped_context": all(
@@ -194,25 +200,31 @@ def rev_order(rows):
 
 
 def _qualified(pairs, order=None):
-    """Pairs from ONE revision that measured BOTH batches: the
-    two-batch sufficiency rule must hold within one code revision (a
-    b128 pair from rev A plus a b256 pair from rev B is two
-    single-batch observations of different code), and when several
-    revisions each carry a complete A/B, only the newest one decides —
-    an older revision's loss must not veto what the current code
-    measures (nor dilute its mean)."""
-    by_rev = {}
+    """Pairs from ONE (revision, sharding) context that measured BOTH
+    batches: the two-batch sufficiency rule must hold within one code
+    revision AND one sharding scheme (a b128 pair from rev A plus a
+    b256 pair from rev B is two single-batch observations of different
+    code; a b128 1x1 pair plus a b256 4x2 pair is two single-batch
+    observations of different PROGRAMS), and when several contexts
+    each carry a complete A/B, the newest revision decides — with the
+    single-device scheme preferred at equal recency, because lever
+    defaults ship for the single-device program."""
+    by_ctx = {}
     for p in pairs:
-        by_rev.setdefault(p.get("rev"), set()).add(p["minibatch"])
-    full = [rev for rev, mbs in by_rev.items() if len(mbs) >= 2]
+        by_ctx.setdefault((p.get("rev"), p.get("sharding") or "1x1"),
+                          set()).add(p["minibatch"])
+    full = [ctx for ctx, mbs in by_ctx.items() if len(mbs) >= 2]
     if not full:
         return []
     order = order or {}
-    winner = max(full, key=lambda r: (
-        order.get(r, ""),
-        sum(1 for p in pairs if p.get("rev") == r),   # deterministic
-        r or ""))                                     # tie-breakers
-    return [p for p in pairs if p.get("rev") == winner]
+    winner = max(full, key=lambda c: (
+        order.get(c[0], ""),
+        c[1] == "1x1",                               # shipped program
+        sum(1 for p in pairs                         # deterministic
+            if (p.get("rev"), p.get("sharding") or "1x1") == c),
+        c[0] or ""))                                 # tie-breakers
+    return [p for p in pairs
+            if (p.get("rev"), p.get("sharding") or "1x1") == winner]
 
 
 def _win(pairs, order=None):
@@ -301,11 +313,12 @@ def main(argv):
     evidence["CONV1 s2d vs direct"] = pairs
     decisions["CONV1"] = conv1_verdicts(pairs, order)
 
-    for (cfg, mb, rev), v in sorted(hl.items(),
-                                    key=lambda kv: (kv[0][1] or 0,
-                                                    _short(kv[0][0]),
-                                                    kv[0][2] or "")):
+    for (cfg, mb, rev, sharding), v in sorted(
+            hl.items(), key=lambda kv: (kv[0][1] or 0,
+                                        _short(kv[0][0]),
+                                        kv[0][2] or "", kv[0][3])):
         print(f"  {_short(cfg):36s} b{mb}"
+              + (f" s{sharding}" if sharding != "1x1" else "")
               + (f" @{rev}" if rev else "")
               + f": {v} img/s", file=sys.stderr)
     for lever, d in decisions.items():

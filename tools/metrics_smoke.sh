@@ -200,10 +200,15 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
         # precompiled all 4 default buckets off the request path, so
         # the whole predict burst must have added ZERO request-path
         # compiles, and the reload's canary compile records its own
-        # cause — the steady-state contract, as metrics
+        # cause — the steady-state contract, as metrics.  The reload
+        # additionally re-warms the NEW generation from the traffic
+        # shape census (PR 8): 4 buckets for the one observed shape,
+        # minus the canary-seeded one = 3 more cold compiles, all off
+        # the request path
         check(series.get('compiles_total{cause="cold",'
-                         'site="serving.engine"}') == 4.0,
-              "warmup compiled 4 bucket executables (cause=cold)")
+                         'site="serving.engine"}') == 7.0,
+              "warmup (4) + post-reload census re-warm (3) compiled "
+              "as cause=cold")
         check(not any('cause="new_bucket"' in k or 'cause="fallback"' in k
                       for k in series),
               "zero request-path compiles (no new_bucket/fallback "
@@ -212,11 +217,11 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
                          'site="serving.canary"}') == 1.0,
               "reload canary compile recorded (cause=reload)")
         check(series.get('compile_time_ms_count{site="serving.engine"}')
-              == 4.0,
-              "compile_time_ms histogram counted the 4 warmup builds")
+              == 7.0,
+              "compile_time_ms histogram counted the 7 off-path builds")
         check(series.get('executable_cache_misses_total'
-                         '{site="serving.engine"}') == 4.0,
-              "cache misses == warmup builds")
+                         '{site="serving.engine"}') == 7.0,
+              "cache misses == warmup + census re-warm builds")
         check(series.get('executable_cache_hits_total'
                          '{site="serving.engine"}') == float(n_good),
               f"cache hits == {n_good} good predicts")

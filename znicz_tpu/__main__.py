@@ -75,6 +75,18 @@ def make_parser() -> argparse.ArgumentParser:
                    help="host:port of process 0 (multi-host SPMD)")
     p.add_argument("--num-processes", type=int, default=1)
     p.add_argument("--process-id", type=int, default=0)
+    p.add_argument("--mesh", default=None, metavar="DP[,TP]",
+                   help="lay the fused train step out over a "
+                        "(data, model) device mesh, e.g. '8' (pure "
+                        "data parallel) or '4,2' (dp=4, tp=2); "
+                        "implies --fused semantics on wf.train; "
+                        "'1,1' or omitted = single-device jit "
+                        "(docs/distributed.md)")
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="persistent on-disk XLA compilation cache: "
+                        "restarts reuse executables across processes "
+                        "(also: $ZNICZ_COMPILE_CACHE; "
+                        "docs/performance.md)")
     return p
 
 
@@ -100,13 +112,21 @@ def main(argv=None) -> int:
         from .analysis.cli import main as lint_main
         return lint_main(argv[1:])
     args = make_parser().parse_args(argv)
+    if args.mesh and not args.fused:
+        # --mesh implies the fused path (the tick loop runs
+        # single-device and would silently ignore the mesh — an
+        # operator who asked for 4x2 must not benchmark 1x1)
+        print("--mesh implies --fused: taking the fused train path",
+              file=sys.stderr)
+        args.fused = True
     launcher = Launcher(
         workflow=args.workflow, config=args.config, backend=args.backend,
         snapshot=args.snapshot, epochs=args.epochs, fused=args.fused,
         seed=args.seed, overrides=args.overrides,
         coordinator=args.coordinator, num_processes=args.num_processes,
         process_id=args.process_id, profile=args.profile,
-        timeline_jsonl=args.timeline_jsonl)
+        timeline_jsonl=args.timeline_jsonl, mesh=args.mesh,
+        compile_cache_dir=args.compile_cache_dir)
     wf = launcher.run()
     decision = getattr(wf, "decision", None)
     if decision is not None and decision.epoch_metrics:

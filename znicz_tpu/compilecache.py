@@ -1,0 +1,87 @@
+"""Persistent on-disk XLA compilation cache (serve/train startup).
+
+Every process restart and hot reload used to pay a fresh XLA compile
+for executables this host had already built — the measurement half
+landed in PR 7 (``compile_time_ms{site}`` shows multi-second cold
+compiles on every cold start), this module is the elimination half:
+wire ``jax.experimental.compilation_cache`` so executables persist
+across processes.  A second cold start of the same model then records
+a visibly lower ``compile_time_ms`` (the jit still traces, but the
+XLA compile is a disk hit), and a hot-reload canary of an
+already-seen model shape costs milliseconds.
+
+Opt-in by path: ``--compile-cache-dir DIR`` on the ``serve`` and
+train CLIs, or ``$ZNICZ_COMPILE_CACHE`` for deployments that cannot
+touch the launch command.  Off by default — a surprise cache
+directory growing under an operator who never asked for one is worse
+than the compile time.
+
+The min-compile-time / min-entry-size floors are zeroed: JAX's
+defaults skip persisting sub-second compiles, which is every compile
+on the CPU-fallback hosts tier-1 runs on — a cache that only works on
+TPU could not be tested here (SNIPPETS.md [1] initializes the same
+cache before its sharding benchmarks for the same reason).
+
+Never raises into startup: a missing/old JAX API or an unwritable
+directory logs a warning and the process runs uncached, exactly as
+before.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_log = logging.getLogger("znicz.compilecache")
+
+#: the deployment-side channel (same pattern as $ZNICZ_PROFILE_DIR)
+ENV_VAR = "ZNICZ_COMPILE_CACHE"
+
+#: the directory enable() actually activated (introspection/tests)
+_active_dir: str | None = None
+
+
+def dir_from_env() -> str | None:
+    return os.environ.get(ENV_VAR) or None
+
+
+def active_dir() -> str | None:
+    """The cache directory this process persists compiles into, or
+    None when running uncached (surfaced on /statusz)."""
+    return _active_dir
+
+
+def enable(cache_dir: str | None = None) -> str | None:
+    """Activate the persistent cache at ``cache_dir`` (default:
+    ``$ZNICZ_COMPILE_CACHE``).  Returns the activated directory, or
+    None when no directory was configured or activation failed —
+    callers treat None as "running uncached", never as an error."""
+    global _active_dir
+    path = os.fspath(cache_dir) if cache_dir is not None \
+        else dir_from_env()
+    if not path:
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+        from jax.experimental.compilation_cache import (
+            compilation_cache as cc)
+        # zero the persistence floors FIRST: set_cache_dir only routes
+        # writes; with the default 1 s floor every sub-second CPU
+        # compile would silently stay uncached and the second-start
+        # speedup this exists for would never materialize
+        for knob, value in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, value)
+            except Exception:
+                pass        # older JAX without the knob: still caches
+        cc.set_cache_dir(path)
+    except Exception as e:
+        _log.warning("persistent compile cache unavailable (%s); "
+                     "running uncached", e)
+        return None
+    _active_dir = path
+    _log.info("persistent XLA compile cache at %s", path)
+    return path

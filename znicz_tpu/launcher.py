@@ -56,7 +56,9 @@ class Launcher:
                  seed: int | None = None, overrides=(),
                  coordinator: str | None = None, num_processes: int = 1,
                  process_id: int = 0, profile: str | None = None,
-                 timeline_jsonl: str | None = None):
+                 timeline_jsonl: str | None = None,
+                 mesh: str | None = None,
+                 compile_cache_dir: str | None = None):
         self.workflow_spec = workflow
         self.config_path = config
         self.backend = backend
@@ -70,6 +72,8 @@ class Launcher:
         self.process_id = process_id
         self.profile = profile
         self.timeline_jsonl = timeline_jsonl
+        self.mesh = mesh
+        self.compile_cache_dir = compile_cache_dir
         self.workflow = None
 
     @contextlib.contextmanager
@@ -121,11 +125,21 @@ class Launcher:
         and deep paths (``mnist.layers.0.<-.learning_rate``) can only
         resolve once the module's default structures exist."""
         self.init_distributed()
+        # the persistent XLA compile cache must activate before any
+        # jit compile of the run (env default: $ZNICZ_COMPILE_CACHE)
+        from . import compilecache
+        compilecache.enable(self.compile_cache_dir)
         if self.config_path:
             exec_config_file(self.config_path)
         module = load_workflow_module(self.workflow_spec)
         self.module = module
         apply_overrides(self.overrides)
+        if self.mesh is not None:
+            # --mesh lands in the config tree, where run_fused's mesh
+            # adoption defaults from — samples' run() signatures stay
+            # untouched; wins over config files like --set does
+            from .parallel.mesh import parse_mesh_arg
+            root.common.mesh_shape = parse_mesh_arg(self.mesh)
         prng.seed_all(self.seed if self.seed is not None
                       else root.common.get("seed", 1234))
         if not hasattr(module, "run"):

@@ -94,6 +94,20 @@ class KohonenWorkflow(AcceleratedWorkflow):
         from ..parallel.som import FusedSOMTrainer
 
         assert self.initialized, "initialize() first"
+        ms = root.common.get("mesh_shape")
+        if isinstance(ms, str):
+            from ..parallel.mesh import parse_mesh_arg
+            try:
+                ms = parse_mesh_arg(ms)
+            except ValueError:
+                ms = None
+        if ms is not None and tuple(ms) != (1, 1):
+            # the SOM scan has no mesh path: a CLI --mesh must not be
+            # silently ignored (bench.py restamps its rows the same
+            # way for this config)
+            self.warning("the kohonen SOM fused path has no mesh "
+                         "support; --mesh is ignored and training "
+                         "runs single-device")
         tr = FusedSOMTrainer(np.asarray(self.forward.weights.mem),
                              self.forward.shape, workflow=self)
         from ..loader.base import TRAIN

@@ -777,10 +777,14 @@ class FusedTrainer:
                 if w is None:
                     self._param_shardings.append((None, None))
                 else:
+                    # plan_tp_sharding replicates (instead of crashing
+                    # device_put) any layer whose split dim the model
+                    # axis doesn't divide — the ONE policy serving's
+                    # _tp_shardings shares
+                    sh, pidx = mesh_lib.plan_tp_sharding(
+                        mesh, pidx, w.shape)
                     self._param_shardings.append(
-                        (mesh_lib.shard_params(mesh, pidx, w.ndim),
-                         mesh_lib.replicated(mesh)))
-                    pidx += 1
+                        (sh, mesh_lib.replicated(mesh)))
             for j, layer in enumerate(spec.layers):
                 # tied deconv: its velocity must shard like the shared W
                 if layer.kind == "deconv" and "tie" in layer.cfg:
@@ -804,6 +808,8 @@ class FusedTrainer:
         self._train_epoch_fn = None
         self._eval_epoch_fn = None
         self._auto_epoch = 0
+        #: _mesh_place memo: id(source) -> (source, placed-on-mesh)
+        self._placed: dict = {}
 
     # -- epoch-granular compiled drivers ----------------------------------
     def _build(self):
@@ -893,6 +899,19 @@ class FusedTrainer:
             _, ms = jax.lax.scan(body, None, (idx, mask))
             return ms
 
+        # mesh runs pin out_shardings: params/vels come back in the
+        # SAME TP layout they went in (donation can then reuse the
+        # buffers in place), metrics come back replicated — and the
+        # sharded-batch + sharded-params layout is what makes XLA
+        # insert the gradient all-reduce over the ``data`` axis.  The
+        # 1x1 / meshless path passes no shardings at all, so the
+        # single-device jit is byte-identical to the pre-SPMD build.
+        jit_kw: dict = {}
+        ejit_kw: dict = {}
+        if self._batch_sharding is not None:
+            psh = [tuple(s) for s in self._param_shardings]
+            jit_kw["out_shardings"] = (psh, psh, self._repl)
+            ejit_kw["out_shardings"] = self._repl
         # compile accounting (telemetry.compilestats): jit compiles
         # lazily, so the first train/eval call of a run is where the
         # whole-epoch XLA compile actually lands — time it into
@@ -900,10 +919,39 @@ class FusedTrainer:
         # subtract compile from measured step time
         from ..telemetry import compilestats
         self._train_epoch_fn = compilestats.first_call_timed(
-            jax.jit(train_epoch, donate_argnums=(0, 1)),
+            jax.jit(train_epoch, donate_argnums=(0, 1), **jit_kw),
             site="train.fused", cause="cold")
         self._eval_epoch_fn = compilestats.first_call_timed(
-            jax.jit(eval_epoch), site="train.fused", cause="cold")
+            jax.jit(eval_epoch, **ejit_kw), site="train.fused",
+            cause="cold")
+
+    def _mesh_place(self, a):
+        """Re-place a whole-epoch tensor onto the mesh (replicated:
+        every step gathers its global batch from it by index, then the
+        with_sharding_constraint shards the batch over ``data``).  A
+        loader's devmem arrives committed to ONE device, which a mesh
+        jit rejects as incompatible — host arrays and already-placed
+        mesh arrays pass through at no cost.  Meshless: identity.
+
+        The placement memoizes on the SOURCE array object: the fused
+        loop hands the same devmem to train/eval several times per
+        epoch, and re-replicating the whole dataset each call would
+        put O(dataset × devices) transfer traffic on the hot path.
+        The memo holds the source too, so an id() can never alias a
+        collected array — callers must not mutate a placed source in
+        place (loader devmem and the epoch tensors never are)."""
+        if self._batch_sharding is None or a is None:
+            return a
+        if getattr(a, "sharding", None) == self._repl:
+            return a
+        hit = self._placed.get(id(a))
+        if hit is not None and hit[0] is a:
+            return hit[1]
+        placed = jax.device_put(a, self._repl)
+        while len(self._placed) >= 8:     # a handful of epoch tensors
+            self._placed.pop(next(iter(self._placed)))
+        self._placed[id(a)] = (a, placed)
+        return placed
 
     @staticmethod
     def _step_scales(lr_scale, lr_scale_bias, n_steps: int):
@@ -957,6 +1005,7 @@ class FusedTrainer:
         self._auto_epoch = epoch + 1
         if self._train_epoch_fn is None:
             self._build()
+        data, target = self._mesh_place(data), self._mesh_place(target)
         idx, mask, ctrs = self._idx_matrix(np.asarray(indices), batch,
                                            ctr_base)
         scales, scales_b = self._step_scales(lr_scale, lr_scale_bias,
@@ -971,6 +1020,7 @@ class FusedTrainer:
                    sync: bool = True) -> dict:
         if self._eval_epoch_fn is None:
             self._build()
+        data, target = self._mesh_place(data), self._mesh_place(target)
         idx, mask, _ = self._idx_matrix(np.asarray(indices), batch)
         ms = self._eval_epoch_fn(self.params, data, target, idx, mask)
         return {k: np.asarray(v) for k, v in ms.items()} if sync else ms

@@ -18,6 +18,90 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..telemetry.registry import REGISTRY
+
+#: mesh adoption visibility: which (dp, tp) layout the live trainer /
+#: serving engine actually runs on (1 on both axes = single-device jit)
+_axis_size = REGISTRY.gauge(
+    "mesh_axis_size",
+    "size of the live mesh axis, by axis (data | model) and site "
+    "(train | serve); 1/1 means the degenerate single-device path")
+
+
+def parse_mesh_arg(arg: str) -> tuple[int, int]:
+    """``--mesh dp,tp`` → (dp, tp).  A single number means pure data
+    parallelism (``--mesh 8`` == ``--mesh 8,1``)."""
+    parts = [p.strip() for p in str(arg).split(",") if p.strip()]
+    if not 1 <= len(parts) <= 2:
+        raise ValueError(f"--mesh expects 'dp' or 'dp,tp', got {arg!r}")
+    try:
+        dp = int(parts[0])
+        tp = int(parts[1]) if len(parts) == 2 else 1
+    except ValueError:
+        raise ValueError(f"--mesh expects integers, got {arg!r}")
+    if dp < 1 or tp < 1:
+        raise ValueError(f"--mesh axes must be >= 1, got {arg!r}")
+    return dp, tp
+
+
+def resolve_mesh(mesh_shape, site: str = "train") -> Mesh | None:
+    """A ``(dp, tp)`` shape (tuple/list or a ``"dp,tp"`` string) to the
+    Mesh the hot paths run on — THE one mesh-adoption policy:
+
+    * ``None`` / ``(1, 1)`` → ``None``: the degenerate single-device
+      jit, bit-identical to the pre-mesh behavior (tier-1 on a plain
+      CPU host never pays SPMD machinery it didn't ask for);
+    * anything else builds the ``("data", "model")`` mesh over the
+      first ``dp*tp`` devices and raises if the host has fewer — a
+      silently-shrunk mesh would train on a different effective batch
+      layout than the operator asked for.
+
+    Records ``mesh_axis_size{axis, site}`` so /metrics and /statusz can
+    answer "what layout is this process actually running".
+    """
+    if mesh_shape is None:
+        # restamp like the explicit (1, 1) branch: the gauges answer
+        # "what layout is this process RUNNING", and a later meshless
+        # run must not keep reporting an earlier run's mesh
+        _axis_size.set(1, axis="data", site=site)
+        _axis_size.set(1, axis="model", site=site)
+        return None
+    if isinstance(mesh_shape, str):
+        mesh_shape = parse_mesh_arg(mesh_shape)
+    if not 1 <= len(mesh_shape) <= 2:
+        # same contract as the string form: a 3-axis shape must not
+        # silently truncate to a different layout than asked for
+        raise ValueError(f"mesh_shape expects (dp,) or (dp, tp), got "
+                         f"{tuple(mesh_shape)!r}")
+    dp, tp = (int(mesh_shape[0]), int(mesh_shape[1])) \
+        if len(mesh_shape) == 2 else (int(mesh_shape[0]), 1)
+    if dp < 1 or tp < 1:
+        raise ValueError(f"mesh axes must be >= 1, got {(dp, tp)}")
+    if dp == tp == 1:
+        _axis_size.set(1, axis="data", site=site)
+        _axis_size.set(1, axis="model", site=site)
+        return None
+    n_avail = len(jax.devices())
+    if dp * tp > n_avail:
+        # refused mesh: the gauges must NOT record it — they answer
+        # "what layout is this process actually running", and after
+        # this raise the caller is running something else
+        raise ValueError(
+            f"mesh {dp}x{tp} needs {dp * tp} devices but this host "
+            f"exposes {n_avail} (force more on CPU with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    _axis_size.set(dp, axis="data", site=site)
+    _axis_size.set(tp, axis="model", site=site)
+    return make_mesh(n_data=dp, n_model=tp)
+
+
+def mesh_shape_of(mesh: Mesh | None) -> tuple[int, int]:
+    """(dp, tp) of a mesh, (1, 1) for the single-device path — the
+    introspection twin of :func:`resolve_mesh` (healthz/statusz)."""
+    if mesh is None:
+        return (1, 1)
+    return (int(mesh.shape["data"]), int(mesh.shape["model"]))
+
 
 def make_mesh(n_data: int | None = None, n_model: int = 1,
               devices=None) -> Mesh:
@@ -37,6 +121,22 @@ def shard_batch(mesh: Mesh):
 
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
+
+
+def plan_tp_sharding(mesh: Mesh, pidx: int, shape) -> tuple:
+    """THE Megatron adoption step both training (FusedTrainer) and
+    serving (ServingEngine._tp_shardings) use for one weight tensor:
+    returns ``(sharding, next_pidx)``.  Shards via :func:`shard_params`
+    at the current pair parity when the split dim is divisible by the
+    ``model`` axis; otherwise replicates — and breaks the pair, so the
+    next shardable layer restarts at split-output (even parity); its
+    activations were gathered at the replicated layer anyway.  One
+    definition, so training and serving TP layouts can never drift."""
+    n_model = int(mesh.shape["model"])
+    if len(shape) >= 2 \
+            and shape[-1 if pidx % 2 == 0 else -2] % n_model == 0:
+        return shard_params(mesh, pidx, len(shape)), pidx + 1
+    return replicated(mesh), pidx + pidx % 2
 
 
 def shard_params(mesh: Mesh, layer_index: int, ndim: int):

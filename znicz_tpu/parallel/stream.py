@@ -109,16 +109,27 @@ class StreamTrainer(FusedTrainer):
             return eval_minibatch(spec, params, x,
                                   x if x_is_target else t, mask)
 
+        # mesh runs pin out_shardings exactly like FusedTrainer._build:
+        # params/vels (and accumulated grads) keep their TP layout
+        # across steps, metrics come back replicated; meshless passes
+        # nothing and stays the identical single-device jit
+        jit_kw: dict = {}
+        ejit_kw: dict = {}
+        psh = None
+        if self._batch_sharding is not None:
+            psh = [tuple(s) for s in self._param_shardings]
+            jit_kw["out_shardings"] = (psh, psh, self._repl)
+            ejit_kw["out_shardings"] = self._repl
         # compile accounting: same contract as FusedTrainer._build —
         # the first streamed step call pays the XLA compile, recorded
         # under its own site so resident and streaming runs are
         # separable in compile_time_ms
         from ..telemetry import compilestats
         self._step_fn = compilestats.first_call_timed(
-            jax.jit(step, donate_argnums=(0, 1)),
+            jax.jit(step, donate_argnums=(0, 1), **jit_kw),
             site="train.stream", cause="cold")
         self._eval_fn = compilestats.first_call_timed(
-            jax.jit(estep), site="train.stream", cause="cold")
+            jax.jit(estep, **ejit_kw), site="train.stream", cause="cold")
         if self.accum_steps > 1:
             # gradient accumulation over the streamed step loop: grads
             # per micro-batch, one update per group — the host-loop
@@ -143,12 +154,30 @@ class StreamTrainer(FusedTrainer):
             def gadd(acc, grads):
                 return jax.tree_util.tree_map(jnp.add, acc, grads)
 
-            self._grad_fn = jax.jit(gstep)
+            gkw: dict = {}
+            akw: dict = {}
+            ckw: dict = {}
+            if psh is not None:
+                # grads shard like their params (tied-deconv rows were
+                # remapped onto the shared encoder's sharding already)
+                # — but gradient-LESS rows are a bare None, not a
+                # (None, None) tuple, so the sharding tree must carry
+                # None there too (pytree prefix structures must match)
+                from .fused import _grad_slot
+                gsh = [None if _grad_slot(la, self.params, i) is None
+                       else psh[i]
+                       for i, la in enumerate(spec.layers)]
+                gkw["out_shardings"] = (gsh, self._repl)
+                akw["out_shardings"] = (psh, psh)
+                ckw["out_shardings"] = gsh
+            self._grad_fn = jax.jit(gstep, **gkw)
             # donate only the velocity/accumulator buffers: params are
             # read by every layer's decay term before their new value
             # exists, so XLA can't reuse them and warns
-            self._apply_fn = jax.jit(gapply, donate_argnums=(1, 2))
-            self._acc_add_fn = jax.jit(gadd, donate_argnums=(0,))
+            self._apply_fn = jax.jit(gapply, donate_argnums=(1, 2),
+                                     **akw)
+            self._acc_add_fn = jax.jit(gadd, donate_argnums=(0,),
+                                       **ckw)
 
     def _device_put(self, a):
         if self._batch_sharding is not None:

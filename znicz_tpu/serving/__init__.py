@@ -25,7 +25,9 @@ chaos smoke: ``python -m znicz_tpu chaos`` (tools/chaos_smoke.sh).
 from ..resilience.breaker import EngineUnavailable
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import ServingEngine
+from .replicas import EngineReplicaSet
 from .server import ServingServer
 
-__all__ = ["DeadlineExceeded", "EngineUnavailable", "MicroBatcher",
-           "QueueFull", "ServingEngine", "ServingServer"]
+__all__ = ["DeadlineExceeded", "EngineReplicaSet", "EngineUnavailable",
+           "MicroBatcher", "QueueFull", "ServingEngine",
+           "ServingServer"]

@@ -97,10 +97,16 @@ class _Generation:
     native model all come from one generation, so a mid-request swap
     can never mix two models)."""
 
-    def __init__(self, number: int, path: str, layers):
+    def __init__(self, number: int, path: str, layers, shardings=None):
         self.number = number
         self.path = path
         self.layers = layers
+        #: per-layer (w, b) NamedShardings for tensor-parallel serving
+        #: (None = single-device placement) — supplied by the engine
+        #: at construction, before the first params() call, so the
+        #: canary and every bucket executable see one consistent
+        #: layout
+        self.shardings = shardings
         self._lock = threading.Lock()
         self._dev_params = None
         self._native = None
@@ -114,14 +120,23 @@ class _Generation:
     def params(self):
         """The weights, device-resident ONCE per generation and passed
         to every bucket executable as jit arguments — N cached
-        executables must not mean N baked-in copies of the model."""
+        executables must not mean N baked-in copies of the model.
+        With tensor-parallel shardings set, each layer's weight lands
+        pre-sharded over the ``model`` mesh axis (Megatron pairing),
+        so every bucket executable computes on the sharded copies and
+        XLA inserts the activation collectives between layers."""
         with self._lock:
             if self._dev_params is None:
                 import jax
+                # device_put(x, None) is the default placement, so the
+                # single-device case needs no separate branch
+                sh = self.shardings or [(None, None)] * len(self.layers)
                 self._dev_params = [
-                    (None if la.w is None else jax.device_put(la.w),
-                     None if la.b is None else jax.device_put(la.b))
-                    for la in self.layers]
+                    (None if la.w is None
+                     else jax.device_put(la.w, s[0]),
+                     None if la.b is None
+                     else jax.device_put(la.b, s[1]))
+                    for la, s in zip(self.layers, sh)]
             return self._dev_params
 
     def adopt_native(self, native) -> None:
@@ -309,13 +324,17 @@ class ServingEngine:
     def __init__(self, model, *, backend: str = "auto",
                  buckets=DEFAULT_BUCKETS, cache_size: int = 8,
                  retry: RetryPolicy | None = None,
-                 breaker: CircuitBreaker | None = None):
+                 breaker: CircuitBreaker | None = None,
+                 tp: int = 1):
         if not buckets or list(buckets) != sorted(set(int(b)
                                                       for b in buckets)):
             raise ValueError(f"buckets must be unique ascending ints, "
                              f"got {buckets!r}")
+        if not isinstance(tp, int) or isinstance(tp, bool) or tp < 1:
+            raise ValueError(f"tp must be a positive int, got {tp!r}")
         self.buckets = tuple(int(b) for b in buckets)
         self.cache_size = int(cache_size)
+        self.tp = tp
         self._tmpdir = None
         if isinstance(model, (str, os.PathLike)):
             path = os.fspath(model)
@@ -330,12 +349,29 @@ class ServingEngine:
         # shape crash under traffic (torn manifests heal, legacy
         # manifest-less files deep-parse; docs/durability.md)
         durability.verify_or_heal(path)
-        self._gen = _Generation(1, path, read_znn(path))
         if backend == "auto":
             backend = "jax" if _jax_usable() else "native"
         if backend not in ("jax", "native"):
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
+        # tensor-parallel forward (docs/distributed.md): a (1, tp)
+        # ("data", "model") mesh; weights of wide fc/conv layers land
+        # pre-sharded (Megatron pairing, same rule as training's
+        # shard_params), inputs replicate, XLA inserts the activation
+        # collectives.  tp=1 (or the native backend, which has no
+        # devices to shard over) is exactly the single-device path.
+        self._mesh = None
+        self._x_sharding = None
+        if tp > 1:
+            if backend != "jax":
+                raise ValueError("tensor-parallel serving (tp > 1) "
+                                 "needs the jax backend")
+            from ..parallel import mesh as mesh_lib
+            self._mesh = mesh_lib.resolve_mesh((1, tp), site="serve")
+            self._x_sharding = mesh_lib.replicated(self._mesh)
+        layers = read_znn(path)
+        self._gen = _Generation(1, path, layers,
+                                self._tp_shardings(layers))
         if backend == "native":
             from ..export import NativeEngine
             self._gen.adopt_native(NativeEngine().load(path))
@@ -369,6 +405,49 @@ class ServingEngine:
         self._last_sample_shape: tuple | None = None
         _generation.set(1)
 
+    # -- tensor parallelism -----------------------------------------------
+    @property
+    def mesh_shape(self) -> tuple[int, int]:
+        """(data, model) axis sizes of the serving layout — (1, 1) on
+        the single-device path (healthz/statusz introspection)."""
+        return (1, self.tp if self._mesh is not None else 1)
+
+    def _tp_shardings(self, layers):
+        """Per-layer (w, b) NamedShardings for one generation, or None
+        without a mesh.  Megatron pairing over the PARAMETERIZED
+        fc/conv/deconv layers only (same alternate-axis rule as
+        training's ``shard_params``); everything else — including the
+        lrn pseudo-weights that store hyperparameters in ``lay.w`` —
+        replicates.  Biases replicate like training's."""
+        if self._mesh is None:
+            return None
+        from ..parallel import mesh as mesh_lib
+        repl = mesh_lib.replicated(self._mesh)
+        shardings, pidx = [], 0
+        for lay in layers:
+            w = lay.w
+            if lay.kind in ("fc", "conv", "deconv") and w is not None \
+                    and getattr(w, "ndim", 0) >= 2:
+                # plan_tp_sharding = THE shared Megatron policy (split
+                # dim by pair parity, replicate + pair-restart when the
+                # model axis doesn't divide) — one definition with the
+                # trainer, so the two layouts can never drift
+                sh, pidx = mesh_lib.plan_tp_sharding(self._mesh, pidx,
+                                                     w.shape)
+                shardings.append((sh, repl))
+            else:
+                shardings.append((repl, repl))
+        return shardings
+
+    def _replicate_input(self, x):
+        """Pin a host batch to the replicated layout before a
+        tensor-parallel executable consumes it — a bare np array next
+        to mesh-committed params would fail jit's device check."""
+        if self._x_sharding is None:
+            return x
+        import jax
+        return jax.device_put(x, self._x_sharding)
+
     # -- generation access ------------------------------------------------
     def _current(self) -> _Generation:
         """The generation currently serving (locked read: reload swaps
@@ -394,7 +473,11 @@ class ServingEngine:
     def _device_key(self) -> str:
         import jax
         d = jax.devices()[0]
-        return f"{d.platform}:{getattr(d, 'id', 0)}"
+        key = f"{d.platform}:{getattr(d, 'id', 0)}"
+        # the TP layout is part of the executable's identity: a tp=2
+        # and a tp=1 engine in one process must never classify each
+        # other's compiles as already-warm shapes
+        return key if self._mesh is None else f"{key}:tp{self.tp}"
 
     def _shape_key(self, bucket, sample_shape, dtype) -> tuple:
         """The generation-independent part of an executable-cache key
@@ -494,8 +577,62 @@ class ServingEngine:
             x = np.zeros((int(bucket),) + shape, np.dtype(dtype))
             # force the lazy jit NOW — an un-invoked executable would
             # still pay its compile on the first request
-            fn(gen.params(), x)
+            fn(gen.params(), self._replicate_input(x))
             built += 1
+        return built
+
+    def warmup_from_census(self, recorder=None, top: int = 4,
+                           fallback_shape=None) -> int:
+        """Census-driven warmup: precompile the bucket ladder for the
+        sample shapes live traffic ACTUALLY sent — the flight
+        recorder's request records carry each request's shape, so a
+        reload or a restart-with-state can precompile what the
+        operator could only guess at with ``--warmup-shape``.  The
+        ``top`` most frequent shapes warm (shape cardinality is
+        client-controlled; warming every shape ever probed would
+        compile without bound); with no census yet (fresh process, no
+        traffic) ``fallback_shape`` warms instead — the operator
+        guess remains the bootstrap.  Returns executables built."""
+        if self.backend != "jax":
+            return 0
+        from ..telemetry import flightrecorder
+        rec = recorder if recorder is not None else flightrecorder.RECORDER
+        # the warm set must FIT the LRU: warming top*len(buckets)
+        # executables into a smaller cache would evict its own entries
+        # — and the reload-seeded canary executable, whose slot stays
+        # reserved here — re-exposing the very request-path compiles
+        # this exists to prevent.  With cache_size <= len(buckets)
+        # even ONE shape overflows, so census warming skips entirely
+        # (the warning below names the knob)
+        fit = (self.cache_size - 1) // len(self.buckets)
+        top = min(max(0, int(top)), max(0, fit))
+        census = rec.shape_census()
+        shapes = [s for s, _ in census[:top]]
+        if len(census) > top:
+            # never a silent cap: a dropped shape's traffic will pay
+            # request-path compiles after the next swap — tell the
+            # operator which, and what knob fixes it
+            import logging
+            logging.getLogger("ServingEngine").warning(
+                "census warmup: %d observed shape(s) beyond the "
+                "cache-fit cap of %d not warmed (%s...); raise "
+                "--cache-size to cover them",
+                len(census) - top, top,
+                [list(s) for s, _ in census[top:top + 3]])
+        if not shapes and fallback_shape is not None:
+            # the OPERATOR's shape fails loud: a --warmup-shape typo
+            # must error at startup, not silently warm nothing and
+            # hand every first request a compile spike
+            return self.warmup(tuple(int(d) for d in fallback_shape))
+        built = 0
+        for s in shapes:
+            try:
+                built += self.warmup(s)
+            except Exception:
+                # the census records shapes CLIENTS sent, including
+                # geometry the model rejects with a 400 — a junk shape
+                # must not abort warming the legitimate ones
+                continue
         return built
 
     # -- degraded path ----------------------------------------------------
@@ -530,7 +667,7 @@ class ServingEngine:
     def _forward_once(self, fn, gen: _Generation,
                       padded: np.ndarray) -> np.ndarray:
         faults.inject("engine.forward")
-        return np.asarray(fn(gen.params(), padded))
+        return np.asarray(fn(gen.params(), self._replicate_input(padded)))
 
     def _count_retry(self, attempt, exc) -> None:
         with self._lock:
@@ -644,7 +781,8 @@ class ServingEngine:
                 # off the request path — cause="reload", and the swap
                 # seeds the executable so traffic never re-pays it
                 with compilestats.timed("serving.canary", "reload"):
-                    y = np.asarray(fn(gen.params(), x))
+                    y = np.asarray(fn(gen.params(),
+                                      self._replicate_input(x)))
                 gen.warmed = ((gen.number,)
                               + self._shape_key(bucket, shape, x.dtype),
                               fn)
@@ -679,8 +817,12 @@ class ServingEngine:
             candidate = native = None
             try:
                 durability.verify_or_heal(target)
-                candidate = _Generation(old.number + 1, target,
-                                        read_znn(target))
+                # TP layout rides construction, before the first
+                # params() touch: the canary compile must match the
+                # serving executables
+                layers = read_znn(target)
+                candidate = _Generation(old.number + 1, target, layers,
+                                        self._tp_shardings(layers))
                 if self.backend == "native":
                     from ..export import NativeEngine
                     native = NativeEngine().load(target)
@@ -715,6 +857,17 @@ class ServingEngine:
                     self._mark_compiled_locked(key[1:])
             if outcome == "ok":
                 _generation.set(candidate.number)
+                # census-driven warmup belongs to the reload itself,
+                # not to any one caller: POST /admin/reload, SIGHUP,
+                # and a promotion controller's direct engine.reload
+                # must all leave the new generation warm for the
+                # shapes live traffic has been sending — the canary
+                # only seeded ONE (shape, bucket) executable
+                # (docs/serving.md zero-post-swap-compiles contract)
+                try:
+                    self.warmup_from_census()
+                except Exception:
+                    pass   # warmup is an optimization, never a failure
             record = {"outcome": outcome, "error": error,
                       "path": target, "canary": canary_result,
                       "generation": (candidate.number
@@ -769,6 +922,8 @@ class ServingEngine:
         m.setdefault("retries", 0)
         m["backend"] = self.backend
         m["buckets"] = list(self.buckets)
+        m["tensor_parallel"] = self.tp if self._mesh is not None else 1
+        m["mesh"] = "x".join(str(d) for d in self.mesh_shape)
         m["breaker"] = self.breaker.metrics()
         m["resilience_state"] = self.resilience_state()
         return m

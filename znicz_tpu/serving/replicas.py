@@ -1,0 +1,305 @@
+"""EngineReplicaSet: N data-parallel serving engines behind one front.
+
+The tensor-parallel engine (``ServingEngine(tp=...)``) is the latency
+lever; this is the throughput one — the "millions of users" story is N
+independent engine replicas behind the existing micro-batcher, each
+with its OWN circuit breaker, retry policy, executable cache, and
+model generation, so one replica's failure domain never takes the
+fleet down:
+
+* **round-robin dispatch** — each batched forward goes to the next
+  replica in rotation, skipping *sick* replicas (breaker open): an
+  open breaker means that replica's JAX engine is refusing work, so
+  routing around it keeps tail latency flat while its cooldown runs;
+* **sick-replica ejection with re-admission** — ejection is computed
+  from live breaker state per dispatch, so a replica that heals
+  (half-open probe succeeds, breaker closes) rejoins rotation with no
+  operator action;
+* **no empty-set failure** — when EVERY replica is sick the dispatch
+  falls through to the scheduled replica anyway: a breaker-open engine
+  still serves via its native CPU fallback (degraded 200s) or raises
+  ``EngineUnavailable`` (503 + Retry-After), never a hang — the same
+  degradation contract a single engine honors;
+* **rolling reload** — ``reload`` swaps replicas one at a time, so
+  traffic keeps flowing on not-yet-swapped generations throughout and
+  a verify/canary failure stops the roll with the remaining replicas
+  untouched.
+
+The set quacks like a single :class:`ServingEngine` where the HTTP
+front (``ServingServer``), ``/statusz`` and the serve CLI touch one —
+``predict``/``metrics``/``reload``/``warmup``/``resilience_state``/
+``close`` — so ``--replicas N`` is a drop-in topology change.
+
+On a multi-chip host each replica would pin its own device subset; on
+the CPU-fallback hosts tier-1 runs on, replicas share the host devices
+and the isolation being bought is the failure domain (breaker, cache,
+generation), not the FLOPs.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..telemetry.registry import REGISTRY
+from .engine import ServingEngine
+
+_replica_count = REGISTRY.gauge(
+    "replica_count",
+    "engine replicas configured in this process's EngineReplicaSet")
+_replica_healthy = REGISTRY.gauge(
+    "replica_healthy",
+    "replicas currently in rotation (circuit breaker not open)")
+_dispatches = REGISTRY.counter(
+    "replica_dispatches_total",
+    "batched forwards dispatched, by replica index")
+_ejections = REGISTRY.counter(
+    "replica_ejections_total",
+    "dispatches that skipped a replica because its breaker was open, "
+    "by (skipped) replica index")
+
+
+class EngineReplicaSet:
+    """N data-parallel :class:`ServingEngine` replicas, round-robin
+    behind one ``predict`` — see the module docstring.
+
+    ``factory(i)`` builds replica ``i`` and must return a FRESH engine
+    per call (a shared breaker/retry across replicas would collapse
+    the failure domains this set exists to separate); the convenience
+    classmethod :meth:`of` covers the common "same model, default
+    isolation" case."""
+
+    def __init__(self, factory, n_replicas: int):
+        if not isinstance(n_replicas, int) or isinstance(
+                n_replicas, bool) or n_replicas < 1:
+            raise ValueError(f"n_replicas must be a positive int, got "
+                             f"{n_replicas!r}")
+        self.replicas: list[ServingEngine] = []
+        try:
+            for i in range(n_replicas):
+                self.replicas.append(factory(i))
+            if len({id(e) for e in self.replicas}) != n_replicas:
+                raise ValueError("factory returned the same engine "
+                                 "object for two replica slots")
+        except Exception:
+            # no half-built fleet leaks — covers factory failures AND
+            # the duplicate-object validation above
+            for eng in {id(e): e for e in self.replicas}.values():
+                try:
+                    eng.close()
+                except Exception:
+                    pass
+            raise
+        self._lock = threading.Lock()
+        self._next = 0
+        #: set-level single-flight: two concurrent rolling reloads
+        #: (e.g. a promotion controller's direct engine.reload racing
+        #: an operator's /admin/reload) would interleave across
+        #: replicas and could leave the fleet permanently serving two
+        #: different models — same contract as a single engine's
+        #: _reload_lock
+        self._reload_lock = threading.Lock()
+        _replica_count.set(n_replicas)
+        self._update_health_gauge()
+
+    @classmethod
+    def of(cls, model, n_replicas: int, **engine_kw) -> \
+            "EngineReplicaSet":
+        """Replicas of one ``.znn`` with per-replica default breaker /
+        retry / cache isolation.  Passing a shared ``breaker`` or
+        ``retry`` object through ``engine_kw`` is rejected — build
+        fresh ones in a custom ``factory`` instead."""
+        if "breaker" in engine_kw or "retry" in engine_kw:
+            raise ValueError("breaker/retry objects cannot be shared "
+                             "across replicas; use the factory "
+                             "constructor to build one per replica")
+        return cls(lambda i: ServingEngine(model, **engine_kw),
+                   n_replicas)
+
+    # -- dispatch ---------------------------------------------------------
+    def _update_health_gauge(self) -> None:
+        _replica_healthy.set(
+            sum(1 for e in self.replicas
+                if e.breaker.state != "open"))
+
+    def _pick(self) -> int:
+        """Next replica index: round-robin over breaker-not-open
+        replicas; all-sick falls through to the scheduled one (its own
+        degraded path still answers)."""
+        n = len(self.replicas)
+        with self._lock:
+            start = self._next
+            self._next = (self._next + 1) % n
+        for hop in range(n):
+            idx = (start + hop) % n
+            if self.replicas[idx].breaker.state != "open":
+                if hop:
+                    # count each sick replica we routed around
+                    for skipped in range(hop):
+                        _ejections.inc(
+                            replica=str((start + skipped) % n))
+                return idx
+        return start
+
+    def predict(self, x):
+        idx = self._pick()
+        _dispatches.inc(replica=str(idx))
+        try:
+            return self.replicas[idx].predict(x)
+        finally:
+            self._update_health_gauge()
+
+    # -- ServingEngine-compatible surface ---------------------------------
+    @property
+    def backend(self) -> str:
+        return self.replicas[0].backend
+
+    @property
+    def buckets(self):
+        return self.replicas[0].buckets
+
+    @property
+    def n_layers(self) -> int:
+        return self.replicas[0].n_layers
+
+    @property
+    def layers(self):
+        return self.replicas[0].layers
+
+    @property
+    def tp(self) -> int:
+        return self.replicas[0].tp
+
+    @property
+    def mesh_shape(self) -> tuple[int, int]:
+        return self.replicas[0].mesh_shape
+
+    @property
+    def breaker(self):
+        """The healthiest replica's breaker (the front consults it for
+        Retry-After when the WHOLE set is refusing) — per-replica
+        state lives in :meth:`replica_status`."""
+        for eng in self.replicas:
+            if eng.breaker.state != "open":
+                return eng.breaker
+        return self.replicas[0].breaker
+
+    @property
+    def generation(self) -> int:
+        """The fleet's trailing generation: a rolling reload is done
+        only when the LAST replica swapped."""
+        return min(e.generation for e in self.replicas)
+
+    def resilience_state(self) -> str:
+        """Best state any replica can offer: ``ok`` while at least one
+        replica's circuit is closed (the set routes around the rest),
+        ``degraded``/``open`` only when every replica is down to its
+        fallback / refusing."""
+        states = [e.resilience_state() for e in self.replicas]
+        for want in ("ok", "degraded"):
+            if want in states:
+                return want
+        return "open"
+
+    def warmup(self, sample_shape, dtype=None, buckets=None) -> int:
+        kw = {} if dtype is None else {"dtype": dtype}
+        return sum(e.warmup(sample_shape, buckets=buckets, **kw)
+                   for e in self.replicas)
+
+    def warmup_from_census(self, recorder=None, top: int = 4,
+                           fallback_shape=None) -> int:
+        return sum(e.warmup_from_census(recorder=recorder, top=top,
+                                        fallback_shape=fallback_shape)
+                   for e in self.replicas)
+
+    # -- rolling reload ---------------------------------------------------
+    def reload(self, path: str | None = None, *,
+               canary: bool = True) -> dict:
+        """Rolling swap, one replica at a time; the first failure
+        stops the roll (the remaining replicas keep their generation
+        — a mixed-generation fleet beats a fleet-wide bad swap).
+        Returns the aggregate record shaped like a single engine's.
+        Single-flight at the SET level, like a single engine: a
+        concurrent roll raises :class:`~znicz_tpu.serving.engine.
+        ReloadInProgress` instead of interleaving models across
+        replicas."""
+        from .engine import ReloadInProgress
+        if not self._reload_lock.acquire(blocking=False):
+            raise ReloadInProgress("a rolling reload is already "
+                                   "running on this replica set")
+        try:
+            outcome, error, records = "ok", None, []
+            for i, eng in enumerate(self.replicas):
+                # each engine's reload census-warms its own new
+                # generation internally, so a partial roll never
+                # leaves an already-swapped replica paying
+                # request-path compiles
+                rec = eng.reload(path, canary=canary)
+                records.append({"replica": i, **rec})
+                if rec["outcome"] != "ok":
+                    outcome, error = rec["outcome"], rec.get("error")
+                    break
+            return {"outcome": outcome, "error": error,
+                    "generation": self.generation, "replicas": records}
+        finally:
+            self._reload_lock.release()
+
+    def reload_status(self) -> dict:
+        per = [e.reload_status() for e in self.replicas]
+        # the front merges this into /healthz: keep a single engine's
+        # keys (trailing generation, worst last outcome) plus detail
+        worst = None
+        for st in per:
+            last = st.get("last_reload")
+            if last and (worst is None or last["outcome"] != "ok"):
+                worst = last
+                if last["outcome"] != "ok":
+                    break
+        return {"model_generation": self.generation,
+                "last_reload": worst,
+                "replica_generations": [st["model_generation"]
+                                        for st in per]}
+
+    # -- introspection ----------------------------------------------------
+    def replica_status(self) -> list:
+        """Per-replica one-liners for /healthz and /statusz: index,
+        generation, breaker state, resilience state — the view that
+        makes a degraded replica visible without grepping logs."""
+        return [{"replica": i, "generation": e.generation,
+                 "breaker": e.breaker.state,
+                 "state": e.resilience_state()}
+                for i, e in enumerate(self.replicas)]
+
+    def metrics(self) -> dict:
+        per = [e.metrics() for e in self.replicas]
+        agg: dict = {}
+        for m in per:
+            for k, v in m.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                agg[k] = agg.get(k, 0) + v
+        # non-additive fields follow the single-engine shape
+        agg["generation"] = self.generation
+        agg["backend"] = self.backend
+        agg["buckets"] = list(self.buckets)
+        agg["tensor_parallel"] = per[0].get("tensor_parallel", 1)
+        agg["mesh"] = per[0].get("mesh", "1x1")
+        agg["breaker"] = self.breaker.metrics()
+        agg["resilience_state"] = self.resilience_state()
+        agg["replica_count"] = len(self.replicas)
+        agg["replicas_healthy"] = sum(
+            1 for e in self.replicas if e.breaker.state != "open")
+        agg["replicas"] = self.replica_status()
+        return agg
+
+    def close(self) -> None:
+        # close EVERY replica even if one raises (each owns tmpdirs /
+        # native handles); the first failure surfaces after the sweep
+        first = None
+        for eng in self.replicas:
+            try:
+                eng.close()
+            except Exception as e:
+                if first is None:
+                    first = e
+        if first is not None:
+            raise first

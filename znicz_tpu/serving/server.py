@@ -486,6 +486,9 @@ class ServingServer:
         # outcomes, not crashes); anything else must not kill the
         # worker silently either — the server keeps serving regardless
         try:
+            # census-driven warmup of the new generation rides the
+            # engine reload itself (every reload channel — admin,
+            # SIGHUP, promotion controller — gets it uniformly)
             self.engine.reload(model)
         except Exception:
             import logging
@@ -508,6 +511,15 @@ class ServingServer:
         # generation + last reload outcome: a rollout driver polls
         # /healthz to learn whether its /admin/reload landed
         out.update(self.engine.reload_status())
+        # SPMD topology: the serving mesh (1x1 = single device) and,
+        # behind a replica set, every replica's breaker — a degraded
+        # replica is visible from the probe a balancer already makes
+        mesh = getattr(self.engine, "mesh_shape", None)
+        if mesh is not None:
+            out["mesh"] = "x".join(str(d) for d in mesh)
+        replica_status = getattr(self.engine, "replica_status", None)
+        if replica_status is not None:
+            out["replicas"] = replica_status()
         ps = self.promotion_status
         if ps is not None:
             try:
@@ -638,7 +650,26 @@ def main(argv=None) -> int:
                         "sample shape (e.g. '4' or '28,28,1') BEFORE "
                         "accepting traffic, so the compiles record as "
                         "cause=cold instead of ambushing first "
-                        "requests as new_bucket latency spikes")
+                        "requests as new_bucket latency spikes; once "
+                        "traffic flows, reload warmup is driven by "
+                        "the observed request-shape census instead "
+                        "of this guess")
+    p.add_argument("--tp", type=int, default=1, metavar="N",
+                   help="tensor-parallel forward over N devices on "
+                        "the (1, N) serving mesh: wide fc/conv "
+                        "weights shard Megatron-style, XLA inserts "
+                        "the activation collectives "
+                        "(docs/distributed.md)")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="N data-parallel engine replicas behind the "
+                        "batcher, each with its own breaker, cache "
+                        "and generation; round-robin dispatch routes "
+                        "around a replica whose breaker is open")
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="persistent on-disk XLA compilation cache: "
+                        "restarts and hot reloads reuse executables "
+                        "across processes (also: "
+                        "$ZNICZ_COMPILE_CACHE; docs/performance.md)")
     p.add_argument("--admin-token", default=None,
                    help="require this token (X-Admin-Token header) on "
                         "POST /admin/reload; defaults to "
@@ -669,17 +700,36 @@ def main(argv=None) -> int:
     from .. import promotion as _promotion  # noqa: F401
     from ..resilience.breaker import CircuitBreaker
     from ..resilience.retry import RetryPolicy
+    # the persistent XLA compile cache must be live before any warmup
+    # or request-path jit — this is what makes a restart's cold
+    # compiles disk hits (docs/performance.md)
+    from .. import compilecache
+    compilecache.enable(args.compile_cache_dir)
     buckets = tuple(int(b) for b in args.buckets.split(","))
-    engine = ServingEngine(
-        args.model, backend=args.backend,
-        buckets=buckets, cache_size=args.cache_size,
-        # same delay budget as the engine's own default: the retry
-        # sleeps ride the single dispatch thread, so they must stay
-        # well under the batcher's cadence even at high --retry-attempts
-        retry=RetryPolicy(max_attempts=args.retry_attempts,
-                          base_delay_s=0.02, max_delay_s=0.25),
-        breaker=CircuitBreaker(failure_threshold=args.breaker_threshold,
-                               cooldown_s=args.breaker_cooldown_s))
+
+    def _make_engine(_i):
+        # per-replica construction: breaker/retry/cache must be FRESH
+        # per engine — a shared breaker would collapse the failure
+        # domains --replicas exists to separate.  Same delay budget as
+        # the engine's own default: the retry sleeps ride the single
+        # dispatch thread, so they must stay well under the batcher's
+        # cadence even at high --retry-attempts
+        return ServingEngine(
+            args.model, backend=args.backend,
+            buckets=buckets, cache_size=args.cache_size, tp=args.tp,
+            retry=RetryPolicy(max_attempts=args.retry_attempts,
+                              base_delay_s=0.02, max_delay_s=0.25),
+            breaker=CircuitBreaker(
+                failure_threshold=args.breaker_threshold,
+                cooldown_s=args.breaker_cooldown_s))
+
+    if args.replicas < 1:
+        p.error("--replicas must be >= 1")
+    if args.replicas > 1:
+        from .replicas import EngineReplicaSet
+        engine = EngineReplicaSet(_make_engine, args.replicas)
+    else:
+        engine = _make_engine(0)
     from ..telemetry import profiler
     profile_dir = args.profile_dir or profiler.dir_from_env()
     server = None
@@ -702,8 +752,13 @@ def main(argv=None) -> int:
         from ..telemetry import debugz as _debugz
         _debugz.install_stack_dump()
         if args.warmup_shape:
+            # census-driven with the operator shape as bootstrap: a
+            # fresh process has no census yet, so this warms
+            # --warmup-shape; a process restarted with a warm
+            # persistent compile cache replays those compiles as disk
+            # hits either way
             shape = tuple(int(d) for d in args.warmup_shape.split(","))
-            n = engine.warmup(shape)
+            n = engine.warmup_from_census(fallback_shape=shape)
             print(f"warmup: {n} bucket executable(s) compiled for "
                   f"sample shape {shape} (cause=cold, off the "
                   f"request path)", flush=True)
@@ -718,8 +773,10 @@ def main(argv=None) -> int:
                                max_body_mb=args.max_body_mb,
                                admin_token=args.admin_token)
         server.start()
+        mesh = "x".join(str(d) for d in engine.mesh_shape)
         print(f"serving {args.model} [{engine.backend}] at "
-              f"{server.url} (POST /predict, GET /healthz, "
+              f"{server.url} (mesh {mesh}, replicas {args.replicas}; "
+              f"POST /predict, GET /healthz, "
               f"GET /metrics, GET /statusz, GET /debug/*)", flush=True)
         # explicit shutdown signaling with a short-tick wait: Python
         # runs signal handlers on the main thread only when it next

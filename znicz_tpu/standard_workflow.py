@@ -186,6 +186,7 @@ class StandardWorkflowBase(AcceleratedWorkflow):
 
     # -- fused execution (the TPU hot path) -------------------------------
     def train(self, fused: bool = False, mesh=None,
+              mesh_shape=None,
               max_epochs: int | None = None,
               compute_dtype: str | None = None,
               storage_dtype: str | None = None,
@@ -205,6 +206,15 @@ class StandardWorkflowBase(AcceleratedWorkflow):
         tree (``root.common.compute_dtype``/``storage_dtype``) so every
         sample and the two-file CLI reach the mixed-precision knobs via
         config files or ``--set`` without per-sample plumbing.
+
+        ``mesh_shape`` = ``(dp, tp)`` (or ``"dp,tp"``) lays the fused
+        step out over a ``("data", "model")`` device mesh —
+        data-parallel batches, Megatron-paired tensor-parallel weights,
+        gradient all-reduce inserted by XLA (docs/distributed.md).  It
+        defaults from ``root.common.mesh_shape`` (the CLI ``--mesh``
+        lands there), and ``(1, 1)``/unset degenerates to exactly
+        today's single-device jit.  An explicit prebuilt ``mesh`` still
+        wins.
 
         Profiling (znicz_tpu.telemetry.profiler): ``profile_dir`` alone
         captures the whole run; with ``profile_every=N`` it captures a
@@ -241,7 +251,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             timeline_jsonl = _flightrecorder.timeline_path_from_env()
         if fused:
             if self.device.is_xla:
-                return self.run_fused(mesh=mesh, max_epochs=max_epochs,
+                return self.run_fused(mesh=mesh, mesh_shape=mesh_shape,
+                                      max_epochs=max_epochs,
                                       compute_dtype=compute_dtype,
                                       storage_dtype=storage_dtype,
                                       profile_dir=profile_dir,
@@ -253,6 +264,9 @@ class StandardWorkflowBase(AcceleratedWorkflow):
                                       timeline_jsonl=timeline_jsonl)
             self.warning("fused path needs an XLA device; falling back "
                          "to the unit-graph tick loop")
+        if mesh is not None or mesh_shape is not None:
+            self.warning("mesh-sharded execution is a fused-path "
+                         "feature; the tick loop runs single-device")
         if timeline_jsonl is not None:
             self.warning("the per-step timeline (timeline_jsonl) is a "
                          "fused-path feature; the tick loop records "
@@ -268,7 +282,8 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             self.decision.max_epochs = max_epochs
         return self.run()
 
-    def run_fused(self, mesh=None, max_epochs: int | None = None,
+    def run_fused(self, mesh=None, mesh_shape=None,
+                  max_epochs: int | None = None,
                   compute_dtype: str | None = None,
                   storage_dtype: str | None = None,
                   profile_dir: str | None = None,
@@ -302,6 +317,17 @@ class StandardWorkflowBase(AcceleratedWorkflow):
             ctx = _profiler.trace(profile_dir)
         else:
             ctx = contextlib.nullcontext()
+        if mesh is None:
+            # mesh adoption policy (parallel/mesh.resolve_mesh): an
+            # explicit mesh wins; else a (dp, tp) shape — argument or
+            # the config tree's root.common.mesh_shape, which is where
+            # the CLI --mesh lands — builds one; (1, 1)/unset stays
+            # the single-device jit so plain-CPU tier-1 never changes
+            from .config import root as _root
+            from .parallel import mesh as _mesh_lib
+            mesh = _mesh_lib.resolve_mesh(
+                mesh_shape if mesh_shape is not None
+                else _root.common.get("mesh_shape"), site="train")
         try:
             with ctx:
                 return self._run_fused_body(mesh, max_epochs,

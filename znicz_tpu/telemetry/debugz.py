@@ -128,6 +128,8 @@ def statusz_text(server=None, *, recorder=None, extra: dict | None = None
     lines.append(f"rev: {rev or 'unknown'}")
     lines.append(f"uptime_s: {process_uptime_s():.1f} "
                  f"(started at {started_at():.3f})")
+    from .. import compilecache
+    lines.append(f"compile_cache: {compilecache.active_dir() or 'off'}")
     if extra:
         lines.append(_fmt_kv(extra))
     if server is not None:
@@ -140,6 +142,16 @@ def statusz_text(server=None, *, recorder=None, extra: dict | None = None
             "generation": em.get("generation"),
             "buckets": ",".join(str(b) for b in eng.buckets),
             "cached_executables": em.get("cached_executables")}))
+        mesh = em.get("mesh")
+        if mesh:
+            # the SPMD topology: serving mesh (1x1 = single device)
+            # and, behind a replica set, one line per replica so a
+            # degraded one is visible without grepping logs
+            lines.append(f"mesh: {mesh}  "
+                         f"tp={em.get('tensor_parallel', 1)}  "
+                         f"replicas={em.get('replica_count', 1)}")
+        for r in (em.get("replicas") or []):
+            lines.append("replica: " + _fmt_kv(r))
         breaker = em.get("breaker") or {}
         lines.append("breaker: " + _fmt_kv(breaker))
         last = (eng.reload_status() or {}).get("last_reload")

@@ -232,6 +232,30 @@ class FlightRecorder:
                       key=lambda r: r.get("duration_ms") or 0.0,
                       reverse=True)[:n]
 
+    def shape_census(self) -> list:
+        """Observed SERVED request sample shapes, most frequent first:
+        ``[(shape_tuple, count), ...]`` over the retained request
+        records (recent + slow rings).  The serving engine's
+        census-driven warmup reads this to precompile what traffic
+        actually sends instead of an operator-guessed
+        ``--warmup-shape`` — bounded by construction because the
+        rings are.  Failed requests are excluded: a client hammering
+        a wrong-geometry shape (every attempt a 400) must not occupy
+        warm slots, let alone outrank the real traffic shape."""
+        census: collections.Counter = collections.Counter()
+        with self._lock:
+            pool = {id(r): r for r in self._recent}
+            pool.update((id(r), r) for r in self._slow)
+        for r in pool.values():
+            shape = r.get("shape")
+            if r.get("kind") == "request" and shape \
+                    and r.get("outcome") == "ok":
+                try:
+                    census[tuple(int(d) for d in shape)] += 1
+                except (TypeError, ValueError):
+                    continue
+        return census.most_common()
+
     def counts(self) -> dict:
         with self._lock:
             return {"recent": len(self._recent),
