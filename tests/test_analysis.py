@@ -13,11 +13,12 @@ import textwrap
 
 import pytest
 
-from znicz_tpu.analysis import (Analyzer, DurationClockRule,
-                                HandlerSafetyRule, JaxHygieneRule,
-                                LockDisciplineRule, MetricDriftRule,
-                                UnseededRandomRule, load_baseline,
-                                run_repo, write_baseline)
+from znicz_tpu.analysis import (Analyzer, DeadlineDisciplineRule,
+                                DurationClockRule, HandlerSafetyRule,
+                                JaxHygieneRule, LockDisciplineRule,
+                                MetricDriftRule, UnseededRandomRule,
+                                load_baseline, run_repo,
+                                write_baseline)
 from znicz_tpu.analysis import cli as zlint_cli
 
 
@@ -593,6 +594,85 @@ class TestDurationClock:
             "return time.time() - t0",
             "return time.time() - t0  # zlint: disable=duration-clock")
         assert lint(tmp_path, src, [DurationClockRule()]) == []
+
+
+# -- deadline discipline ---------------------------------------------------
+
+DEADLINE_BAD = """
+    import queue
+    import threading
+    import urllib.request
+
+    def dispatch_loop(q, done, worker):
+        item = q.get()                       # parks forever
+        done.wait()                          # unbounded Event.wait
+        worker.join()                        # unbounded join
+        urllib.request.urlopen("http://x/")  # no timeout
+        return item
+"""
+
+DEADLINE_GOOD = """
+    import queue
+    import urllib.request
+
+    def dispatch_loop(q, done, worker, cfg):
+        item = q.get(timeout=1.0)
+        blocking = q.get(True, 0.5)          # positional timeout ok
+        done.wait(0.25)
+        worker.join(timeout=5.0)
+        urllib.request.urlopen("http://x/", timeout=2.0)
+        name = cfg.get("name")               # dict.get: has a key arg
+        return item, blocking, name
+"""
+
+
+class TestDeadlineDiscipline:
+    SERVING = "znicz_tpu/serving/mod.py"
+
+    def test_unbounded_waits_fire_on_serving_paths(self, tmp_path):
+        found = lint(tmp_path, DEADLINE_BAD, [DeadlineDisciplineRule()],
+                     rel=self.SERVING)
+        assert rules_of(found) == ["deadline-discipline"]
+        assert len(found) == 4          # get / wait / join / urlopen
+
+    def test_bounded_twins_stay_silent(self, tmp_path):
+        assert lint(tmp_path, DEADLINE_GOOD, [DeadlineDisciplineRule()],
+                    rel=self.SERVING) == []
+
+    def test_out_of_scope_modules_not_patrolled(self, tmp_path):
+        # the rule guards the REQUEST path; a training-side module
+        # with a deliberate unbounded wait is not its business
+        assert lint(tmp_path, DEADLINE_BAD, [DeadlineDisciplineRule()],
+                    rel="znicz_tpu/ops/mod.py") == []
+
+    def test_resilience_modules_in_scope(self, tmp_path):
+        found = lint(tmp_path, DEADLINE_BAD, [DeadlineDisciplineRule()],
+                     rel="znicz_tpu/resilience/mod.py")
+        assert rules_of(found) == ["deadline-discipline"]
+
+    def test_blocking_get_block_true_without_timeout(self, tmp_path):
+        found = lint(tmp_path, """
+    def loop(q):
+        return q.get(block=True)
+""", [DeadlineDisciplineRule()], rel=self.SERVING)
+        assert len(found) == 1
+
+    def test_contextvar_get_exempt(self, tmp_path):
+        assert lint(tmp_path, """
+    import contextvars
+    _deadline_var = contextvars.ContextVar("d", default=None)
+
+    def current():
+        return _deadline_var.get()           # never blocks
+""", [DeadlineDisciplineRule()], rel=self.SERVING) == []
+
+    def test_inline_suppression(self, tmp_path):
+        src = DEADLINE_BAD.replace(
+            "item = q.get()                       # parks forever",
+            "item = q.get()  # zlint: disable=deadline-discipline")
+        found = lint(tmp_path, src, [DeadlineDisciplineRule()],
+                     rel=self.SERVING)
+        assert len(found) == 3          # the .get() finding is muted
 
 
 # -- suppression + baseline ------------------------------------------------

@@ -1,0 +1,674 @@
+"""Overload defense in depth (ISSUE 10): end-to-end deadlines, the
+process-wide retry budget, hedged replica dispatch, CoDel-style
+adaptive shedding, and graceful drain.
+
+Covered per the issue checklist: deadline arithmetic across hops
+(admission reject vs mid-flight expiry), retry-budget exhaustion vs
+refill, hedge fires-once/first-wins/budget-gated, the shed ladder
+honoring criticality, and drain semantics (in-flight requests complete
+during shutdown while new admissions get 503 + Retry-After).  The
+whole-stack acceptance lives in ``chaos --scenario overload`` /
+``tools/overload_smoke.sh`` (wrapped here as a slow test).
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from znicz_tpu.resilience import faults, overload
+from znicz_tpu.resilience.chaos import _write_demo_znn
+from znicz_tpu.resilience.overload import (CoDelShedder, Deadline,
+                                           DeadlineExceeded,
+                                           DoomedDeadline, Draining,
+                                           HedgePolicy, RetryBudget,
+                                           Shed)
+from znicz_tpu.resilience.retry import RetryPolicy
+from znicz_tpu.serving import MicroBatcher, ServingEngine, ServingServer
+from znicz_tpu.serving.replicas import EngineReplicaSet
+from znicz_tpu.telemetry.registry import REGISTRY
+
+X = [[0.1, -0.2, 0.3, 0.4]]
+
+
+def _deadline_count(stage):
+    snap = REGISTRY.as_dict().get("deadline_exceeded_total", 0)
+    if isinstance(snap, dict):
+        return snap.get(f"stage={stage}", 0)
+    return 0
+
+
+def _post(url, payload, timeout=30.0, headers=None):
+    req = urllib.request.Request(
+        url + "predict", json.dumps(payload).encode(),
+        {"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def demo_engine(tmp_path_factory):
+    path = tmp_path_factory.mktemp("overload") / "demo.znn"
+    _write_demo_znn(str(path))
+    engine = ServingEngine(str(path), backend="jax", buckets=(1, 2))
+    engine.predict(np.asarray(X, np.float32))       # warm the jit
+    yield engine
+    engine.close()
+
+
+# -- deadline arithmetic ---------------------------------------------------
+
+class TestDeadline:
+    def test_from_ms_remaining_and_expiry(self):
+        d = Deadline.from_ms(1000)
+        assert not d.expired()
+        assert 0 < d.remaining_ms() <= 1000
+        past = Deadline(at=time.monotonic() - 0.01)
+        assert past.expired() and past.remaining_s() < 0
+
+    def test_none_deadline_is_unbounded(self):
+        d = Deadline()
+        assert not d.expired()
+        assert d.remaining_s() == float("inf")
+        d.check("forward", need_s=1e9)          # never raises
+
+    def test_check_raises_typed_with_stage_and_counts(self):
+        before = _deadline_count("forward")
+        d = Deadline(at=time.monotonic() - 0.01)
+        with pytest.raises(DeadlineExceeded) as ei:
+            d.check("forward")
+        assert ei.value.stage == "forward"
+        assert _deadline_count("forward") == before + 1
+
+    def test_check_refuses_unaffordable_next_stage(self):
+        # not yet expired, but the next stage cannot fit: still doomed
+        d = Deadline.from_ms(20)
+        with pytest.raises(DeadlineExceeded):
+            d.check("retry", need_s=1.0)
+
+    def test_scope_propagates_and_resets(self):
+        assert overload.current_deadline() is None
+        d = Deadline.from_ms(5000)
+        with overload.deadline_scope(d):
+            assert overload.current_deadline() is d
+            overload.check_deadline("dispatch")      # plenty left
+        assert overload.current_deadline() is None
+        overload.check_deadline("dispatch")          # no-op bare
+
+    def test_criticality_validated(self):
+        with pytest.raises(ValueError):
+            Deadline(criticality="urgent")
+
+
+# -- retry budget ----------------------------------------------------------
+
+class TestRetryBudget:
+    def test_exhaustion_and_refill(self):
+        b = RetryBudget(ratio=0.5, capacity=2)
+        assert b.try_spend() and b.try_spend()
+        assert not b.try_spend()                 # empty → denied
+        assert b.metrics()["denied"] == 1
+        b.on_success()
+        b.on_success()                           # 2 × 0.5 = 1 token
+        assert b.try_spend()
+        assert not b.try_spend()
+
+    def test_policy_denies_retry_when_budget_empty(self):
+        b = RetryBudget(ratio=0.1, capacity=1)
+        assert b.try_spend()                     # drain it
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("transient")
+
+        policy = RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                             budget=b)
+        with pytest.raises(RuntimeError):
+            policy.call(boom)
+        assert len(calls) == 1                   # no retry happened
+
+    def test_policy_success_refills(self):
+        b = RetryBudget(ratio=1.0, capacity=2)
+        assert b.try_spend() and b.try_spend()   # drain
+        policy = RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                             budget=b)
+        assert policy.call(lambda: "ok") == "ok"
+        assert b.metrics()["tokens"] == 1.0      # success refilled
+
+    def test_retry_refused_when_deadline_cannot_fit_backoff(self):
+        before = _deadline_count("retry")
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("transient")
+
+        policy = RetryPolicy(max_attempts=5, base_delay_s=5.0,
+                             jitter=0.0)
+        with overload.deadline_scope(Deadline.from_ms(100)):
+            with pytest.raises(RuntimeError):
+                policy.call(boom)
+        assert len(calls) == 1                   # the retry was doomed
+        assert _deadline_count("retry") == before + 1
+
+    def test_no_deadline_no_budget_retries_as_before(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.001)
+        assert policy.call(flaky) == "ok"
+        assert len(calls) == 3
+
+
+# -- adaptive shedding -----------------------------------------------------
+
+class TestCoDelShedder:
+    def test_ladder_escalates_and_resets(self):
+        now = [0.0]
+        sh = CoDelShedder(target_ms=10, interval_ms=100,
+                          clock=lambda: now[0])
+        sh.note_queue_wait(50)                   # first sample above
+        assert sh.level == 0                     # not standing yet
+        now[0] = 0.15
+        sh.note_queue_wait(50)                   # a full interval above
+        assert sh.level == 1
+        now[0] = 0.30
+        sh.note_queue_wait(50)
+        assert sh.level == 2
+        now[0] = 0.45
+        sh.note_queue_wait(50)
+        assert sh.level == 2                     # capped
+        sh.note_queue_wait(1)                    # back under target
+        assert sh.level == 0
+
+    def test_admit_honors_criticality_ladder(self):
+        now = [0.0]
+        sh = CoDelShedder(target_ms=10, interval_ms=100,
+                          clock=lambda: now[0])
+        assert all(sh.admit(c) for c in overload.CRITICALITIES)
+        sh.note_queue_wait(50)
+        now[0] = 0.15
+        sh.note_queue_wait(50)                   # level 1
+        assert not sh.admit("sheddable")
+        assert sh.admit("default") and sh.admit("critical")
+        now[0] = 0.30
+        sh.note_queue_wait(50)                   # level 2
+        assert not sh.admit("sheddable") and not sh.admit("default")
+        assert sh.admit("critical")              # never shed here
+        m = sh.metrics()
+        assert m["shed"] == {"sheddable": 2, "default": 1}
+        assert "critical" not in m["shed"]
+
+    def test_ladder_decays_when_no_samples_arrive(self):
+        """The anti-latch path: at level 2 non-critical traffic is
+        refused at admission, so the queue can empty and no wait
+        sample would ever arrive to reset the ladder — sample-free
+        silence must de-escalate one level per interval, judged at
+        admission time."""
+        now = [0.0]
+        sh = CoDelShedder(target_ms=10, interval_ms=100,
+                          clock=lambda: now[0])
+        sh.note_queue_wait(50)
+        now[0] = 0.15
+        sh.note_queue_wait(50)
+        now[0] = 0.30
+        sh.note_queue_wait(50)                   # level 2
+        assert not sh.admit("default")
+        now[0] = 0.45                            # one quiet interval
+        assert sh.level == 1
+        assert sh.admit("default")               # default readmitted
+        now[0] = 0.60                            # two quiet intervals
+        assert sh.level == 0
+        assert sh.admit("sheddable")             # fully recovered
+
+    def test_stale_anchor_does_not_escalate_fresh_burst(self):
+        """An above-target sample left from BEFORE an idle stretch
+        must not let the first sample of a new burst count as a full
+        standing interval — escalation needs the wait to stand above
+        target across contiguous samples."""
+        now = [0.0]
+        sh = CoDelShedder(target_ms=10, interval_ms=100,
+                          clock=lambda: now[0])
+        sh.note_queue_wait(50)                   # anchor at t=0
+        now[0] = 60.0                            # minutes of idle
+        sh.note_queue_wait(50)                   # fresh burst, sample 1
+        assert sh.level == 0                     # no instant brownout
+        now[0] = 60.15
+        sh.note_queue_wait(50)                   # standing a full
+        assert sh.level == 1                     # interval: NOW shed
+
+
+# -- batcher admission pipeline --------------------------------------------
+
+class TestBatcherAdmission:
+    def test_doomed_deadline_rejected_at_admission(self):
+        """With a measured service rate and a real backlog, a budget
+        the queue drain alone outspends is refused as 503-class
+        DoomedDeadline BEFORE queueing — never doomed work."""
+        gate = threading.Event()
+
+        def slow(x):
+            gate.wait(5.0)
+            return np.asarray(x)
+
+        b = MicroBatcher(slow, max_batch=1, max_wait_ms=1.0,
+                         max_queue=64)
+        try:
+            with b._cond:                        # measured history
+                b._step_times.append(0.2)
+            before = _deadline_count("admission")
+            b.submit(X)                          # in flight (blocked)
+            time.sleep(0.05)
+            b.submit(X)                          # queued backlog
+            with pytest.raises(DoomedDeadline) as ei:
+                b.submit(X, deadline_ms=50)      # < 2 × 200ms backlog
+            assert ei.value.retry_after >= 1
+            assert _deadline_count("admission") == before + 1
+            assert b.metrics()["doomed"] == 1
+            # an affordable budget is admitted
+            req = b.submit(X, deadline_ms=30000)
+            assert req is not None
+        finally:
+            gate.set()
+            b.close()
+
+    def test_idle_queue_short_deadline_still_expires_in_flight(self):
+        """PR-1 pin: deadline_ms=0 on an idle batcher is admitted and
+        expires at dispatch (504-class DeadlineExceeded), NOT
+        admission-rejected — early rejection needs a backlog."""
+        b = MicroBatcher(lambda x: np.asarray(x), max_batch=4,
+                         max_wait_ms=1.0)
+        try:
+            with pytest.raises(DeadlineExceeded) as ei:
+                b.predict(X, deadline_ms=0, timeout=10.0)
+            assert ei.value.stage == "queue"
+            assert "deadline" in str(ei.value)
+        finally:
+            b.close()
+
+    def test_shedder_wired_into_submit(self):
+        sh = CoDelShedder(target_ms=1, interval_ms=200)
+        sh.note_queue_wait(50)
+        time.sleep(0.25)
+        sh.note_queue_wait(50)
+        assert sh.level >= 1
+        b = MicroBatcher(lambda x: np.asarray(x), max_wait_ms=1.0,
+                         shedder=sh)
+        try:
+            with pytest.raises(Shed) as ei:
+                b.submit(X, criticality="sheddable")
+            assert ei.value.retry_after >= 1
+            assert b.metrics()["shed"] == 1
+            # critical sails through the ladder
+            y = b.predict(X, criticality="critical", timeout=10.0)
+            assert y.shape == (1, 4)
+        finally:
+            b.close()
+
+    def test_drain_finishes_inflight_then_refuses(self):
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow(x):
+            started.set()
+            release.wait(5.0)
+            return np.asarray(x)
+
+        b = MicroBatcher(slow, max_batch=4, max_wait_ms=1.0)
+        try:
+            req = b.submit(X)
+            assert started.wait(5.0)
+            drained_box = {}
+            t = threading.Thread(
+                target=lambda: drained_box.update(
+                    ok=b.drain(timeout_s=10.0)))
+            t.start()
+            time.sleep(0.05)
+            with pytest.raises(Draining):
+                b.submit(X)                      # admission stopped
+            release.set()
+            t.join(10.0)
+            assert drained_box.get("ok") is True
+            assert req.event.is_set() and req.error is None
+            assert req.result.shape == (1, 4)    # in-flight completed
+            assert b.metrics()["draining"] is True
+        finally:
+            release.set()
+            b.close()
+
+    def test_drain_timeout_returns_false(self):
+        release = threading.Event()
+
+        def stuck(x):
+            release.wait(10.0)
+            return np.asarray(x)
+
+        b = MicroBatcher(stuck, max_wait_ms=1.0)
+        try:
+            b.submit(X)
+            time.sleep(0.05)
+            assert b.drain(timeout_s=0.2) is False
+        finally:
+            release.set()
+            b.close()
+
+    def test_bad_criticality_is_value_error(self):
+        b = MicroBatcher(lambda x: np.asarray(x), max_wait_ms=1.0)
+        try:
+            with pytest.raises(ValueError):
+                b.submit(X, criticality="urgent")
+        finally:
+            b.close()
+
+
+# -- engine forward hop ----------------------------------------------------
+
+class TestEngineForwardHop:
+    def test_expired_deadline_refused_before_forward(self, demo_engine):
+        before = demo_engine.metrics()["forward_calls"]
+        with overload.deadline_scope(
+                Deadline(at=time.monotonic() - 0.01)):
+            with pytest.raises(DeadlineExceeded) as ei:
+                demo_engine.predict(np.asarray(X, np.float32))
+        assert ei.value.stage == "forward"
+        # no device slot was burned, and the breaker saw no failure
+        assert demo_engine.metrics()["forward_calls"] == before
+        assert demo_engine.breaker.state == "closed"
+
+
+# -- hedged dispatch -------------------------------------------------------
+
+class _StubBreaker:
+    def __init__(self):
+        self.state = "closed"
+
+
+class _StubReplica:
+    """Quacks enough like a ServingEngine for EngineReplicaSet
+    dispatch: predict/breaker/close."""
+
+    def __init__(self, tag, delay_s=0.0, error=None):
+        self.tag = tag
+        self.delay_s = delay_s
+        self.error = error
+        self.calls = 0
+        self.breaker = _StubBreaker()
+
+    def predict(self, x):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.error is not None:
+            raise self.error
+        return np.full((len(x), 1), self.tag, np.float32)
+
+    def close(self):
+        pass
+
+
+def _rset(replicas, hedge=None):
+    it = iter(replicas)
+    return EngineReplicaSet(lambda i: next(it), len(replicas),
+                            hedge=hedge)
+
+
+class TestHedgedDispatch:
+    def test_hedge_fires_once_first_result_wins(self):
+        slow = _StubReplica(0, delay_s=0.5)
+        fast = _StubReplica(1)
+        rs = _rset([slow, fast], hedge=HedgePolicy(after_ms=30))
+        y = rs.predict(np.asarray(X, np.float32))
+        assert float(y[0, 0]) == 1.0             # the hedge's answer
+        assert fast.calls == 1                   # exactly ONE hedge
+        m = rs.hedge_status()
+        assert m["outcomes"].get("won") == 1
+
+    def test_fast_primary_never_hedges(self):
+        a, b = _StubReplica(0), _StubReplica(1)
+        rs = _rset([a, b], hedge=HedgePolicy(after_ms=200))
+        y = rs.predict(np.asarray(X, np.float32))
+        assert float(y[0, 0]) == 0.0
+        assert b.calls == 0
+        assert rs.hedge_status()["outcomes"] == {}
+
+    def test_hedge_budget_gated(self):
+        budget = RetryBudget(ratio=0.1, capacity=1)
+        assert budget.try_spend()                # drain it
+        slow = _StubReplica(0, delay_s=0.2)
+        fast = _StubReplica(1)
+        rs = _rset([slow, fast],
+                   hedge=HedgePolicy(after_ms=20, budget=budget))
+        y = rs.predict(np.asarray(X, np.float32))
+        assert float(y[0, 0]) == 0.0             # rode out the primary
+        assert fast.calls == 0                   # hedge denied
+        assert rs.hedge_status()["outcomes"].get("denied") == 1
+
+    def test_auto_threshold_needs_samples(self):
+        policy = HedgePolicy(min_samples=4)
+        assert policy.threshold_ms() is None     # no data: no hedging
+        for ms in (10.0, 12.0, 14.0, 100.0):
+            policy.record_ms(ms)
+        assert policy.threshold_ms() == 100.0    # p95 of 4 samples
+        slow = _StubReplica(0, delay_s=0.3)
+        fast = _StubReplica(1)
+        rs = _rset([slow, fast], hedge=HedgePolicy(min_samples=64))
+        y = rs.predict(np.asarray(X, np.float32))
+        assert float(y[0, 0]) == 0.0 and fast.calls == 0
+
+    def test_primary_error_defers_to_hedge(self):
+        bad = _StubReplica(0, delay_s=0.1,
+                           error=RuntimeError("device lost"))
+        good = _StubReplica(1)
+        rs = _rset([bad, good], hedge=HedgePolicy(after_ms=20))
+        y = rs.predict(np.asarray(X, np.float32))
+        assert float(y[0, 0]) == 1.0
+
+    def test_both_error_surfaces_primary_error(self):
+        bad0 = _StubReplica(0, delay_s=0.1,
+                            error=RuntimeError("primary boom"))
+        bad1 = _StubReplica(1, error=RuntimeError("hedge boom"))
+        rs = _rset([bad0, bad1], hedge=HedgePolicy(after_ms=20))
+        with pytest.raises(RuntimeError, match="primary boom"):
+            rs.predict(np.asarray(X, np.float32))
+
+    def test_no_second_healthy_replica(self):
+        slow = _StubReplica(0, delay_s=0.15)
+        sick = _StubReplica(1)
+        sick.breaker.state = "open"
+        rs = _rset([slow, sick], hedge=HedgePolicy(after_ms=20))
+        y = rs.predict(np.asarray(X, np.float32))
+        assert float(y[0, 0]) == 0.0
+        assert sick.calls == 0
+        assert rs.hedge_status()["outcomes"].get("no_replica") == 1
+
+    def test_replica_slow_fault_site_fires_per_index(self):
+        a, b = _StubReplica(0), _StubReplica(1)
+        rs = _rset([a, b])
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "replica.slow.1", kind="latency", latency_s=0.0)])
+        with plan:
+            rs.predict(np.asarray(X, np.float32))   # round-robin → 0
+            rs.predict(np.asarray(X, np.float32))   # → 1
+        assert plan.snapshot() == {"replica.slow.1:latency": 1}
+
+
+# -- HTTP front ------------------------------------------------------------
+
+class TestServerOverloadHTTP:
+    def test_x_deadline_ms_header_enforced(self, demo_engine):
+        server = ServingServer(demo_engine, max_wait_ms=1.0).start()
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "batcher.dispatch", kind="latency", latency_s=0.25,
+            times=1)])
+        try:
+            with plan:
+                status, body, _ = _post(
+                    server.url, {"inputs": X},
+                    headers={"X-Deadline-Ms": "50"})
+            assert status == 504
+            assert "deadline" in body["error"]
+            # and without the fault the same header is plenty
+            status, _body, _ = _post(server.url, {"inputs": X},
+                                     headers={"X-Deadline-Ms": "5000"})
+            assert status == 200
+        finally:
+            server.stop()
+
+    def test_header_beats_body_deadline(self, demo_engine):
+        server = ServingServer(demo_engine, max_wait_ms=1.0).start()
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "batcher.dispatch", kind="latency", latency_s=0.25,
+            times=1)])
+        try:
+            with plan:
+                status, _body, _ = _post(
+                    server.url,
+                    {"inputs": X, "deadline_ms": 60000},
+                    headers={"X-Deadline-Ms": "50"})
+            assert status == 504
+        finally:
+            server.stop()
+
+    def test_server_default_deadline_applies(self, demo_engine):
+        server = ServingServer(demo_engine, max_wait_ms=1.0,
+                               default_deadline_ms=50.0).start()
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "batcher.dispatch", kind="latency", latency_s=0.25,
+            times=1)])
+        try:
+            with plan:
+                status, body, _ = _post(server.url, {"inputs": X})
+            assert status == 504 and "deadline" in body["error"]
+        finally:
+            server.stop()
+
+    def test_junk_criticality_is_400(self, demo_engine):
+        server = ServingServer(demo_engine, max_wait_ms=1.0).start()
+        try:
+            status, body, _ = _post(server.url, {"inputs": X},
+                                    headers={"X-Criticality": "vip"})
+            assert status == 400
+            assert "X-Criticality" in body["error"]
+        finally:
+            server.stop()
+
+    def test_shed_target_must_exceed_coalescing_window(self, demo_engine):
+        # a target at or under max_wait_ms would read normal batching
+        # patience as standing overload and brown out an idle replica
+        with pytest.raises(ValueError, match="max_wait_ms"):
+            ServingServer(demo_engine, max_wait_ms=5.0,
+                          shed_target_ms=5.0)
+
+    def test_shed_is_503_with_retry_after(self, demo_engine):
+        server = ServingServer(demo_engine, max_wait_ms=1.0,
+                               shed_target_ms=5.0,
+                               shed_interval_ms=200.0).start()
+        sh = server.batcher.shedder
+        try:
+            sh.note_queue_wait(50)
+            time.sleep(0.25)
+            sh.note_queue_wait(50)
+            assert sh.level >= 1
+            status, body, headers = _post(
+                server.url, {"inputs": X},
+                headers={"X-Criticality": "sheddable"})
+            assert status == 503
+            assert "Retry-After" in headers
+            assert "shed" in body["error"]
+            # critical still lands while the ladder sheds
+            status, _body, _ = _post(server.url, {"inputs": X},
+                                     headers={"X-Criticality":
+                                              "critical"})
+            assert status == 200
+        finally:
+            server.stop()
+
+    def test_overload_status_surfaces(self, demo_engine):
+        from znicz_tpu.telemetry import debugz
+        server = ServingServer(demo_engine, max_wait_ms=1.0,
+                               default_deadline_ms=1234.0).start()
+        try:
+            _post(server.url, {"inputs": X})
+            m = server.metrics()
+            assert m["overload"]["default_deadline_ms"] == 1234.0
+            assert m["overload"]["draining"] is False
+            page = debugz.statusz_text(server)
+            assert "overload" in page
+            assert "default_deadline_ms=1234.0" in page
+        finally:
+            server.stop()
+
+    def test_drain_completes_inflight_and_refuses_new(self, demo_engine):
+        """THE graceful-shutdown pin: during drain the in-flight
+        request completes 200, a new one gets 503 + Retry-After,
+        /healthz reports draining, and drain_state ends at 2."""
+        server = ServingServer(demo_engine, max_wait_ms=1.0).start()
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "batcher.dispatch", kind="latency", latency_s=0.5,
+            times=1)])
+        inflight = {}
+
+        def fire():
+            inflight["answer"] = _post(server.url, {"inputs": X},
+                                       timeout=30.0)
+
+        stopped = False
+        try:
+            with plan:
+                t = threading.Thread(target=fire, daemon=True)
+                t.start()
+                time.sleep(0.15)                 # held by the fault
+                drain_box = {}
+                dt = threading.Thread(
+                    target=lambda: drain_box.update(
+                        ok=server.drain(10.0)))
+                dt.start()
+                time.sleep(0.1)
+                with urllib.request.urlopen(
+                        server.url + "healthz", timeout=5) as r:
+                    assert json.loads(r.read())["status"] == "draining"
+                status, _body, headers = _post(server.url,
+                                               {"inputs": X},
+                                               timeout=10.0)
+                assert status == 503 and "Retry-After" in headers
+                dt.join(15.0)
+                t.join(15.0)
+            stopped = True
+            assert inflight["answer"][0] == 200  # completed mid-drain
+            assert drain_box.get("ok") is True
+            assert REGISTRY.as_dict().get("drain_state") == 2
+        finally:
+            overload.set_drain_state(overload.DRAIN_SERVING)
+            if not stopped:
+                server.stop()
+
+
+# -- acceptance smoke (slow) -----------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestOverloadSmoke:
+    def test_overload_smoke_script(self):
+        """tools/overload_smoke.sh: the chaos drill plus a REAL serve
+        process drained by SIGTERM with a request in flight."""
+        proc = subprocess.run(
+            ["bash", "tools/overload_smoke.sh"],
+            capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, \
+            f"stdout:\n{proc.stdout[-4000:]}\nstderr:\n" \
+            f"{proc.stderr[-2000:]}"

@@ -128,6 +128,17 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
                   series), "predict_latency_ms buckets present")
         check(series.get('breaker_state{state="closed"}') == 1.0,
               "breaker_state enum present (closed)")
+        # overload-defense families (znicz_tpu.resilience.overload):
+        # registered at import, scraped from zero on an idle replica
+        # so dashboards see the series before the first incident
+        for fam, kind in (("deadline_exceeded_total", "counter"),
+                          ("retry_budget_tokens", "gauge"),
+                          ("hedges_total", "counter"),
+                          ("shed_total", "counter"),
+                          ("drain_state", "gauge")):
+            check(typed.get(fam) == kind, f"{fam} typed {kind}")
+        check(series.get("drain_state") == 0.0,
+              "drain_state == 0 (serving) on a live replica")
         sent = n_good + n_bad
         got_pred = sum(v for k, v in series.items()
                        if k.startswith('requests_total{')

@@ -1,0 +1,100 @@
+#!/bin/bash
+# Overload-defense smoke (ISSUE 10 acceptance, operator-runnable):
+#
+#   1. `python -m znicz_tpu chaos --scenario overload` — sustained
+#      past-capacity load on a 2-replica fleet with one latency-faulted
+#      replica (replica.slow.0): zero hangs, zero raw 500s, every
+#      shed/backpressure answer carries Retry-After, the CoDel ladder
+#      sheds (sheddable/default only, never critical), hedges fire and
+#      hedged p99 lands measurably below unhedged p99 in the same
+#      drill, and fleet retries stay within the retry budget.
+#
+#   2. a REAL `python -m znicz_tpu serve` process gets SIGTERM while a
+#      request is in flight (a batcher.dispatch latency fault holds it
+#      there): the in-flight request must complete 200, the process
+#      must print the drain banner and exit 0 — the pre-PR-10 behavior
+#      (tick loop stops, teardown cuts the answer off) stays dead.
+#
+# Registered beside tools/chaos_smoke.sh; pytest wrapper (marked slow):
+# tests/test_overload.py::TestOverloadSmoke.
+#
+# Usage:  bash tools/overload_smoke.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: chaos --scenario overload =="
+JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario overload || exit 1
+
+echo "== phase 2: SIGTERM drains a live serve process =="
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, signal, socket, subprocess, sys, tempfile, threading
+import time, urllib.request
+
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+with tempfile.TemporaryDirectory(prefix="znicz_overload_smoke_") as tmp:
+    model = os.path.join(tmp, "demo.znn")
+    from znicz_tpu.resilience.chaos import _write_demo_znn
+    _write_demo_znn(model)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    plan = json.dumps({"faults": [{
+        "site": "batcher.dispatch", "kind": "latency",
+        "latency_s": 1.0, "after": 1,
+        "message": "smoke: hold a request in flight"}]})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "serve", "--model", model,
+         "--port", str(port), "--max-wait-ms", "1",
+         "--drain-timeout-s", "15", "--fault-plan", plan],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    url = f"http://127.0.0.1:{port}/"
+    box = {}
+    try:
+        for _ in range(120):                    # wait for the listener
+            try:
+                urllib.request.urlopen(url + "healthz", timeout=2)
+                break
+            except Exception:
+                time.sleep(0.25)
+        # warm request (unfaulted: after=1 skips the first dispatch)
+        req = urllib.request.Request(
+            url + "predict",
+            json.dumps({"inputs": [[0.1, -0.2, 0.3, 0.4]]}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            check(r.status == 200, "warm request answered 200")
+
+        def inflight():
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    box["status"] = r.status
+            except Exception as e:
+                box["status"] = repr(e)
+
+        t = threading.Thread(target=inflight, daemon=True)
+        t.start()
+        time.sleep(0.3)          # the latency fault holds it in flight
+        proc.send_signal(signal.SIGTERM)
+        t.join(30.0)
+        check(box.get("status") == 200,
+              f"in-flight request completed during drain "
+              f"(got {box.get('status')!r})")
+        rc = proc.wait(timeout=30)
+        check(rc == 0, f"serve exited 0 after SIGTERM drain (rc={rc})")
+        out = proc.stdout.read()
+        check("draining" in out, "drain banner printed")
+        check("drain complete" in out, "drain completed inside bound")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+print("PASS" if not fails else f"FAIL: {fails}")
+sys.exit(1 if fails else 0)
+PY

@@ -19,13 +19,16 @@ Examples::
         # POST /predict carries an X-Request-Id (docs/observability.md),
         # and POST /admin/reload (or SIGHUP) hot-reloads the model with
         # verify + canary + rollback (docs/durability.md)
-    python -m znicz_tpu chaos [--scenario reload|promote]
+    python -m znicz_tpu chaos [--scenario reload|promote|overload]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
         # --scenario reload drills corrupt-artifact rollback;
         # --scenario promote drives the closed promotion loop (N
         # train-while-serving promotions + an SLO-breaching candidate
-        # auto-rolled-back, zero dropped requests; docs/promotion.md)
+        # auto-rolled-back, zero dropped requests; docs/promotion.md);
+        # --scenario overload drills the overload defenses (deadlines,
+        # retry budget, hedged dispatch, adaptive shedding, graceful
+        # drain under 4x load with one slow replica; docs/resilience.md)
     python -m znicz_tpu promote --candidates DIR --url http://host:port/
         # closed-loop promotion controller sidecar: watch a trainer's
         # export directory, verify + canary-deploy each new candidate

@@ -16,7 +16,12 @@ PRs grew (serving, resilience, telemetry, elastic):
   (:mod:`.metric_drift`);
 * ``duration-clock`` — durations computed from the wall clock
   (``time.time()`` arithmetic) instead of ``time.monotonic()`` /
-  ``perf_counter`` (:mod:`.clocks`).
+  ``perf_counter`` (:mod:`.clocks`);
+* ``deadline-discipline`` — unbounded blocking waits (``Queue.get`` /
+  ``Event.wait`` / ``Condition.wait`` / bare ``join`` / socket
+  connects without timeout) on serving dispatch paths, where every
+  wait must be bounded so end-to-end deadlines can fire
+  (:mod:`.deadlines`).
 
 Run it: ``python -m znicz_tpu lint`` (or ``tools/lint.sh``); gate:
 ``pytest -m lint``.  Suppress: ``# zlint: disable=RULE`` inline, or a
@@ -28,6 +33,7 @@ from .clocks import DurationClockRule
 from .core import (Analyzer, Finding, ModuleInfo, RepoRule, Rule,
                    load_baseline, write_baseline)
 from .cli import default_rules, main, run_repo
+from .deadlines import DeadlineDisciplineRule
 from .handlers import HandlerSafetyRule
 from .jaxrules import JaxHygieneRule, UnseededRandomRule
 from .locks import LockDisciplineRule
@@ -38,5 +44,5 @@ __all__ = [
     "load_baseline", "write_baseline", "default_rules", "run_repo",
     "main", "LockDisciplineRule", "JaxHygieneRule",
     "UnseededRandomRule", "HandlerSafetyRule", "MetricDriftRule",
-    "DurationClockRule",
+    "DurationClockRule", "DeadlineDisciplineRule",
 ]

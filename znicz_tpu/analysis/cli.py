@@ -17,6 +17,7 @@ import sys
 
 from .clocks import DurationClockRule
 from .core import Analyzer, default_root, write_baseline
+from .deadlines import DeadlineDisciplineRule
 from .handlers import HandlerSafetyRule
 from .jaxrules import JaxHygieneRule, UnseededRandomRule
 from .locks import LockDisciplineRule
@@ -28,7 +29,8 @@ DEFAULT_BASELINE = "tools/zlint_baseline.json"
 def default_rules() -> list:
     return [LockDisciplineRule(), JaxHygieneRule(),
             UnseededRandomRule(), HandlerSafetyRule(),
-            MetricDriftRule(), DurationClockRule()]
+            MetricDriftRule(), DurationClockRule(),
+            DeadlineDisciplineRule()]
 
 
 def run_repo(root: str | None = None, baseline: str | None = None,

@@ -14,14 +14,25 @@ one of three small primitives:
   per-attempt timeout, transient-vs-deterministic classifier.
 * :mod:`breaker` — circuit breaker (closed→open→half_open→closed) with
   :class:`~breaker.EngineUnavailable` carrying Retry-After for fronts.
+* :mod:`overload` — overload defense in depth: end-to-end
+  :class:`~overload.Deadline` propagation, the process-wide
+  :class:`~overload.RetryBudget`, :class:`~overload.HedgePolicy` for
+  hedged replica dispatch, and the :class:`~overload.CoDelShedder`
+  adaptive admission ladder (docs/resilience.md "Overload defense").
 
 See docs/resilience.md for the knob reference and degradation matrix.
 """
 
 from .breaker import CircuitBreaker, EngineUnavailable
 from .faults import FaultInjected, FaultPlan, FaultSpec, inject
+from .overload import (CoDelShedder, Deadline, DeadlineExceeded,
+                       DoomedDeadline, Draining, EarlyReject,
+                       HedgePolicy, RetryBudget, Shed)
 from .retry import AttemptTimeout, RetryPolicy, default_transient
 
-__all__ = ["AttemptTimeout", "CircuitBreaker", "EngineUnavailable",
-           "FaultInjected", "FaultPlan", "FaultSpec", "RetryPolicy",
-           "default_transient", "inject"]
+__all__ = ["AttemptTimeout", "CircuitBreaker", "CoDelShedder",
+           "Deadline", "DeadlineExceeded", "DoomedDeadline",
+           "Draining", "EarlyReject", "EngineUnavailable",
+           "FaultInjected", "FaultPlan", "FaultSpec", "HedgePolicy",
+           "RetryBudget", "RetryPolicy", "Shed", "default_transient",
+           "inject"]

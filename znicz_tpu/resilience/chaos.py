@@ -75,12 +75,13 @@ def _write_demo_znn(path: str, fin: int = 4, hidden: int = 3,
     _commit_znn(path)
 
 
-def _post(url: str, payload: dict, timeout: float = 30.0):
+def _post(url: str, payload: dict, timeout: float = 30.0,
+          headers: dict | None = None):
     """(status, body) — errors become their status code, a connection
     hang becomes the invariant failure it is."""
     req = urllib.request.Request(
         url + "predict", json.dumps(payload).encode(),
-        {"Content-Type": "application/json"})
+        {"Content-Type": "application/json", **(headers or {})})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read()), dict(r.headers)
@@ -359,6 +360,253 @@ def _promote_scenario(args) -> int:
     return 1 if bad else 0
 
 
+def _overload_scenario(args) -> int:
+    """``--scenario overload`` — the overload-defense acceptance
+    (docs/resilience.md "Overload defense"): sustained offered load
+    well past capacity against a 2-replica fleet with ONE
+    latency-faulted replica (``replica.slow.0``) plus a low-p
+    transient ``engine.forward`` error fault, driven twice — hedging
+    off, then on — and once more for the graceful drain.  Asserted:
+
+    * zero hangs (every request resolves within the client bound) and
+      zero raw 500s — the only answers are 200 / 429 / 503 / 504;
+    * every 429/503 carries ``Retry-After``;
+    * the shed ladder fired, and only against sheddable/default
+      traffic — ``critical`` is never shed adaptively;
+    * hedges fired, and hedged p99 is measurably below unhedged p99
+      under the SAME fault and load;
+    * fleet-wide retries stayed within the retry budget's invariant
+      (spent ≤ capacity + ratio × successes);
+    * SIGTERM-style drain: the in-flight request completes 200 while
+      new admissions get 503 + Retry-After, then the process state
+      reaches ``drain_state=2``.
+    """
+    import collections
+    import threading
+
+    from ..serving.engine import ServingEngine
+    from ..serving.server import ServingServer
+    from ..serving.replicas import EngineReplicaSet
+    from ..telemetry.registry import REGISTRY
+    from . import overload
+
+    bad: list[str] = []
+    x = [[0.1, -0.2, 0.3, 0.4]]
+    crit_cycle = ("sheddable", "default", "default", "critical")
+
+    def run_phase(model: str, hedged: bool) -> dict:
+        # roomy capacity: with ONE of TWO replicas slow, hedging is
+        # not a 5%-tail affair but ~half of dispatches — the drill
+        # asserts the budget INVARIANT (spent ≤ capacity + ratio ×
+        # successes), not starvation, which would just re-expose the
+        # slow replica and muddy the p99 comparison
+        budget = overload.RetryBudget(ratio=args.budget_ratio,
+                                      capacity=500.0)
+
+        def factory(i):
+            # per-replica breaker/retry state, ONE shared budget —
+            # the fleet-wide cap is the thing under test
+            return ServingEngine(
+                model, backend="jax", buckets=(1, 2, 4),
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.005,
+                                  max_delay_s=0.02, budget=budget),
+                breaker=CircuitBreaker(failure_threshold=10,
+                                       cooldown_s=0.5))
+
+        hedge = (overload.HedgePolicy(after_ms=args.hedge_after_ms,
+                                      budget=budget)
+                 if hedged else None)
+        engine = EngineReplicaSet(factory, 2, hedge=hedge)
+        server = ServingServer(
+            engine, max_batch=4, max_wait_ms=1.0, max_queue=24,
+            default_deadline_ms=5000.0, shed_target_ms=25.0,
+            shed_interval_ms=100.0).start()
+        plan = faults.FaultPlan([
+            faults.FaultSpec("replica.slow.0", kind="latency",
+                             latency_s=args.slow_s,
+                             message="chaos: slow replica"),
+            faults.FaultSpec("engine.forward", p=0.1,
+                             message="chaos: transient device "
+                                     "fault")], seed=11)
+        answers = []          # (code, latency_s, retry_after_present,
+        mu = threading.Lock()  # criticality, done_at)
+        stop = threading.Event()
+        retries_before = _retry_total()
+
+        def client(ci: int):
+            k = 0
+            while not stop.is_set():
+                crit = crit_cycle[(ci + k) % len(crit_cycle)]
+                k += 1
+                t0 = time.monotonic()
+                try:
+                    status, _b, headers = _post(
+                        server.url, {"inputs": x}, timeout=20.0,
+                        headers={"X-Criticality": crit})
+                except Exception:
+                    status, headers = -1, {}   # hang/drop = failure
+                done = time.monotonic()
+                with mu:
+                    answers.append((status, done - t0,
+                                    "Retry-After" in headers, crit,
+                                    done))
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(args.clients)]
+        try:
+            with plan:
+                # one warm request per bucket shape before the storm,
+                # so jit compiles don't masquerade as tail latency
+                _post(server.url, {"inputs": x}, timeout=60.0)
+                t_start = time.monotonic()
+                for t in threads:
+                    t.start()
+                stop.wait(args.duration_s)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(30.0)
+            metrics = server.metrics()
+            server.stop()
+            engine.close()
+        # p99 over the STEADY state: the first second is the shed
+        # ladder finding its level while the queue fills — both phases
+        # pay it identically, and it would otherwise drown the
+        # hedging-vs-not signal the drill exists to measure
+        lat200 = sorted(lat for code, lat, _ra, _c, done in answers
+                        if code == 200 and done - t_start > 1.0)
+        p99 = (lat200[min(len(lat200) - 1, int(len(lat200) * 0.99))]
+               if lat200 else None)
+        return {"answers": answers, "p99_s": p99,
+                "hedge": (engine.hedge_status() or {}),
+                "shed": (metrics.get("shedder") or {}),
+                "budget": budget.metrics(),
+                "retries": _retry_total() - retries_before,
+                "fired": plan.snapshot()}
+
+    def _retry_total() -> int:
+        snap = REGISTRY.as_dict().get("retry_attempts_total", 0)
+        return int(sum(snap.values()) if isinstance(snap, dict)
+                   else snap)
+
+    def check_answers(phase: str, result: dict) -> None:
+        codes = collections.Counter(c for c, _l, _ra, _cr, _d
+                                    in result["answers"])
+        if codes.get(-1):
+            bad.append(f"{phase}: {codes[-1]} request(s) hung or "
+                       f"dropped the connection")
+        raw = {c for c in codes if c not in (200, 429, 503, 504, -1)}
+        if raw:
+            bad.append(f"{phase}: raw failure codes {sorted(raw)} "
+                       f"(contract allows 200/429/503/504)")
+        missing_ra = sum(1 for c, _l, ra, _cr, _d in result["answers"]
+                         if c in (429, 503) and not ra)
+        if missing_ra:
+            bad.append(f"{phase}: {missing_ra} shed/backpressure "
+                       f"answer(s) without Retry-After")
+        b = result["budget"]
+        if b["spent"] > b["capacity"] + b["ratio"] * b["successes"]:
+            bad.append(f"{phase}: retries outspent the budget "
+                       f"invariant: {b}")
+        shed = result["shed"].get("shed") or {}
+        if shed.get("critical"):
+            bad.append(f"{phase}: critical traffic was shed "
+                       f"adaptively: {shed}")
+        print(json.dumps({"phase": phase, "codes": dict(codes),
+                          "p99_ms": (round(result["p99_s"] * 1e3, 1)
+                                     if result["p99_s"] else None),
+                          "shed": shed, "hedge": result["hedge"],
+                          "budget": b, "retries": result["retries"],
+                          "fired": result["fired"]}))
+
+    with tempfile.TemporaryDirectory(prefix="znicz_chaos_") as tmp:
+        model = os.path.join(tmp, "demo.znn")
+        _write_demo_znn(model)
+        unhedged = run_phase(model, hedged=False)
+        check_answers("unhedged", unhedged)
+        hedged = run_phase(model, hedged=True)
+        check_answers("hedged", hedged)
+        outcomes = hedged["hedge"].get("outcomes") or {}
+        fired = outcomes.get("won", 0) + outcomes.get("lost", 0)
+        if fired < 1:
+            slow_ms = args.slow_s * 1e3
+            bad.append(f"no hedges fired under a {slow_ms:.0f}ms-slow "
+                       f"replica: {outcomes}")
+        total_shed = (sum((unhedged["shed"].get("shed") or {})
+                          .values())
+                      + sum((hedged["shed"].get("shed") or {})
+                            .values()))
+        if total_shed < 1:
+            bad.append("the adaptive shed ladder never fired under "
+                       "sustained overload")
+        if unhedged["p99_s"] is None or hedged["p99_s"] is None:
+            bad.append("a phase produced no 200s to measure p99 on")
+        elif not (hedged["p99_s"] < unhedged["p99_s"] * 0.8):
+            bad.append(f"hedging did not bound p99: hedged "
+                       f"{hedged['p99_s'] * 1e3:.1f}ms vs unhedged "
+                       f"{unhedged['p99_s'] * 1e3:.1f}ms")
+
+        # graceful drain: in-flight completes, new admissions 503,
+        # drain_state reaches 2
+        engine = ServingEngine(model, backend="jax", buckets=(1, 2))
+        server = ServingServer(engine, max_wait_ms=1.0).start()
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "batcher.dispatch", kind="latency", latency_s=0.4,
+            message="chaos: slow dispatch holds the drain window")],
+            seed=3)
+        inflight: dict = {}
+
+        def fire_inflight():
+            inflight["answer"] = _post(server.url, {"inputs": x},
+                                       timeout=30.0)
+
+        try:
+            with plan:
+                _post(server.url, {"inputs": x}, timeout=60.0)  # warm
+                t = threading.Thread(target=fire_inflight,
+                                     daemon=True)
+                t.start()
+                time.sleep(0.1)       # let it into the batcher
+                drain_box: dict = {}
+
+                def do_drain():
+                    drain_box["drained"] = server.drain(15.0)
+
+                dt = threading.Thread(target=do_drain, daemon=True)
+                dt.start()
+                time.sleep(0.1)       # drain flag set, still draining
+                status, _b, headers = _post(server.url,
+                                            {"inputs": x},
+                                            timeout=10.0)
+                if status != 503 or "Retry-After" not in headers:
+                    bad.append(f"admission during drain answered "
+                               f"{status} (expected 503 + "
+                               f"Retry-After)")
+                dt.join(30.0)
+                t.join(30.0)
+            if inflight.get("answer", (None,))[0] != 200:
+                bad.append(f"in-flight request did not complete "
+                           f"during drain: "
+                           f"{inflight.get('answer', ('hung',))[0]}")
+            if not drain_box.get("drained"):
+                bad.append("drain timed out with work still queued")
+            if REGISTRY.as_dict().get("drain_state") != 2:
+                bad.append(f"drain_state gauge "
+                           f"{REGISTRY.as_dict().get('drain_state')}"
+                           f", expected 2 (drained)")
+            print(json.dumps({"phase": "drain",
+                              "inflight": inflight.get(
+                                  "answer", ("hung",))[0],
+                              "drained": drain_box.get("drained")}))
+        finally:
+            server.stop()
+            engine.close()
+    print(json.dumps({"scenario": "overload", "ok": not bad,
+                      "violations": bad}))
+    return 1 if bad else 0
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -379,14 +627,19 @@ def main(argv=None) -> int:
     p.add_argument("--cooldown-s", type=float, default=1.0)
     p.add_argument("--retry-attempts", type=int, default=2)
     p.add_argument("--scenario", default="breaker",
-                   choices=("breaker", "reload", "promote"),
+                   choices=("breaker", "reload", "promote", "overload"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
                         "(docs/durability.md); promote: the closed "
                         "loop — N promotions under fault injection "
                         "plus a regressed candidate auto-rolled-back "
-                        "by the SLO watch (docs/promotion.md)")
+                        "by the SLO watch (docs/promotion.md); "
+                        "overload: sustained past-capacity load with "
+                        "one latency-faulted replica — deadlines, "
+                        "retry budget, hedging, adaptive shedding and "
+                        "graceful drain all asserted "
+                        "(docs/resilience.md)")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -398,11 +651,31 @@ def main(argv=None) -> int:
     p.add_argument("--bad-latency-s", type=float, default=0.08,
                    help="promote: per-forward latency injected while "
                         "the regressed candidate serves")
+    p.add_argument("--duration-s", type=float, default=3.5,
+                   help="overload: seconds of sustained load per "
+                        "phase (unhedged, then hedged; the first "
+                        "second is warm-up, excluded from p99)")
+    p.add_argument("--clients", type=int, default=8,
+                   help="overload: concurrent client threads (offered "
+                        "load is several times the faulted fleet's "
+                        "capacity)")
+    p.add_argument("--slow-s", type=float, default=0.25,
+                   help="overload: latency injected at replica.slow.0 "
+                        "— the one slow-but-not-sick replica")
+    p.add_argument("--hedge-after-ms", type=float, default=30.0,
+                   help="overload: fixed hedge trigger for the hedged "
+                        "phase (fixed, not p95, so the drill is "
+                        "deterministic)")
+    p.add_argument("--budget-ratio", type=float, default=0.1,
+                   help="overload: retry-budget refill fraction under "
+                        "test")
     args = p.parse_args(argv)
     if args.scenario == "reload":
         return _reload_scenario(args)
     if args.scenario == "promote":
         return _promote_scenario(args)
+    if args.scenario == "overload":
+        return _overload_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

@@ -50,6 +50,11 @@ Instrumented sites (grow this list as subsystems adopt injection):
                        /metrics scrape) in the promotion controller —
                        a flaky probe must be retried, never counted
                        as a breach
+``replica.slow.<i>``   EngineReplicaSet dispatch to replica ``i`` (one
+                       site per replica index) — a latency fault here
+                       is the deterministic "one slow-but-not-sick
+                       replica" the hedging drill keys on
+                       (``chaos --scenario overload``)
 =====================  ====================================================
 """
 

@@ -16,6 +16,7 @@ import threading
 import time
 
 from ..telemetry.registry import REGISTRY
+from . import overload
 
 _retry_attempts = REGISTRY.counter(
     "retry_attempts_total",
@@ -30,12 +31,17 @@ class AttemptTimeout(TimeoutError):
 def default_transient(exc: BaseException) -> bool:
     """Default classifier: programming/shape errors are deterministic —
     retrying cannot help and hides the bug from the caller (the serving
-    front maps them to 400, not 503).  Everything else (RuntimeError,
-    OSError, jaxlib's XlaRuntimeError, injected faults, timeouts) is
-    treated as possibly-transient."""
+    front maps them to 400, not 503).  A passed deadline is equally
+    unretryable: the budget that ran out does not come back, and a
+    retry would be exactly the doomed work deadline propagation
+    exists to refuse.  Everything else (RuntimeError, OSError,
+    jaxlib's XlaRuntimeError, injected faults, timeouts) is treated
+    as possibly-transient."""
     return not isinstance(exc, (ValueError, TypeError, KeyError,
                                 IndexError, AttributeError,
-                                NotImplementedError, AssertionError))
+                                NotImplementedError, AssertionError,
+                                overload.DeadlineExceeded,
+                                overload.EarlyReject))
 
 
 class RetryPolicy:
@@ -55,13 +61,25 @@ class RetryPolicy:
     around calls that eventually return, like a slow collective or a
     hung filesystem write, where "stop waiting" is the required
     behavior and "stop computing" is impossible anyway.
+
+    Overload defense (docs/resilience.md): with ``budget`` set (a
+    process-wide :class:`~znicz_tpu.resilience.overload.RetryBudget`)
+    every retry spends one token — empty bucket means the LAST error
+    surfaces instead of another attempt, so a correlated failure
+    cannot turn into a fleet-wide retry storm.  Independent of the
+    budget, when the current request carries a deadline
+    (:func:`~znicz_tpu.resilience.overload.current_deadline`), a
+    retry whose backoff + observed attempt time cannot fit the
+    remaining budget is refused as doomed work
+    (``deadline_exceeded_total{stage="retry"}``).
     """
 
     def __init__(self, max_attempts: int = 3, base_delay_s: float = 0.05,
                  max_delay_s: float = 2.0, jitter: float = 0.5,
                  attempt_timeout_s: float | None = None,
                  retryable=default_transient, seed: int = 0,
-                 sleep=time.sleep):
+                 sleep=time.sleep,
+                 budget: "overload.RetryBudget | None" = None):
         if max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, "
                              f"got {max_attempts}")
@@ -73,6 +91,7 @@ class RetryPolicy:
         self.jitter = float(jitter)
         self.attempt_timeout_s = attempt_timeout_s
         self.retryable = retryable
+        self.budget = budget
         self._rng = random.Random(seed)
         self._sleep = sleep
 
@@ -110,16 +129,36 @@ class RetryPolicy:
         (metrics hook).  Raises the LAST exception when attempts run
         out, and non-retryable exceptions immediately."""
         for attempt in range(1, self.max_attempts + 1):
+            t0 = time.monotonic()
             try:
-                return self._attempt(fn, args, kwargs)
+                result = self._attempt(fn, args, kwargs)
             except Exception as e:     # KeyboardInterrupt/SystemExit
                 #                        always propagate unretried
+                attempt_s = time.monotonic() - t0
                 if attempt >= self.max_attempts or not self.retryable(e):
+                    raise
+                backoff = self.backoff_s(attempt)
+                dl = overload.current_deadline()
+                if dl is not None and dl.at is not None \
+                        and dl.remaining_s() < backoff + attempt_s:
+                    # the sleep + another attempt of the size just
+                    # observed cannot fit the remaining budget: the
+                    # retry is doomed work, surface the error now
+                    overload.note_deadline("retry")
+                    raise
+                if self.budget is not None \
+                        and not self.budget.try_spend():
+                    # fleet-wide budget empty: retrying would amplify
+                    # the correlated failure that drained it
                     raise
                 _retry_attempts.inc(fn=getattr(fn, "__name__", "?"))
                 if on_retry is not None:
                     on_retry(attempt, e)
-                self._sleep(self.backoff_s(attempt))
+                self._sleep(backoff)
+            else:
+                if self.budget is not None:
+                    self.budget.on_success()
+                return result
 
     def wrap(self, fn, on_retry=None):
         """Decorator form of :meth:`call`."""

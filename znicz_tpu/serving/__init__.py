@@ -18,6 +18,14 @@ persistent ones trip a circuit breaker and predicts route to the
 native CPU fallback or answer 503 + Retry-After — ``/healthz`` turns
 ``degraded``/``open`` so balancers can react (docs/resilience.md).
 
+Overload defense (znicz_tpu.resilience.overload): requests carry an
+end-to-end deadline (``X-Deadline-Ms``) and a criticality class
+(``X-Criticality``) checked at every hop; retries and hedges spend a
+process-wide budget; a CoDel shed ladder keyed on measured queue wait
+brownouts sheddable traffic first; and SIGTERM drains gracefully —
+stop admitting, finish in-flight, exit (docs/resilience.md
+"Overload defense").
+
 CLI: ``python -m znicz_tpu serve --model path.znn --port N``;
 chaos smoke: ``python -m znicz_tpu chaos`` (tools/chaos_smoke.sh).
 """

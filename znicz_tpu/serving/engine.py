@@ -56,7 +56,7 @@ import numpy as np
 
 from .. import durability
 from ..export import ZnnLayer, read_znn
-from ..resilience import faults
+from ..resilience import faults, overload
 from ..resilience.breaker import CircuitBreaker, EngineUnavailable
 from ..resilience.retry import RetryPolicy
 from ..telemetry import compilestats, tracing
@@ -680,6 +680,11 @@ class ServingEngine:
             raise ValueError(f"expected a batched input, got {x.shape}")
         if len(x) == 0:
             raise ValueError("empty batch")
+        # deadline hop "forward": a batch whose every rider's budget
+        # already ran out must not burn a device slot — the raise is
+        # typed DeadlineExceeded (non-retryable, maps to 504), never
+        # a breaker event (the engine is fine, the budget is not)
+        overload.check_deadline("forward")
         # one generation per request: a hot reload mid-request must
         # never mix two models' layers/params (the canary also reuses
         # live traffic's sample shape, recorded here)

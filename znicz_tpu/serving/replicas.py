@@ -23,7 +23,21 @@ fleet down:
 * **rolling reload** — ``reload`` swaps replicas one at a time, so
   traffic keeps flowing on not-yet-swapped generations throughout and
   a verify/canary failure stops the roll with the remaining replicas
-  untouched.
+  untouched;
+* **hedged dispatch** (optional, ``hedge=HedgePolicy(...)`` /
+  ``serve --hedge``) — a breaker only catches a replica that FAILS; a
+  slow-but-not-sick replica drags p99 for every request routed to it.
+  With hedging, a dispatch that outlives the policy threshold (the
+  observed p95 forward latency, or a fixed ``--hedge-after-ms``)
+  fires ONE second attempt on another healthy replica;
+  first-result-wins, the loser's result is discarded and counted
+  (``hedges_total{outcome}``), and every hedge is budget-gated
+  through the process retry budget so speculative work cannot
+  multiply an overload (docs/resilience.md "Overload defense").
+
+Chaos site ``replica.slow.<i>`` fires on every dispatch to replica
+``i`` — a latency fault there is the deterministic "one slow replica"
+the overload drill (``chaos --scenario overload``) keys on.
 
 The set quacks like a single :class:`ServingEngine` where the HTTP
 front (``ServingServer``), ``/statusz`` and the serve CLI touch one —
@@ -38,8 +52,12 @@ generation), not the FLOPs.
 
 from __future__ import annotations
 
+import queue
 import threading
+import time
 
+from ..resilience import faults, overload
+from ..telemetry import tracing
 from ..telemetry.registry import REGISTRY
 from .engine import ServingEngine
 
@@ -66,9 +84,12 @@ class EngineReplicaSet:
     per call (a shared breaker/retry across replicas would collapse
     the failure domains this set exists to separate); the convenience
     classmethod :meth:`of` covers the common "same model, default
-    isolation" case."""
+    isolation" case.  ``hedge`` (a :class:`~znicz_tpu.resilience.
+    overload.HedgePolicy`, None = off) enables hedged dispatch — see
+    the module docstring."""
 
-    def __init__(self, factory, n_replicas: int):
+    def __init__(self, factory, n_replicas: int,
+                 hedge: "overload.HedgePolicy | None" = None):
         if not isinstance(n_replicas, int) or isinstance(
                 n_replicas, bool) or n_replicas < 1:
             raise ValueError(f"n_replicas must be a positive int, got "
@@ -91,6 +112,7 @@ class EngineReplicaSet:
             raise
         self._lock = threading.Lock()
         self._next = 0
+        self.hedge = hedge
         #: set-level single-flight: two concurrent rolling reloads
         #: (e.g. a promotion controller's direct engine.reload racing
         #: an operator's /admin/reload) would interleave across
@@ -140,13 +162,146 @@ class EngineReplicaSet:
                 return idx
         return start
 
+    def _pick_other(self, avoid: int) -> int | None:
+        """A healthy replica other than ``avoid`` for a hedge, or None
+        — a hedge re-sent to the replica that is already slow would be
+        pure added load."""
+        n = len(self.replicas)
+        with self._lock:
+            start = self._next
+            self._next = (self._next + 1) % n
+        for hop in range(n):
+            idx = (start + hop) % n
+            if idx != avoid \
+                    and self.replicas[idx].breaker.state != "open":
+                return idx
+        return None
+
+    def _call_replica(self, idx: int, x):
+        """One replica forward — the ``replica.slow.<i>`` chaos site
+        fires here, per dispatch, so a drill can latency-fault exactly
+        one replica of the fleet."""
+        faults.inject(f"replica.slow.{idx}")
+        return self.replicas[idx].predict(x)
+
     def predict(self, x):
+        # deadline hop "dispatch": refuse a batch whose budget already
+        # ran out before it costs a replica forward
+        overload.check_deadline("dispatch")
         idx = self._pick()
-        _dispatches.inc(replica=str(idx))
+        if self.hedge is None or len(self.replicas) < 2:
+            _dispatches.inc(replica=str(idx))
+            t0 = time.monotonic()
+            try:
+                y = self._call_replica(idx, x)
+            finally:
+                self._update_health_gauge()
+            if self.hedge is not None:
+                self.hedge.record_ms((time.monotonic() - t0) * 1e3)
+            return y
         try:
-            return self.replicas[idx].predict(x)
+            return self._hedged_predict(idx, x)
         finally:
             self._update_health_gauge()
+
+    # -- hedged dispatch --------------------------------------------------
+    def _hedged_predict(self, primary: int, x):
+        """First-result-wins dispatch with at most ONE hedge.
+
+        The primary runs on a worker thread; if it has not answered
+        within the policy threshold, a hedge fires on another healthy
+        replica (budget- and deadline-gated).  The first *successful*
+        result wins; an attempt that errors defers to the other one,
+        and only when every fired attempt has failed does the
+        primary's error surface (the same error the un-hedged path
+        would have raised).  The loser keeps running on its daemon
+        thread and its result is discarded — Python cannot cancel a
+        device call — but it is counted (``hedges_total``), which is
+        the honest cost ledger of hedging."""
+        policy = self.hedge
+        threshold_ms = policy.threshold_ms()
+        results: queue.Queue = queue.Queue()
+        dl = overload.current_deadline()
+        ids = tracing.current_request_ids()
+
+        def run(kind: str, idx: int):
+            # helper threads: contextvars (request ids, deadline) do
+            # not propagate — re-enter both so engine spans stay
+            # correlated and downstream hops still see the budget
+            token = tracing.set_request_ids(ids)
+            t0 = time.monotonic()
+            try:
+                with overload.deadline_scope(dl):
+                    y = self._call_replica(idx, x)
+                policy.record_ms((time.monotonic() - t0) * 1e3)
+                results.put((kind, None, y))
+            except BaseException as e:
+                results.put((kind, e, None))
+            finally:
+                tracing.reset_request_ids(token)
+
+        def wait_bound() -> float:
+            # every attempt terminates (bounded retries inside the
+            # engine), but a blocking wait without a timeout is still
+            # a hang waiting for a bug — bound by the deadline when
+            # one exists, generously otherwise
+            if dl is not None and dl.at is not None:
+                return max(0.05, dl.remaining_s() + 5.0)
+            return 600.0
+
+        _dispatches.inc(replica=str(primary))
+        threading.Thread(target=run, args=("primary", primary),
+                         daemon=True,
+                         name=f"znicz-replica-{primary}").start()
+        first = None
+        if threshold_ms is not None:
+            try:
+                first = results.get(timeout=threshold_ms / 1e3)
+            except queue.Empty:
+                first = None
+        hedged = False
+        if first is None and threshold_ms is not None:
+            # the primary outlived the threshold: hedge if a second
+            # healthy replica exists, the budget allows, and the
+            # request's own budget isn't already spent
+            idx2 = self._pick_other(primary)
+            if idx2 is None:
+                policy.note_outcome("no_replica")
+            elif (dl is not None and dl.expired()):
+                pass        # doomed either way; just await the primary
+            elif policy.allow_hedge():   # counts "denied" on refusal
+                hedged = True
+                _dispatches.inc(replica=str(idx2))
+                threading.Thread(target=run, args=("hedge", idx2),
+                                 daemon=True,
+                                 name=f"znicz-replica-{idx2}h").start()
+        expected = 2 if hedged else 1
+        errors: dict = {}
+        for _ in range(expected):
+            if first is None:
+                try:
+                    first = results.get(timeout=wait_bound())
+                except queue.Empty:
+                    break
+            kind, err, y = first
+            first = None
+            if err is None:
+                if hedged:
+                    policy.note_outcome("won" if kind == "hedge"
+                                        else "lost")
+                return y
+            errors[kind] = err
+        # every fired attempt failed (or the bounded wait ran out):
+        # surface the primary's error — the same one the un-hedged
+        # path raises — so error semantics don't depend on hedging
+        if "primary" in errors:
+            raise errors["primary"]
+        if errors:
+            raise next(iter(errors.values()))
+        overload.note_deadline("dispatch")
+        raise overload.DeadlineExceeded(
+            "hedged dispatch timed out waiting for any replica",
+            stage="dispatch")
 
     # -- ServingEngine-compatible surface ---------------------------------
     @property
@@ -289,7 +444,13 @@ class EngineReplicaSet:
         agg["replicas_healthy"] = sum(
             1 for e in self.replicas if e.breaker.state != "open")
         agg["replicas"] = self.replica_status()
+        if self.hedge is not None:
+            agg["hedge"] = self.hedge.metrics()
         return agg
+
+    def hedge_status(self) -> dict | None:
+        """Hedging policy snapshot for /statusz (None = hedging off)."""
+        return None if self.hedge is None else self.hedge.metrics()
 
     def close(self) -> None:
         # close EVERY replica even if one raises (each owns tmpdirs /

@@ -12,6 +12,14 @@ Wire protocol (JSON both ways):
   A 1-D ``inputs`` is treated as a single sample.  Errors: 400
   (malformed), 429 + ``Retry-After`` header (admission queue full),
   504 (request deadline passed while queued), 503 (engine failure).
+  Overload defense (docs/resilience.md): ``X-Deadline-Ms`` attaches
+  an end-to-end deadline at admission (header beats the body field;
+  ``--default-deadline-ms`` applies when neither is sent) that every
+  downstream hop checks — a budget the measured backlog cannot fit is
+  refused EARLY as 503 + ``Retry-After`` instead of doing doomed
+  work; ``X-Criticality: sheddable|default|critical`` places the
+  request on the adaptive (CoDel) shed ladder, and a shed or a
+  draining replica also answers 503 + ``Retry-After``.
 * ``GET /healthz``   liveness + model/backend summary.  ``status`` is
   the engine's resilience state — ``ok`` | ``degraded`` (circuit open,
   native CPU fallback serving) | ``open`` (circuit open, no fallback:
@@ -81,6 +89,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..resilience import overload
 from ..resilience.breaker import EngineUnavailable
 from ..telemetry import buildinfo, debugz, flightrecorder, tracing
 from ..telemetry.registry import (PROMETHEUS_CONTENT_TYPE, REGISTRY,
@@ -106,13 +115,20 @@ class ServingServer:
                  max_queue: int | None = None,
                  default_timeout_s: float = 60.0,
                  max_body_mb: float = 64.0,
-                 admin_token: str | None = None):
-        knobs = (max_batch, max_wait_ms, max_queue)
+                 admin_token: str | None = None,
+                 default_deadline_ms: float | None = None,
+                 shed_target_ms: float | None = None,
+                 shed_interval_ms: float = 500.0):
+        knobs = (max_batch, max_wait_ms, max_queue, shed_target_ms)
         if batcher is not None and any(k is not None for k in knobs):
             # silently dropping the knobs would look like they applied
             raise ValueError("pass batching knobs OR a prebuilt "
                              "batcher, not both")
         self.engine = engine
+        #: deadline attached to requests that carry neither an
+        #: X-Deadline-Ms header nor a body deadline_ms (None = only
+        #: explicit deadlines are enforced)
+        self.default_deadline_ms = default_deadline_ms
         # /admin/reload shares the public listener with /predict, so
         # it gets its own gate: when a token is configured (flag or
         # $ZNICZ_ADMIN_TOKEN), reload requests must carry it in
@@ -122,13 +138,33 @@ class ServingServer:
         self.admin_token = admin_token if admin_token is not None \
             else os.environ.get("ZNICZ_ADMIN_TOKEN") or None
         self.max_body = int(max_body_mb * 1e6)
+        if shed_target_ms is not None:
+            wait = 5.0 if max_wait_ms is None else float(max_wait_ms)
+            if shed_target_ms <= wait:
+                # the coalescing window IS queue wait on a healthy
+                # server: a target at or under max_wait_ms would read
+                # normal batching patience as standing overload and
+                # brown out an idle replica
+                raise ValueError(
+                    f"shed_target_ms ({shed_target_ms}) must exceed "
+                    f"max_wait_ms ({wait}): every under-filled batch "
+                    f"waits up to max_wait_ms by design")
         self._own_batcher = batcher is None
         self.batcher = batcher or MicroBatcher(
             engine.predict,
             max_batch=32 if max_batch is None else max_batch,
             max_wait_ms=5.0 if max_wait_ms is None else max_wait_ms,
-            max_queue=128 if max_queue is None else max_queue)
+            max_queue=128 if max_queue is None else max_queue,
+            # adaptive shedding is opt-in at construction (None = the
+            # fixed queue bound only, the PR-1 contract tests pin);
+            # the serve CLI enables it by default
+            shedder=(overload.CoDelShedder(
+                target_ms=shed_target_ms,
+                interval_ms=shed_interval_ms)
+                if shed_target_ms is not None else None))
         self.default_timeout_s = default_timeout_s
+        self._draining = False
+        self._stopped = False
         #: build stamp for scraped metrics (same rule as bench.py's
         #: transcript rows); computed once — forking git per scrape
         #: would make /metrics the hottest endpoint on the box
@@ -371,8 +407,24 @@ class ServingServer:
                     self._rec_rows = int(len(x))
                     self._rec_shape = [int(d) for d in x.shape[1:]]
                     deadline_ms = payload.get("deadline_ms")
+                    # X-Deadline-Ms beats the body field (a proxy can
+                    # tighten a budget without rewriting the body);
+                    # neither present → the server default applies
+                    hdr = self.headers.get("X-Deadline-Ms")
+                    if hdr is not None:
+                        deadline_ms = hdr
+                    if deadline_ms is None:
+                        deadline_ms = outer.default_deadline_ms
                     if deadline_ms is not None:   # junk → 400, not 503
                         deadline_ms = float(deadline_ms)
+                    criticality = (self.headers.get("X-Criticality")
+                                   or "default").strip().lower()
+                    if criticality not in overload.CRITICALITIES:
+                        # a typo'd class is a client bug: silently
+                        # demoting (or promoting) it would be worse
+                        raise ValueError(
+                            f"X-Criticality {criticality!r}; expected "
+                            f"one of {overload.CRITICALITIES}")
                 except Exception as e:
                     # ANY parse/shape failure is the client's error: a
                     # JSON 400 body, never a raw 500 traceback (ragged
@@ -384,10 +436,20 @@ class ServingServer:
                 try:
                     y = outer.batcher.predict(
                         x, deadline_ms=deadline_ms,
-                        timeout=outer.default_timeout_s)
+                        timeout=outer.default_timeout_s,
+                        criticality=criticality)
                 except QueueFull as e:
                     self._rec_error = str(e)
                     self._reply(429, {"error": str(e),
+                                      "retry_after_s": e.retry_after},
+                                {"Retry-After": str(e.retry_after)})
+                except overload.EarlyReject as e:
+                    # draining / adaptive shed / doomed deadline: the
+                    # request was refused BEFORE any work — 503 with
+                    # an honest come-back time, same contract as the
+                    # breaker's refusals (never a hang, never a 500)
+                    self._rec_error = str(e)
+                    self._reply(503, {"error": str(e),
                                       "retry_after_s": e.retry_after},
                                 {"Retry-After": str(e.retry_after)})
                 except DeadlineExceeded as e:
@@ -498,6 +560,10 @@ class ServingServer:
     # -- payload builders -------------------------------------------------
     def health(self) -> dict:
         state = self.engine.resilience_state()
+        if self._draining:
+            # a draining replica must drop out of rotation BEFORE its
+            # refusals reach clients — the probe is how balancers learn
+            state = "draining"
         out = {"status": state, "backend": self.engine.backend,
                "n_layers": self.engine.n_layers,
                "buckets": list(self.engine.buckets),
@@ -533,9 +599,34 @@ class ServingServer:
             out["retry_after_s"] = int(self.engine.breaker.retry_after())
         return out
 
+    def overload_status(self, bm: dict | None = None) -> dict:
+        """The overload-defense snapshot /statusz renders (and the
+        JSON /metrics view embeds): drain state, default deadline,
+        measured queue wait, shed ladder, hedge policy, and the
+        process retry budget's level.  ``bm`` lets :meth:`metrics`
+        reuse its already-computed batcher snapshot instead of
+        sorting the latency deques twice under the batcher lock."""
+        if bm is None:
+            bm = self.batcher.metrics()
+        out = {"draining": self._draining,
+               "default_deadline_ms": self.default_deadline_ms,
+               "queue_wait_p50_ms": bm.get("queue_wait_p50_ms"),
+               "queue_wait_p95_ms": bm.get("queue_wait_p95_ms"),
+               "shed": bm.get("shedder"),
+               "doomed": bm.get("doomed", 0),
+               "expired": bm.get("expired", 0)}
+        hedge_status = getattr(self.engine, "hedge_status", None)
+        if hedge_status is not None:
+            out["hedge"] = hedge_status()
+        budget = overload.process_budget()
+        if budget is not None:
+            out["retry_budget"] = budget.metrics()
+        return out
+
     def metrics(self) -> dict:
         m = self.batcher.metrics()
         m["engine"] = self.engine.metrics()
+        m["overload"] = self.overload_status(bm=m)
         # build attribution + the registry's request totals: the same
         # Counter objects back the Prometheus text view, so the two
         # formats can never disagree
@@ -595,7 +686,35 @@ class ServingServer:
         self._thread.start()
         return self
 
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: stop admitting (new ``/predict`` work is
+        refused 503 + ``Retry-After`` and ``/healthz`` turns
+        ``draining`` so balancers rotate this replica out), wait —
+        bounded by ``timeout_s`` — for every already-admitted request
+        to be answered, then :meth:`stop`.  Returns True when the
+        queue fully drained before the bound.  This is what the serve
+        CLI runs on SIGTERM (docs/serving.md)."""
+        self._draining = True
+        overload.set_drain_state(overload.DRAIN_DRAINING)
+        drained = self.batcher.drain(timeout_s)
+        # the batcher answered every request (events set), but the
+        # handler threads still have to wake and WRITE the responses —
+        # give them a beat before the listener goes away, or a CLI
+        # exit right after drain() can cut the last bytes off
+        time.sleep(0.25)
+        self.stop()
+        if drained:
+            # a timed-out drain stays at 1: the gauge exists to tell
+            # an orchestrator whether the shutdown was clean, and a
+            # cut-off in-flight request is exactly the case it must
+            # not mask
+            overload.set_drain_state(overload.DRAIN_DRAINED)
+        return drained
+
     def stop(self) -> None:
+        if self._stopped:
+            return          # drain() already stopped us; idempotent
+        self._stopped = True
         REGISTRY.unregister_collector(self._collect_components)
         self.server.shutdown()
         self.server.server_close()
@@ -636,6 +755,43 @@ def main(argv=None) -> int:
                         "is slow)")
     p.add_argument("--max-body-mb", type=float, default=64.0,
                    help="largest accepted /predict body (413 beyond)")
+    p.add_argument("--default-deadline-ms", type=float, default=None,
+                   help="end-to-end deadline attached to requests "
+                        "that send neither X-Deadline-Ms nor a body "
+                        "deadline_ms (default: none — only explicit "
+                        "deadlines are enforced); every hop checks "
+                        "it and doomed work is refused early "
+                        "(docs/resilience.md)")
+    p.add_argument("--shed-target-ms", type=float, default=None,
+                   help="adaptive (CoDel) load shedding: queue wait "
+                        "standing above this target escalates the "
+                        "brownout ladder — sheddable traffic first, "
+                        "then default, critical never "
+                        "(X-Criticality header; 0 disables shedding; "
+                        "default: max(100, 2 x max-wait-ms), so a "
+                        "long coalescing window never reads as "
+                        "overload)")
+    p.add_argument("--hedge", action="store_true",
+                   help="hedged dispatch (needs --replicas >= 2): a "
+                        "batch that outlives the observed p95 forward "
+                        "latency fires one budget-gated second "
+                        "attempt on another healthy replica, first "
+                        "result wins — collapses slow-replica tail "
+                        "latency")
+    p.add_argument("--hedge-after-ms", type=float, default=None,
+                   help="fixed hedge trigger instead of the adaptive "
+                        "p95 (useful when a known SLO bound beats the "
+                        "observed tail)")
+    p.add_argument("--retry-budget", type=float, default=0.1,
+                   help="process-wide retry budget: retries AND "
+                        "hedges are limited to this fraction of "
+                        "successful traffic (SRE retry-budget rule; "
+                        "0 disables the budget and restores "
+                        "unconditional per-call retries)")
+    p.add_argument("--drain-timeout-s", type=float, default=20.0,
+                   help="SIGTERM graceful drain bound: stop admitting "
+                        "(503 + Retry-After), finish in-flight "
+                        "requests up to this long, then exit")
     p.add_argument("--retry-attempts", type=int, default=3,
                    help="attempts per forward for transient device "
                         "errors (1 disables retries)")
@@ -706,6 +862,23 @@ def main(argv=None) -> int:
     from .. import compilecache
     compilecache.enable(args.compile_cache_dir)
     buckets = tuple(int(b) for b in args.buckets.split(","))
+    # the retry budget is deliberately ONE object shared by every
+    # replica's RetryPolicy and the hedge policy: unlike breakers
+    # (which isolate per-replica failure domains), the budget is a
+    # fleet-process-wide resource — that is exactly what stops a
+    # correlated failure from multiplying into a retry storm
+    budget = (overload.RetryBudget(ratio=args.retry_budget)
+              if args.retry_budget > 0 else None)
+    overload.set_process_budget(budget)
+    # the shedding default is DERIVED from the coalescing window: an
+    # operator who raises --max-wait-ms must not have that deliberate
+    # batching patience read as standing overload (an EXPLICIT target
+    # at or under max-wait-ms still fails fast in ServingServer)
+    if args.shed_target_ms is None:
+        shed_target_ms = max(100.0, 2.0 * args.max_wait_ms)
+    else:
+        shed_target_ms = (args.shed_target_ms
+                          if args.shed_target_ms > 0 else None)
 
     def _make_engine(_i):
         # per-replica construction: breaker/retry/cache must be FRESH
@@ -718,16 +891,24 @@ def main(argv=None) -> int:
             args.model, backend=args.backend,
             buckets=buckets, cache_size=args.cache_size, tp=args.tp,
             retry=RetryPolicy(max_attempts=args.retry_attempts,
-                              base_delay_s=0.02, max_delay_s=0.25),
+                              base_delay_s=0.02, max_delay_s=0.25,
+                              budget=budget),
             breaker=CircuitBreaker(
                 failure_threshold=args.breaker_threshold,
                 cooldown_s=args.breaker_cooldown_s))
 
     if args.replicas < 1:
         p.error("--replicas must be >= 1")
+    if args.hedge and args.replicas < 2:
+        p.error("--hedge needs --replicas >= 2 (a hedge goes to "
+                "ANOTHER replica)")
     if args.replicas > 1:
         from .replicas import EngineReplicaSet
-        engine = EngineReplicaSet(_make_engine, args.replicas)
+        hedge = (overload.HedgePolicy(after_ms=args.hedge_after_ms,
+                                      budget=budget)
+                 if args.hedge else None)
+        engine = EngineReplicaSet(_make_engine, args.replicas,
+                                  hedge=hedge)
     else:
         engine = _make_engine(0)
     from ..telemetry import profiler
@@ -771,7 +952,10 @@ def main(argv=None) -> int:
                                max_queue=args.max_queue,
                                default_timeout_s=args.timeout_s,
                                max_body_mb=args.max_body_mb,
-                               admin_token=args.admin_token)
+                               admin_token=args.admin_token,
+                               default_deadline_ms=args
+                               .default_deadline_ms,
+                               shed_target_ms=shed_target_ms)
         server.start()
         mesh = "x".join(str(d) for d in engine.mesh_shape)
         print(f"serving {args.model} [{engine.backend}] at "
@@ -788,11 +972,17 @@ def main(argv=None) -> int:
         # path as Ctrl-C for container runtimes.
         import signal as _signal
         stop = threading.Event()
+        term = threading.Event()
         hup = threading.Event()
 
         def _arm():
-            for _sig in (_signal.SIGINT, _signal.SIGTERM):
-                _signal.signal(_sig, lambda *_: stop.set())
+            # SIGINT = stop NOW (an operator's Ctrl-C); SIGTERM = the
+            # orchestrator's polite eviction — stop ADMITTING, finish
+            # in-flight requests (bounded by --drain-timeout-s), then
+            # exit: a rolling restart must not cut answers off mid-
+            # flight (docs/serving.md "Graceful drain")
+            _signal.signal(_signal.SIGINT, lambda *_: stop.set())
+            _signal.signal(_signal.SIGTERM, lambda *_: term.set())
             # the thread-dump handler rides the same re-arm loop (the
             # native-lib sigaction clobbering below hits it too)
             _debugz.install_stack_dump()
@@ -803,7 +993,7 @@ def main(argv=None) -> int:
                 # POST /admin/reload
                 _signal.signal(_signal.SIGHUP, lambda *_: hup.set())
         _arm()
-        while not stop.is_set():
+        while not stop.is_set() and not term.is_set():
             stop.wait(0.5)
             _arm()    # native libs (XLA's profiler) can clobber the
             #           process sigaction; re-arming each tick keeps
@@ -823,6 +1013,18 @@ def main(argv=None) -> int:
                 profile_deadline = None
                 print(f"profile capture complete: "
                       f"{profiler.stop_trace()}", flush=True)
+        if term.is_set():
+            # graceful SIGTERM drain: admission stops (503 + Retry-
+            # After, /healthz flips to "draining"), in-flight requests
+            # finish — bounded — and only then does the listener die.
+            # Before this existed, SIGTERM just stopped the tick loop
+            # and the process teardown cut in-flight answers off.
+            print(f"SIGTERM: draining (bound "
+                  f"{args.drain_timeout_s:.0f}s; new requests get "
+                  f"503 + Retry-After)", flush=True)
+            drained = server.drain(args.drain_timeout_s)
+            print(f"drain {'complete' if drained else 'timed out'}; "
+                  f"exiting", flush=True)
     except KeyboardInterrupt:
         pass
     finally:

@@ -168,6 +168,34 @@ def statusz_text(server=None, *, recorder=None, extra: dict | None = None
                                     "rejected", "expired",
                                     "latency_p50_ms",
                                     "latency_p99_ms")}))
+        ov_fn = getattr(server, "overload_status", None)
+        if ov_fn is not None:
+            # the overload-defense snapshot: is this replica shedding,
+            # hedging, draining, or denying retries RIGHT NOW — the
+            # questions a 503 spike raises mid-incident
+            try:
+                ov = ov_fn()
+            except Exception:
+                ov = None
+            if ov:
+                lines += ["", "overload", "-" * 8]
+                lines.append(_fmt_kv({
+                    "draining": ov.get("draining"),
+                    "default_deadline_ms":
+                        ov.get("default_deadline_ms"),
+                    "queue_wait_p50_ms": ov.get("queue_wait_p50_ms"),
+                    "queue_wait_p95_ms": ov.get("queue_wait_p95_ms"),
+                    "doomed": ov.get("doomed"),
+                    "expired": ov.get("expired")}))
+                shed = ov.get("shed")
+                if shed:
+                    lines.append("shed ladder: " + _fmt_kv(shed))
+                hedge = ov.get("hedge")
+                if hedge:
+                    lines.append("hedge: " + _fmt_kv(hedge))
+                budget = ov.get("retry_budget")
+                if budget:
+                    lines.append("retry budget: " + _fmt_kv(budget))
     snap = compilestats.snapshot()
     lines += ["", "compile accounting", "-" * 18]
     if not snap["compiles"]:
