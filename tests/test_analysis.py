@@ -104,6 +104,63 @@ class TestLockDiscipline:
 """, [LockDisciplineRule()])
         assert found == []
 
+    def test_model_registry_torn_read_fires(self, tmp_path):
+        # the zoo registry's exact mutable-state shape (ISSUE 11): an
+        # LRU recency map + entries dict guarded in most methods, with
+        # one scrape-path read outside the lock — the torn-read bug
+        # PR 4 flagged in ServingEngine.metrics, re-pinned here so the
+        # registry class stays honest
+        found = lint(tmp_path, """
+    import threading
+    import time
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._entries = {}
+            self._last_used = {}
+
+        def add(self, name, engine):
+            with self._lock:
+                self._entries[name] = engine
+                self._last_used[name] = time.monotonic()
+
+        def touch(self, name):
+            with self._lock:
+                self._last_used[name] = time.monotonic()
+
+        def coldest(self):
+            return min(self._last_used)   # unguarded scrape read
+""", [LockDisciplineRule()])
+        assert rules_of(found) == ["lock-discipline"]
+        assert len(found) == 1 and "_last_used" in found[0].message
+
+    def test_model_registry_guarded_is_silent(self, tmp_path):
+        found = lint(tmp_path, """
+    import threading
+    import time
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._entries = {}
+            self._last_used = {}
+
+        def add(self, name, engine):
+            with self._lock:
+                self._entries[name] = engine
+                self._last_used[name] = time.monotonic()
+
+        def touch(self, name):
+            with self._lock:
+                self._last_used[name] = time.monotonic()
+
+        def coldest(self):
+            with self._lock:
+                return min(self._last_used)
+""", [LockDisciplineRule()])
+        assert found == []
+
     def test_lock_held_helper_inferred(self, tmp_path):
         # a private helper only ever called under the lock runs under
         # it by construction (the MicroBatcher._queued_rows idiom)
@@ -497,6 +554,83 @@ class TestMetricDrift:
                                script_paths=("tools/smoke.sh",))
         assert Analyzer([rule],
                         root=str(tmp_path)).run(["pkg/m.py"]) == []
+
+    def test_labeled_backtick_is_a_reference(self, tmp_path):
+        # a backticked token WITH a label set is a metric reference
+        # even when the bare name lacks a metric suffix — the zoo's
+        # `model_resident{model=...}` idiom (ISSUE 11).  Registered →
+        # silent AND counts as documentation; unregistered → drift.
+        mod = tmp_path / "pkg" / "m.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text('from telemetry import REGISTRY\n'
+                       '_g = REGISTRY.gauge("model_resident", "h")\n')
+        doc = tmp_path / "docs" / "obs.md"
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        (tmp_path / "tools").mkdir(exist_ok=True)
+        (tmp_path / "tools" / "smoke.sh").write_text("")
+        rule = MetricDriftRule(doc_paths=("docs/obs.md",),
+                               script_paths=("tools/smoke.sh",))
+        # registered + labeled-referenced: in sync, both directions
+        doc.write_text('watch `model_resident{model="wine"}` flip\n')
+        assert Analyzer([rule],
+                        root=str(tmp_path)).run(["pkg/m.py"]) == []
+        # the same labeled idiom naming a ghost family must fire —
+        # before the label-set extension this drift was invisible
+        doc.write_text('watch `model_resident{model="wine"}` and '
+                       '`model_phantom{model="x"}`\n')
+        found = Analyzer([rule],
+                         root=str(tmp_path)).run(["pkg/m.py"])
+        assert len(found) == 1 and "model_phantom" in found[0].message
+        # a bare suffix-less token stays prose (no false positive)
+        doc.write_text('`model_resident{model="w"}`; the resident '
+                       'set and `some_config` are prose\n')
+        assert Analyzer([rule],
+                        root=str(tmp_path)).run(["pkg/m.py"]) == []
+
+    def test_concat_built_prefix_registers(self, tmp_path):
+        # dynamic family names built by string concatenation IN a
+        # family tuple's name slot — ("gauge", "zoo_model_" + k, …) —
+        # whitelist their prefix exactly like the ("prefix_", source)
+        # fan-out tuple shape
+        mod = tmp_path / "pkg" / "m.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text(textwrap.dedent("""
+            def collect(self):
+                fams = []
+                for k, v in self.rows().items():
+                    fams.append(("gauge", "zoo_model_" + k, "m", []))
+                return fams
+        """))
+        doc = tmp_path / "docs" / "obs.md"
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        doc.write_text("`zoo_model_generation{model=...}` per model\n")
+        (tmp_path / "tools").mkdir(exist_ok=True)
+        (tmp_path / "tools" / "smoke.sh").write_text("")
+        rule = MetricDriftRule(doc_paths=("docs/obs.md",),
+                               script_paths=("tools/smoke.sh",))
+        assert Analyzer([rule],
+                        root=str(tmp_path)).run(["pkg/m.py"]) == []
+
+    def test_bare_concat_does_not_whitelist_namespace(self, tmp_path):
+        # the guard on the extension: a prefix-shaped concat OUTSIDE
+        # a family tuple (a filename, a log tag) must not whitelist
+        # the namespace and mask real drift
+        mod = tmp_path / "pkg" / "m.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text(textwrap.dedent("""
+            def save(self, name):
+                return open("model_" + name + ".znn", "wb")
+        """))
+        doc = tmp_path / "docs" / "obs.md"
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        doc.write_text('`model_ghost{model="x"}` is watched\n')
+        (tmp_path / "tools").mkdir(exist_ok=True)
+        (tmp_path / "tools" / "smoke.sh").write_text("")
+        rule = MetricDriftRule(doc_paths=("docs/obs.md",),
+                               script_paths=("tools/smoke.sh",))
+        found = Analyzer([rule],
+                         root=str(tmp_path)).run(["pkg/m.py"])
+        assert len(found) == 1 and "model_ghost" in found[0].message
 
 
 # -- duration clock --------------------------------------------------------

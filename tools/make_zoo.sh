@@ -1,0 +1,31 @@
+#!/bin/bash
+# Build a demo model zoo: one tiny .znn per model family (mnist, wine,
+# kohonen — distinct layer chains AND input widths, see
+# znicz_tpu/serving/zoo.py DEMO_SHAPES), each committed through the
+# real atomic export path with a sha256 manifest, so multi-tenant
+# tests, smoke drills and manual `serve --zoo` runs all have real
+# multi-family inputs.
+#
+# Usage:  bash tools/make_zoo.sh [DIR]          (default: ./zoo)
+#
+# Then:   python -m znicz_tpu serve --zoo DIR --port 8100
+#         curl -s localhost:8100/predict -H 'X-Model: wine' \
+#              -d '{"inputs": [[0.1, ... 13 floats]]}'
+set -eu -o pipefail
+cd "$(dirname "$0")/.."
+
+DIR="${1:-zoo}"
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$DIR" <<'PY'
+import json
+import sys
+
+from znicz_tpu.serving.zoo import DEMO_SHAPES, make_demo_zoo
+
+directory = sys.argv[1]
+paths = make_demo_zoo(directory)
+for family, path in sorted(paths.items()):
+    print(json.dumps({"model": family, "path": path,
+                      "input_features": DEMO_SHAPES[family]}))
+print(f"zoo of {len(paths)} model families in {directory!r} — serve "
+      f"with:  python -m znicz_tpu serve --zoo {directory}")
+PY

@@ -19,7 +19,15 @@ Examples::
         # POST /predict carries an X-Request-Id (docs/observability.md),
         # and POST /admin/reload (or SIGHUP) hot-reloads the model with
         # verify + canary + rollback (docs/durability.md)
-    python -m znicz_tpu chaos [--scenario reload|promote|overload]
+    python -m znicz_tpu serve --zoo DIR --memory-budget-mb 64
+        # multi-tenant model zoo: every *.znn in DIR becomes a routable
+        # model (X-Model header / body "model" field; repeatable
+        # --model name=path,criticality=...,quota-rps=... adds or
+        # overrides entries) with per-model engines, batchers, quotas,
+        # criticality/deadline classes, per-model /admin/reload, and a
+        # weight-residency LRU under the memory budget
+        # (docs/serving.md "Multi-tenant model zoo")
+    python -m znicz_tpu chaos [--scenario reload|promote|overload|zoo]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
         # --scenario reload drills corrupt-artifact rollback;
@@ -28,7 +36,10 @@ Examples::
         # auto-rolled-back, zero dropped requests; docs/promotion.md);
         # --scenario overload drills the overload defenses (deadlines,
         # retry budget, hedged dispatch, adaptive shedding, graceful
-        # drain under 4x load with one slow replica; docs/resilience.md)
+        # drain under 4x load with one slow replica; docs/resilience.md);
+        # --scenario zoo drills multi-tenant serving (three families
+        # under a memory budget forcing weight eviction, one tenant
+        # latency-faulted, one reloaded mid-burst; docs/serving.md)
     python -m znicz_tpu promote --candidates DIR --url http://host:port/
         # closed-loop promotion controller sidecar: watch a trainer's
         # export directory, verify + canary-deploy each new candidate

@@ -17,12 +17,18 @@ Cross-check, repo-wide:
   shape ``MetricsRegistry.register_collector`` samples — register
   their name too (``breaker_state`` et al).
 * **Dynamic prefixes**: string constants matching ``name_`` (trailing
-  underscore) used in collector code (``serving_batcher_``,
-  ``serving_engine_``) whitelist every name they prefix.
+  underscore) used in collector code — the ``("serving_batcher_", …)``
+  fan-out tuple shape AND ``"zoo_model_" + field`` concatenation *in a
+  family tuple's name slot* (``("gauge", "zoo_model_" + f, …)``; a
+  bare concat elsewhere must not whitelist a namespace) — whitelist
+  every name they prefix.
 * **References**: metric-shaped tokens in the doc inventory table, in
   backticks anywhere in the doc, and in the smoke scripts
   (``_bucket``/``_sum``/``_count`` histogram suffixes are folded onto
-  their base series).
+  their base series).  A backticked token carrying a label set
+  (``model_resident{model="wine"}``) is a metric reference even when
+  the bare name lacks a metric suffix — the zoo's ``model_*{model=…}``
+  families read naturally in prose that way.
 
 Findings: a referenced name nobody registers (**unregistered
 reference** — the doc/smoke is asserting a series that no longer
@@ -50,8 +56,12 @@ _METRIC_SHAPE = re.compile(
 #: doc inventory-table row: ``| `name` | type | ...``
 _TABLE_ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`")
 
-#: backticked token, optionally with a label set (`name{label=...}`)
-_BACKTICK = re.compile(r"`([a-z][a-z0-9_]*)(?:\{[^`]*\})?`")
+#: backticked token, optionally with a label set (`name{label=...}`);
+#: group 2 (the label set) being present makes the token a metric
+#: reference REGARDLESS of suffix morphology — `model_resident{model=
+#: "wine"}` is unambiguously a metric even though a bare
+#: `model_resident` would read as prose
+_BACKTICK = re.compile(r"`([a-z][a-z0-9_]*)(\{[^`]*\})?`")
 
 #: any identifier-ish token (for shell scripts)
 _WORD = re.compile(r"[a-z][a-z0-9_]{3,}")
@@ -108,11 +118,26 @@ class MetricDriftRule(RepoRule):
                     first, second = node.elts[0], node.elts[1]
                     if (isinstance(first, ast.Constant)
                             and first.value in ("counter", "gauge",
-                                                "histogram")
-                            and isinstance(second, ast.Constant)
-                            and isinstance(second.value, str)):
-                        registered.setdefault(
-                            second.value, (mod.path, node.lineno))
+                                                "histogram")):
+                        if isinstance(second, ast.Constant) \
+                                and isinstance(second.value, str):
+                            registered.setdefault(
+                                second.value, (mod.path, node.lineno))
+                        elif (isinstance(second, ast.BinOp)
+                              and isinstance(second.op, ast.Add)
+                              and isinstance(second.left, ast.Constant)
+                              and isinstance(second.left.value, str)
+                              and _PREFIX_SHAPE.match(
+                                  second.left.value)):
+                            # a dynamic family name built by
+                            # concatenation IN the family-name slot —
+                            # ("gauge", "zoo_model_" + field, …) —
+                            # registers its prefix.  Constrained to
+                            # this slot on purpose: a bare
+                            # '"model_" + x' elsewhere (a filename,
+                            # a log tag) must NOT whitelist a whole
+                            # metric namespace and mask drift
+                            prefixes.add(second.left.value)
                 if isinstance(node, ast.Tuple) and len(node.elts) == 2:
                     # the collector fan-out shape: ("serving_engine_",
                     # <metrics source>) — NOT every trailing-underscore
@@ -142,10 +167,14 @@ class MetricDriftRule(RepoRule):
             if m and (m.group(1), i) not in seen:
                 seen.add((m.group(1), i))
                 refs.append((m.group(1), i, text.strip()))
-            for name in _BACKTICK.findall(text):
+            for name, labels in _BACKTICK.findall(text):
                 # a table row also matches the backtick scan — one
-                # reference per (name, line), not two findings
-                if _METRIC_SHAPE.match(name) and (name, i) not in seen:
+                # reference per (name, line), not two findings.  A
+                # label set (`name{model=...}`) marks a metric
+                # reference even when the bare name lacks a metric
+                # suffix (the `model_*{model=...}` zoo families)
+                if (labels or _METRIC_SHAPE.match(name)) \
+                        and (name, i) not in seen:
                     seen.add((name, i))
                     refs.append((name, i, text.strip()))
         return refs

@@ -33,6 +33,15 @@ be auto-rolled-back within the SLO window.  Asserted: zero non-200
 rollback restored the previous generation's exact bytes, and the
 promotion ledger records every transition.
 
+The fifth drill, ``--scenario zoo`` (tools/zoo_smoke.sh), is the
+multi-tenant acceptance (docs/serving.md "Multi-tenant model zoo"):
+three model families behind one server under a weight-residency
+budget that forces eviction, mixed-criticality traffic with one
+tenant latency-faulted (``zoo.model.<name>``) and one hot-reloaded
+mid-burst — zero raw 500s, the critical tenant never shed, page-in
+byte-identity, page-in p99 bounded by the warmup compile cost, and
+per-model reload isolation all asserted.
+
 Exit code 0 when every invariant holds — tools/chaos_smoke.sh wires
 this into CI-ish usage.  The same ``FaultPlan`` mechanism drives the
 pytest ``chaos`` marker; this mode exists so an operator can smoke a
@@ -607,6 +616,212 @@ def _overload_scenario(args) -> int:
     return 1 if bad else 0
 
 
+def _zoo_scenario(args) -> int:
+    """``--scenario zoo`` — the multi-tenant acceptance
+    (docs/serving.md "Multi-tenant model zoo"): three model families
+    behind ONE server under a memory budget smaller than their
+    combined weights, mixed-tenant traffic with per-model criticality
+    classes, one tenant latency-faulted (``zoo.model.mnist``), one
+    reloaded mid-burst.  Asserted:
+
+    * zero raw 500s and zero hangs — every answer is 200/429/503/504,
+      with ``Retry-After`` on every 429/503;
+    * the ``critical`` tenant is never shed and answers only 200s
+      while the ``sheddable`` one browns out;
+    * the residency LRU actually churned (evictions ≥ 1) and every
+      page-in served byte-identical outputs (per-model distinct-output
+      counts stay 1, except the deliberately reloaded tenant's 2);
+    * page-in p99 is bounded by the compile cost warmup already paid;
+    * the mid-burst reload moved ONLY its own model's generation.
+    """
+    import collections
+    import threading
+
+    from ..serving.server import ServingServer
+    from ..serving import zoo as zoo_mod
+    from ..telemetry.registry import REGISTRY
+
+    bad: list[str] = []
+    inputs = {"mnist": [[0.2] * 16], "wine": [[0.1] * 13],
+              "kohonen": [[0.3] * 6]}
+    with tempfile.TemporaryDirectory(prefix="znicz_chaos_") as tmp:
+        paths = zoo_mod.make_demo_zoo(tmp)
+        wine_v2 = os.path.join(tmp, "wine_v2.znn")
+        zoo_mod.write_demo_model(wine_v2, "wine", seed=101)
+        # one bucket only: byte-identity across eviction/page-in is an
+        # assertion here, and different pad buckets legitimately
+        # differ in low-order bits (XLA vectorizes batch shapes
+        # differently — the PR-7 de-flake); a single bucket removes
+        # that axis so any byte drift IS a residency bug
+        zoo = zoo_mod.ModelZoo()       # budget installed after warmup
+        zoo.add("mnist", paths["mnist"], backend="jax",
+                buckets=(1,), criticality="sheddable")
+        zoo.add("wine", paths["wine"], backend="jax",
+                buckets=(1,), default=True)
+        zoo.add("kohonen", paths["kohonen"], backend="jax",
+                buckets=(1,), criticality="critical")
+        # shed interval 400ms: the slow tenant dispatches one batch
+        # per injected fault latency (250ms), and CoDel's "standing"
+        # anchor deliberately breaks on a 2-interval sample gap — the
+        # interval must comfortably exceed the dispatch cadence or
+        # overload can never read as standing
+        server = ServingServer(
+            zoo=zoo, max_batch=4, max_wait_ms=1.0, max_queue=32,
+            default_deadline_ms=10000.0, shed_target_ms=25.0,
+            shed_interval_ms=400.0).start()
+        # pay every compile up front and TIME it — "page-in p99
+        # bounded by warmup" is the claim that re-admitting an evicted
+        # model costs device_put milliseconds, not the jit seconds
+        # warmup paid once
+        t0 = time.monotonic()
+        total_bytes = 0
+        for entry in zoo.entries():
+            entry.engine.warmup((len(inputs[entry.name][0]),))
+            total_bytes += entry.engine.weight_nbytes()
+        warmup_ms = (time.monotonic() - t0) * 1e3
+        # now tighten the screw: the budget holds ~60% of the zoo, so
+        # cycling all three tenants HAS to evict
+        zoo.memory_budget = int(total_bytes * args.zoo_budget_frac)
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "zoo.model.mnist", kind="latency",
+            latency_s=args.slow_s,
+            message="chaos: slow tenant")], seed=13)
+        answers = collections.defaultdict(list)  # model -> (code, ra)
+        outputs = collections.defaultdict(set)   # model -> bodies seen
+        mu = threading.Lock()
+        stop = threading.Event()
+
+        def client(model: str):
+            while not stop.is_set():
+                try:
+                    code, body, headers = _post(
+                        server.url, {"inputs": inputs[model]},
+                        timeout=30.0, headers={"X-Model": model})
+                except Exception:
+                    code, body, headers = -1, {}, {}
+                with mu:
+                    answers[model].append(
+                        (code, "Retry-After" in headers))
+                    if code == 200:
+                        outputs[model].add(json.dumps(body["outputs"]))
+                stop.wait(0.002)
+
+        threads = [threading.Thread(target=client, args=(m,),
+                                    daemon=True)
+                   for m in ("mnist",) * 4 + ("wine",) * 2
+                   + ("kohonen",) * 2]
+
+        def _shed_critical() -> float:
+            snap = REGISTRY.as_dict().get("shed_total", 0)
+            return (snap.get("criticality=critical", 0)
+                    if isinstance(snap, dict) else 0)
+
+        shed_crit_before = _shed_critical()
+        reload_rec: dict = {}
+        try:
+            with plan:
+                for t in threads:
+                    t.start()
+                # mid-burst: hot-reload ONE tenant while the other two
+                # keep serving — isolation is the assertion
+                stop.wait(args.duration_s / 3.0)
+                status, rec = _admin_reload_named(server.url, "wine",
+                                                  wine_v2)
+                reload_rec = {"http_status": status, **rec}
+                stop.wait(args.duration_s * 2.0 / 3.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(30.0)
+            zoo_metrics = zoo.metrics()
+            shed_state = {e.name: (e.batcher.shedder.metrics()
+                                   if e.batcher and e.batcher.shedder
+                                   else {})
+                          for e in zoo.entries()}
+            server.stop()
+            zoo.close()
+        # -- invariants ---------------------------------------------------
+        for model, got in sorted(answers.items()):
+            codes = collections.Counter(c for c, _ra in got)
+            if codes.get(-1):
+                bad.append(f"{model}: {codes[-1]} hung/dropped "
+                           f"request(s)")
+            raw = {c for c in codes if c not in (200, 429, 503, 504)}
+            if raw:
+                bad.append(f"{model}: raw failure codes {sorted(raw)}")
+            missing_ra = sum(1 for c, ra in got
+                             if c in (429, 503) and not ra)
+            if missing_ra:
+                bad.append(f"{model}: {missing_ra} refusal(s) without "
+                           f"Retry-After")
+            print(json.dumps({"phase": "burst", "model": model,
+                              "codes": dict(codes)}))
+        crit = collections.Counter(c for c, _ra in answers["kohonen"])
+        if set(crit) != {200}:
+            bad.append(f"critical tenant saw non-200 answers: "
+                       f"{dict(crit)}")
+        shed_crit = _shed_critical() - shed_crit_before
+        if shed_crit:
+            bad.append(f"critical traffic was shed {shed_crit} "
+                       f"time(s) during the drill")
+        if not any(sm.get("shed") for sm in shed_state.values()):
+            bad.append(f"no tenant ever shed under a "
+                       f"{args.slow_s * 1e3:.0f}ms-slow sheddable "
+                       f"tenant: {shed_state}")
+        evicted = REGISTRY.as_dict().get("model_evictions_total", 0)
+        n_evicted = (sum(evicted.values())
+                     if isinstance(evicted, dict) else evicted)
+        if n_evicted < 1:
+            bad.append(f"the residency LRU never evicted under a "
+                       f"{zoo.memory_budget}-byte budget "
+                       f"(weights total {total_bytes})")
+        p99 = zoo_metrics.get("pagein_p99_ms")
+        if p99 is None:
+            bad.append("no page-ins recorded — the budget never bit")
+        elif p99 >= warmup_ms:
+            bad.append(f"page-in p99 {p99:.1f}ms not bounded by the "
+                       f"warmup compile cost {warmup_ms:.1f}ms — "
+                       f"re-admission is paying compiles again")
+        if reload_rec.get("http_status") != 200 \
+                or (reload_rec.get("last_reload") or {}).get("outcome") \
+                != "ok":
+            bad.append(f"mid-burst wine reload failed: {reload_rec}")
+        gens = {r["model"]: r["generation"]
+                for r in zoo_metrics["models"].values()}
+        if gens != {"mnist": 1, "wine": 2, "kohonen": 1}:
+            bad.append(f"reload isolation violated: generations "
+                       f"{gens}, expected wine=2 and others=1")
+        if len(outputs["mnist"]) != 1 or len(outputs["kohonen"]) != 1:
+            bad.append(f"eviction/page-in changed answer bytes: "
+                       f"mnist {len(outputs['mnist'])} distinct, "
+                       f"kohonen {len(outputs['kohonen'])}")
+        if len(outputs["wine"]) != 2:
+            bad.append(f"wine should have exactly 2 distinct outputs "
+                       f"(pre/post reload), saw "
+                       f"{len(outputs['wine'])}")
+        print(json.dumps({
+            "scenario": "zoo", "ok": not bad, "violations": bad,
+            "warmup_ms": round(warmup_ms, 1),
+            "pagein_p99_ms": p99, "evictions": n_evicted,
+            "shed": {m: s.get("shed") for m, s in shed_state.items()},
+            "reload": reload_rec.get("http_status"),
+            "generations": gens}))
+    return 1 if bad else 0
+
+
+def _admin_reload_named(url: str, name: str, model: str,
+                        timeout: float = 60.0):
+    """(status, body) of a synchronous per-model ``POST
+    /admin/reload`` naming a zoo entry."""
+    req = urllib.request.Request(
+        url + "admin/reload",
+        json.dumps({"name": name, "model": model,
+                    "wait": True}).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -627,7 +842,8 @@ def main(argv=None) -> int:
     p.add_argument("--cooldown-s", type=float, default=1.0)
     p.add_argument("--retry-attempts", type=int, default=2)
     p.add_argument("--scenario", default="breaker",
-                   choices=("breaker", "reload", "promote", "overload"),
+                   choices=("breaker", "reload", "promote", "overload",
+                            "zoo"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -639,7 +855,13 @@ def main(argv=None) -> int:
                         "one latency-faulted replica — deadlines, "
                         "retry budget, hedging, adaptive shedding and "
                         "graceful drain all asserted "
-                        "(docs/resilience.md)")
+                        "(docs/resilience.md); zoo: three model "
+                        "families in one multi-tenant server under a "
+                        "memory budget that forces weight eviction, "
+                        "one tenant latency-faulted, one hot-reloaded "
+                        "mid-burst — routing, residency byte-"
+                        "identity, criticality classes and reload "
+                        "isolation asserted (docs/serving.md)")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -669,6 +891,11 @@ def main(argv=None) -> int:
     p.add_argument("--budget-ratio", type=float, default=0.1,
                    help="overload: retry-budget refill fraction under "
                         "test")
+    p.add_argument("--zoo-budget-frac", type=float, default=0.6,
+                   help="zoo: weight-residency budget as a fraction "
+                        "of the demo zoo's combined weight bytes "
+                        "(< 1 forces eviction while all tenants "
+                        "cycle)")
     args = p.parse_args(argv)
     if args.scenario == "reload":
         return _reload_scenario(args)
@@ -676,6 +903,8 @@ def main(argv=None) -> int:
         return _promote_scenario(args)
     if args.scenario == "overload":
         return _overload_scenario(args)
+    if args.scenario == "zoo":
+        return _zoo_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

@@ -26,8 +26,18 @@ brownouts sheddable traffic first; and SIGTERM drains gracefully —
 stop admitting, finish in-flight, exit (docs/resilience.md
 "Overload defense").
 
-CLI: ``python -m znicz_tpu serve --model path.znn --port N``;
-chaos smoke: ``python -m znicz_tpu chaos`` (tools/chaos_smoke.sh).
+Multi-tenant model zoo (``zoo``): a :class:`ModelZoo` registry makes a
+model NAME the routable unit — per-model engines/batchers/generations,
+``X-Model`` routing, token-bucket quotas (429), per-tenant criticality
+and deadline classes on the shed ladder, and a weight-residency LRU
+that evicts cold models' device weights under a memory budget and
+pages them back in on demand (docs/serving.md "Multi-tenant model
+zoo").
+
+CLI: ``python -m znicz_tpu serve --model path.znn --port N`` (or
+``--zoo DIR`` / repeated ``--model name=path,...`` for a zoo);
+chaos smoke: ``python -m znicz_tpu chaos`` (tools/chaos_smoke.sh,
+tools/zoo_smoke.sh).
 """
 
 from ..resilience.breaker import EngineUnavailable
@@ -35,7 +45,9 @@ from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import ServingEngine
 from .replicas import EngineReplicaSet
 from .server import ServingServer
+from .zoo import ModelEntry, ModelZoo, QuotaExceeded, UnknownModel
 
 __all__ = ["DeadlineExceeded", "EngineReplicaSet", "EngineUnavailable",
-           "MicroBatcher", "QueueFull", "ServingEngine",
-           "ServingServer"]
+           "MicroBatcher", "ModelEntry", "ModelZoo", "QueueFull",
+           "QuotaExceeded", "ServingEngine", "ServingServer",
+           "UnknownModel"]

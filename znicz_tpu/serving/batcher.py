@@ -94,10 +94,15 @@ class MicroBatcher:
 
     def __init__(self, predict_fn, *, max_batch: int = 32,
                  max_wait_ms: float = 5.0, max_queue: int = 128,
-                 shedder: "overload.CoDelShedder | None" = None):
+                 shedder: "overload.CoDelShedder | None" = None,
+                 name: str | None = None):
         self._predict = (predict_fn.predict
                          if hasattr(predict_fn, "predict")
                          else predict_fn)
+        #: owner label — a multi-tenant zoo runs one batcher (and one
+        #: dispatch thread) per model, and a thread dump of N identical
+        #: "znicz-microbatcher" threads is useless mid-incident
+        self.name = name
         self.max_batch = int(max_batch)
         self.max_wait = float(max_wait_ms) / 1e3
         self.max_queue = int(max_queue)
@@ -115,8 +120,9 @@ class MicroBatcher:
         self._latencies = collections.deque(maxlen=1024)   # seconds
         self._step_times = collections.deque(maxlen=64)    # seconds
         self._queue_waits = collections.deque(maxlen=256)  # seconds
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="znicz-microbatcher")
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="znicz-microbatcher" + (f"-{name}" if name else ""))
         self._thread.start()
 
     # -- client side ------------------------------------------------------
@@ -413,6 +419,8 @@ class MicroBatcher:
         m["max_batch"] = self.max_batch
         m["max_wait_ms"] = self.max_wait * 1e3
         m["max_queue"] = self.max_queue
+        if self.name is not None:
+            m["model"] = self.name
         return m
 
     def drain(self, timeout_s: float = 30.0) -> bool:

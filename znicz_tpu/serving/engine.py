@@ -109,6 +109,11 @@ class _Generation:
         self.shardings = shardings
         self._lock = threading.Lock()
         self._dev_params = None
+        self._released = False        # evicted at least once before
+        self.pageins = 0              # materializations (under _lock)
+        #: pagein observer ``(cause, duration_ms)`` — the engine wires
+        #: its own accounting hook here; fired AFTER the lock drops
+        self.on_pagein = None
         self._native = None
         self._native_failed = False   # fallback tried and unavailable
         #: (cache key, jitted fn) compiled by the reload canary —
@@ -117,16 +122,18 @@ class _Generation:
         #: generation's executables
         self.warmed: tuple | None = None
 
-    def params(self):
-        """The weights, device-resident ONCE per generation and passed
-        to every bucket executable as jit arguments — N cached
-        executables must not mean N baked-in copies of the model.
-        With tensor-parallel shardings set, each layer's weight lands
-        pre-sharded over the ``model`` mesh axis (Megatron pairing),
-        so every bucket executable computes on the sharded copies and
-        XLA inserts the activation collectives between layers."""
+    def _materialize(self):
+        """Device-materialize the weights if absent, single-flight
+        under the generation lock: a second caller racing the same
+        page-in parks on the lock and adopts the first caller's copy —
+        never a double device allocation (the weight-residency LRU's
+        eviction/page-in contract, pinned by the concurrent-eviction
+        test).  Returns ``(dev_params, pagein_info | None)`` where the
+        info tuple is non-None iff THIS call did the materialization."""
         with self._lock:
+            paged = None
             if self._dev_params is None:
+                t0 = time.monotonic()
                 import jax
                 # device_put(x, None) is the default placement, so the
                 # single-device case needs no separate branch
@@ -137,7 +144,58 @@ class _Generation:
                      None if la.b is None
                      else jax.device_put(la.b, s[1]))
                     for la, s in zip(self.layers, sh)]
-            return self._dev_params
+                self.pageins += 1
+                paged = ("evicted" if self._released else "cold",
+                         (time.monotonic() - t0) * 1e3)
+            return self._dev_params, paged
+
+    def _fire_pagein(self, paged) -> None:
+        # outside the generation lock: the observer chain ends in the
+        # zoo registry, which takes its own lock — holding this one
+        # across foreign code is how lock-order cycles are born
+        if paged is not None and self.on_pagein is not None:
+            self.on_pagein(*paged)
+
+    def params(self):
+        """The weights, device-resident ONCE per generation and passed
+        to every bucket executable as jit arguments — N cached
+        executables must not mean N baked-in copies of the model.
+        With tensor-parallel shardings set, each layer's weight lands
+        pre-sharded over the ``model`` mesh axis (Megatron pairing),
+        so every bucket executable computes on the sharded copies and
+        XLA inserts the activation collectives between layers.
+        Materialization is lazy AND revocable: :meth:`release_params`
+        (the zoo's weight-residency LRU) drops the device copy and the
+        next call here pages it back in from the retained host layers
+        — byte-identical, because the host arrays never moved."""
+        dev, paged = self._materialize()
+        self._fire_pagein(paged)
+        return dev
+
+    def ensure(self) -> bool:
+        """Page the weights in if evicted; True iff THIS call did the
+        materialization (the zoo counts page-ins through it)."""
+        _dev, paged = self._materialize()
+        self._fire_pagein(paged)
+        return paged is not None
+
+    def release_params(self) -> bool:
+        """Drop the device-resident weight copy (weight-residency LRU
+        eviction).  The parsed host layers stay, so the next
+        :meth:`params` call re-materializes the SAME bytes; an
+        executable holding no baked-in weights (they ride as jit
+        arguments) survives eviction untouched, which is what makes
+        re-admission cheap.  True when a copy was actually resident."""
+        with self._lock:
+            had = self._dev_params is not None
+            if had:
+                self._dev_params = None
+                self._released = True
+            return had
+
+    def params_resident(self) -> bool:
+        with self._lock:
+            return self._dev_params is not None
 
     def adopt_native(self, native) -> None:
         """Install an eagerly-loaded native model (backend="native"
@@ -370,8 +428,13 @@ class ServingEngine:
             self._mesh = mesh_lib.resolve_mesh((1, tp), site="serve")
             self._x_sharding = mesh_lib.replicated(self._mesh)
         layers = read_znn(path)
+        #: zoo residency hook ``(cause, duration_ms)`` — fired on every
+        #: weight page-in of whichever generation is serving (set by
+        #: ModelZoo.add; None outside a zoo)
+        self.on_pagein = None
         self._gen = _Generation(1, path, layers,
                                 self._tp_shardings(layers))
+        self._gen.on_pagein = self._note_pagein
         if backend == "native":
             from ..export import NativeEngine
             self._gen.adopt_native(NativeEngine().load(path))
@@ -447,6 +510,62 @@ class ServingEngine:
             return x
         import jax
         return jax.device_put(x, self._x_sharding)
+
+    # -- weight residency (the zoo's memory-budget LRU) -------------------
+    def _note_pagein(self, cause: str, dt_ms: float) -> None:
+        """Every generation's pagein observer: count it and forward to
+        the zoo hook (if any) so ``model_pagein_total{model,cause}``
+        is exact even for page-ins the zoo did not initiate — e.g. a
+        dispatch thread re-materializing a just-evicted straggler."""
+        with self._lock:
+            self._stats["weight_pageins"] += 1
+        cb = self.on_pagein
+        if cb is not None:
+            cb(cause, dt_ms)
+
+    def weight_nbytes(self) -> int:
+        """Host-side byte size of the serving generation's parameters
+        — the device-resident copy costs the same (fp32 both sides),
+        so this is what the zoo's residency budget accounts."""
+        return sum((0 if la.w is None else la.w.nbytes)
+                   + (0 if la.b is None else la.b.nbytes)
+                   for la in self._current().layers)
+
+    def weights_resident(self) -> bool:
+        """Whether the serving generation currently holds its device
+        weight copy (native backend: never — nothing to page)."""
+        return self.backend == "jax" \
+            and self._current().params_resident()
+
+    def resident_weight_bytes(self) -> int:
+        """Bytes actually on device right now — 0 when evicted (or on
+        the native backend).  The zoo's budget arithmetic uses THIS,
+        not :meth:`weight_nbytes`, so a replica set that is only
+        partially re-materialized is billed for what it holds."""
+        return self.weight_nbytes() if self.weights_resident() else 0
+
+    def release_weights(self) -> int:
+        """Evict the device weight copy (zoo LRU); returns the bytes
+        freed (0 when nothing was resident or on the native backend).
+        In-flight forwards pinned to the generation re-materialize on
+        demand — eviction can cost a page-in, never correctness."""
+        if self.backend != "jax":
+            return 0
+        gen = self._current()
+        if not gen.release_params():
+            return 0
+        with self._lock:
+            self._stats["weight_releases"] += 1
+        return self.weight_nbytes()
+
+    def ensure_weights(self) -> bool:
+        """Page the serving generation's weights in if evicted; True
+        iff this call did the materialization (single-flight: a
+        concurrent caller parks on the generation lock instead of
+        double-allocating)."""
+        if self.backend != "jax":
+            return False
+        return self._current().ensure()
 
     # -- generation access ------------------------------------------------
     def _current(self) -> _Generation:
@@ -828,6 +947,10 @@ class ServingEngine:
                 layers = read_znn(target)
                 candidate = _Generation(old.number + 1, target, layers,
                                         self._tp_shardings(layers))
+                # the candidate's first materialization (the canary)
+                # must count like any other page-in — the zoo's
+                # residency accounting sees reloads too
+                candidate.on_pagein = self._note_pagein
                 if self.backend == "native":
                     from ..export import NativeEngine
                     native = NativeEngine().load(target)
@@ -925,6 +1048,10 @@ class ServingEngine:
         m.setdefault("forward_failures", 0)
         m.setdefault("fallback_calls", 0)
         m.setdefault("retries", 0)
+        m.setdefault("weight_pageins", 0)
+        m.setdefault("weight_releases", 0)
+        m["weight_bytes"] = self.weight_nbytes()
+        m["weights_resident"] = self.weights_resident()
         m["backend"] = self.backend
         m["buckets"] = list(self.buckets)
         m["tensor_parallel"] = self.tp if self._mesh is not None else 1

@@ -355,6 +355,42 @@ class EngineReplicaSet:
                 return want
         return "open"
 
+    # -- weight residency (zoo LRU surface, summed over replicas) ---------
+    def weight_nbytes(self) -> int:
+        """Total device bytes the fleet's weight copies cost — each
+        replica holds its OWN copy (failure-domain isolation), so the
+        zoo's residency budget must account all of them."""
+        return sum(e.weight_nbytes() for e in self.replicas)
+
+    def weights_resident(self) -> bool:
+        return any(e.weights_resident() for e in self.replicas)
+
+    def resident_weight_bytes(self) -> int:
+        """Bytes actually on device across the fleet — per-replica,
+        so a partially re-materialized set (one dispatch-thread
+        straggler paged its own copy back in) bills only what it
+        holds, not n_replicas × the model."""
+        return sum(e.resident_weight_bytes() for e in self.replicas)
+
+    def release_weights(self) -> int:
+        return sum(e.release_weights() for e in self.replicas)
+
+    def ensure_weights(self) -> bool:
+        # list first: any() short-circuits, and every replica must be
+        # paged in, not just the first evicted one
+        return any([e.ensure_weights() for e in self.replicas])
+
+    @property
+    def on_pagein(self):
+        return self.replicas[0].on_pagein
+
+    @on_pagein.setter
+    def on_pagein(self, fn) -> None:
+        # one zoo hook fans out to every replica: per-replica page-ins
+        # are separate device allocations and each must be counted
+        for eng in self.replicas:
+            eng.on_pagein = fn
+
     def warmup(self, sample_shape, dtype=None, buckets=None) -> int:
         kw = {} if dtype is None else {"dtype": dtype}
         return sum(e.warmup(sample_shape, buckets=buckets, **kw)

@@ -8,10 +8,20 @@ waiting producers, one dispatching consumer).
 Wire protocol (JSON both ways):
 
 * ``POST /predict``  body ``{"inputs": [[...], ...],
-  "deadline_ms": optional}`` → ``{"outputs": [[...], ...]}``.
+  "deadline_ms": optional, "model": optional}`` →
+  ``{"outputs": [[...], ...]}``.
   A 1-D ``inputs`` is treated as a single sample.  Errors: 400
-  (malformed), 429 + ``Retry-After`` header (admission queue full),
+  (malformed), 404 (unknown model name), 429 + ``Retry-After`` header
+  (admission queue full, or a model's token-bucket quota breached),
   504 (request deadline passed while queued), 503 (engine failure).
+  Multi-tenant routing (serving.zoo; docs/serving.md): the
+  ``X-Model`` header (beats the body ``model`` field) picks which
+  registered model answers; absent → the default model, preserving
+  the single-model contract.  Each model carries its own criticality
+  class and deadline default (applied when the request sends
+  neither), its own micro-batcher/queue/shed ladder, and rides the
+  weight-residency LRU — the request that wakes an evicted model
+  pays its page-in.
   Overload defense (docs/resilience.md): ``X-Deadline-Ms`` attaches
   an end-to-end deadline at admission (header beats the body field;
   ``--default-deadline-ms`` applies when neither is sent) that every
@@ -94,6 +104,7 @@ from ..resilience.breaker import EngineUnavailable
 from ..telemetry import buildinfo, debugz, flightrecorder, tracing
 from ..telemetry.registry import (PROMETHEUS_CONTENT_TYPE, REGISTRY,
                                   DEFAULT_LATENCY_BUCKETS_MS)
+from . import zoo as zoo_mod
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import ServingEngine
 
@@ -107,7 +118,8 @@ _ROUTES = ("/predict", "/healthz", "/metrics", "/admin/reload",
 class ServingServer:
     """Engine + batcher behind an HTTP front (start()/stop()/url)."""
 
-    def __init__(self, engine: ServingEngine, *,
+    def __init__(self, engine: ServingEngine | None = None, *,
+                 zoo: "zoo_mod.ModelZoo | None" = None,
                  host: str = "127.0.0.1", port: int = 0,
                  batcher: MicroBatcher | None = None,
                  max_batch: int | None = None,
@@ -124,7 +136,29 @@ class ServingServer:
             # silently dropping the knobs would look like they applied
             raise ValueError("pass batching knobs OR a prebuilt "
                              "batcher, not both")
-        self.engine = engine
+        if (engine is None) == (zoo is None):
+            raise ValueError("pass exactly one of engine= or zoo=")
+        if zoo is not None and batcher is not None:
+            # each zoo entry needs its OWN batcher (coalescing across
+            # models would mix tenants into one device call)
+            raise ValueError("pass batching knobs, not a prebuilt "
+                             "batcher, with a zoo")
+        #: the model registry every /predict routes through.  A single
+        #: engine wraps into an implicit one-entry zoo named "default"
+        #: so routing, quota and residency logic have ONE code path —
+        #: the multi-tenant surface (healthz models table, /metrics
+        #: zoo block, per-model collector families) only renders for
+        #: an EXPLICIT zoo, keeping every single-model contract
+        #: byte-identical.
+        self._zoo_explicit = zoo is not None
+        if zoo is None:
+            # labeled_metrics=False: a single-model server's /metrics
+            # must not grow model_*{model="default"} series a scraper
+            # pinned to the pre-zoo surface never asked for
+            zoo = zoo_mod.ModelZoo(labeled_metrics=False)
+            zoo.add("default", engine=engine)
+        self.zoo = zoo
+        self.engine = zoo.resolve().engine
         #: deadline attached to requests that carry neither an
         #: X-Deadline-Ms header nor a body deadline_ms (None = only
         #: explicit deadlines are enforced)
@@ -149,19 +183,42 @@ class ServingServer:
                     f"shed_target_ms ({shed_target_ms}) must exceed "
                     f"max_wait_ms ({wait}): every under-filled batch "
                     f"waits up to max_wait_ms by design")
-        self._own_batcher = batcher is None
-        self.batcher = batcher or MicroBatcher(
-            engine.predict,
-            max_batch=32 if max_batch is None else max_batch,
-            max_wait_ms=5.0 if max_wait_ms is None else max_wait_ms,
-            max_queue=128 if max_queue is None else max_queue,
-            # adaptive shedding is opt-in at construction (None = the
-            # fixed queue bound only, the PR-1 contract tests pin);
-            # the serve CLI enables it by default
-            shedder=(overload.CoDelShedder(
-                target_ms=shed_target_ms,
-                interval_ms=shed_interval_ms)
-                if shed_target_ms is not None else None))
+        #: batchers this server built (and therefore closes) — one per
+        #: zoo entry; a caller-attached batcher stays the caller's
+        self._built_batchers: list[MicroBatcher] = []
+        for entry in zoo.entries():
+            if entry.batcher is None and batcher is not None:
+                # the prebuilt-batcher escape hatch (single-model only,
+                # rejected above for zoos)
+                entry.batcher = batcher
+            elif entry.batcher is None:
+                # one batcher (and dispatch thread) per model: requests
+                # of different tenants must never coalesce into one
+                # device call, and each tenant gets its own queue
+                # bound, shed ladder and backpressure — a hot tenant's
+                # 429s cannot starve a quiet one.  Adaptive shedding
+                # stays opt-in at construction (None = the fixed queue
+                # bound only, the PR-1 contract tests pin); the serve
+                # CLI enables it by default.
+                entry.batcher = MicroBatcher(
+                    entry.predict,
+                    max_batch=32 if max_batch is None else max_batch,
+                    max_wait_ms=(5.0 if max_wait_ms is None
+                                 else max_wait_ms),
+                    max_queue=128 if max_queue is None else max_queue,
+                    # unnamed for the implicit single-model wrapper:
+                    # the name surfaces in the /metrics JSON and the
+                    # dispatch thread's name, and the single-model
+                    # surface must stay byte-identical to pre-zoo
+                    name=(entry.name if self._zoo_explicit else None),
+                    shedder=(overload.CoDelShedder(
+                        target_ms=shed_target_ms,
+                        interval_ms=shed_interval_ms)
+                        if shed_target_ms is not None else None))
+                self._built_batchers.append(entry.batcher)
+        #: the DEFAULT model's batcher — the single-model surface
+        #: (metrics, statusz, overload status) keeps reading it
+        self.batcher = zoo.resolve().batcher
         self.default_timeout_s = default_timeout_s
         self._draining = False
         self._stopped = False
@@ -305,6 +362,7 @@ class ServingServer:
                 self._status_code = None
                 self._rec_shape = self._rec_rows = None
                 self._rec_error = None
+                self._model_name = None
                 with tracing.request(rid):
                     with tracing.span("server.predict"):
                         self._predict()
@@ -314,6 +372,14 @@ class ServingServer:
                 # record's span tree includes it (telemetry.
                 # flightrecorder; served on /debug/flightrecorder)
                 code = self._status_code or 500
+                if self._model_name is not None \
+                        and outer._zoo_explicit:
+                    # per-tenant outcome accounting — counted once,
+                    # with the FINAL status, so quota 429s and shed
+                    # 503s attribute to the tenant that caused them
+                    # (explicit zoos only: the single-model surface
+                    # stays label-free)
+                    zoo_mod.note_model_request(self._model_name, code)
                 # since=t0: a retry reusing its first attempt's
                 # X-Request-Id must not inherit that attempt's spans —
                 # stage timings would double-count
@@ -326,6 +392,7 @@ class ServingServer:
                     error=self._rec_error,
                     request_id=rid, code=code,
                     rows=self._rec_rows, shape=self._rec_shape,
+                    model=self._model_name,
                     stages=flightrecorder.stage_breakdown(spans),
                     spans=spans)
 
@@ -361,34 +428,48 @@ class ServingServer:
                     model = payload.get("model")
                     if model is not None and not isinstance(model, str):
                         raise ValueError("'model' must be a path string")
+                    # zoo: "name" selects WHICH registered model swaps
+                    # (absent → the default model, the single-model
+                    # contract); "model" stays the artifact path
+                    name = payload.get("name")
+                    if name is not None and not isinstance(name, str):
+                        raise ValueError("'name' must be a model name "
+                                         "string")
                     wait = bool(payload.get("wait", False))
                 except Exception as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
-                worker = outer.reload_async(model)
+                try:
+                    outer.zoo.resolve(name)
+                except zoo_mod.UnknownModel as e:
+                    self._reply(404, {"error": str(e)})
+                    return
+                worker = outer.reload_async(model, name=name)
                 if worker is None:
                     # honest come-back time, consistent with the
-                    # 429/503 paths: the in-flight reload should take
-                    # about as long as the last one did
-                    status = outer.engine.reload_status()
-                    last = status.get("last_reload") or {}
-                    dur_ms = float(last.get("duration_ms") or 0.0)
-                    ra = max(1, min(30, int(dur_ms / 1e3) + 1))
+                    # 429/503 paths.  The single-flight lock spans the
+                    # WHOLE zoo, so the in-flight reload may be some
+                    # other model's — size the estimate on the worst
+                    # last duration any entry has seen, not on the
+                    # named model's (whose "never reloaded" would
+                    # suggest an instant 1s retry against a slow roll)
+                    ra = outer.reload_retry_after()
                     self._reply(409, {
                         "error": "a reload is already in progress",
-                        "retry_after_s": ra, **status},
+                        "retry_after_s": ra,
+                        **outer.reload_status(name)},
                         {"Retry-After": str(ra)})
                     return
                 if wait:
                     worker.join(outer.default_timeout_s)   # bounded
-                    status = outer.engine.reload_status()
+                    status = outer.reload_status(name)
                     code = 200 if not worker.is_alive() else 202
                     self._reply(code, {"status": "done"
                                        if code == 200 else "running",
                                        **status})
                 else:
                     self._reply(202, {"status": "reload started",
-                                      **outer.engine.reload_status()})
+                                      **outer.reload_status(name)})
 
             def _predict(self):
                 try:
@@ -406,25 +487,46 @@ class ServingServer:
                         x = x[None]
                     self._rec_rows = int(len(x))
                     self._rec_shape = [int(d) for d in x.shape[1:]]
+                    # zoo routing: X-Model beats the body's "model"
+                    # (same precedence rule as the deadline — a proxy
+                    # can pin a tenant without rewriting bodies);
+                    # neither → the default model, the PR-1 contract
+                    model_name = self.headers.get("X-Model")
+                    if model_name is not None:
+                        # an empty header is "unset" (same reading as
+                        # X-Criticality below): fall through to the
+                        # body field / default model, never a 404 on
+                        # the literal name ""
+                        model_name = model_name.strip() or None
+                    if model_name is None:
+                        model_name = payload.get("model")
+                        if model_name is not None \
+                                and not isinstance(model_name, str):
+                            raise ValueError(
+                                "'model' must be a model name string")
                     deadline_ms = payload.get("deadline_ms")
                     # X-Deadline-Ms beats the body field (a proxy can
-                    # tighten a budget without rewriting the body);
-                    # neither present → the server default applies
+                    # tighten a budget without rewriting the body)
                     hdr = self.headers.get("X-Deadline-Ms")
                     if hdr is not None:
                         deadline_ms = hdr
-                    if deadline_ms is None:
-                        deadline_ms = outer.default_deadline_ms
                     if deadline_ms is not None:   # junk → 400, not 503
                         deadline_ms = float(deadline_ms)
-                    criticality = (self.headers.get("X-Criticality")
-                                   or "default").strip().lower()
-                    if criticality not in overload.CRITICALITIES:
-                        # a typo'd class is a client bug: silently
-                        # demoting (or promoting) it would be worse
-                        raise ValueError(
-                            f"X-Criticality {criticality!r}; expected "
-                            f"one of {overload.CRITICALITIES}")
+                    criticality = self.headers.get("X-Criticality")
+                    if criticality is not None:
+                        criticality = criticality.strip().lower()
+                        if not criticality:
+                            # an empty header is "unset", exactly as
+                            # pre-zoo `(header or "default")` read it
+                            # — the tenant default applies, not a 400
+                            criticality = None
+                        elif criticality not in overload.CRITICALITIES:
+                            # a typo'd class is a client bug: silently
+                            # demoting (or promoting) it would be worse
+                            raise ValueError(
+                                f"X-Criticality {criticality!r}; "
+                                f"expected one of "
+                                f"{overload.CRITICALITIES}")
                 except Exception as e:
                     # ANY parse/shape failure is the client's error: a
                     # JSON 400 body, never a raw 500 traceback (ragged
@@ -434,10 +536,46 @@ class ServingServer:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
                 try:
-                    y = outer.batcher.predict(
+                    entry = outer.zoo.resolve(model_name)
+                except zoo_mod.UnknownModel as e:
+                    # a routing miss, not a client-syntax error and not
+                    # a server fault: 404, like any unknown resource
+                    self._rec_error = str(e)
+                    self._reply(404, {"error": str(e)})
+                    return
+                self._model_name = entry.name
+                # tenant policy: explicit request values win; the
+                # registry's criticality class and deadline default
+                # cover the (typical) header-less majority of a
+                # tenant's traffic — this is how a sheddable tenant
+                # browns out before a critical one without every
+                # client cooperating.  The server-wide default
+                # deadline stays the last resort.
+                criticality, deadline_ms = entry.effective_policy(
+                    criticality, deadline_ms)
+                if deadline_ms is None:
+                    deadline_ms = outer.default_deadline_ms
+                try:
+                    outer.zoo.admit(entry)
+                except zoo_mod.QuotaExceeded as e:
+                    # per-tenant token bucket: same contract as the
+                    # queue-full 429 — honest come-back time, never a
+                    # silent drop
+                    self._rec_error = str(e)
+                    self._reply(429, {"error": str(e),
+                                      "retry_after_s": e.retry_after},
+                                {"Retry-After": str(e.retry_after)})
+                    return
+                # residency: the request that wakes a cold model pays
+                # its page-in here (single-flight — a concurrent
+                # eviction race parks on the generation lock), and
+                # colder tenants are evicted to fit the budget
+                outer.zoo.touch(entry)
+                try:
+                    y = entry.batcher.predict(
                         x, deadline_ms=deadline_ms,
                         timeout=outer.default_timeout_s,
-                        criticality=criticality)
+                        criticality=criticality or "default")
                 except QueueFull as e:
                     self._rec_error = str(e)
                     self._reply(429, {"error": str(e),
@@ -457,9 +595,11 @@ class ServingServer:
                     self._reply(504, {"error": str(e)})
                 except TimeoutError as e:
                     # server-side wait timeout (e.g. a slow first jit
-                    # compile): retryable, and NOT an engine failure
+                    # compile): retryable, and NOT an engine failure.
+                    # The come-back time is the ROUTED tenant's
+                    # backlog, not the default model's
                     self._rec_error = f"answer timeout: {e}"
-                    ra = outer.batcher.retry_after()
+                    ra = entry.batcher.retry_after()
                     self._reply(503, {"error": f"timed out waiting "
                                                f"for an answer: {e}",
                                       "retry_after_s": ra},
@@ -525,37 +665,88 @@ class ServingServer:
         self.promotion_status = status_fn
 
     # -- hot reload -------------------------------------------------------
-    def reload_async(self, model: str | None = None
+    def reload_status(self, name: str | None = None) -> dict:
+        """One model's generation + last reload outcome (None = the
+        default model — the single-model shape, unchanged)."""
+        entry = self.zoo.resolve(name)
+        status = entry.engine.reload_status()
+        if self._zoo_explicit:
+            status["model"] = entry.name
+        return status
+
+    def reload_retry_after(self) -> int:
+        """Come-back estimate while a reload holds the single-flight
+        slot: the worst last-reload duration across every zoo entry
+        (the busy reload may be any model's), bounded [1, 30]s."""
+        worst_ms = 0.0
+        for entry in self.zoo.entries():
+            last = (entry.engine.reload_status() or {}
+                    ).get("last_reload") or {}
+            worst_ms = max(worst_ms,
+                           float(last.get("duration_ms") or 0.0))
+        return max(1, min(30, int(worst_ms / 1e3) + 1))
+
+    def reload_async(self, model: str | None = None, *,
+                     name: str | None = None
                      ) -> threading.Thread | None:
         """Start a background hot reload of ``model`` (None = re-read
-        the engine's current artifact path).  Returns the worker
-        thread, or None when a reload is already in flight.  The old
-        generation serves throughout; outcomes land in the engine's
-        ``last_reload`` / ``/healthz`` / ``model_reloads_total``."""
+        the entry's current artifact path) for zoo entry ``name``
+        (None = the default model).  Returns the worker thread, or
+        None when a reload is already in flight.  The old generation
+        serves throughout; outcomes land in the engine's
+        ``last_reload`` / ``/healthz`` / ``model_reloads_total`` —
+        and only THAT entry's generation/caches move: tenants are
+        separate engines by construction."""
         with self._reload_mu:
             if self._reload_thread is not None \
                     and self._reload_thread.is_alive():
                 return None
             worker = threading.Thread(
-                target=self._reload_worker, args=(model,), daemon=True,
+                target=self._reload_worker, args=(model, name),
+                daemon=True, name="znicz-model-reload")
+            self._reload_thread = worker
+            worker.start()
+            return worker
+
+    def reload_all_async(self) -> threading.Thread | None:
+        """Re-read EVERY zoo artifact in place, rolling one model at a
+        time (the SIGHUP channel); single-flight with
+        :meth:`reload_async`.  On a single-model server this is
+        exactly the old SIGHUP behavior."""
+        with self._reload_mu:
+            if self._reload_thread is not None \
+                    and self._reload_thread.is_alive():
+                return None
+            worker = threading.Thread(
+                target=self._reload_all_worker, daemon=True,
                 name="znicz-model-reload")
             self._reload_thread = worker
             worker.start()
             return worker
 
-    def _reload_worker(self, model: str | None) -> None:
+    def _reload_worker(self, model: str | None,
+                       name: str | None = None) -> None:
         # engine.reload never raises for artifact problems (they are
         # outcomes, not crashes); anything else must not kill the
         # worker silently either — the server keeps serving regardless
         try:
             # census-driven warmup of the new generation rides the
             # engine reload itself (every reload channel — admin,
-            # SIGHUP, promotion controller — gets it uniformly)
-            self.engine.reload(model)
+            # SIGHUP, promotion controller — gets it uniformly); the
+            # zoo wrapper re-stamps recency and re-balances residency
+            self.zoo.reload(name, model)
         except Exception:
             import logging
             logging.getLogger("ServingServer").exception(
                 "hot reload worker failed")
+
+    def _reload_all_worker(self) -> None:
+        try:
+            self.zoo.reload_all()
+        except Exception:
+            import logging
+            logging.getLogger("ServingServer").exception(
+                "zoo-wide hot reload worker failed")
 
     # -- payload builders -------------------------------------------------
     def health(self) -> dict:
@@ -586,6 +777,13 @@ class ServingServer:
         replica_status = getattr(self.engine, "replica_status", None)
         if replica_status is not None:
             out["replicas"] = replica_status()
+        if self._zoo_explicit:
+            # the per-model table: generation, residency, criticality
+            # class, queue depth and state per tenant — a rollout
+            # driver or balancer learns the whole zoo from the probe
+            # it already makes
+            out["models"] = self.zoo.status()
+            out["default_model"] = self.zoo.default_name
         ps = self.promotion_status
         if ps is not None:
             try:
@@ -623,10 +821,19 @@ class ServingServer:
             out["retry_budget"] = budget.metrics()
         return out
 
+    def zoo_status(self) -> dict | None:
+        """The zoo snapshot /statusz renders as a per-model table
+        (None on a single-model server — nothing to tabulate)."""
+        return self.zoo.metrics() if self._zoo_explicit else None
+
     def metrics(self) -> dict:
         m = self.batcher.metrics()
         m["engine"] = self.engine.metrics()
         m["overload"] = self.overload_status(bm=m)
+        if self._zoo_explicit:
+            # top-level fields stay the DEFAULT model's (the PR-1
+            # shape); the zoo block carries every tenant
+            m["zoo"] = self.zoo.metrics()
         # build attribution + the registry's request totals: the same
         # Counter objects back the Prometheus text view, so the two
         # formats can never disagree
@@ -679,6 +886,29 @@ class ServingServer:
             fams.append(("counter", "breaker_probes_total",
                          "half-open probe attempts granted",
                          [(None, float(breaker.get("probes", 0)))]))
+        if self._zoo_explicit:
+            # per-model families, sampled from the same rows /healthz
+            # serves — a scraper sees every tenant without N scrape
+            # targets (model-labeled, bounded by registry size)
+            rows = self.zoo.status()
+            fams.append((
+                "gauge", "model_queue_depth",
+                "queued requests per zoo model's own batcher",
+                [({"model": r["model"]}, float(r["queue_depth"]))
+                 for r in rows]))
+            fams.append((
+                "gauge", "model_weight_bytes",
+                "host/device byte size of each zoo model's serving "
+                "generation (what the residency budget accounts)",
+                [({"model": r["model"]}, float(r["weight_bytes"]))
+                 for r in rows]))
+            fams.append((
+                "gauge", "zoo_model_generation",
+                "serving generation per zoo model (the unlabeled "
+                "model_generation gauge is last-swap-wins across "
+                "tenants)",
+                [({"model": r["model"]}, float(r["generation"]))
+                 for r in rows]))
         return fams
 
     # -- lifecycle --------------------------------------------------------
@@ -696,7 +926,16 @@ class ServingServer:
         CLI runs on SIGTERM (docs/serving.md)."""
         self._draining = True
         overload.set_drain_state(overload.DRAIN_DRAINING)
-        drained = self.batcher.drain(timeout_s)
+        # every tenant's batcher drains, sharing ONE deadline — a
+        # multi-model replica must not hold its eviction slot N times
+        # longer than a single-model one
+        deadline = time.monotonic() + float(timeout_s)
+        drained = True
+        for entry in self.zoo.entries():
+            if entry.batcher is None:
+                continue
+            left = max(0.0, deadline - time.monotonic())
+            drained = entry.batcher.drain(left) and drained
         # the batcher answered every request (events set), but the
         # handler threads still have to wake and WRITE the responses —
         # give them a beat before the listener goes away, or a CLI
@@ -718,8 +957,10 @@ class ServingServer:
         REGISTRY.unregister_collector(self._collect_components)
         self.server.shutdown()
         self.server.server_close()
-        if self._own_batcher:
-            self.batcher.close()
+        # close every batcher THIS server built (one per zoo entry);
+        # caller-attached batchers stay the caller's to close
+        for b in self._built_batchers:
+            b.close()
 
     @property
     def url(self) -> str:
@@ -733,10 +974,31 @@ def main(argv=None) -> int:
 
     p = argparse.ArgumentParser(
         prog="znicz_tpu serve",
-        description="serve a trained model (.znn) over HTTP with "
-                    "dynamic micro-batching")
-    p.add_argument("--model", required=True,
-                   help="path to a .znn export (see export_workflow)")
+        description="serve trained models (.znn) over HTTP with "
+                    "dynamic micro-batching — one model or a whole "
+                    "multi-tenant zoo (docs/serving.md)")
+    p.add_argument("--model", action="append", metavar="SPEC",
+                   help="model to serve: a bare .znn path "
+                        "(single-model mode, the historical contract) "
+                        "or NAME=PATH[,criticality=sheddable|default|"
+                        "critical][,deadline-ms=N][,quota-rps=N]"
+                        "[,quota-burst=N][,default] — repeatable, "
+                        "combines with --zoo (a NAME=... spec "
+                        "overrides the scanned entry of that name)")
+    p.add_argument("--zoo", default=None, metavar="DIR",
+                   help="serve every *.znn in DIR as a model named by "
+                        "its file stem; /predict routes by the "
+                        "X-Model header / body 'model' field "
+                        "(docs/serving.md 'Multi-tenant model zoo')")
+    p.add_argument("--memory-budget-mb", type=float, default=None,
+                   help="weight-residency budget across the zoo: when "
+                        "resident device weights exceed it, the "
+                        "coldest models' copies are evicted and paged "
+                        "back in on demand (default: no eviction)")
+    p.add_argument("--default-model", default=None, metavar="NAME",
+                   help="model served when a request names none "
+                        "(default: the first registered; a spec's "
+                        "',default' flag does the same)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8100)
     p.add_argument("--backend", default="auto",
@@ -846,6 +1108,44 @@ def main(argv=None) -> int:
                         "trace of a long-lived server grows without "
                         "limit and is only written out at stop)")
     args = p.parse_args(argv)
+    # -- the model set: --zoo DIR scanned first, --model specs second
+    # (a NAME=PATH spec overrides the scanned entry of the same name;
+    # a single bare PATH with no zoo flags is the historical
+    # single-model mode, byte-identical behavior)
+    specs: dict = {}                      # name -> (path, options)
+    order: list = []
+    bare: list = []
+    if args.zoo:
+        for nm, path in zoo_mod.scan_zoo_dir(args.zoo).items():
+            specs[nm] = (path, {})
+            order.append(nm)
+    for spec in args.model or []:
+        nm, path, opts = zoo_mod.parse_model_spec(spec)
+        if nm is None:
+            bare.append(path)
+        else:
+            if nm not in specs:
+                order.append(nm)
+            specs[nm] = (path, opts)
+    if not specs and not bare:
+        p.error("pass --model and/or --zoo")
+    single_mode = (not specs and len(bare) == 1
+                   and args.memory_budget_mb is None
+                   and args.default_model is None)
+    if not single_mode:
+        for path in bare:                 # bare paths: named by stem
+            nm = os.path.splitext(os.path.basename(path))[0]
+            if not nm:
+                p.error(f"cannot derive a model name from {path!r}; "
+                        f"use --model NAME=PATH")
+            if nm not in specs:
+                order.append(nm)
+            specs[nm] = (path, {})
+        if args.default_model is not None \
+                and args.default_model not in specs:
+            p.error(f"--default-model {args.default_model!r} is not "
+                    f"among the registered models "
+                    f"({sorted(specs) or bare})")
     if args.fault_plan is not None:
         from ..resilience import faults as _faults
         _faults.install(_faults.parse_plan(args.fault_plan))
@@ -880,7 +1180,7 @@ def main(argv=None) -> int:
         shed_target_ms = (args.shed_target_ms
                           if args.shed_target_ms > 0 else None)
 
-    def _make_engine(_i):
+    def _make_engine(_i, path):
         # per-replica construction: breaker/retry/cache must be FRESH
         # per engine — a shared breaker would collapse the failure
         # domains --replicas exists to separate.  Same delay budget as
@@ -888,7 +1188,7 @@ def main(argv=None) -> int:
         # dispatch thread, so they must stay well under the batcher's
         # cadence even at high --retry-attempts
         return ServingEngine(
-            args.model, backend=args.backend,
+            path, backend=args.backend,
             buckets=buckets, cache_size=args.cache_size, tp=args.tp,
             retry=RetryPolicy(max_attempts=args.retry_attempts,
                               base_delay_s=0.02, max_delay_s=0.25,
@@ -902,15 +1202,40 @@ def main(argv=None) -> int:
     if args.hedge and args.replicas < 2:
         p.error("--hedge needs --replicas >= 2 (a hedge goes to "
                 "ANOTHER replica)")
-    if args.replicas > 1:
-        from .replicas import EngineReplicaSet
-        hedge = (overload.HedgePolicy(after_ms=args.hedge_after_ms,
-                                      budget=budget)
-                 if args.hedge else None)
-        engine = EngineReplicaSet(_make_engine, args.replicas,
-                                  hedge=hedge)
+
+    def _build_engine(path):
+        # the topology knobs (--tp/--replicas/--hedge) apply per
+        # model: each zoo entry is its own replica set / TP engine —
+        # hedges and retries still share the ONE process budget
+        if args.replicas > 1:
+            from .replicas import EngineReplicaSet
+            hedge = (overload.HedgePolicy(after_ms=args.hedge_after_ms,
+                                          budget=budget)
+                     if args.hedge else None)
+            return EngineReplicaSet(
+                lambda i, _p=path: _make_engine(i, _p),
+                args.replicas, hedge=hedge)
+        return _make_engine(0, path)
+
+    if single_mode:
+        zoo = None
+        engine = _build_engine(bare[0])
+        closer = engine.close
     else:
-        engine = _make_engine(0)
+        zoo = zoo_mod.ModelZoo(
+            memory_budget_bytes=(int(args.memory_budget_mb * 1e6)
+                                 if args.memory_budget_mb else None))
+        for nm in order:
+            path, opts = specs[nm]
+            zoo.add(nm, engine=_build_engine(path),
+                    criticality=opts.get("criticality", "default"),
+                    deadline_ms=opts.get("deadline_ms"),
+                    quota_rps=opts.get("quota_rps"),
+                    quota_burst=opts.get("quota_burst"),
+                    default=(opts.get("default", False)
+                             or nm == args.default_model))
+        engine = zoo.resolve().engine     # the default model's
+        closer = zoo.close
     from ..telemetry import profiler
     profile_dir = args.profile_dir or profiler.dir_from_env()
     server = None
@@ -937,7 +1262,9 @@ def main(argv=None) -> int:
             # fresh process has no census yet, so this warms
             # --warmup-shape; a process restarted with a warm
             # persistent compile cache replays those compiles as disk
-            # hits either way
+            # hits either way.  In zoo mode the shape targets the
+            # DEFAULT model (sample shapes are per-family); other
+            # tenants census-warm once traffic has flowed.
             shape = tuple(int(d) for d in args.warmup_shape.split(","))
             n = engine.warmup_from_census(fallback_shape=shape)
             print(f"warmup: {n} bucket executable(s) compiled for "
@@ -946,19 +1273,27 @@ def main(argv=None) -> int:
         # construct THEN start: if start() unwinds (KeyboardInterrupt),
         # `server` must already be bound so the finally below can stop
         # it — a skipped stop() leaks the registry collector
-        server = ServingServer(engine, host=args.host, port=args.port,
-                               max_batch=args.max_batch,
-                               max_wait_ms=args.max_wait_ms,
-                               max_queue=args.max_queue,
-                               default_timeout_s=args.timeout_s,
-                               max_body_mb=args.max_body_mb,
-                               admin_token=args.admin_token,
-                               default_deadline_ms=args
-                               .default_deadline_ms,
-                               shed_target_ms=shed_target_ms)
+        kwargs = dict(host=args.host, port=args.port,
+                      max_batch=args.max_batch,
+                      max_wait_ms=args.max_wait_ms,
+                      max_queue=args.max_queue,
+                      default_timeout_s=args.timeout_s,
+                      max_body_mb=args.max_body_mb,
+                      admin_token=args.admin_token,
+                      default_deadline_ms=args.default_deadline_ms,
+                      shed_target_ms=shed_target_ms)
+        server = (ServingServer(engine, **kwargs) if zoo is None
+                  else ServingServer(zoo=zoo, **kwargs))
         server.start()
         mesh = "x".join(str(d) for d in engine.mesh_shape)
-        print(f"serving {args.model} [{engine.backend}] at "
+        if zoo is None:
+            what = bare[0]
+        else:
+            what = (f"zoo of {len(zoo)} models "
+                    f"{zoo.names()} (default {zoo.default_name!r}, "
+                    f"budget "
+                    f"{args.memory_budget_mb or 'unbounded'} MB)")
+        print(f"serving {what} [{engine.backend}] at "
               f"{server.url} (mesh {mesh}, replicas {args.replicas}; "
               f"POST /predict, GET /healthz, "
               f"GET /metrics, GET /statusz, GET /debug/*)", flush=True)
@@ -1000,7 +1335,10 @@ def main(argv=None) -> int:
             #           Ctrl-C/SIGTERM working for the whole lifetime
             if hup.is_set():
                 hup.clear()
-                if server.reload_async() is not None:
+                # zoo-aware: re-read EVERY registered artifact in
+                # place, one model at a time (single-model servers
+                # have exactly one entry — the old behavior)
+                if server.reload_all_async() is not None:
                     print("SIGHUP: hot reload started "
                           f"(generation {engine.generation})",
                           flush=True)
@@ -1032,7 +1370,7 @@ def main(argv=None) -> int:
             profiler.stop_trace()
         if server is not None:
             server.stop()
-        engine.close()
+        closer()      # zoo.close() (every engine) or engine.close()
     return 0
 
 

@@ -156,6 +156,29 @@ def statusz_text(server=None, *, recorder=None, extra: dict | None = None
         lines.append("breaker: " + _fmt_kv(breaker))
         last = (eng.reload_status() or {}).get("last_reload")
         lines.append(f"last_reload: {last or 'never'}")
+        zoo_fn = getattr(server, "zoo_status", None)
+        zoo = zoo_fn() if zoo_fn is not None else None
+        if zoo:
+            # the per-tenant table: which models this replica serves,
+            # whose weights are resident, who is shedding/queueing —
+            # the first question a multi-tenant 503 spike raises
+            lines += ["", "model zoo", "-" * 9]
+            lines.append(
+                f"budget_bytes={zoo.get('memory_budget_bytes')}  "
+                f"resident_bytes={zoo.get('resident_bytes')}  "
+                f"pagein_p50_ms={zoo.get('pagein_p50_ms')}  "
+                f"pagein_p99_ms={zoo.get('pagein_p99_ms')}")
+            lines.append(f"  {'model':<16} {'gen':>4} {'crit':<10} "
+                         f"{'res':<4} {'bytes':>10} {'queue':>6} "
+                         f"{'idle_s':>8}  state")
+            for r in (zoo.get("models") or {}).values():
+                name = r["model"] + ("*" if r.get("default") else "")
+                lines.append(
+                    f"  {name:<16} {r['generation']:>4} "
+                    f"{r['criticality']:<10} "
+                    f"{'yes' if r['resident'] else 'no':<4} "
+                    f"{r['weight_bytes']:>10} {r['queue_depth']:>6} "
+                    f"{r['idle_s']:>8.1f}  {r['state']}")
         ps = server.promotion_status
         if ps is not None:
             try:
