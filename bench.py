@@ -27,6 +27,11 @@ Extra modes (not used by the driver):
   VERDICT item 3 asks for; results land in BASELINE.md).
 * ``--config NAME`` — bench a non-flagship BASELINE config
   (cifar/autoencoder/kohonen/mnist) instead of AlexNet.
+* ``serve`` / ``--serve`` — the request-path twin of the headline: a
+  real ``python -m znicz_tpu serve`` subprocess under closed-loop HTTP
+  load, stamping req/s/core + p50/p99 + device-ms/request transcript
+  rows (rev-stamped like every other row) so the ROADMAP's
+  request-path speed arc is a measured trajectory.
 """
 
 import argparse
@@ -561,6 +566,196 @@ def bench_loader(args) -> int:
         result["error"] = (result["error"]
                            + f" loader bench failed: {e!r}").strip()[:600]
     return _emit(result)
+
+
+def _serve_row(latencies_ms, codes, duration_s, cores,
+               device_ms_total) -> dict:
+    """The serve-mode transcript row's measured core (pure function —
+    tests pin the schema without booting a server).  ``codes`` is a
+    {status: count} map over every answer; throughput counts 200s only
+    (a 429 storm must not inflate req/s), latency quantiles cover every
+    answered request (a refusal's latency is real client experience).
+
+    ``req_per_sec_per_core`` divides by the host's core count — the
+    cross-machine-comparable figure the ROADMAP's request-path arc
+    tracks, exactly like images/sec/chip on the training side."""
+    n = sum(codes.values())
+    n_ok = codes.get(200, 0)
+    lat = sorted(latencies_ms)
+    dur = max(1e-9, float(duration_s))
+    cores = max(1, int(cores))
+    row = {"requests": int(n), "ok": int(n_ok),
+           "codes": {str(k): int(v) for k, v in sorted(codes.items())},
+           "duration_s": round(dur, 3), "cores": cores,
+           "req_per_sec": round(n_ok / dur, 2),
+           "req_per_sec_per_core": round(n_ok / dur / cores, 3),
+           "device_ms_total": round(float(device_ms_total), 1),
+           "device_ms_per_request": (
+               round(float(device_ms_total) / n_ok, 3) if n_ok
+               else None)}
+    if lat:
+        row["p50_ms"] = round(lat[len(lat) // 2], 3)
+        row["p99_ms"] = round(
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3)
+    else:
+        row["p50_ms"] = row["p99_ms"] = None
+    return row
+
+
+def bench_serve(args) -> int:
+    """``bench.py serve`` (or ``--serve``): drive a REAL
+    ``python -m znicz_tpu serve`` process and stamp a rev-stamped
+    transcript row with req/s/core, p50/p99 and device-ms/request —
+    the request-path speed arc measured exactly like the on-chip one
+    (ROADMAP "raw request-path speed").  The server is a subprocess
+    (its threads, signal handling and JSON parse costs are all IN the
+    measurement — an in-process shortcut would flatter the number);
+    the client side is N threads of closed-loop traffic."""
+    import collections
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    result = {"metric": "serve_requests_per_sec_per_core",
+              "value": None, "unit": "req/s/core",
+              "vs_baseline": None}
+    tmp = tempfile.mkdtemp(prefix="znicz_bench_serve_")
+    proc = None
+    try:
+        model = args.serve_model
+        width = args.serve_width
+        if model is None:
+            from znicz_tpu.resilience.chaos import _write_demo_znn
+            model = os.path.join(tmp, "demo.znn")
+            width = 4
+            _write_demo_znn(model)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "serve",
+             "--model", model, "--port", str(port),
+             "--max-wait-ms", "1", "--warmup-shape", str(width)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        url = f"http://127.0.0.1:{port}/"
+        for _ in range(240):
+            try:
+                with urllib.request.urlopen(url + "healthz",
+                                            timeout=2) as r:
+                    health = json.loads(r.read())
+                break
+            except Exception:
+                if proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    result["error"] = (f"serve exited "
+                                       f"rc={proc.returncode}: "
+                                       + out[-400:])
+                    return _emit(result)
+                time.sleep(0.5)
+        else:
+            result["error"] = "serve never answered /healthz"
+            return _emit(result)
+        payload = json.dumps(
+            {"inputs": [[0.1] * width] * max(1, args.serve_rows)}
+        ).encode()
+
+        def post(timeout=30.0):
+            req = urllib.request.Request(
+                url + "predict", payload,
+                {"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    r.read()
+                    return r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+
+        post(timeout=60.0)            # one warm lap before the clock
+        answers = []                  # (latency_ms, code)
+        mu = threading.Lock()
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    code = post()
+                except Exception:
+                    code = -1
+                dt_ms = (time.monotonic() - t0) * 1e3
+                with mu:
+                    answers.append((dt_ms, code))
+
+        dev0 = _scrape_device_ms(url)
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(max(1, args.serve_clients))]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        stop.wait(args.serve_duration_s)
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        duration_s = time.monotonic() - t_start
+        device_ms = _scrape_device_ms(url) - dev0
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        proc = None
+        codes = collections.Counter(c for _l, c in answers)
+        # quantiles cover ANSWERED requests only (the _serve_row
+        # contract): a hung/dropped request's "latency" is just the
+        # client timeout and would corrupt p99 for the whole row — it
+        # is reported through the codes map and the error note instead
+        row = _serve_row([latency for latency, c in answers if c != -1],
+                         codes, duration_s, os.cpu_count() or 1,
+                         device_ms)
+        result.update(row)
+        result["value"] = row["req_per_sec_per_core"]
+        result["device"] = (f"host serve "
+                            f"[{health.get('backend', '?')}]")
+        result["clients"] = args.serve_clients
+        result["rows_per_request"] = max(1, args.serve_rows)
+        rev = _git_rev()
+        if rev:
+            result["rev"] = rev
+        result["sharding"] = "1x1"
+        result["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime())
+        if codes.get(-1):
+            result.setdefault("error", "")
+            result["error"] = (result["error"] + f" {codes[-1]} "
+                               f"request(s) hung/dropped").strip()
+    except Exception as e:
+        result.setdefault("error", "")
+        result["error"] = (result["error"]
+                           + f" serve bench failed: {e!r}").strip()[:600]
+    finally:
+        if proc is not None:
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return _emit(result)
+
+
+def _scrape_device_ms(url: str) -> float:
+    """The server's measured engine device-ms total from the JSON
+    /metrics view (0.0 when unreachable — the delta then honestly
+    reads as 'unmeasured', not a crash)."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url + "metrics", timeout=10) as r:
+            m = json.loads(r.read())
+        return float((m.get("engine") or {}).get("device_ms_total", 0.0))
+    except Exception:
+        return 0.0
 
 
 def measure_unit_graph(wf, ticks: int) -> float:
@@ -1309,6 +1504,11 @@ def bench_kernels(args) -> int:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # `bench.py serve ...` reads like the serve CLI it drives;
+        # normalize to the flag form argparse speaks
+        argv = ["--serve", *argv[1:]]
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="alexnet")
     p.add_argument("--minibatch", type=int, default=128)
@@ -1343,8 +1543,30 @@ def main(argv=None) -> int:
                         "device mesh, e.g. '4,2'; the row stamps the "
                         "scheme as sharding='dpxtp' so decide_levers "
                         "pairs like-for-like (omitted = '1x1')")
+    p.add_argument("--serve", action="store_true",
+                   help="request-path bench: boot a real `serve` "
+                        "subprocess, drive closed-loop HTTP traffic, "
+                        "and stamp a rev-stamped transcript row with "
+                        "req/s/core + p50/p99 + device-ms/request "
+                        "(`bench.py serve` works too; ROADMAP "
+                        "request-path speed arc)")
+    p.add_argument("--serve-model", default=None, metavar="PATH",
+                   help="serve bench: .znn to serve (default: the "
+                        "tiny built-in demo model)")
+    p.add_argument("--serve-width", type=int, default=4,
+                   help="serve bench: flat input feature count of "
+                        "--serve-model (ignored for the demo model)")
+    p.add_argument("--serve-clients", type=int, default=4,
+                   help="serve bench: concurrent closed-loop client "
+                        "threads")
+    p.add_argument("--serve-rows", type=int, default=1,
+                   help="serve bench: rows per /predict request")
+    p.add_argument("--serve-duration-s", type=float, default=5.0,
+                   help="serve bench: measured traffic window")
     args = p.parse_args(argv)
     try:
+        if args.serve:
+            return bench_serve(args)
         if args.kernels:
             return bench_kernels(args)
         if args.loader:

@@ -611,6 +611,56 @@ class TestMetricDrift:
         assert Analyzer([rule],
                         root=str(tmp_path)).run(["pkg/m.py"]) == []
 
+    def test_slo_labeled_families_in_sync(self, tmp_path):
+        # the SLO engine's idiom (ISSUE 12): multi-label backticked
+        # references — `slo_burn_rate{slo=,model=,window=}` — whose
+        # bare names carry NO metric suffix (_rate / _remaining are
+        # not in the suffix set).  Registered + label-referenced must
+        # be silent in BOTH directions: the reference resolves, and
+        # the labeled mention counts as documentation
+        mod = tmp_path / "pkg" / "m.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text(
+            'from telemetry import REGISTRY\n'
+            '_b = REGISTRY.gauge("slo_burn_rate", "h")\n'
+            '_r = REGISTRY.gauge("slo_budget_remaining", "h")\n'
+            '_a = REGISTRY.counter("slo_alerts_total", "h")\n')
+        doc = tmp_path / "docs" / "obs.md"
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        doc.write_text(
+            'watch `slo_burn_rate{slo="a",model="m",window="fast"}` '
+            'against `slo_budget_remaining{slo="a",model="m"}`; '
+            'firings count into '
+            '`slo_alerts_total{slo="a",model="m",severity="page"}`\n')
+        (tmp_path / "tools").mkdir(exist_ok=True)
+        (tmp_path / "tools" / "smoke.sh").write_text("")
+        rule = MetricDriftRule(doc_paths=("docs/obs.md",),
+                               script_paths=("tools/smoke.sh",))
+        assert Analyzer([rule],
+                        root=str(tmp_path)).run(["pkg/m.py"]) == []
+
+    def test_slo_labeled_ghost_family_fires(self, tmp_path):
+        # the same labeled idiom naming a family nobody registers must
+        # fire — a renamed slo_* gauge would otherwise leave the doc
+        # asserting a series that no longer exists
+        mod = tmp_path / "pkg" / "m.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text('from telemetry import REGISTRY\n'
+                       '_b = REGISTRY.gauge("slo_burn_rate", "h")\n')
+        doc = tmp_path / "docs" / "obs.md"
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        doc.write_text(
+            '`slo_burn_rate{slo="a",model="m",window="slow"}` is '
+            'real; `slo_burn_velocity{slo="a",model="m"}` is not\n')
+        (tmp_path / "tools").mkdir(exist_ok=True)
+        (tmp_path / "tools" / "smoke.sh").write_text("")
+        rule = MetricDriftRule(doc_paths=("docs/obs.md",),
+                               script_paths=("tools/smoke.sh",))
+        found = Analyzer([rule],
+                         root=str(tmp_path)).run(["pkg/m.py"])
+        assert len(found) == 1
+        assert "slo_burn_velocity" in found[0].message
+
     def test_bare_concat_does_not_whitelist_namespace(self, tmp_path):
         # the guard on the extension: a prefix-shaped concat OUTSIDE
         # a family tuple (a filename, a log tag) must not whitelist

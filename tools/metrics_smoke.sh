@@ -236,6 +236,27 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
         check(series.get('executable_cache_hits_total'
                          '{site="serving.engine"}') == float(n_good),
               f"cache hits == {n_good} good predicts")
+        # cost attribution + SLO families (telemetry.sloengine /
+        # serving.zoo, ISSUE 12): registered at import so every
+        # serving process scrapes them from zero — a single-model
+        # replica carries the families (label-free, zero) even though
+        # only explicit zoos populate the model-labeled children
+        for fam, kind in (("model_device_ms_total", "counter"),
+                          ("model_latency_ms", "histogram"),
+                          ("slo_burn_rate", "gauge"),
+                          ("slo_budget_remaining", "gauge"),
+                          ("slo_alerts_total", "counter"),
+                          ("engine_busy_ratio", "gauge")):
+            check(typed.get(fam) == kind, f"{fam} typed {kind}")
+        check(not any(k.startswith("model_device_ms_total{")
+                      for k in series),
+              "single-model surface grows no model-labeled "
+              "device-ms children")
+        busy = series.get("engine_busy_ratio")
+        check(busy is not None and 0.0 <= busy <= 1.0,
+              f"engine_busy_ratio in [0, 1] (got {busy})")
+        check(series.get("serving_engine_device_ms_total", 0.0) > 0.0,
+              "engine device-time accounting moved under traffic")
     finally:
         proc.send_signal(signal.SIGINT)
         try:

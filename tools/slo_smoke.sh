@@ -1,0 +1,104 @@
+#!/bin/bash
+# SLO burn-rate smoke (ISSUE 12 acceptance, operator-runnable):
+#
+#   1. `python -m znicz_tpu chaos --scenario slo` — two tenants with
+#      latency SLOs judged by a live burn-rate engine on sub-second
+#      windows; the sheddable tenant is latency-faulted at its
+#      zoo.model.<name> site.  Asserted: the faulted tenant's
+#      fast-window burn rate crosses the threshold and EXACTLY ONE
+#      alert fires for it (none for the quiet critical tenant, whose
+#      error budget stays intact), zero raw 500s / hangs, /alertz +
+#      /statusz + flight-recorder surfaces live, and the per-tenant
+#      model_device_ms_total ledger sums to within 10% of the device
+#      time the engines measured.
+#
+#   2. a REAL `python -m znicz_tpu serve --slo ...` process: the
+#      declared objective shows up on GET /alertz with burn rates and
+#      budget, and the slo_* metric families scrape.
+#
+# Registered beside tools/zoo_smoke.sh / tools/metrics_smoke.sh.
+#
+# Usage:  bash tools/slo_smoke.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: chaos --scenario slo =="
+JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario slo || exit 1
+
+echo "== phase 2: a real serve --slo process =="
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, signal, socket, subprocess, sys, tempfile, time
+import urllib.request
+
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+with tempfile.TemporaryDirectory(prefix="znicz_slo_smoke_") as tmp:
+    model = os.path.join(tmp, "demo.znn")
+    from znicz_tpu.resilience.chaos import _write_demo_znn
+    _write_demo_znn(model)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "serve",
+         "--model", model, "--port", str(port),
+         "--max-wait-ms", "1", "--warmup-shape", "4",
+         "--slo", "availability,target=99,fast-s=2,slow-s=6,burn=2",
+         "--slo-interval-s", "0.5"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    url = f"http://127.0.0.1:{port}/"
+    try:
+        for _ in range(240):
+            try:
+                urllib.request.urlopen(url + "healthz", timeout=2)
+                break
+            except Exception:
+                if proc.poll() is not None:
+                    sys.exit("serve exited rc=%s:\n%s"
+                             % (proc.returncode, proc.stdout.read()))
+                time.sleep(0.25)
+        req = urllib.request.Request(
+            url + "predict",
+            json.dumps({"inputs": [[0.1, -0.2, 0.3, 0.4]]}).encode(),
+            {"Content-Type": "application/json"})
+        for _ in range(5):
+            with urllib.request.urlopen(req, timeout=30) as r:
+                check(r.status == 200, "predict -> 200")
+        time.sleep(1.2)              # let at least one tick land
+        with urllib.request.urlopen(url + "alertz", timeout=10) as r:
+            alertz = json.loads(r.read())
+        check(alertz.get("enabled") is True, "alertz enabled")
+        slos = {s["slo"]: s for s in alertz.get("slos", [])}
+        check("availability" in slos,
+              "declared objective listed on /alertz")
+        row = slos.get("availability", {})
+        check(row.get("firing") is False and row.get("burn_fast") == 0,
+              f"clean traffic burns nothing ({row})")
+        check(alertz.get("alerts") == [], "no alerts on clean traffic")
+        req = urllib.request.Request(url + "metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            text = r.read().decode()
+        for fam in ("slo_burn_rate", "slo_budget_remaining",
+                    "slo_alerts_total", "engine_busy_ratio"):
+            check(f"# TYPE {fam} " in text, f"{fam} family scrapes")
+        statusz = urllib.request.urlopen(url + "statusz",
+                                         timeout=10).read().decode()
+        check("slo burn rates" in statusz,
+              "/statusz renders the SLO section")
+        proc.send_signal(signal.SIGINT)
+        rc = proc.wait(timeout=30)
+        check(rc == 0, f"serve --slo exited 0 (rc={rc})")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+print("PASS" if not fails else f"FAIL: {fails}")
+sys.exit(1 if fails else 0)
+PY

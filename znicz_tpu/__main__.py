@@ -27,7 +27,15 @@ Examples::
         # criticality/deadline classes, per-model /admin/reload, and a
         # weight-residency LRU under the memory budget
         # (docs/serving.md "Multi-tenant model zoo")
-    python -m znicz_tpu chaos [--scenario reload|promote|overload|zoo]
+    python -m znicz_tpu serve --model model.znn \
+            --slo availability,target=99.9 --slo-interval-s 10
+        # declare per-model SLOs judged as rolling multi-window burn
+        # rates (telemetry.sloengine): GET /alertz serves the firing
+        # alerts + per-SLO burns/budgets, /statusz grows an SLO
+        # section, and slo_burn_rate / slo_budget_remaining /
+        # slo_alerts_total join the scrape
+        # (docs/observability.md "SLO engine")
+    python -m znicz_tpu chaos [--scenario reload|promote|overload|zoo|slo]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
         # --scenario reload drills corrupt-artifact rollback;
@@ -39,7 +47,11 @@ Examples::
         # drain under 4x load with one slow replica; docs/resilience.md);
         # --scenario zoo drills multi-tenant serving (three families
         # under a memory budget forcing weight eviction, one tenant
-        # latency-faulted, one reloaded mid-burst; docs/serving.md)
+        # latency-faulted, one reloaded mid-burst; docs/serving.md);
+        # --scenario slo drills the burn-rate SLO engine (one tenant
+        # latency-faulted => exactly one alert, the quiet tenant's
+        # budget intact, per-tenant device-ms ledger sums;
+        # docs/observability.md)
     python -m znicz_tpu promote --candidates DIR --url http://host:port/
         # closed-loop promotion controller sidecar: watch a trainer's
         # export directory, verify + canary-deploy each new candidate

@@ -25,15 +25,16 @@ from .controller import (CANARY_FAILED, EXPORT_FAILED, PROMOTED,
                          CrashLoop, EngineTarget, HttpTarget,
                          PromotionController, ReloadBusy)
 from .ledger import LedgerReplay, PromotionLedger
-from .slo import (SLOPolicy, SLOSample, delta_quantile, parse_prometheus,
-                  prometheus_sample, registry_sample)
+from .slo import (BurnRatePolicy, SLOPolicy, SLOSample, delta_quantile,
+                  parse_prometheus, prometheus_sample, registry_sample)
 from .sources import Candidate, CheckpointSource, DirectorySource
 
 __all__ = [
     "CANARY_FAILED", "EXPORT_FAILED", "PROMOTED", "ROLLBACK_FAILED",
-    "ROLLED_BACK", "VERIFY_FAILED", "Candidate", "CheckpointSource",
-    "CrashLoop", "DirectorySource", "EngineTarget", "HttpTarget",
-    "LedgerReplay", "PromotionController", "PromotionLedger",
-    "ReloadBusy", "SLOPolicy", "SLOSample", "delta_quantile",
-    "parse_prometheus", "prometheus_sample", "registry_sample",
+    "ROLLED_BACK", "VERIFY_FAILED", "BurnRatePolicy", "Candidate",
+    "CheckpointSource", "CrashLoop", "DirectorySource", "EngineTarget",
+    "HttpTarget", "LedgerReplay", "PromotionController",
+    "PromotionLedger", "ReloadBusy", "SLOPolicy", "SLOSample",
+    "delta_quantile", "parse_prometheus", "prometheus_sample",
+    "registry_sample",
 ]

@@ -123,6 +123,110 @@ def count_breach(breach: dict) -> None:
     _breaches.inc(slo=str(breach.get("slo", "unknown")))
 
 
+class BurnRatePolicy:
+    """Burn-rate canary watch: judge the candidate on rolling
+    multi-window error-budget burn instead of one whole-window delta.
+
+    :class:`SLOPolicy` asks "did the window's aggregate p99/error-rate
+    cross a line"; this asks the SRE-Workbook question — "at the rate
+    the candidate is burning its error budget, is it *sustained*?" —
+    by requiring BOTH a fast window (the last ``fast_window_s`` of
+    probes) and the slow window (the whole watch so far) to exceed
+    ``max_burn_rate``.  A one-probe blip cannot roll a healthy
+    candidate back, and a genuine regression is caught as soon as the
+    fast window fills instead of only at whatever rate dilutes the
+    full-window average.  The arithmetic is
+    :func:`znicz_tpu.telemetry.sloengine.burn_between` — the same code
+    the serving-side SLO engine alerts on, so the canary judge and the
+    production pager can never disagree about what "burning" means.
+
+    Duck-type-compatible with :class:`SLOPolicy` where the controller
+    touches a policy (``window_s``, ``probe_interval_s``,
+    ``evaluate(start, now)``); breaches carry ``slo="burn_rate"`` into
+    ``slo_breaches_total``.  The probe ring resets itself when a new
+    watch begins (a fresh ``start`` sample object), so one policy
+    instance serves every candidate the controller drives."""
+
+    def __init__(self, *, objective: str = "availability",
+                 target: float = 0.999,
+                 threshold_ms: float | None = None,
+                 window_s: float = 30.0,
+                 probe_interval_s: float = 2.0,
+                 fast_window_s: float | None = None,
+                 max_burn_rate: float = 2.0, min_samples: int = 5,
+                 require_breaker_closed: bool = True):
+        from ..telemetry import sloengine
+        if objective not in sloengine.OBJECTIVES:
+            raise ValueError(f"objective {objective!r}; expected one "
+                             f"of {sloengine.OBJECTIVES}")
+        if objective == "latency" and threshold_ms is None:
+            raise ValueError("a latency burn-rate watch needs "
+                             "threshold_ms")
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"target must be a fraction in (0, 1), "
+                             f"got {target!r}")
+        self._burn_between = sloengine.burn_between
+        self.objective = objective
+        self.target = float(target)
+        self.threshold_ms = threshold_ms
+        self.window_s = float(window_s)
+        self.probe_interval_s = float(probe_interval_s)
+        # default fast window: wide enough for a couple of probes,
+        # narrow enough to react well inside the watch
+        self.fast_window_s = (float(fast_window_s)
+                              if fast_window_s is not None
+                              else max(2.0 * self.probe_interval_s,
+                                       self.window_s / 6.0))
+        if self.fast_window_s > self.window_s:
+            raise ValueError(f"fast_window_s ({self.fast_window_s}) "
+                             f"must fit inside window_s "
+                             f"({self.window_s})")
+        self.max_burn_rate = float(max_burn_rate)
+        self.min_samples = int(min_samples)
+        self.require_breaker_closed = bool(require_breaker_closed)
+        self._watch_start = None
+        self._ring: list = []
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+    def evaluate(self, start: SLOSample, now: SLOSample) -> list:
+        """Same contract as :meth:`SLOPolicy.evaluate`: the breaches
+        of this probe, empty while clean.  ``start`` is the watch
+        baseline the controller sampled once; each ``now`` probe joins
+        the internal ring the fast window slides over."""
+        if start is not self._watch_start:
+            # a new watch began: the previous candidate's probes must
+            # not leak into this one's fast window
+            self._watch_start = start
+            self._ring = [start]
+        self._ring.append(now)
+        breaches = []
+        if self.require_breaker_closed and now.breaker_state not in (
+                None, "closed"):
+            breaches.append({"slo": "breaker",
+                             "value": now.breaker_state,
+                             "limit": "closed"})
+        kw = dict(budget=self.budget, objective=self.objective,
+                  threshold_ms=self.threshold_ms,
+                  min_events=self.min_samples)
+        slow, _ev = self._burn_between(start, now, **kw)
+        fast_base = start
+        cut = now.at - self.fast_window_s
+        for s in self._ring:
+            if s.at <= cut:
+                fast_base = s
+            else:
+                break
+        fast, _ev = self._burn_between(fast_base, now, **kw)
+        if fast >= self.max_burn_rate and slow >= self.max_burn_rate:
+            breaches.append({"slo": "burn_rate",
+                             "value": round(max(fast, slow), 4),
+                             "limit": self.max_burn_rate})
+        return breaches
+
+
 def delta_quantile(start: SLOSample, now: SLOSample,
                    q: float = 0.99) -> float | None:
     """The ``q`` quantile (bucket upper edge) of the observations made

@@ -809,6 +809,206 @@ def _zoo_scenario(args) -> int:
     return 1 if bad else 0
 
 
+def _slo_scenario(args) -> int:
+    """``--scenario slo`` — the burn-rate observability acceptance
+    (docs/observability.md "SLO engine"): two tenants behind one
+    server, each with a latency SLO judged by a live
+    :class:`~znicz_tpu.telemetry.sloengine.SLOEngine` on sub-second
+    windows; the ``sheddable`` tenant is latency-faulted at its
+    ``zoo.model.<name>`` site while the ``critical`` tenant stays
+    quiet.  Asserted:
+
+    * the faulted tenant's fast-window burn rate crosses the alert
+      threshold and EXACTLY ONE alert fires for it — none for the
+      healthy tenant, whose error budget stays intact;
+    * ``GET /alertz`` reports the firing alert live, ``/statusz``
+      renders the SLO section, and the alert transition landed in the
+      flight recorder;
+    * zero raw 500s and zero hangs — a latency regression must burn
+      the budget, not the degradation contract;
+    * per-tenant cost attribution: the sum of
+      ``model_device_ms_total`` across tenants is within 10% of the
+      total device time the engines measured (the chip bill adds up).
+    """
+    import collections
+    import threading
+
+    from ..serving.server import ServingServer
+    from ..serving import zoo as zoo_mod
+    from ..telemetry import sloengine
+    from ..telemetry.flightrecorder import RECORDER
+    from ..telemetry.registry import REGISTRY
+
+    bad: list[str] = []
+    inputs = {"mnist": [[0.2] * 16], "wine": [[0.1] * 13]}
+
+    def _labeled(name: str) -> dict:
+        snap = REGISTRY.as_dict().get(name, 0)
+        return snap if isinstance(snap, dict) else {}
+
+    with tempfile.TemporaryDirectory(prefix="znicz_chaos_") as tmp:
+        paths = zoo_mod.make_demo_zoo(tmp, families=("mnist", "wine"))
+        zoo = zoo_mod.ModelZoo()
+        zoo.add("mnist", paths["mnist"], backend="jax",
+                buckets=(1, 2, 4), criticality="sheddable")
+        zoo.add("wine", paths["wine"], backend="jax",
+                buckets=(1, 2, 4), criticality="critical",
+                default=True)
+        # no shed ladder and no deadlines: the drill's contract is
+        # that a latency regression burns the BUDGET, with every
+        # answer still a 200 — refusals would be a different drill
+        server = ServingServer(zoo=zoo, max_batch=4, max_wait_ms=1.0,
+                               max_queue=64).start()
+        for entry in zoo.entries():
+            entry.engine.warmup((len(inputs[entry.name][0]),))
+        fast_s, slow_s = args.slo_fast_s, 3.0 * args.slo_fast_s
+        specs = [sloengine.SLOSpec(
+            name="latency", model=m, objective="latency",
+            threshold_ms=args.slo_threshold_ms, target=0.9,
+            fast_window_s=fast_s, slow_window_s=slow_s,
+            burn_threshold=args.slo_burn, min_events=5,
+            budget_window_s=10.0 * slow_s, severity="page")
+            for m in ("mnist", "wine")]
+        slo = sloengine.SLOEngine.for_server(
+            server, specs, interval_s=max(0.05, fast_s / 5.0))
+        server.attach_slo(slo)
+        slo.start()
+        alerts_before = dict(_labeled("slo_alerts_total"))
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "zoo.model.mnist", kind="latency", latency_s=args.slow_s,
+            message="chaos: slow tenant burning its latency SLO")],
+            seed=17)
+        answers = collections.defaultdict(list)
+        mu = threading.Lock()
+        stop = threading.Event()
+
+        def client(model: str):
+            while not stop.is_set():
+                try:
+                    code, _body, _h = _post(
+                        server.url, {"inputs": inputs[model]},
+                        timeout=30.0, headers={"X-Model": model})
+                except Exception:
+                    code = -1          # hang / dropped conn = failure
+                with mu:
+                    answers[model].append(code)
+                stop.wait(0.002)
+
+        threads = [threading.Thread(target=client, args=(m,),
+                                    daemon=True)
+                   for m in ("mnist",) * 3 + ("wine",) * 2]
+        alertz_mid: dict = {}
+        try:
+            with plan:
+                for t in threads:
+                    t.start()
+                stop.wait(args.duration_s * 0.7)
+                # mid-burst, fault still live: the alert must already
+                # be visible on the live surface
+                with urllib.request.urlopen(server.url + "alertz",
+                                            timeout=10.0) as r:
+                    alertz_mid = json.loads(r.read())
+                with urllib.request.urlopen(server.url + "statusz",
+                                            timeout=10.0) as r:
+                    statusz_text = r.read().decode()
+                stop.wait(args.duration_s * 0.3)
+                # one final deterministic evaluation before the fault
+                # plan lifts (the loop's own cadence keeps running
+                # underneath; tick() is just a judged snapshot)
+                slo.tick()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(30.0)
+            slo.stop()
+            status = slo.status()
+            server.stop()
+            zoo.close()
+        # -- invariants ---------------------------------------------------
+        for model, got in sorted(answers.items()):
+            codes = collections.Counter(got)
+            if codes.get(-1):
+                bad.append(f"{model}: {codes[-1]} hung/dropped "
+                           f"request(s)")
+            raw = {c for c in codes if c not in (200, 429, 503, 504)}
+            if raw:
+                bad.append(f"{model}: raw failure codes {sorted(raw)}")
+            if codes.get(500):
+                bad.append(f"{model}: {codes[500]} raw 500(s)")
+            print(json.dumps({"phase": "burst", "model": model,
+                              "codes": dict(codes)}))
+        rows = {(r["slo"], r["model"]): r for r in status["slos"]}
+        hot = rows[("latency", "mnist")]
+        quiet = rows[("latency", "wine")]
+        if hot["burn_fast"] < args.slo_burn:
+            bad.append(f"faulted tenant's fast-window burn "
+                       f"{hot['burn_fast']} never crossed the "
+                       f"{args.slo_burn} threshold")
+        if not hot["firing"]:
+            bad.append("faulted tenant's alert is not firing at the "
+                       "end of the faulted burst")
+        alerts_after = _labeled("slo_alerts_total")
+        fired = {k: v - alerts_before.get(k, 0)
+                 for k, v in alerts_after.items()
+                 if v - alerts_before.get(k, 0)}
+        mnist_key = "model=mnist,severity=page,slo=latency"
+        wine_fired = sum(v for k, v in fired.items() if "model=wine" in k)
+        if fired.get(mnist_key) != 1:
+            bad.append(f"expected exactly one alert firing for the "
+                       f"faulted tenant, saw {fired}")
+        if wine_fired:
+            bad.append(f"the healthy tenant fired {wine_fired} "
+                       f"alert(s)")
+        if quiet["budget_remaining"] < 0.9:
+            bad.append(f"healthy tenant's budget eroded to "
+                       f"{quiet['budget_remaining']} under someone "
+                       f"else's fault")
+        if quiet["firing"]:
+            bad.append("healthy tenant's alert is firing")
+        if not alertz_mid.get("enabled") \
+                or not any(a["model"] == "mnist"
+                           for a in alertz_mid.get("alerts", [])):
+            bad.append(f"GET /alertz did not report the firing alert "
+                       f"mid-burst: {alertz_mid}")
+        if "slo burn rates" not in statusz_text:
+            bad.append("/statusz has no SLO section")
+        # a firing alert lands in the ERROR ring too (outcome !=
+        # "ok") — check there: a busy burst legitimately flushes the
+        # recent ring, which is exactly why the error ring exists
+        snap = RECORDER.snapshot()
+        recorded = [r for r in snap["errors"] + snap["recent"]
+                    if r.get("kind") == "slo_alert"
+                    and r.get("model") == "mnist"
+                    and r.get("transition") == "fire"]
+        if not recorded:
+            bad.append("the alert transition never reached the "
+                       "flight recorder")
+        # cost attribution: the per-tenant ledger must add up to what
+        # the engines measured (within 10%, per the acceptance)
+        attributed = sum(_labeled("model_device_ms_total").values())
+        measured = sum(e.engine.device_ms_total()
+                       for e in zoo.entries())
+        if measured <= 0:
+            bad.append("engines measured zero device time under a "
+                       "multi-second burst")
+        elif abs(attributed - measured) > 0.1 * measured:
+            bad.append(f"per-tenant device-ms attribution "
+                       f"({attributed:.1f}) is not within 10% of the "
+                       f"measured engine device time "
+                       f"({measured:.1f})")
+        print(json.dumps({
+            "scenario": "slo", "ok": not bad, "violations": bad,
+            "hot": {k: hot[k] for k in ("burn_fast", "burn_slow",
+                                        "budget_remaining", "firing")},
+            "quiet": {k: quiet[k] for k in ("burn_fast", "burn_slow",
+                                            "budget_remaining",
+                                            "firing")},
+            "alerts_fired": fired,
+            "device_ms": {"attributed": round(attributed, 1),
+                          "measured": round(measured, 1)}}))
+    return 1 if bad else 0
+
+
 def _admin_reload_named(url: str, name: str, model: str,
                         timeout: float = 60.0):
     """(status, body) of a synchronous per-model ``POST
@@ -843,7 +1043,7 @@ def main(argv=None) -> int:
     p.add_argument("--retry-attempts", type=int, default=2)
     p.add_argument("--scenario", default="breaker",
                    choices=("breaker", "reload", "promote", "overload",
-                            "zoo"),
+                            "zoo", "slo"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -861,7 +1061,14 @@ def main(argv=None) -> int:
                         "one tenant latency-faulted, one hot-reloaded "
                         "mid-burst — routing, residency byte-"
                         "identity, criticality classes and reload "
-                        "isolation asserted (docs/serving.md)")
+                        "isolation asserted (docs/serving.md); slo: "
+                        "two tenants with latency SLOs judged by a "
+                        "live burn-rate engine on sub-second windows, "
+                        "one tenant latency-faulted — exactly one "
+                        "alert for the burning tenant, the quiet "
+                        "tenant's budget intact, zero raw 500s, and "
+                        "the per-tenant device-ms ledger adds up "
+                        "(docs/observability.md)")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -896,6 +1103,17 @@ def main(argv=None) -> int:
                         "of the demo zoo's combined weight bytes "
                         "(< 1 forces eviction while all tenants "
                         "cycle)")
+    p.add_argument("--slo-threshold-ms", type=float, default=50.0,
+                   help="slo: the latency objective's good/bad "
+                        "threshold — the injected fault (--slow-s) "
+                        "must land well past it, quiet-tenant "
+                        "forwards well under it")
+    p.add_argument("--slo-fast-s", type=float, default=1.0,
+                   help="slo: fast burn window (the slow window is "
+                        "3x, the snapshot tick a fifth)")
+    p.add_argument("--slo-burn", type=float, default=2.0,
+                   help="slo: burn-rate alert threshold both windows "
+                        "must exceed to fire")
     args = p.parse_args(argv)
     if args.scenario == "reload":
         return _reload_scenario(args)
@@ -905,6 +1123,8 @@ def main(argv=None) -> int:
         return _overload_scenario(args)
     if args.scenario == "zoo":
         return _zoo_scenario(args)
+    if args.scenario == "slo":
+        return _slo_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

@@ -432,6 +432,12 @@ class ServingEngine:
         #: weight page-in of whichever generation is serving (set by
         #: ModelZoo.add; None outside a zoo)
         self.on_pagein = None
+        #: per-tenant cost-attribution hook ``(duration_ms)`` — fired
+        #: after every fenced forward with the measured device time
+        #: (set by ModelZoo.add so ``model_device_ms_total{model}``
+        #: bills the tenant whose batch spent the chip; None outside
+        #: a labeled zoo)
+        self.on_device_time = None
         self._gen = _Generation(1, path, layers,
                                 self._tp_shardings(layers))
         self._gen.on_pagein = self._note_pagein
@@ -522,6 +528,27 @@ class ServingEngine:
         cb = self.on_pagein
         if cb is not None:
             cb(cause, dt_ms)
+
+    # -- device-time cost attribution -------------------------------------
+    def _note_device_time(self, dt_ms: float) -> None:
+        """One fenced forward's measured wall time (the ``np.asarray``
+        readback IS the block_until_ready fence, so this is dispatch +
+        compute + readback — retry backoff sleeps and chaos-injected
+        latency are outside the measurement).  Accumulated into
+        ``device_ms_total`` and forwarded to the zoo hook so the
+        tenant that spent the chip is the one billed."""
+        with self._lock:
+            self._stats["device_ms_total"] += dt_ms
+        cb = self.on_device_time
+        if cb is not None:
+            cb(dt_ms)
+
+    def device_ms_total(self) -> float:
+        """Measured device milliseconds this engine has spent across
+        every fenced forward (the zoo's per-tenant attribution and the
+        server's ``engine_busy_ratio`` collector both read this)."""
+        with self._lock:
+            return float(self._stats["device_ms_total"])
 
     def weight_nbytes(self) -> int:
         """Host-side byte size of the serving generation's parameters
@@ -776,17 +803,30 @@ class ServingEngine:
             self._stats["rows_in"] += len(x)
         try:
             with tracing.span("engine.forward", backend="fallback",
-                              rows=int(len(x))):
-                return native.infer(x, feats)
+                              rows=int(len(x))) as sp:
+                t0 = time.monotonic()
+                y = native.infer(x, feats)
+                dt_ms = (time.monotonic() - t0) * 1e3
+                sp.attrs["device_ms"] = round(dt_ms, 3)
+            self._note_device_time(dt_ms)
+            return y
         except Exception as e:
             raise EngineUnavailable(
                 f"native fallback failed: {e!r}",
                 retry_after=self.breaker.retry_after())
 
-    def _forward_once(self, fn, gen: _Generation,
-                      padded: np.ndarray) -> np.ndarray:
+    def _forward_once(self, fn, gen: _Generation, padded: np.ndarray,
+                      dev_acc: list | None = None) -> np.ndarray:
         faults.inject("engine.forward")
-        return np.asarray(fn(gen.params(), self._replicate_input(padded)))
+        # measure AFTER the fault site: injected latency is chaos, not
+        # chip time, and must not pollute the cost attribution
+        t0 = time.monotonic()
+        y = np.asarray(fn(gen.params(), self._replicate_input(padded)))
+        dt_ms = (time.monotonic() - t0) * 1e3
+        if dev_acc is not None:
+            dev_acc[0] += dt_ms
+        self._note_device_time(dt_ms)
+        return y
 
     def _count_retry(self, attempt, exc) -> None:
         with self._lock:
@@ -817,8 +857,13 @@ class ServingEngine:
                 self._stats["forward_calls"] += 1
                 self._stats["rows_in"] += len(x)
             with tracing.span("engine.forward", backend="native",
-                              rows=int(len(x))):
-                return native.infer(x, feats)
+                              rows=int(len(x))) as sp:
+                t0 = time.monotonic()
+                y = native.infer(x, feats)
+                dt_ms = (time.monotonic() - t0) * 1e3
+                sp.attrs["device_ms"] = round(dt_ms, 3)
+            self._note_device_time(dt_ms)
+            return y
         if not self.breaker.allow():
             return self._fallback_predict(x, gen)
         top = self.buckets[-1]
@@ -836,11 +881,21 @@ class ServingEngine:
                     padded = chunk
                 fn = self._executable(gen, bucket, chunk.shape[1:],
                                       chunk.dtype)
+                # the span carries the chunk's measured device time so
+                # flight-record stage breakdowns can split the chip
+                # bill pro-rata across the batch's riders.  Accumulated
+                # per CALL (not as a delta of the engine-global total):
+                # a concurrent forward on the same engine — a hedge's
+                # losing attempt, a replica straggler — must not leak
+                # its chip time into this span's attribution
+                dev_acc = [0.0]
                 with tracing.span("engine.forward", backend="jax",
-                                  bucket=bucket, rows=int(len(chunk))):
+                                  bucket=bucket,
+                                  rows=int(len(chunk))) as sp:
                     y = self.retry.call(self._forward_once, fn, gen,
-                                        padded,
+                                        padded, dev_acc,
                                         on_retry=self._count_retry)
+                    sp.attrs["device_ms"] = round(dev_acc[0], 3)
                 with self._lock:
                     self._stats["forward_calls"] += 1
                     self._stats["rows_in"] += len(chunk)
@@ -1050,6 +1105,7 @@ class ServingEngine:
         m.setdefault("retries", 0)
         m.setdefault("weight_pageins", 0)
         m.setdefault("weight_releases", 0)
+        m.setdefault("device_ms_total", 0.0)
         m["weight_bytes"] = self.weight_nbytes()
         m["weights_resident"] = self.weights_resident()
         m["backend"] = self.backend

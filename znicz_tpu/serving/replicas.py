@@ -391,6 +391,23 @@ class EngineReplicaSet:
         for eng in self.replicas:
             eng.on_pagein = fn
 
+    @property
+    def on_device_time(self):
+        return self.replicas[0].on_device_time
+
+    @on_device_time.setter
+    def on_device_time(self, fn) -> None:
+        # every replica's chip time bills the same tenant — a hedge's
+        # losing attempt included: speculative work is real device
+        # spend, and the cost ledger must say whose
+        for eng in self.replicas:
+            eng.on_device_time = fn
+
+    def device_ms_total(self) -> float:
+        """Fleet-wide measured device milliseconds (the per-replica
+        engines each fence their own forwards)."""
+        return sum(e.device_ms_total() for e in self.replicas)
+
     def warmup(self, sample_shape, dtype=None, buckets=None) -> int:
         kw = {} if dtype is None else {"dtype": dtype}
         return sum(e.warmup(sample_shape, buckets=buckets, **kw)

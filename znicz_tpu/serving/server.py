@@ -59,10 +59,19 @@ Wire protocol (JSON both ways):
   token is configured, ``/statusz`` and both ``/debug/*`` routes
   require the same ``X-Admin-Token`` as ``/admin/reload`` — stack
   dumps, request shapes and error tracebacks are operator data.
+* ``GET /alertz``   the SLO engine's judgment surface (JSON): every
+  declared objective's fast/slow-window burn rates, error budget
+  remaining, and the currently-firing alerts — open like ``/healthz``
+  (an alerting probe is monitoring infrastructure); ``enabled: false``
+  when no SLO engine is attached (``serve --slo`` /
+  :meth:`ServingServer.attach_slo`; telemetry.sloengine,
+  docs/observability.md "SLO engine").
 * ``GET /debug/flightrecorder``  the bounded ring of recent request /
-  train-step records as JSON (``?n=`` bounds the recent slice) —
-  per-request span trees, stage timings, retained slow outliers, last
-  errors with tracebacks (telemetry.flightrecorder).
+  train-step records as JSON (``?n=`` bounds the recent slice,
+  ``?model=`` scopes every ring to one zoo tenant) — per-request span
+  trees, stage timings (incl. the measured per-request device-time
+  share), retained slow outliers, last errors with tracebacks
+  (telemetry.flightrecorder).
 * ``GET /debug/threadz``  every live thread with its current Python
   stack (JSON) — diagnosing a live hang; ``kill -USR1 <pid>`` dumps
   the same to stderr when the HTTP threads themselves are what hung.
@@ -112,7 +121,8 @@ from .engine import ServingEngine
 #: anything else pools under "other" (label cardinality stays bounded
 #: no matter what paths clients probe)
 _ROUTES = ("/predict", "/healthz", "/metrics", "/admin/reload",
-           "/statusz", "/debug/flightrecorder", "/debug/threadz")
+           "/statusz", "/alertz", "/debug/flightrecorder",
+           "/debug/threadz")
 
 
 class ServingServer:
@@ -305,6 +315,12 @@ class ServingServer:
                     return
                 if path == "/healthz":
                     self._reply(200, outer.health())
+                elif path == "/alertz":
+                    # the SLO engine's judgment surface: active burn-
+                    # rate alerts + per-SLO burns/budgets.  Open like
+                    # /healthz — an alerting probe is monitoring
+                    # infrastructure, not operator data
+                    self._reply(200, outer.alertz())
                 elif path == "/statusz":
                     # the human one-pager: text, because it exists to
                     # be curl'd mid-incident, not parsed
@@ -314,14 +330,22 @@ class ServingServer:
                     query = (self.path.split("?", 1)[1]
                              if "?" in self.path else "")
                     n = None
+                    model = None
                     for part in query.split("&"):
                         if part.startswith("n="):
                             try:
                                 n = max(1, int(part[2:]))
                             except ValueError:
                                 pass
+                        elif part.startswith("model="):
+                            # slice the rings to one tenant (records
+                            # carry `model` since the zoo landed);
+                            # names are URL-safe by the registry's
+                            # grammar, so no decoding is needed
+                            model = part[len("model="):] or None
                     self._reply(200,
-                                flightrecorder.RECORDER.snapshot(n))
+                                flightrecorder.RECORDER.snapshot(
+                                    n, model=model))
                 elif path == "/debug/threadz":
                     self._reply(200, debugz.threadz())
                 elif path == "/metrics":
@@ -378,8 +402,12 @@ class ServingServer:
                     # with the FINAL status, so quota 429s and shed
                     # 503s attribute to the tenant that caused them
                     # (explicit zoos only: the single-model surface
-                    # stays label-free)
-                    zoo_mod.note_model_request(self._model_name, code)
+                    # stays label-free).  The wall latency rides along
+                    # into model_latency_ms{model} — the per-tenant
+                    # histogram the SLO engine's latency objectives
+                    # judge
+                    zoo_mod.note_model_request(self._model_name, code,
+                                               dt_ms)
                 # since=t0: a retry reusing its first attempt's
                 # X-Request-Id must not inherit that attempt's spans —
                 # stage timings would double-count
@@ -393,7 +421,8 @@ class ServingServer:
                     request_id=rid, code=code,
                     rows=self._rec_rows, shape=self._rec_shape,
                     model=self._model_name,
-                    stages=flightrecorder.stage_breakdown(spans),
+                    stages=flightrecorder.stage_breakdown(
+                        spans, rows=self._rec_rows),
                     spans=spans)
 
             def _admin_reload(self):
@@ -656,6 +685,15 @@ class ServingServer:
         #: optional status() of an in-process promotion controller
         #: (znicz_tpu.promotion) — surfaced on /healthz when attached
         self.promotion_status = None
+        #: optional attached SLOEngine (telemetry.sloengine) — serves
+        #: GET /alertz and the /statusz SLO section; caller-owned
+        #: lifecycle, same contract as the promotion attach
+        self.slo_engine = None
+        #: engine_busy_ratio bookkeeping: (monotonic stamp, device ms
+        #: total) of the previous scrape, so the collector reports the
+        #: scrape-to-scrape busy fraction instead of a lifetime average
+        self._busy_lock = threading.Lock()
+        self._busy_prev = (time.monotonic(), self._device_ms_now())
 
     def attach_promotion(self, status_fn) -> None:
         """Surface a promotion controller's ``status()`` on
@@ -663,6 +701,46 @@ class ServingServer:
         balancer polls one endpoint for breaker, generation, AND
         promotion state."""
         self.promotion_status = status_fn
+
+    def attach_slo(self, engine) -> None:
+        """Attach a :class:`~znicz_tpu.telemetry.sloengine.SLOEngine`
+        so ``GET /alertz`` and the ``/statusz`` SLO section render its
+        judgment (docs/observability.md "SLO engine").  The caller
+        keeps lifecycle ownership (``start``/``stop``), exactly like
+        the promotion attach."""
+        self.slo_engine = engine
+
+    def slo_status(self) -> dict | None:
+        """The attached SLO engine's ``status()`` (None when no
+        engine is attached); a wedged engine must not take the
+        introspection surfaces down with it."""
+        eng = self.slo_engine
+        if eng is None:
+            return None
+        try:
+            return eng.status()
+        except Exception:
+            return {"error": "slo engine status probe failed"}
+
+    def alertz(self) -> dict:
+        """The ``GET /alertz`` payload: active burn-rate alerts plus
+        every SLO's current readings — ``enabled: false`` (and no
+        alerts) when no SLO engine is attached, so probers can hit the
+        route unconditionally."""
+        status = self.slo_status()
+        if status is None:
+            return {"enabled": False, "alerts": []}
+        return {"enabled": True, **status}
+
+    def _device_ms_now(self) -> float:
+        """Measured device ms across every tenant's engine right now
+        (the engine_busy_ratio collector's numerator source)."""
+        total = 0.0
+        for entry in self.zoo.entries():
+            fn = getattr(entry.engine, "device_ms_total", None)
+            if fn is not None:
+                total += fn()
+        return total
 
     # -- hot reload -------------------------------------------------------
     def reload_status(self, name: str | None = None) -> dict:
@@ -830,6 +908,9 @@ class ServingServer:
         m = self.batcher.metrics()
         m["engine"] = self.engine.metrics()
         m["overload"] = self.overload_status(bm=m)
+        slo = self.slo_status()
+        if slo is not None:
+            m["slo"] = slo
         if self._zoo_explicit:
             # top-level fields stay the DEFAULT model's (the PR-1
             # shape); the zoo block carries every tenant
@@ -886,6 +967,24 @@ class ServingServer:
             fams.append(("counter", "breaker_probes_total",
                          "half-open probe attempts granted",
                          [(None, float(breaker.get("probes", 0)))]))
+        # scrape-to-scrape busy fraction: measured device ms spent
+        # since the previous scrape over the wall time elapsed — the
+        # "is the chip the bottleneck" one-number answer (a lifetime
+        # average would bury today's overload under yesterday's idle)
+        now = time.monotonic()
+        total_ms = self._device_ms_now()
+        with self._busy_lock:
+            prev_t, prev_ms = self._busy_prev
+            self._busy_prev = (now, total_ms)
+        wall_ms = (now - prev_t) * 1e3
+        busy = (max(0.0, min(1.0, (total_ms - prev_ms) / wall_ms))
+                if wall_ms > 0 else 0.0)
+        fams.append((
+            "gauge", "engine_busy_ratio",
+            "fraction of wall time since the previous scrape spent "
+            "inside fenced engine forwards (all tenants; > 1 clamps "
+            "— replicas can overlap)",
+            [(None, round(busy, 4))]))
         if self._zoo_explicit:
             # per-model families, sampled from the same rows /healthz
             # serves — a scraper sees every tenant without N scrape
@@ -1088,6 +1187,19 @@ def main(argv=None) -> int:
                         "restarts and hot reloads reuse executables "
                         "across processes (also: "
                         "$ZNICZ_COMPILE_CACHE; docs/performance.md)")
+    p.add_argument("--slo", action="append", metavar="SPEC",
+                   help="declare one SLO judged as rolling multi-"
+                        "window burn rates: NAME[,model=M]"
+                        "[,objective=availability|latency]"
+                        "[,target=99.9][,threshold-ms=N][,fast-s=N]"
+                        "[,slow-s=N][,burn=N] — repeatable; alerts "
+                        "surface on GET /alertz, /statusz and "
+                        "slo_*{slo=,model=,window=} metric families "
+                        "(docs/observability.md 'SLO engine')")
+    p.add_argument("--slo-interval-s", type=float, default=10.0,
+                   help="SLO engine snapshot cadence (window "
+                        "arithmetic resolution; alerts cannot react "
+                        "faster than this)")
     p.add_argument("--admin-token", default=None,
                    help="require this token (X-Admin-Token header) on "
                         "POST /admin/reload; defaults to "
@@ -1154,6 +1266,16 @@ def main(argv=None) -> int:
     # process scrapes them from zero — a dashboard must not see the
     # series appear only once a controller starts driving this replica
     from .. import promotion as _promotion  # noqa: F401
+    # same contract for the SLO families (slo_burn_rate /
+    # slo_budget_remaining / slo_alerts_total): registered at import,
+    # scraped from zero even on replicas serving without --slo
+    from ..telemetry import sloengine
+    slo_specs = []
+    for raw in args.slo or []:
+        try:
+            slo_specs.append(sloengine.parse_slo_spec(raw))
+        except ValueError as e:
+            p.error(str(e))
     from ..resilience.breaker import CircuitBreaker
     from ..resilience.retry import RetryPolicy
     # the persistent XLA compile cache must be live before any warmup
@@ -1239,6 +1361,7 @@ def main(argv=None) -> int:
     from ..telemetry import profiler
     profile_dir = args.profile_dir or profiler.dir_from_env()
     server = None
+    slo_engine = None
     try:
         # the trace starts BEFORE the server exists: the profiler's
         # session hooks every live Python thread, and hooking a
@@ -1285,6 +1408,22 @@ def main(argv=None) -> int:
         server = (ServingServer(engine, **kwargs) if zoo is None
                   else ServingServer(zoo=zoo, **kwargs))
         server.start()
+        if slo_specs:
+            # a spec naming an unknown tenant would judge zeros
+            # forever — that is a config bug, refuse to boot on it
+            known = set(zoo.names()) if zoo is not None else set()
+            for spec in slo_specs:
+                if spec.model is not None and spec.model not in known:
+                    p.error(f"--slo names unknown model "
+                            f"{spec.model!r} (serving: "
+                            f"{sorted(known) or ['<single-model>']})")
+            slo_engine = sloengine.SLOEngine.for_server(
+                server, slo_specs, interval_s=args.slo_interval_s)
+            server.attach_slo(slo_engine)
+            slo_engine.start()
+            print(f"slo engine: {len(slo_specs)} objective(s), "
+                  f"tick {args.slo_interval_s:g}s "
+                  f"(GET /alertz)", flush=True)
         mesh = "x".join(str(d) for d in engine.mesh_shape)
         if zoo is None:
             what = bare[0]
@@ -1296,7 +1435,8 @@ def main(argv=None) -> int:
         print(f"serving {what} [{engine.backend}] at "
               f"{server.url} (mesh {mesh}, replicas {args.replicas}; "
               f"POST /predict, GET /healthz, "
-              f"GET /metrics, GET /statusz, GET /debug/*)", flush=True)
+              f"GET /metrics, GET /statusz, GET /alertz, "
+              f"GET /debug/*)", flush=True)
         # explicit shutdown signaling with a short-tick wait: Python
         # runs signal handlers on the main thread only when it next
         # executes bytecode, and the OS may deliver the C-level signal
@@ -1368,6 +1508,8 @@ def main(argv=None) -> int:
     finally:
         if profile_dir:
             profiler.stop_trace()
+        if slo_engine is not None:
+            slo_engine.stop()
         if server is not None:
             server.stop()
         closer()      # zoo.close() (every engine) or engine.close()

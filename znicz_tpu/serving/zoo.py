@@ -90,12 +90,33 @@ _quota_rejected = REGISTRY.counter(
     "model_quota_rejected_total",
     "requests refused 429 + Retry-After by a model's token-bucket "
     "quota, by model")
+_model_latency = REGISTRY.histogram(
+    "model_latency_ms",
+    "POST /predict wall time at the HTTP front per routed zoo model, "
+    "2xx answers only (the per-tenant twin of predict_latency_ms; "
+    "the SLO engine's latency objectives judge this — a fast refusal "
+    "must not read as a latency success), milliseconds")
+_device_ms = REGISTRY.counter(
+    "model_device_ms_total",
+    "measured device time spent forwarding each zoo model's batches "
+    "(wall time of the fenced forward: dispatch + compute + "
+    "readback), milliseconds — the per-tenant chip cost ledger")
 
 
-def note_model_request(name: str, code: int) -> None:
+def note_model_request(name: str, code: int,
+                       duration_ms: float | None = None) -> None:
     """Count one routed /predict outcome (the HTTP front calls this
-    once per request, with the final status)."""
+    once per request, with the final status and wall latency).
+
+    Latency observes SERVED answers (2xx) only: a shed/quota refusal
+    answers in microseconds, and counting it as a fast event would
+    make a server that is 503ing a tenant look latency-HEALTHY —
+    refusals burn the availability SLO instead (found by the live
+    drive: a latency-faulted sheddable tenant's burn rate fell as the
+    shed ladder kicked in)."""
     _model_requests.inc(model=name, code=str(code))
+    if duration_ms is not None and 200 <= int(code) < 300:
+        _model_latency.observe(duration_ms, model=name)
 
 
 class UnknownModel(KeyError):
@@ -281,6 +302,13 @@ class ModelZoo:
         # or a dispatch-thread straggler racing an eviction
         engine.on_pagein = (lambda cause, ms, n=name:
                             self._note_pagein(n, cause, ms))
+        if self.labeled_metrics:
+            # cost attribution: every fenced forward of this entry's
+            # engine (all replicas, hedges included) bills THIS tenant
+            # — unlabeled zoos skip it, keeping the single-model
+            # /metrics surface free of model_* series
+            engine.on_device_time = (lambda ms, n=name:
+                                     _device_ms.inc(ms, model=n))
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered")
@@ -446,9 +474,12 @@ class ModelZoo:
         rows = []
         for name, e in items:
             eng = e.engine
+            dev_fn = getattr(eng, "device_ms_total", None)
             rows.append({
                 "model": name,
                 "default": name == default,
+                "device_ms": (round(dev_fn(), 1)
+                              if dev_fn is not None else None),
                 "generation": eng.generation,
                 "criticality": e.criticality,
                 "deadline_ms": e.deadline_ms,

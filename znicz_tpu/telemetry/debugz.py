@@ -219,6 +219,24 @@ def statusz_text(server=None, *, recorder=None, extra: dict | None = None
                 budget = ov.get("retry_budget")
                 if budget:
                     lines.append("retry budget: " + _fmt_kv(budget))
+        slo_fn = getattr(server, "slo_status", None)
+        slo = slo_fn() if slo_fn is not None else None
+        if slo and slo.get("slos"):
+            # the SLO engine's verdict, one row per objective: is a
+            # tenant's budget burning RIGHT NOW, and how fast — the
+            # first question a paging alert raises (the full payload
+            # lives on GET /alertz)
+            lines += ["", "slo burn rates", "-" * 14]
+            lines.append(f"  {'slo':<14} {'model':<12} "
+                         f"{'objective':<13} {'burn_fast':>9} "
+                         f"{'burn_slow':>9} {'budget':>7}  state")
+            for r in slo["slos"]:
+                lines.append(
+                    f"  {r['slo']:<14} {r['model']:<12} "
+                    f"{r['objective']:<13} {r['burn_fast']:>9} "
+                    f"{r['burn_slow']:>9} "
+                    f"{r['budget_remaining']:>7}  "
+                    f"{'FIRING' if r['firing'] else 'ok'}")
     snap = compilestats.snapshot()
     lines += ["", "compile accounting", "-" * 18]
     if not snap["compiles"]:

@@ -115,22 +115,39 @@ class TimelineWriter:
                 self._fh = None
 
 
-def stage_breakdown(spans: list) -> dict:
+def stage_breakdown(spans: list, rows: int | None = None) -> dict:
     """Queue/compile/forward stage timings (ms) out of a request's
     span dicts.  ``queue_ms`` is the handler wall not accounted to the
     dispatch stage — time the request sat in the admission queue plus
     parse/serialize overhead; negative residue (spans from a coalesced
-    batch overlap several requests) clamps to 0."""
+    batch overlap several requests) clamps to 0.
+
+    ``device_ms`` is the measured fenced device time the engine
+    stamped onto its forward spans (cost attribution).  A forward span
+    covers the WHOLE coalesced batch; with ``rows`` (this request's
+    row count) the device bill is split pro-rata by rows across the
+    batch's riders — the per-request figure ``bench.py --serve`` and
+    the per-tenant flight records report."""
     by_name: dict[str, float] = {}
+    device_ms = None
     for s in spans:
         d = s.get("duration_ms")
         if s.get("name") in _STAGE_SPANS and d is not None:
             # a batch may compile + forward more than once (chunking):
             # stages sum
             by_name[s["name"]] = by_name.get(s["name"], 0.0) + float(d)
+        dev = s.get("device_ms")
+        if s.get("name") == "engine.forward" and dev is not None:
+            share = float(dev)
+            span_rows = s.get("rows")
+            if rows is not None and span_rows:
+                share *= min(1.0, float(rows) / float(span_rows))
+            device_ms = (device_ms or 0.0) + share
     out = {}
     if "engine.forward" in by_name:
         out["forward_ms"] = round(by_name["engine.forward"], 3)
+    if device_ms is not None:
+        out["device_ms"] = round(device_ms, 3)
     if "compile" in by_name:
         out["compile_ms"] = round(by_name["compile"], 3)
     if "batcher.dispatch" in by_name:
@@ -204,23 +221,63 @@ class FlightRecorder:
         return rec
 
     # -- read side --------------------------------------------------------
-    def snapshot(self, n: int | None = None) -> dict:
+    def snapshot(self, n: int | None = None,
+                 model: str | None = None) -> dict:
         """JSON-able state: the three rings newest-last (``n`` bounds
         the recent ring's slice), config, and totals — what
-        ``GET /debug/flightrecorder`` serves."""
+        ``GET /debug/flightrecorder`` serves.  ``model`` slices every
+        ring to one tenant's records (``?model=`` on the endpoint) —
+        records carrying no ``model`` field (train steps, single-model
+        servers) are excluded from a model-scoped view."""
         with self._lock:
             recent = list(self._recent)
             slow = list(self._slow)
             errors = list(self._errors)
             seq = self._seq
+        if model is not None:
+            recent = [r for r in recent if r.get("model") == model]
+            slow = [r for r in slow if r.get("model") == model]
+            errors = [r for r in errors if r.get("model") == model]
         if n is not None:
             recent = recent[-int(n):]
-        return {"config": {"capacity": self.capacity,
-                           "slow_threshold_ms": self.slow_threshold_ms,
-                           "slow_capacity": self.slow_capacity,
-                           "error_capacity": self.error_capacity},
-                "recorded_total": seq,
-                "recent": recent, "slow": slow, "errors": errors}
+        out = {"config": {"capacity": self.capacity,
+                          "slow_threshold_ms": self.slow_threshold_ms,
+                          "slow_capacity": self.slow_capacity,
+                          "error_capacity": self.error_capacity},
+               "recorded_total": seq,
+               "recent": recent, "slow": slow, "errors": errors}
+        if model is not None:
+            out["model"] = model
+        return out
+
+    def stage_breakdown(self, model: str | None = None) -> dict:
+        """Aggregate per-stage timings over the retained request
+        records (recent + slow rings, deduplicated), optionally scoped
+        to one zoo ``model`` — "where does THIS tenant's time go"
+        without exporting the raw rings.  Each stage reports total /
+        mean ms and how many records carried it."""
+        with self._lock:
+            pool = {id(r): r for r in self._recent}
+            pool.update((id(r), r) for r in self._slow)
+        agg: dict[str, list] = {}
+        n = 0
+        for r in pool.values():
+            if r.get("kind") != "request":
+                continue
+            if model is not None and r.get("model") != model:
+                continue
+            n += 1
+            for stage, ms in (r.get("stages") or {}).items():
+                if isinstance(ms, (int, float)):
+                    entry = agg.setdefault(stage, [0.0, 0])
+                    entry[0] += float(ms)
+                    entry[1] += 1
+        return {"model": model, "requests": n,
+                "stages": {stage: {"total_ms": round(total, 3),
+                                   "mean_ms": round(total / count, 3),
+                                   "records": count}
+                           for stage, (total, count)
+                           in sorted(agg.items())}}
 
     def slowest(self, n: int = 10) -> list:
         """The ``n`` slowest retained records, slowest first — the
