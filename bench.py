@@ -640,7 +640,12 @@ def bench_serve(args) -> int:
         proc = subprocess.Popen(
             [sys.executable, "-m", "znicz_tpu", "serve",
              "--model", model, "--port", str(port),
-             "--max-wait-ms", "1", "--warmup-shape", str(width)],
+             "--max-wait-ms", "1", "--warmup-shape", str(width)]
+            # repeat traffic only pays off with the response cache on;
+            # a pure-unique run serves WITHOUT memoization so the two
+            # trajectories measure different levers, not one
+            + (["--memoize", "4096"]
+               if args.repeat_fraction > 0 else []),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         url = f"http://127.0.0.1:{port}/"
         for _ in range(240):
@@ -660,41 +665,76 @@ def bench_serve(args) -> int:
         else:
             result["error"] = "serve never answered /healthz"
             return _emit(result)
-        payload = json.dumps(
-            {"inputs": [[0.1] * width] * max(1, args.serve_rows)}
-        ).encode()
+        import http.client
 
-        def post(timeout=30.0):
-            req = urllib.request.Request(
-                url + "predict", payload,
-                {"Content-Type": "application/json"})
-            try:
-                with urllib.request.urlopen(req, timeout=timeout) as r:
-                    r.read()
-                    return r.status
-            except urllib.error.HTTPError as e:
-                e.read()
-                return e.code
+        import numpy as np
+        from znicz_tpu.serving import wire as wire_mod
 
-        post(timeout=60.0)            # one warm lap before the clock
+        rows = max(1, args.serve_rows)
+        base = np.full((rows, width), 0.1, dtype=np.float32)
+        binary = args.payload == "binary"
+        headers = ({"Content-Type": wire_mod.CONTENT_TYPE,
+                    "Accept": wire_mod.CONTENT_TYPE} if binary
+                   else {"Content-Type": "application/json"})
+
+        def body_for(i: int) -> bytes:
+            # i < 0 = the FIXED repeat payload; unique bodies perturb
+            # one element deterministically (no RNG on a bench path)
+            x = base
+            if i >= 0:
+                x = base.copy()
+                x[0, 0] = 0.1 + (i % 100003) * 1e-4
+            if binary:
+                return wire_mod.encode_tensor(x)
+            return json.dumps({"inputs": x.tolist()}).encode()
+
+        fixed_body = body_for(-1)
+        repeat_pct = int(round(args.repeat_fraction * 100))
+        n_clients = max(1, args.serve_clients)
+
+        def post_conn(conn, body):
+            conn.request("POST", "/predict", body, headers)
+            r = conn.getresponse()
+            r.read()
+            return r.status
+
+        warm = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        post_conn(warm, fixed_body)   # one warm lap before the clock
+        warm.close()
         answers = []                  # (latency_ms, code)
         mu = threading.Lock()
         stop = threading.Event()
 
-        def client():
+        def client(ci: int):
+            # one persistent connection per closed-loop client — the
+            # HTTP/1.1 keep-alive contract is part of what's measured;
+            # a dropped connection re-opens (that request's latency
+            # carries the reconnect, like a real client's would)
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            i = ci
             while not stop.is_set():
+                body = (fixed_body if (i % 100) < repeat_pct
+                        else body_for(i))
                 t0 = time.monotonic()
                 try:
-                    code = post()
+                    code = post_conn(conn, body)
                 except Exception:
+                    conn.close()
+                    conn = http.client.HTTPConnection("127.0.0.1",
+                                                      port, timeout=30)
                     code = -1
                 dt_ms = (time.monotonic() - t0) * 1e3
                 with mu:
                     answers.append((dt_ms, code))
+                i += n_clients
+            conn.close()
 
         dev0 = _scrape_device_ms(url)
-        threads = [threading.Thread(target=client, daemon=True)
-                   for _ in range(max(1, args.serve_clients))]
+        threads = [threading.Thread(target=client, args=(ci,),
+                                    daemon=True)
+                   for ci in range(n_clients)]
         t_start = time.monotonic()
         for t in threads:
             t.start()
@@ -724,6 +764,10 @@ def bench_serve(args) -> int:
                             f"[{health.get('backend', '?')}]")
         result["clients"] = args.serve_clients
         result["rows_per_request"] = max(1, args.serve_rows)
+        # wire-format + repeat-mix provenance: trajectories only pair
+        # like-for-like when the row says WHICH path was driven
+        result["payload"] = args.payload
+        result["repeat_fraction"] = args.repeat_fraction
         rev = _git_rev()
         if rev:
             result["rev"] = rev
@@ -1563,7 +1607,24 @@ def main(argv=None) -> int:
                    help="serve bench: rows per /predict request")
     p.add_argument("--serve-duration-s", type=float, default=5.0,
                    help="serve bench: measured traffic window")
+    p.add_argument("--payload", default="json",
+                   choices=("json", "binary"),
+                   help="serve bench: wire format of the driven "
+                        "traffic — json (the historical contract) or "
+                        "binary (application/x-znicz-tensor, the "
+                        "zero-copy path); stamped into the transcript "
+                        "row so trajectories pair like-for-like")
+    p.add_argument("--repeat-fraction", type=float, default=0.0,
+                   help="serve bench: fraction [0,1] of requests "
+                        "reusing ONE fixed input (the rest are "
+                        "unique per request) — drives the response-"
+                        "memoization hit rate; > 0 boots the server "
+                        "with --memoize, and the fraction is stamped "
+                        "into the transcript row")
     args = p.parse_args(argv)
+    if not 0.0 <= args.repeat_fraction <= 1.0:
+        p.error(f"--repeat-fraction must be in [0, 1], "
+                f"got {args.repeat_fraction}")
     try:
         if args.serve:
             return bench_serve(args)

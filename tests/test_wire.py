@@ -1,0 +1,648 @@
+"""Request-path wire protocol, response memoization, int8 serving
+(ISSUE 13): the binary tensor codec's round-trip + malformed-input
+400 pins, JSON-vs-binary byte/parity across every demo zoo family,
+the single-buffer JSON encoder's byte-identity with ``json.dumps``,
+memoization hit/miss semantics across a hot reload, HTTP/1.1
+keep-alive framing, and the int8 quantized engine's tolerance +
+counted-fallback contract.  All tier-1, CPU, in-process servers."""
+
+import http.client
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from znicz_tpu.serving import (ResponseCache, ServingEngine,
+                               ServingServer, WireError)
+from znicz_tpu.serving import engine as engine_mod
+from znicz_tpu.serving import wire
+from znicz_tpu.serving.zoo import (DEMO_FAMILIES, DEMO_SHAPES,
+                                   ModelZoo, write_demo_model)
+
+
+# -- binary codec ----------------------------------------------------------
+class TestBinaryCodec:
+    @pytest.mark.parametrize("dtype", ["float32", "float64", "int32",
+                                       "int64", "int8", "uint8",
+                                       "float16"])
+    def test_roundtrip_dtypes(self, dtype):
+        x = (np.arange(24).reshape(2, 3, 4) * 3 - 7).astype(dtype)
+        y = wire.decode_tensor(wire.encode_tensor(x))
+        assert y.dtype == np.dtype(dtype)
+        np.testing.assert_array_equal(x, y)
+
+    def test_roundtrip_shapes(self):
+        for shape in [(1,), (5,), (2, 3), (1, 13), (4, 2, 2, 3)]:
+            x = np.linspace(-2, 2, int(np.prod(shape)),
+                            dtype=np.float32).reshape(shape)
+            np.testing.assert_array_equal(
+                x, wire.decode_tensor(wire.encode_tensor(x)))
+
+    def test_decode_is_zero_copy_view(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        buf = wire.encode_tensor(x)
+        y = wire.decode_tensor(buf)
+        # a view over the wire buffer, not a copy — and read-only,
+        # because the buffer is shared
+        assert y.base is not None
+        assert not y.flags.writeable
+
+    def test_truncated_header(self):
+        with pytest.raises(WireError, match="truncated header"):
+            wire.decode_tensor(b"ZNT")
+
+    def test_bad_magic(self):
+        buf = bytearray(wire.encode_tensor(np.zeros(3, np.float32)))
+        buf[:4] = b"JUNK"
+        with pytest.raises(WireError, match="bad magic"):
+            wire.decode_tensor(bytes(buf))
+
+    def test_bad_version(self):
+        buf = bytearray(wire.encode_tensor(np.zeros(3, np.float32)))
+        buf[4] = 99
+        with pytest.raises(WireError, match="version"):
+            wire.decode_tensor(bytes(buf))
+
+    def test_unknown_dtype_code(self):
+        buf = bytearray(wire.encode_tensor(np.zeros(3, np.float32)))
+        buf[5] = 200
+        with pytest.raises(WireError, match="dtype code"):
+            wire.decode_tensor(bytes(buf))
+
+    def test_junk_ndim(self):
+        buf = bytearray(wire.encode_tensor(np.zeros(3, np.float32)))
+        buf[6] = 0
+        with pytest.raises(WireError, match="ndim"):
+            wire.decode_tensor(bytes(buf))
+        buf[6] = 9
+        with pytest.raises(WireError, match="ndim"):
+            wire.decode_tensor(bytes(buf))
+
+    def test_truncated_and_oversized_payloads(self):
+        buf = wire.encode_tensor(np.zeros((2, 4), np.float32))
+        with pytest.raises(WireError, match="size mismatch"):
+            wire.decode_tensor(buf[:-1])
+        with pytest.raises(WireError, match="size mismatch"):
+            wire.decode_tensor(buf + b"\x00")
+
+    def test_dim_overflow_refused_without_allocation(self):
+        # a header claiming 2^32-1 x 2^32-1 elements must fail the
+        # arithmetic bound, not attempt to allocate
+        import struct
+        hdr = struct.pack("<4sBBBB", wire.MAGIC, wire.VERSION, 1, 2, 0)
+        hdr += struct.pack("<2I", 0xFFFFFFFF, 0xFFFFFFFF)
+        with pytest.raises(WireError, match="element bound"):
+            wire.decode_tensor(hdr)
+
+    def test_empty_tensor_refused(self):
+        import struct
+        hdr = struct.pack("<4sBBBB", wire.MAGIC, wire.VERSION, 1, 1, 0)
+        hdr += struct.pack("<I", 0)
+        with pytest.raises(WireError, match="empty"):
+            wire.decode_tensor(hdr)
+
+
+class TestJsonEncoder:
+    @pytest.mark.parametrize("arr", [
+        np.zeros((1, 1), np.float32),
+        np.linspace(-3, 3, 12, dtype=np.float32).reshape(3, 4),
+        np.array([[0.1, 1e-7, -1.5e33, 42.0]], np.float32),
+        np.arange(6, dtype=np.float64).reshape(2, 3) / 7,
+        np.zeros((3, 0), np.float32),
+        np.zeros((0, 3), np.float32),
+    ])
+    def test_byte_identical_to_json_dumps(self, arr):
+        ref = json.dumps({"outputs": arr.tolist()},
+                         default=float).encode()
+        assert wire.encode_json_outputs(arr) == ref
+
+    def test_non_2d_falls_back_to_reference(self):
+        arr = np.arange(8, dtype=np.float32).reshape(2, 2, 2)
+        ref = json.dumps({"outputs": arr.tolist()},
+                         default=float).encode()
+        assert wire.encode_json_outputs(arr) == ref
+
+
+# -- HTTP parity across every zoo family -----------------------------------
+def _post_raw(url, body, headers, timeout=30.0):
+    req = urllib.request.Request(url + "predict", data=body,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def zoo_server(tmp_path_factory):
+    """One in-process server hosting every demo family, memoization
+    on — shared by the parity/memo/decoder-pin tests."""
+    d = tmp_path_factory.mktemp("wire_zoo")
+    zoo = ModelZoo()
+    for fam in DEMO_FAMILIES:
+        path = str(d / f"{fam}.znn")
+        write_demo_model(path, fam)
+        zoo.add(fam, engine=ServingEngine(path), default=(fam == "wine"))
+    server = ServingServer(zoo=zoo, max_wait_ms=1,
+                           memo_entries=128).start()
+    yield server
+    server.stop()
+    zoo.close()
+
+
+def _family_input(fam, rows=2):
+    width = DEMO_SHAPES[fam]
+    return np.linspace(-1.0, 1.0, rows * width,
+                       dtype=np.float32).reshape(rows, width)
+
+
+class TestWireParity:
+    @pytest.mark.parametrize("fam", DEMO_FAMILIES)
+    def test_json_and_binary_agree_per_family(self, zoo_server, fam):
+        x = _family_input(fam)
+        code, jbody, _ = _post_raw(
+            zoo_server.url, json.dumps({"inputs": x.tolist()}).encode(),
+            {"Content-Type": "application/json", "X-Model": fam})
+        assert code == 200
+        outputs = json.loads(jbody)["outputs"]
+        # the JSON bytes are EXACTLY what the historical encoder
+        # produced — existing clients see an unchanged contract
+        assert jbody == json.dumps({"outputs": outputs},
+                                   default=float).encode()
+        code, bbody, headers = _post_raw(
+            zoo_server.url, wire.encode_tensor(x),
+            {"Content-Type": wire.CONTENT_TYPE,
+             "Accept": wire.CONTENT_TYPE, "X-Model": fam})
+        assert code == 200
+        assert headers["Content-Type"] == wire.CONTENT_TYPE
+        y_bin = wire.decode_tensor(bbody)
+        assert y_bin.dtype == np.float32
+        # JSON floats re-parse to the SAME float32 values the binary
+        # format carries exactly (repr round-trips)
+        np.testing.assert_array_equal(
+            y_bin, np.asarray(outputs, np.float32))
+
+    def test_binary_request_json_response_and_vice_versa(
+            self, zoo_server):
+        x = _family_input("wine")
+        # binary in, JSON out (no Accept header)
+        code, body, headers = _post_raw(
+            zoo_server.url, wire.encode_tensor(x),
+            {"Content-Type": wire.CONTENT_TYPE})
+        assert code == 200
+        assert headers["Content-Type"] == "application/json"
+        y1 = np.asarray(json.loads(body)["outputs"], np.float32)
+        # JSON in, binary out
+        code, body, headers = _post_raw(
+            zoo_server.url, json.dumps({"inputs": x.tolist()}).encode(),
+            {"Content-Type": "application/json",
+             "Accept": wire.CONTENT_TYPE})
+        assert code == 200
+        assert headers["Content-Type"] == wire.CONTENT_TYPE
+        np.testing.assert_array_equal(y1, wire.decode_tensor(body))
+
+    def test_binary_1d_is_one_sample(self, zoo_server):
+        x = _family_input("wine", rows=1)
+        code, body, _ = _post_raw(
+            zoo_server.url, wire.encode_tensor(x[0]),
+            {"Content-Type": wire.CONTENT_TYPE,
+             "Accept": wire.CONTENT_TYPE})
+        assert code == 200
+        assert wire.decode_tensor(body).shape[0] == 1
+
+    def test_binary_routing_headers_still_apply(self, zoo_server):
+        # X-Model routes (mnist vs the wine default have different
+        # output widths — a routing mistake is a shape change)
+        x = _family_input("mnist")
+        code, body, _ = _post_raw(
+            zoo_server.url, wire.encode_tensor(x),
+            {"Content-Type": wire.CONTENT_TYPE,
+             "Accept": wire.CONTENT_TYPE, "X-Model": "mnist"})
+        assert code == 200
+        assert wire.decode_tensor(body).shape == (2, 10)
+        # unknown model stays a 404 on the binary leg
+        code, _, _ = _post_raw(
+            zoo_server.url, wire.encode_tensor(x),
+            {"Content-Type": wire.CONTENT_TYPE, "X-Model": "nope"})
+        assert code == 404
+
+    @pytest.mark.parametrize("mangle", [
+        lambda b: b[:5],                              # truncated header
+        lambda b: b"JUNK" + b[4:],                    # bad magic
+        lambda b: b[:4] + bytes([77]) + b[5:],        # bad version
+        lambda b: b[:5] + bytes([200]) + b[6:],       # bad dtype code
+        lambda b: b[:-3],                             # truncated payload
+        lambda b: b + b"\x00\x01",                    # trailing junk
+    ])
+    def test_malformed_binary_is_400(self, zoo_server, mangle):
+        body = mangle(wire.encode_tensor(_family_input("wine")))
+        code, err, _ = _post_raw(
+            zoo_server.url, body, {"Content-Type": wire.CONTENT_TYPE})
+        assert code == 400
+        assert b"bad request" in err
+
+    def test_wrong_geometry_binary_is_400(self, zoo_server):
+        x = np.zeros((2, 7), np.float32)      # wine wants 13 features
+        code, err, _ = _post_raw(
+            zoo_server.url, wire.encode_tensor(x),
+            {"Content-Type": wire.CONTENT_TYPE})
+        assert code == 400
+
+    def test_transfer_encoding_refused_not_desynced(self, zoo_server):
+        # chunked bodies are not spoken: accepting the request while
+        # reading Content-Length=0 would leave the chunk bytes in the
+        # buffer to be parsed as the NEXT request's head (a keep-alive
+        # desync / smuggling vector) — the contract is a loud 501 and
+        # a dropped connection
+        import socket
+        with socket.create_connection(("127.0.0.1", zoo_server.port),
+                                      timeout=10) as s:
+            s.sendall(b"POST /predict HTTP/1.1\r\n"
+                      b"Host: x\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Transfer-Encoding: chunked\r\n\r\n"
+                      b"4\r\n{\"i\r\n0\r\n\r\n")
+            s.settimeout(10)
+            data = s.recv(65536)
+        assert data.startswith(b"HTTP/1.1 501")
+
+    def test_http09_request_answered_not_crashed(self, zoo_server):
+        # the stdlib request parser accepts HTTP/0.9 GETs (no headers,
+        # no status line in the reply) — the single-write response
+        # path must not assume a header buffer exists
+        import socket
+        with socket.create_connection(("127.0.0.1", zoo_server.port),
+                                      timeout=10) as s:
+            s.sendall(b"GET /healthz\r\n")
+            # a 0.9 client has no headers to send: half-close so the
+            # server's header read sees EOF (stdlib semantics)
+            s.shutdown(socket.SHUT_WR)
+            s.settimeout(10)
+            chunks = []
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                chunks.append(b)
+        body = b"".join(chunks)
+        # bare body, no status line, and it parses as the healthz JSON
+        assert json.loads(body)["status"] in ("ok", "degraded", "open")
+
+    def test_duplicate_header_fold_does_not_corrupt_first_value(
+            self, zoo_server):
+        # duplicates are first-wins; an obs-fold continuation of a
+        # DROPPED duplicate must not append to the retained value
+        import socket
+        payload = json.dumps(
+            {"inputs": _family_input("mnist").tolist()}).encode()
+        with socket.create_connection(("127.0.0.1", zoo_server.port),
+                                      timeout=10) as s:
+            s.sendall(b"POST /predict HTTP/1.1\r\n"
+                      b"Host: x\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"X-Model: mnist\r\n"
+                      b"X-Model: wi\r\n"
+                      b" ne\r\n"          # fold of the dropped dup
+                      b"Connection: close\r\n"
+                      b"Content-Length: "
+                      + str(len(payload)).encode() + b"\r\n\r\n"
+                      + payload)
+            s.settimeout(10)
+            chunks = []
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                chunks.append(b)
+        head, _, body = b"".join(chunks).partition(b"\r\n\r\n")
+        assert head.startswith(b"HTTP/1.1 200"), head[:60]
+        # routed to mnist (10 output classes), not to a corrupted name
+        assert len(json.loads(body)["outputs"][0]) == 10
+
+    def test_keepalive_two_requests_one_connection(self, zoo_server):
+        x = _family_input("wine")
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          zoo_server.port, timeout=30)
+        try:
+            bodies = []
+            for _ in range(2):
+                conn.request("POST", "/predict", wire.encode_tensor(x),
+                             {"Content-Type": wire.CONTENT_TYPE,
+                              "Accept": wire.CONTENT_TYPE})
+                r = conn.getresponse()
+                assert r.status == 200
+                bodies.append(r.read())
+            assert bodies[0] == bodies[1]
+        finally:
+            conn.close()
+
+
+# -- memoization -----------------------------------------------------------
+class TestMemoization:
+    def test_repeat_input_hits_and_reload_invalidates(self, tmp_path):
+        path = str(tmp_path / "wine.znn")
+        write_demo_model(path, "wine")
+        engine = ServingEngine(path)
+        server = ServingServer(engine, max_wait_ms=1,
+                               memo_entries=32).start()
+        try:
+            x = _family_input("wine")
+            body = json.dumps({"inputs": x.tolist()}).encode()
+            hdrs = {"Content-Type": "application/json"}
+            _c, first, _ = _post_raw(server.url, body, hdrs)
+            cache = server.zoo.resolve().response_cache
+            assert cache.metrics()["misses"] == 1
+            _c, second, _ = _post_raw(server.url, body, hdrs)
+            assert cache.metrics()["hits"] == 1
+            assert second == first          # byte-identical from cache
+            forwards_before = engine.metrics()["forward_calls"]
+            _post_raw(server.url, body, hdrs)
+            # a hit never reaches the engine
+            assert engine.metrics()["forward_calls"] == forwards_before
+            # hot reload: generation bump ⇒ new key space ⇒ the same
+            # input misses once, then hits again under the new gen
+            rec = engine.reload(path)
+            assert rec["outcome"] == "ok"
+            m0 = cache.metrics()
+            _c, after, _ = _post_raw(server.url, body, hdrs)
+            m1 = cache.metrics()
+            assert m1["misses"] == m0["misses"] + 1
+            assert after == first     # same artifact ⇒ same answer
+            _post_raw(server.url, body, hdrs)
+            assert cache.metrics()["hits"] == m1["hits"] + 1
+        finally:
+            server.stop()
+            engine.close()
+
+    def test_get_with_body_closes_connection(self, zoo_server):
+        # no GET route reads a body: under keep-alive the unread bytes
+        # would be parsed as the next request's head — the server must
+        # answer and then DROP the connection
+        import socket
+        with socket.create_connection(("127.0.0.1", zoo_server.port),
+                                      timeout=10) as s:
+            s.sendall(b"GET /healthz HTTP/1.1\r\n"
+                      b"Host: x\r\n"
+                      b"Content-Length: 12\r\n\r\n"
+                      b"smuggledbits")
+            s.settimeout(10)
+            chunks = []
+            while True:
+                b = s.recv(65536)
+                if not b:        # connection closed by the server
+                    break
+                chunks.append(b)
+        data = b"".join(chunks)
+        assert data.startswith(b"HTTP/1.1 200")
+        # exactly ONE response came back — the body bytes were not
+        # misread as a second request
+        assert data.count(b"HTTP/1.1 ") == 1
+
+    def test_memo_bypassed_on_mixed_generation_replicas(
+            self, tmp_path):
+        # a replica set mid-roll (or stuck mixed after a failed
+        # canary) has no single coherent generation — the cache must
+        # be BYPASSED, never pin one replica's model under a shared
+        # key (serving.server._memo_generation)
+        from znicz_tpu.serving import EngineReplicaSet
+        path = str(tmp_path / "wine.znn")
+        write_demo_model(path, "wine")
+        rs = EngineReplicaSet.of(path, 2)
+        server = ServingServer(rs, max_wait_ms=1,
+                               memo_entries=32).start()
+        try:
+            cache = server.zoo.resolve().response_cache
+            x = _family_input("wine")
+            body = json.dumps({"inputs": x.tolist()}).encode()
+            hdrs = {"Content-Type": "application/json"}
+            _post_raw(server.url, body, hdrs)
+            _post_raw(server.url, body, hdrs)
+            assert cache.metrics()["hits"] == 1   # uniform fleet: on
+            # force a mixed fleet: reload ONE replica directly
+            rec = rs.replicas[0].reload(path)
+            assert rec["outcome"] == "ok"
+            assert rs.replicas[0].generation != \
+                rs.replicas[1].generation
+            m0 = cache.metrics()
+            _post_raw(server.url, body, hdrs)
+            _post_raw(server.url, body, hdrs)
+            m1 = cache.metrics()
+            # bypassed: neither hits nor misses moved, nothing stored
+            assert (m1["hits"], m1["misses"]) == (m0["hits"],
+                                                 m0["misses"])
+            # converge the fleet: caching resumes on the new gen
+            rec = rs.replicas[1].reload(path)
+            assert rec["outcome"] == "ok"
+            _post_raw(server.url, body, hdrs)
+            _post_raw(server.url, body, hdrs)
+            m2 = cache.metrics()
+            assert m2["hits"] == m1["hits"] + 1
+        finally:
+            server.stop()
+            rs.close()
+
+    def test_cache_bounds_and_isolation(self):
+        c = ResponseCache(max_entries=2, max_bytes=10_000)
+        xs = [np.full((1, 4), i, np.float32) for i in range(3)]
+        keys = [ResponseCache.key_for(1, x) for x in xs]
+        for k, x in zip(keys, xs):
+            c.put(k, x)
+        m = c.metrics()
+        assert m["entries"] == 2 and m["evictions"] == 1
+        assert c.get(keys[0]) is None       # LRU-evicted
+        assert c.get(keys[2]) is not None
+
+    def test_key_separates_generation_shape_dtype(self):
+        x = np.zeros((2, 8), np.float32)
+        assert ResponseCache.key_for(1, x) != ResponseCache.key_for(2, x)
+        assert ResponseCache.key_for(1, x) != \
+            ResponseCache.key_for(1, x.reshape(4, 4))
+        assert ResponseCache.key_for(1, x) != \
+            ResponseCache.key_for(1, x.astype(np.float64))
+
+    def test_put_copies_views_instead_of_pinning_the_batch(self):
+        # the batcher hands each request a VIEW of the coalesced
+        # batch output; caching the view would pin the whole batch
+        # array while billing only the slice's bytes
+        c = ResponseCache()
+        batch = np.zeros((128, 16), np.float32)
+        k = ResponseCache.key_for(1, np.zeros((1, 16), np.float32))
+        c.put(k, batch[3:4])
+        stored = c.get(k)
+        assert stored.base is None          # an owned copy
+        assert c.metrics()["bytes"] == stored.nbytes == 64
+
+    def test_closing_reply_advertises_connection_close(self,
+                                                       tmp_path):
+        # a 413 closes the connection without reading the body — the
+        # reply must SAY so, or an HTTP/1.1 client pipelines its next
+        # request onto a socket the server is dropping
+        path = str(tmp_path / "wine.znn")
+        write_demo_model(path, "wine")
+        engine = ServingEngine(path)
+        server = ServingServer(engine, max_wait_ms=1,
+                               max_body_mb=0.0001).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1",
+                                              server.port, timeout=10)
+            conn.request("POST", "/predict", b"x" * 4096,
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            r.read()
+            assert r.status == 413
+            assert (r.getheader("Connection") or "").lower() == "close"
+            conn.close()
+        finally:
+            server.stop()
+            engine.close()
+
+    def test_second_recorder_does_not_zero_live_ring_gauge(self):
+        # gauges write on length CHANGE only — constructing a second
+        # (test-local) recorder must not reset the process singleton's
+        # already-published ring length to a value record() would
+        # never repair
+        from znicz_tpu.telemetry import flightrecorder as fr
+        fr.RECORDER.record("request", duration_ms=1.0)
+        before = fr._records_g.value(ring="recent")
+        assert before >= 1.0
+        fr.FlightRecorder()                 # a test-local recorder
+        assert fr._records_g.value(ring="recent") == before
+
+    def test_cached_arrays_are_read_only(self):
+        c = ResponseCache()
+        k = ResponseCache.key_for(1, np.zeros((1, 2), np.float32))
+        c.put(k, np.ones((1, 2), np.float32))
+        y = c.get(k)
+        with pytest.raises(ValueError):
+            y[0, 0] = 5.0
+
+
+# -- int8 quantized serving -------------------------------------------------
+class TestInt8Serving:
+    def test_quantized_matches_fp32_within_tolerance(self, tmp_path):
+        path = str(tmp_path / "mnist.znn")
+        write_demo_model(path, "mnist")
+        e32 = ServingEngine(path)
+        eq = ServingEngine(path, quantize="int8")
+        try:
+            assert eq.quantized_active()
+            assert eq.metrics()["quantized"] is True
+            assert eq.metrics()["quantize_fallbacks"] == 0
+            rng = np.random.default_rng(7)
+            x = rng.standard_normal((5, DEMO_SHAPES["mnist"])
+                                    ).astype(np.float32)
+            np.testing.assert_allclose(
+                eq.predict(x), e32.predict(x),
+                rtol=engine_mod.QUANT_RTOL, atol=engine_mod.QUANT_ATOL)
+        finally:
+            e32.close()
+            eq.close()
+
+    def test_unsupported_family_falls_back_counted(self, tmp_path):
+        # the kohonen head has no fc layer: quantize must fall back to
+        # fp32 (counted), and serving must be unaffected
+        path = str(tmp_path / "kohonen.znn")
+        write_demo_model(path, "kohonen")
+        eq = ServingEngine(path, quantize="int8")
+        e32 = ServingEngine(path)
+        try:
+            assert not eq.quantized_active()
+            assert eq.metrics()["quantize_fallbacks"] == 1
+            x = _family_input("kohonen")
+            np.testing.assert_allclose(eq.predict(x), e32.predict(x),
+                                       rtol=1e-5, atol=1e-5)
+        finally:
+            eq.close()
+            e32.close()
+
+    def test_tolerance_breach_falls_back_counted(self, tmp_path,
+                                                 monkeypatch):
+        # force a breach: with a zero tolerance the verification batch
+        # cannot pass, so the build must count a fallback and serve
+        # fp32 bytes identical to the plain engine
+        monkeypatch.setattr(engine_mod, "QUANT_RTOL", 0.0)
+        monkeypatch.setattr(engine_mod, "QUANT_ATOL", 0.0)
+        path = str(tmp_path / "wine.znn")
+        write_demo_model(path, "wine")
+        eq = ServingEngine(path, quantize="int8")
+        e32 = ServingEngine(path)
+        try:
+            assert not eq.quantized_active()
+            assert eq.metrics()["quantize_fallbacks"] == 1
+            x = _family_input("wine")
+            np.testing.assert_array_equal(eq.predict(x),
+                                          e32.predict(x))
+        finally:
+            eq.close()
+            e32.close()
+
+    def test_reload_requantizes_per_generation(self, tmp_path):
+        path = str(tmp_path / "wine.znn")
+        write_demo_model(path, "wine")
+        eq = ServingEngine(path, quantize="int8")
+        try:
+            assert eq.quantized_active()
+            rec = eq.reload(path)
+            assert rec["outcome"] == "ok"
+            assert eq.generation == 2
+            assert eq.quantized_active()    # the NEW generation's copy
+        finally:
+            eq.close()
+
+    def test_quantize_rejects_tp_and_junk_mode(self, tmp_path):
+        path = str(tmp_path / "wine.znn")
+        write_demo_model(path, "wine")
+        with pytest.raises(ValueError, match="quantize"):
+            ServingEngine(path, quantize="int4")
+        with pytest.raises(ValueError, match="tensor-parallel"):
+            ServingEngine(path, quantize="int8", tp=2)
+
+    def test_quantize_layers_arithmetic(self):
+        from znicz_tpu.serving.engine import quantize_layers
+        from znicz_tpu.export import read_znn
+        import tempfile, os
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.znn")
+            write_demo_model(p, "wine")
+            layers = read_znn(p)
+            q, n = quantize_layers(layers)
+            assert n == 2                      # both fc layers
+            for lay, ql in zip(layers, q):
+                if ql is None:
+                    continue
+                wq, scale = ql
+                assert wq.dtype == np.int8
+                assert np.abs(wq).max() <= 127
+                # dequantized copy within one quantization step
+                np.testing.assert_allclose(
+                    wq.astype(np.float32) * scale, lay.w,
+                    atol=float(scale.max()) + 1e-7)
+
+
+# -- CLI spec ---------------------------------------------------------------
+class TestSpecParsing:
+    def test_per_spec_quantize_with_tp_is_clean_cli_error(self,
+                                                          tmp_path):
+        # the per-SPEC quantize option must hit the same clean
+        # argparse error as the global --quantize flag when combined
+        # with --tp > 1, not a raw engine ValueError traceback
+        from znicz_tpu.serving.server import main as serve_main
+        path = str(tmp_path / "w.znn")
+        write_demo_model(path, "wine")
+        with pytest.raises(SystemExit) as ei:
+            serve_main(["--model", f"wine={path},quantize=int8",
+                        "--tp", "2", "--port", "0"])
+        assert ei.value.code == 2          # argparse p.error, not a
+        #                                    ValueError traceback
+
+    def test_quantize_spec_option(self):
+        from znicz_tpu.serving.zoo import parse_model_spec
+        name, path, opts = parse_model_spec(
+            "wine=/tmp/w.znn,quantize=int8,default")
+        assert (name, path) == ("wine", "/tmp/w.znn")
+        assert opts["quantize"] == "int8" and opts["default"] is True
+        with pytest.raises(ValueError, match="quantize"):
+            parse_model_spec("wine=/tmp/w.znn,quantize=fp8")

@@ -1,0 +1,28 @@
+"""Pytest wrapper for tools/wire_smoke.sh (ISSUE 13 satellite).
+
+Marked ``slow`` — it boots real ``python -m znicz_tpu`` subprocesses
+(chaos --scenario wire, then a serve process driven over both wire
+formats) — so it rides the nightly/`-m slow` tier beside the chaos
+and metrics smokes, not tier-1 (tests/test_wire.py is the tier-1
+coverage of the same surface, in-process).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_wire_smoke_script_passes():
+    proc = subprocess.run(
+        ["bash", os.path.join(_REPO, "tools", "wire_smoke.sh")],
+        capture_output=True, text=True, timeout=600, cwd=_REPO)
+    sys.stdout.write(proc.stdout[-4000:])
+    assert proc.returncode == 0, (
+        f"wire smoke failed rc={proc.returncode}:\n"
+        f"{proc.stdout[-3000:]}\n{proc.stderr[-1000:]}")
+    assert '"ok": true' in proc.stdout

@@ -257,6 +257,24 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
               f"engine_busy_ratio in [0, 1] (got {busy})")
         check(series.get("serving_engine_device_ms_total", 0.0) > 0.0,
               "engine device-time accounting moved under traffic")
+        # wire protocol + response memoization + int8 serving families
+        # (ISSUE 13): registered at import — an un-memoized fp32 JSON
+        # replica still scrapes them (zero where idle), and the JSON
+        # burst above counted into the wire-format label
+        for fam, kind in (("wire_requests_total", "counter"),
+                          ("response_cache_hits_total", "counter"),
+                          ("response_cache_misses_total", "counter"),
+                          ("response_cache_bytes", "gauge"),
+                          ("quantize_fallback_total", "counter")):
+            check(typed.get(fam) == kind, f"{fam} typed {kind}")
+        check(series.get('wire_requests_total{format="json"}')
+              == float(n_good),
+              f"wire_requests_total{{format=json}} == {n_good} "
+              f"decoded payloads (malformed bodies never count)")
+        check(series.get("response_cache_hits_total") == 0.0,
+              "response-cache families scrape zero without --memoize")
+        check(series.get("quantize_fallback_total") == 0.0,
+              "quantize_fallback_total present (fp32 serving, zero)")
     finally:
         proc.send_signal(signal.SIGINT)
         try:

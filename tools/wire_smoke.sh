@@ -1,0 +1,155 @@
+#!/bin/bash
+# Request-path wire-protocol smoke (ISSUE 13 acceptance,
+# operator-runnable):
+#
+#   1. `python -m znicz_tpu chaos --scenario wire` — JSON + binary +
+#      malformed-binary traffic against an in-process int8-quantized
+#      memoizing server while a transient engine.forward fault trips
+#      the breaker: zero raw 500s / hangs on either format, every
+#      malformed binary body a FAST 400, post-recovery cross-format
+#      parity, memo hit during the burst and the reload swapping the
+#      key space.
+#
+#   2. a REAL `python -m znicz_tpu serve --memoize --quantize int8`
+#      process driven over BOTH formats with keep-alive connections:
+#      JSON responses byte-identical to the reference encoder, binary
+#      responses decoding to the same float32 outputs, malformed
+#      binary a 400 (never a 500/hang), repeat inputs hitting the
+#      response cache, and the new metric families
+#      (wire_requests_total, response_cache_hits_total /
+#      response_cache_misses_total / response_cache_bytes,
+#      quantize_fallback_total) present in the Prometheus text view.
+#
+# Registered beside tools/chaos_smoke.sh / tools/zoo_smoke.sh.
+#
+# Usage:  bash tools/wire_smoke.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: chaos --scenario wire =="
+JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario wire || exit 1
+
+echo "== phase 2: a real serve process over both wire formats =="
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client, json, os, signal, socket, subprocess, sys
+import tempfile, time
+import urllib.request
+import numpy as np
+
+from znicz_tpu.serving import wire
+
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+with tempfile.TemporaryDirectory(prefix="znicz_wire_smoke_") as tmp:
+    model = os.path.join(tmp, "demo.znn")
+    from znicz_tpu.resilience.chaos import _write_demo_znn
+    _write_demo_znn(model)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "serve", "--model", model,
+         "--port", str(port), "--max-wait-ms", "1",
+         "--warmup-shape", "4", "--memoize", "64",
+         "--quantize", "int8"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}/"
+    try:
+        for _ in range(240):
+            try:
+                urllib.request.urlopen(url + "healthz", timeout=2)
+                break
+            except Exception:
+                if proc.poll() is not None:
+                    print(proc.stdout.read().decode(errors="replace"))
+                    sys.exit("serve exited early")
+                time.sleep(0.5)
+        else:
+            sys.exit("serve never answered /healthz")
+
+        x = np.asarray([[0.1, -0.2, 0.3, 0.4]], np.float32)
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=30)
+
+        def post(body, headers):
+            conn.request("POST", "/predict", body, headers)
+            r = conn.getresponse()
+            return r.status, r.read(), dict(r.getheaders())
+
+        # JSON leg: byte-identical to the reference encoder
+        jbody = json.dumps({"inputs": x.tolist()}).encode()
+        code, raw, _ = post(jbody,
+                            {"Content-Type": "application/json"})
+        check(code == 200, f"JSON predict answers 200 (got {code})")
+        outputs = json.loads(raw)["outputs"]
+        check(raw == json.dumps({"outputs": outputs},
+                                default=float).encode(),
+              "JSON body is byte-identical to the reference encoding")
+
+        # binary leg on the SAME keep-alive connection
+        code, rawb, hdrs = post(
+            wire.encode_tensor(x),
+            {"Content-Type": wire.CONTENT_TYPE,
+             "Accept": wire.CONTENT_TYPE})
+        check(code == 200, f"binary predict answers 200 (got {code})")
+        check(hdrs.get("Content-Type") == wire.CONTENT_TYPE,
+              "binary response carries the negotiated Content-Type")
+        y_bin = wire.decode_tensor(rawb)
+        check(np.array_equal(y_bin,
+                             np.asarray(outputs, np.float32)),
+              "binary outputs equal the JSON outputs exactly")
+
+        # repeat input -> response-cache hit (same bytes back)
+        code2, rawb2, _ = post(
+            wire.encode_tensor(x),
+            {"Content-Type": wire.CONTENT_TYPE,
+             "Accept": wire.CONTENT_TYPE})
+        check(code2 == 200 and rawb2 == rawb,
+              "repeat input serves identical bytes from the cache")
+
+        # malformed binary -> 400, never a hang / 500
+        t0 = time.monotonic()
+        code, err, _ = post(wire.encode_tensor(x)[:6],
+                            {"Content-Type": wire.CONTENT_TYPE})
+        dt = time.monotonic() - t0
+        check(code == 400, f"malformed binary answers 400 (got {code})")
+        check(dt < 5.0, f"malformed binary answered fast ({dt:.2f}s)")
+
+        # the new families scrape in the text view
+        with urllib.request.urlopen(url + "metrics?format=prometheus",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        for family in ("wire_requests_total",
+                       "response_cache_hits_total",
+                       "response_cache_misses_total",
+                       "response_cache_bytes",
+                       "quantize_fallback_total"):
+            check(family in text,
+                  f"{family} present in the Prometheus view")
+        check('wire_requests_total{format="binary"}' in text,
+              "binary wire format counted with its label")
+        with urllib.request.urlopen(url + "metrics", timeout=10) as r:
+            m = json.loads(r.read())
+        rc = m.get("response_cache") or {}
+        check(rc.get("hits", 0) >= 1,
+              f"response cache reports hits in /metrics ({rc})")
+        check((m.get("engine") or {}).get("quantized") is True,
+              "engine reports the int8 path active")
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+print(json.dumps({"scenario": "wire_smoke", "ok": not fails,
+                  "violations": fails}))
+sys.exit(1 if fails else 0)
+PY

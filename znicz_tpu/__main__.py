@@ -19,6 +19,16 @@ Examples::
         # POST /predict carries an X-Request-Id (docs/observability.md),
         # and POST /admin/reload (or SIGHUP) hot-reloads the model with
         # verify + canary + rollback (docs/durability.md)
+    python -m znicz_tpu serve --model model.znn \
+            --quantize int8 --memoize 1024
+        # request-path speed levers (docs/serving.md "Wire protocol"):
+        # POST /predict also accepts/answers the zero-copy binary
+        # tensor format (Content-Type/Accept:
+        # application/x-znicz-tensor), --memoize answers repeat inputs
+        # from a generation-keyed per-model cache without a device
+        # call, and --quantize int8 serves verified per-channel int8
+        # weight copies of the fc-heavy families (fp32 fallback,
+        # counted, on tolerance breach)
     python -m znicz_tpu serve --zoo DIR --memory-budget-mb 64
         # multi-tenant model zoo: every *.znn in DIR becomes a routable
         # model (X-Model header / body "model" field; repeatable
@@ -35,7 +45,8 @@ Examples::
         # section, and slo_burn_rate / slo_budget_remaining /
         # slo_alerts_total join the scrape
         # (docs/observability.md "SLO engine")
-    python -m znicz_tpu chaos [--scenario reload|promote|overload|zoo|slo]
+    python -m znicz_tpu chaos \
+            [--scenario reload|promote|overload|zoo|slo|wire]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
         # --scenario reload drills corrupt-artifact rollback;
@@ -51,7 +62,12 @@ Examples::
         # --scenario slo drills the burn-rate SLO engine (one tenant
         # latency-faulted => exactly one alert, the quiet tenant's
         # budget intact, per-tenant device-ms ledger sums;
-        # docs/observability.md)
+        # docs/observability.md);
+        # --scenario wire drills the binary wire protocol + response
+        # memoization + int8 serving under a transient device fault
+        # (zero raw 500s on either format, junk binary answers 400
+        # fast, cross-format parity, reload swaps the memo key space;
+        # docs/serving.md "Wire protocol")
     python -m znicz_tpu promote --candidates DIR --url http://host:port/
         # closed-loop promotion controller sidecar: watch a trainer's
         # export directory, verify + canary-deploy each new candidate

@@ -809,6 +809,243 @@ def _zoo_scenario(args) -> int:
     return 1 if bad else 0
 
 
+def _wire_scenario(args) -> int:
+    """``--scenario wire`` — the request-path wire-protocol acceptance
+    (docs/serving.md "Wire protocol"): ONE server serving the demo
+    model int8-quantized with response memoization on, driven by
+    concurrent JSON and binary (``application/x-znicz-tensor``)
+    keep-alive clients plus a malformed-binary attacker, while a
+    transient ``engine.forward`` fault trips the breaker mid-burst.
+    Asserted:
+
+    * zero raw 500s and zero hangs on BOTH wire formats — every
+      answer is 200/429/503/504, with ``Retry-After`` on refusals;
+    * every malformed binary body answers 400 FAST (bounded p99) —
+      a junk header must never wedge a handler or leak a 500;
+    * post-recovery, one fresh input posted through both formats
+      decodes to exactly equal outputs, and the JSON bytes are
+      byte-identical to the reference ``json.dumps`` encoding;
+    * memoization HIT during the burst (the fixed payload repeats),
+      and a hot reload swaps the key space — the same input misses
+      once under the new generation, then hits again;
+    * the int8 path stays active throughout (verified at load, zero
+      fallbacks counted).
+    """
+    import collections
+    import http.client as http_client
+    import threading
+
+    from ..serving import wire as wire_mod
+    from ..serving.engine import ServingEngine
+    from ..serving.server import ServingServer
+
+    bad: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="znicz_chaos_") as tmp:
+        model = os.path.join(tmp, "demo.znn")
+        _write_demo_znn(model)
+        engine = ServingEngine(
+            model, backend="jax", buckets=(1, 2, 8), quantize="int8",
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                              max_delay_s=0.05),
+            breaker=CircuitBreaker(failure_threshold=2,
+                                   cooldown_s=0.5))
+        if not engine.quantized_active():
+            bad.append("int8 build fell back on the demo model at "
+                       "load — nothing quantized is being drilled")
+        server = ServingServer(engine, max_wait_ms=1.0,
+                               memo_entries=64).start()
+        x = np.asarray([[0.1, -0.2, 0.3, 0.4]], np.float32)
+        fixed_json = json.dumps({"inputs": x.tolist()}).encode()
+        fixed_bin = wire_mod.encode_tensor(x)
+        good_bin = wire_mod.encode_tensor(x)
+        junk_bodies = [good_bin[:5],                  # short header
+                       b"JUNKJUNKJUNKJUNK",           # bad magic
+                       good_bin[:-2],                 # truncated payload
+                       good_bin + b"\x00"]            # trailing junk
+
+        def unique_x(i: int) -> np.ndarray:
+            ux = x.copy()
+            ux[0, 0] = 0.1 + (i % 997) * 1e-3
+            return ux
+
+        def json_body(i: int) -> bytes:
+            # every other request repeats the fixed payload (memo
+            # exercise); the rest are unique and MUST reach the
+            # engine, where the fault plan is waiting
+            if i % 2 == 0:
+                return fixed_json
+            return json.dumps({"inputs": unique_x(i).tolist()}).encode()
+
+        def bin_body(i: int) -> bytes:
+            if i % 2 == 0:
+                return fixed_bin
+            return wire_mod.encode_tensor(unique_x(i))
+
+        lanes = {
+            "json": (json_body, {"Content-Type": "application/json"}),
+            "binary": (bin_body,
+                       {"Content-Type": wire_mod.CONTENT_TYPE,
+                        "Accept": wire_mod.CONTENT_TYPE}),
+            "junk": (lambda i: junk_bodies[i % len(junk_bodies)],
+                     {"Content-Type": wire_mod.CONTENT_TYPE}),
+        }
+        answers = collections.defaultdict(list)  # lane -> (code, ms, ra)
+        mu = threading.Lock()
+        stop = threading.Event()
+
+        def client(lane_name: str):
+            body_fn, headers = lanes[lane_name]
+            conn = http_client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            i = 0
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    conn.request("POST", "/predict", body_fn(i),
+                                 headers)
+                    r = conn.getresponse()
+                    r.read()
+                    code, ra = r.status, bool(
+                        r.getheader("Retry-After"))
+                except Exception:
+                    conn.close()
+                    conn = http_client.HTTPConnection(
+                        "127.0.0.1", server.port, timeout=30)
+                    code, ra = -1, False
+                ms = (time.monotonic() - t0) * 1e3
+                with mu:
+                    answers[lane_name].append((code, ms, ra))
+                i += 1
+                stop.wait(0.002)
+            conn.close()
+
+        # transient device fault mid-burst: enough firings to trip the
+        # breaker through the retries, then exhausted — the drill must
+        # see open, degraded AND recovered serving under binary load
+        plan = faults.FaultPlan([faults.FaultSpec(
+            "engine.forward", after=20, times=8,
+            message="chaos: injected transient device fault")],
+            seed=11)
+        threads = [threading.Thread(target=client, args=(ln,),
+                                    daemon=True)
+                   for ln in ("json", "json", "binary", "binary",
+                              "junk")]
+        try:
+            with plan:
+                for t in threads:
+                    t.start()
+                stop.wait(args.duration_s)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(30.0)
+        # -- invariants (cleanup guaranteed: an unexpected raise in
+        # the checks must not leak the server's threads) ------------------
+        try:
+            bad, summary = _wire_invariants(bad, answers, server,
+                                            engine, model, x, wire_mod)
+        finally:
+            server.stop()
+            engine.close()
+        print(json.dumps(summary))
+    return 1 if bad else 0
+
+
+def _wire_invariants(bad, answers, server, engine, model, x,
+                     wire_mod):
+    """The wire scenario's post-burst assertions (split out so the
+    caller can guarantee server/engine teardown around them)."""
+    import collections
+
+    for lane_name in ("json", "binary"):
+        got = answers[lane_name]
+        codes = collections.Counter(c for c, _ms, _ra in got)
+        if codes.get(-1):
+            bad.append(f"{lane_name}: {codes[-1]} hung/dropped "
+                       f"request(s)")
+        raw = {c for c in codes if c not in (200, 429, 503, 504)}
+        if raw:
+            bad.append(f"{lane_name}: raw failure codes "
+                       f"{sorted(raw)}")
+        missing_ra = sum(1 for c, _ms, ra in got
+                         if c in (429, 503) and not ra)
+        if missing_ra:
+            bad.append(f"{lane_name}: {missing_ra} refusal(s) "
+                       f"without Retry-After")
+        print(json.dumps({"phase": "burst", "lane": lane_name,
+                          "codes": dict(codes)}))
+    junk_codes = collections.Counter(
+        c for c, _ms, _ra in answers["junk"])
+    if set(junk_codes) != {400}:
+        bad.append(f"malformed binary must answer 400 and only "
+                   f"400, saw {dict(junk_codes)}")
+    junk_ms = sorted(ms for _c, ms, _ra in answers["junk"])
+    junk_p99 = (junk_ms[min(len(junk_ms) - 1,
+                            int(len(junk_ms) * 0.99))]
+                if junk_ms else None)
+    if junk_p99 is None or junk_p99 > 2000.0:
+        bad.append(f"malformed-binary p99 {junk_p99}ms — a junk "
+                   f"header is hanging the handler")
+    # recovery + deterministic cross-format parity on fresh input
+    time.sleep(0.7)
+    probe = np.asarray([[0.05, 0.1, -0.15, 0.2]], np.float32)
+    code_j, body_j, _ = _post(server.url,
+                              {"inputs": probe.tolist()})
+    req = urllib.request.Request(
+        server.url + "predict", wire_mod.encode_tensor(probe),
+        {"Content-Type": wire_mod.CONTENT_TYPE,
+         "Accept": wire_mod.CONTENT_TYPE})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            code_b, raw_b = r.status, r.read()
+    except urllib.error.HTTPError as e:
+        # a non-200 must become the violation it is, not an
+        # unhandled traceback (the JSON probe's _post helper
+        # already eats HTTPError the same way)
+        code_b, raw_b = e.code, e.read()
+    if code_j != 200 or code_b != 200:
+        bad.append(f"post-recovery probes not 200: json={code_j} "
+                   f"binary={code_b}")
+    else:
+        y_json = np.asarray(body_j["outputs"], np.float32)
+        y_bin = wire_mod.decode_tensor(raw_b)
+        if not np.array_equal(y_json, y_bin):
+            bad.append("post-recovery JSON and binary outputs "
+                       "disagree")
+    # memoization: the fixed payload must have HIT during the
+    # burst, and a reload must swap the key space (miss then hit)
+    cache = server.zoo.resolve().response_cache
+    m0 = cache.metrics()
+    if m0["hits"] < 1:
+        bad.append(f"response cache never hit under repeat "
+                   f"traffic: {m0}")
+    rec = engine.reload(model)
+    if rec["outcome"] != "ok":
+        bad.append(f"post-burst reload failed: {rec}")
+    _post(server.url, {"inputs": x.tolist()})
+    m1 = cache.metrics()
+    if m1["misses"] != m0["misses"] + 1:
+        bad.append(f"reload did not swap the memo key space: "
+                   f"misses {m0['misses']} -> {m1['misses']}")
+    _post(server.url, {"inputs": x.tolist()})
+    m2 = cache.metrics()
+    if m2["hits"] != m1["hits"] + 1:
+        bad.append(f"repeat under the new generation did not hit: "
+                   f"hits {m1['hits']} -> {m2['hits']}")
+    em = engine.metrics()
+    if not em.get("quantized"):
+        bad.append(f"int8 serving fell back during the drill "
+                   f"(fallbacks={em.get('quantize_fallbacks')})")
+    summary = {"scenario": "wire", "ok": not bad,
+               "violations": bad,
+               "junk_p99_ms": (round(junk_p99, 1)
+                               if junk_p99 is not None else None),
+               "memo": m2, "breaker": engine.breaker.metrics(),
+               "quantized": em.get("quantized"),
+               "generation": engine.generation}
+    return bad, summary
+
+
 def _slo_scenario(args) -> int:
     """``--scenario slo`` — the burn-rate observability acceptance
     (docs/observability.md "SLO engine"): two tenants behind one
@@ -1043,7 +1280,7 @@ def main(argv=None) -> int:
     p.add_argument("--retry-attempts", type=int, default=2)
     p.add_argument("--scenario", default="breaker",
                    choices=("breaker", "reload", "promote", "overload",
-                            "zoo", "slo"),
+                            "zoo", "slo", "wire"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -1068,7 +1305,14 @@ def main(argv=None) -> int:
                         "alert for the burning tenant, the quiet "
                         "tenant's budget intact, zero raw 500s, and "
                         "the per-tenant device-ms ledger adds up "
-                        "(docs/observability.md)")
+                        "(docs/observability.md); wire: JSON + "
+                        "binary + malformed-binary traffic against "
+                        "an int8-quantized memoizing server under a "
+                        "transient device fault — zero raw 500s on "
+                        "either format, junk binary answers 400 "
+                        "fast, cross-format parity, and a reload "
+                        "swaps the memo key space (docs/serving.md "
+                        "'Wire protocol')")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -1125,6 +1369,8 @@ def main(argv=None) -> int:
         return _zoo_scenario(args)
     if args.scenario == "slo":
         return _slo_scenario(args)
+    if args.scenario == "wire":
+        return _wire_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

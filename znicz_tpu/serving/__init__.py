@@ -11,7 +11,15 @@ The training side of the snapshot→inference story ends at ``export.py``
   into one device call, with a bounded admission queue, backpressure
   and per-request deadlines.
 * ``server``  — stdlib HTTP front (same idiom as ``web_status.py``):
-  ``POST /predict``, ``GET /healthz``, ``GET /metrics``.
+  ``POST /predict``, ``GET /healthz``, ``GET /metrics``; HTTP/1.1
+  persistent connections.
+* ``wire``    — the request-path wire formats: the zero-copy binary
+  tensor protocol (``application/x-znicz-tensor``, one
+  ``np.frombuffer`` per request) and the single-buffer JSON response
+  encoder (byte-identical to the historical ``json.dumps`` output).
+* ``memo``    — generation-keyed response memoization: a bounded
+  per-model LRU answering repeat inputs without a device call
+  (``serve --memoize``); a hot reload swaps the key space.
 
 Degradation (znicz_tpu.resilience): transient device errors retry,
 persistent ones trip a circuit breaker and predicts route to the
@@ -43,11 +51,13 @@ tools/zoo_smoke.sh).
 from ..resilience.breaker import EngineUnavailable
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import ServingEngine
+from .memo import ResponseCache
 from .replicas import EngineReplicaSet
 from .server import ServingServer
+from .wire import WireError
 from .zoo import ModelEntry, ModelZoo, QuotaExceeded, UnknownModel
 
 __all__ = ["DeadlineExceeded", "EngineReplicaSet", "EngineUnavailable",
            "MicroBatcher", "ModelEntry", "ModelZoo", "QueueFull",
-           "QuotaExceeded", "ServingEngine", "ServingServer",
-           "UnknownModel"]
+           "QuotaExceeded", "ResponseCache", "ServingEngine",
+           "ServingServer", "UnknownModel", "WireError"]

@@ -65,6 +65,14 @@ from ..telemetry.registry import REGISTRY
 #: default pad-to-bucket ladder for request batch sizes
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
+#: int8 serving parity tolerances: the quantized forward must match
+#: the fp32 engine on the verification batch within these bounds or
+#: the generation serves fp32 (counted) — same shape of contract as
+#: the BASELINE bf16 tolerance story (docs/performance.md): a speed
+#: path may never silently change answers beyond a pinned bound
+QUANT_RTOL = 5e-2
+QUANT_ATOL = 5e-2
+
 _reloads = REGISTRY.counter(
     "model_reloads_total",
     "hot-reload attempts, by outcome (ok | verify_failed | "
@@ -74,6 +82,12 @@ _generation = REGISTRY.gauge(
     "generation number of the model currently serving (bumps on every "
     "successful hot reload; last engine to swap wins in a "
     "multi-engine process)")
+_quant_fallbacks = REGISTRY.counter(
+    "quantize_fallback_total",
+    "int8 quantized-serving builds that fell back to fp32, by reason "
+    "(unsupported = no quantizable fc chain or non-jax backend | "
+    "tolerance = verification batch breached the parity tolerances | "
+    "error = the quantized build/verify raised)")
 
 
 class ReloadInProgress(RuntimeError):
@@ -107,6 +121,14 @@ class _Generation:
         #: canary and every bucket executable see one consistent
         #: layout
         self.shardings = shardings
+        #: per-layer int8 weight copies — ``None`` (fp32 serving) or a
+        #: list aligned with ``layers`` whose quantized entries are
+        #: ``(wq int8, scale f32 per-output-channel)`` and the rest
+        #: ``None``.  Set by the engine AFTER verification against the
+        #: fp32 forward, before the first ``params()`` call, so every
+        #: bucket executable of this generation sees one consistent
+        #: parameter layout.
+        self.qlayers = None
         self._lock = threading.Lock()
         self._dev_params = None
         self._released = False        # evicted at least once before
@@ -138,12 +160,28 @@ class _Generation:
                 # device_put(x, None) is the default placement, so the
                 # single-device case needs no separate branch
                 sh = self.shardings or [(None, None)] * len(self.layers)
-                self._dev_params = [
-                    (None if la.w is None
-                     else jax.device_put(la.w, s[0]),
-                     None if la.b is None
-                     else jax.device_put(la.b, s[1]))
-                    for la, s in zip(self.layers, sh)]
+                ql = self.qlayers or [None] * len(self.layers)
+                params = []
+                for la, s, q in zip(self.layers, sh, ql):
+                    if q is not None:
+                        # quantized layer: the int8 copy + per-channel
+                        # scale ride as a 3-tuple; jax_forward keys the
+                        # int8 matmul off the third element.  tp>1 is
+                        # rejected with quantize at construction, so
+                        # no sharding to honor here.
+                        wq, scale = q
+                        params.append((
+                            jax.device_put(wq),
+                            None if la.b is None
+                            else jax.device_put(la.b),
+                            jax.device_put(scale)))
+                    else:
+                        params.append((
+                            None if la.w is None
+                            else jax.device_put(la.w, s[0]),
+                            None if la.b is None
+                            else jax.device_put(la.b, s[1])))
+                self._dev_params = params
                 self.pageins += 1
                 paged = ("evicted" if self._released else "cold",
                          (time.monotonic() - t0) * 1e3)
@@ -291,7 +329,17 @@ def jax_forward(layers: list[ZnnLayer], x, params=None):
     executable shares one device copy instead of baking the full model
     in as compile-time constants; None falls back to the layers' own
     arrays.  LRN's 3 hyperparameters always come from the static layer
-    (they parameterize the trace itself)."""
+    (they parameterize the trace itself).
+
+    Int8 serving (docs/serving.md "Int8 quantized serving"): an fc
+    layer whose params entry is a 3-tuple ``(wq int8, b, scale)``
+    takes the quantized path — the activations are dynamically
+    quantized per row (symmetric, like the per-output-channel weight
+    quantization), the int8×int8 matmul accumulates in fp32
+    (``preferred_element_type``), and the product of the two scales
+    dequantizes the result.  The tuple arity is part of the traced
+    structure, so a quantized and an fp32 generation can never share
+    an executable."""
     import jax
     import jax.numpy as jnp
 
@@ -305,13 +353,30 @@ def jax_forward(layers: list[ZnnLayer], x, params=None):
     pool_ctx = {}        # layer index -> (offsets, input shape, geometry)
     for li, lay in enumerate(layers):
         p = lay.p
-        w, b = (params[li] if params is not None else (lay.w, lay.b))
+        entry = (params[li] if params is not None else (lay.w, lay.b))
+        w, b = entry[0], entry[1]
+        qscale = entry[2] if len(entry) > 2 else None
         if lay.kind == "fc":
             h2 = h.reshape(h.shape[0], -1)
             if h2.shape[1] != p[0]:
                 raise ValueError(f"layer {li}: fc expects {p[0]} "
                                  f"features, got {h2.shape[1]}")
-            pre = h2 @ w
+            if qscale is not None:
+                # int8 weight-and-activation matmul, fp32 accumulation:
+                # rows quantize dynamically against their own absmax
+                # (a zero row keeps scale 1 — 0/0 must not NaN the
+                # batch), the per-output-channel weight scale pairs
+                # with it to dequantize the accumulator
+                amax = jnp.max(jnp.abs(h2), axis=1, keepdims=True)
+                sx = jnp.where(amax > 0, amax / 127.0, 1.0)
+                xq = jnp.clip(jnp.round(h2 / sx),
+                              -127, 127).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    xq, w, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                pre = acc * (sx * qscale[None, :])
+            else:
+                pre = h2 @ w
             if b is not None:
                 pre = pre + b
             h = BY_NAME[lay.activation].fwd(pre, jnp)
@@ -359,6 +424,33 @@ def jax_forward(layers: list[ZnnLayer], x, params=None):
     return h.reshape(h.shape[0], -1)
 
 
+def quantize_layers(layers: list[ZnnLayer]) -> tuple[list, int]:
+    """Symmetric per-output-channel int8 copies of the fc weights.
+
+    Returns ``(qlayers, n)`` where ``qlayers`` aligns with ``layers``
+    (``(wq, scale)`` for each quantized fc layer, ``None`` elsewhere)
+    and ``n`` counts quantized layers.  Only fc weights quantize — the
+    FC-heavy families are where the bytes are; conv/LRN/pool/kohonen
+    layers keep fp32 (a kohonen head's squared-distance arithmetic is
+    not a matmul, and the conv chains fail the parity verification on
+    the wrong side of the tolerance for no byte win)."""
+    q, n = [], 0
+    for lay in layers:
+        w = lay.w
+        if lay.kind == "fc" and w is not None \
+                and getattr(w, "ndim", 0) == 2:
+            scale = np.max(np.abs(w), axis=0) / 127.0
+            # an all-zero output channel keeps scale 1: 0/0 would NaN
+            # the whole dequantization for a column that is exactly 0
+            scale = np.where(scale > 0.0, scale, 1.0).astype(np.float32)
+            wq = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+            q.append((wq, scale))
+            n += 1
+        else:
+            q.append(None)
+    return q, n
+
+
 def _jax_usable() -> bool:
     """Whether this host has an initializable JAX backend at all —
     the fallback trigger the engine's ``backend="auto"`` keys on."""
@@ -383,13 +475,23 @@ class ServingEngine:
                  buckets=DEFAULT_BUCKETS, cache_size: int = 8,
                  retry: RetryPolicy | None = None,
                  breaker: CircuitBreaker | None = None,
-                 tp: int = 1):
+                 tp: int = 1, quantize: str = "none"):
         if not buckets or list(buckets) != sorted(set(int(b)
                                                       for b in buckets)):
             raise ValueError(f"buckets must be unique ascending ints, "
                              f"got {buckets!r}")
         if not isinstance(tp, int) or isinstance(tp, bool) or tp < 1:
             raise ValueError(f"tp must be a positive int, got {tp!r}")
+        if quantize not in ("none", "int8"):
+            raise ValueError(f"quantize must be 'none' or 'int8', "
+                             f"got {quantize!r}")
+        if quantize != "none" and tp > 1:
+            # the Megatron shardings split fp32 weight matrices; a
+            # sharded int8 copy would need its own scale layout —
+            # refuse loudly rather than silently serving fp32
+            raise ValueError("quantize cannot combine with tensor-"
+                             "parallel serving (tp > 1)")
+        self.quantize = quantize
         self.buckets = tuple(int(b) for b in buckets)
         self.cache_size = int(cache_size)
         self.tp = tp
@@ -472,7 +574,63 @@ class ServingEngine:
         self._reload_lock = threading.Lock()
         self.last_reload: dict | None = None
         self._last_sample_shape: tuple | None = None
+        # int8 build rides construction, after the stats/locks exist
+        # and BEFORE any params() materialization — the verification
+        # runs eagerly on host copies, so a failed build costs nothing
+        # on device and the generation simply serves fp32 (counted)
+        self._try_quantize(self._gen)
         _generation.set(1)
+
+    # -- int8 quantized serving -------------------------------------------
+    def _try_quantize(self, gen: _Generation) -> None:
+        """Build and VERIFY ``gen``'s int8 weight copy (engine
+        ``quantize="int8"``): quantize the fc layers, run a seeded
+        verification batch through the fp32 and quantized forwards
+        eagerly, and publish ``gen.qlayers`` only when the outputs
+        agree within :data:`QUANT_RTOL`/:data:`QUANT_ATOL`.  Any
+        breach — no fc chain, non-jax backend, tolerance, a raise —
+        falls back to fp32 for this generation and counts
+        ``quantize_fallback_total{reason}``; serving never degrades
+        below the fp32 contract because of a quantization knob."""
+        if self.quantize != "int8":
+            return
+        reason = None
+        try:
+            qlayers, n = quantize_layers(gen.layers)
+            first = gen.layers[0]
+            if self.backend != "jax" or n == 0 \
+                    or first.kind != "fc":
+                # non-fc-first chains (conv H×W underivable from the
+                # kernel alone) cannot build a verification batch —
+                # and a model with nothing to quantize has no int8
+                # path to verify
+                reason = "unsupported"
+            else:
+                shape = (int(first.p[0]),)
+                rng = np.random.default_rng(0)   # deterministic batch
+                x = rng.standard_normal(
+                    (self.buckets[0],) + shape).astype(np.float32)
+                y32 = np.asarray(jax_forward(gen.layers, x))
+                host = [((q[0], la.b, q[1]) if q is not None
+                         else (la.w, la.b))
+                        for la, q in zip(gen.layers, qlayers)]
+                yq = np.asarray(jax_forward(gen.layers, x, host))
+                if np.allclose(yq, y32, rtol=QUANT_RTOL,
+                               atol=QUANT_ATOL):
+                    gen.qlayers = qlayers
+                else:
+                    reason = "tolerance"
+        except Exception:
+            reason = "error"
+        if reason is not None:
+            with self._lock:
+                self._stats["quantize_fallbacks"] += 1
+            _quant_fallbacks.inc(reason=reason)
+
+    def quantized_active(self) -> bool:
+        """Whether the CURRENT serving generation holds a verified
+        int8 weight copy (False on fp32 fallback or quantize='none')."""
+        return self._current().qlayers is not None
 
     # -- tensor parallelism -----------------------------------------------
     @property
@@ -622,7 +780,11 @@ class ServingEngine:
         key = f"{d.platform}:{getattr(d, 'id', 0)}"
         # the TP layout is part of the executable's identity: a tp=2
         # and a tp=1 engine in one process must never classify each
-        # other's compiles as already-warm shapes
+        # other's compiles as already-warm shapes.  Same rule for the
+        # quantize mode — an int8 and an fp32 engine trace different
+        # programs for one shape
+        if self.quantize != "none":
+            key = f"{key}:q-{self.quantize}"
         return key if self._mesh is None else f"{key}:tp{self.tp}"
 
     def _shape_key(self, bucket, sample_shape, dtype) -> tuple:
@@ -1006,6 +1168,12 @@ class ServingEngine:
                 # must count like any other page-in — the zoo's
                 # residency accounting sees reloads too
                 candidate.on_pagein = self._note_pagein
+                # re-quantize PER GENERATION, verified against the
+                # candidate's own fp32 forward: new weights get a
+                # fresh int8 copy or a fresh (counted) fp32 fallback
+                # — and the canary below then exercises whichever
+                # path will actually serve
+                self._try_quantize(candidate)
                 if self.backend == "native":
                     from ..export import NativeEngine
                     native = NativeEngine().load(target)
@@ -1106,6 +1274,9 @@ class ServingEngine:
         m.setdefault("weight_pageins", 0)
         m.setdefault("weight_releases", 0)
         m.setdefault("device_ms_total", 0.0)
+        m.setdefault("quantize_fallbacks", 0)
+        m["quantize_mode"] = self.quantize
+        m["quantized"] = self.quantized_active()
         m["weight_bytes"] = self.weight_nbytes()
         m["weights_resident"] = self.weights_resident()
         m["backend"] = self.backend

@@ -5,11 +5,26 @@ tornado/twisted/asgi; each connection gets a thread that blocks on the
 micro-batcher, which is exactly the shape the batcher wants (many
 waiting producers, one dispatching consumer).
 
-Wire protocol (JSON both ways):
+Wire protocol (JSON by default, binary by negotiation —
+docs/serving.md "Wire protocol"):
 
 * ``POST /predict``  body ``{"inputs": [[...], ...],
   "deadline_ms": optional, "model": optional}`` →
   ``{"outputs": [[...], ...]}``.
+  With ``Content-Type: application/x-znicz-tensor`` the body is
+  instead ONE binary tensor (fixed little-endian header + raw
+  row-major bytes; serving.wire) decoded with a single zero-copy
+  ``np.frombuffer`` — request fields then travel as headers only
+  (``X-Model``/``X-Deadline-Ms``/``X-Criticality``), and a malformed
+  binary body is a 400 exactly like unparseable JSON.  A client
+  sending ``Accept: application/x-znicz-tensor`` gets its outputs in
+  the same binary format; everyone else keeps the byte-identical JSON
+  contract.  Connections are HTTP/1.1 persistent: a closed-loop
+  client pays the TCP+thread setup once, not per request.
+  With ``--memoize N``, repeat inputs under an unchanged model
+  generation answer from a bounded per-model response cache without
+  a device call (serving.memo; a hot reload swaps the key space, so
+  a new generation can never serve its predecessor's outputs).
   A 1-D ``inputs`` is treated as a single sample.  Errors: 400
   (malformed), 404 (unknown model name), 429 + ``Retry-After`` header
   (admission queue full, or a model's token-bucket quota breached),
@@ -99,11 +114,13 @@ resolves as a native-fallback 200 or a 503 carrying Retry-After.
 from __future__ import annotations
 
 import hmac
+import http.client as _http_client
 import json
 import os
 import threading
 import time
 import traceback
+from http import HTTPStatus
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -113,9 +130,11 @@ from ..resilience.breaker import EngineUnavailable
 from ..telemetry import buildinfo, debugz, flightrecorder, tracing
 from ..telemetry.registry import (PROMETHEUS_CONTENT_TYPE, REGISTRY,
                                   DEFAULT_LATENCY_BUCKETS_MS)
+from . import wire
 from . import zoo as zoo_mod
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
 from .engine import ServingEngine
+from .memo import ResponseCache
 
 #: routes with their own label value in requests_total/errors_total —
 #: anything else pools under "other" (label cardinality stays bounded
@@ -123,6 +142,55 @@ from .engine import ServingEngine
 _ROUTES = ("/predict", "/healthz", "/metrics", "/admin/reload",
            "/statusz", "/alertz", "/debug/flightrecorder",
            "/debug/threadz")
+
+_wire_requests = REGISTRY.counter(
+    "wire_requests_total",
+    "successfully decoded POST /predict payloads, by wire format "
+    "(json | binary — Content-Type: application/x-znicz-tensor)")
+
+
+def _json_object(raw: bytes) -> dict:
+    """Parse ONE request body as a JSON object — the single parse
+    site both POST legs thread their dict from (the payload used to
+    be decoded ad hoc per leg)."""
+    payload = json.loads(raw or b"{}")
+    if not isinstance(payload, dict):
+        raise ValueError("body must be a JSON object")
+    return payload
+
+
+class _FastHeaders(dict):
+    """Case-insensitive single-valued request headers (keys stored
+    lowercased).  The stdlib parses request headers through the full
+    ``email.parser`` MIME machinery — ~0.15 ms per request, a third
+    of the measured non-forward budget on the serve bench — and the
+    serving front only ever asks ``headers.get(name)``."""
+
+    __slots__ = ()
+
+    def get(self, name, default=None):
+        return dict.get(self, name.lower(), default)
+
+
+#: (second, formatted) cache for the response Date header — strftime
+#: per response is measurable at bench request rates; GIL-guarded,
+#: and a same-second race merely formats the same string twice
+_date_cache: list = [None, ""]
+
+
+def _memo_generation(engine) -> int | None:
+    """The generation a memo key may safely pin — or ``None`` for a
+    MIXED-generation replica set (mid-roll, or a roll stopped by a
+    failed canary): the set's ``generation`` property is the fleet
+    minimum, so two replicas serving different models would share one
+    key space and the cache could pin either model's answer.  The
+    cache is bypassed until the fleet converges; correctness beats
+    hit rate during a roll."""
+    replicas = getattr(engine, "replicas", None)
+    if replicas is None:
+        return engine.generation
+    gens = {e.generation for e in replicas}
+    return gens.pop() if len(gens) == 1 else None
 
 
 class ServingServer:
@@ -140,7 +208,9 @@ class ServingServer:
                  admin_token: str | None = None,
                  default_deadline_ms: float | None = None,
                  shed_target_ms: float | None = None,
-                 shed_interval_ms: float = 500.0):
+                 shed_interval_ms: float = 500.0,
+                 memo_entries: int = 0,
+                 memo_mb: float = 32.0):
         knobs = (max_batch, max_wait_ms, max_queue, shed_target_ms)
         if batcher is not None and any(k is not None for k in knobs):
             # silently dropping the knobs would look like they applied
@@ -226,6 +296,19 @@ class ServingServer:
                         interval_ms=shed_interval_ms)
                         if shed_target_ms is not None else None))
                 self._built_batchers.append(entry.batcher)
+        #: generation-keyed response memoization (serving.memo) —
+        #: opt-in (``--memoize``); one bounded LRU per zoo entry so
+        #: tenants stay isolated, label-free on the single-model
+        #: surface (the same rule as every model_* family)
+        self.memo_entries = int(memo_entries)
+        if self.memo_entries > 0:
+            for entry in zoo.entries():
+                if entry.response_cache is None:
+                    entry.response_cache = ResponseCache(
+                        max_entries=self.memo_entries,
+                        max_bytes=int(memo_mb * 1e6),
+                        model=(entry.name if self._zoo_explicit
+                               else None))
         #: the DEFAULT model's batcher — the single-model surface
         #: (metrics, statusz, overload status) keeps reading it
         self.batcher = zoo.resolve().batcher
@@ -251,11 +334,169 @@ class ServingServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # persistent connections: a closed-loop client pays TCP
+            # setup + thread spawn ONCE instead of per request — on
+            # the measured request path (bench.py serve) connection
+            # churn was a top non-forward cost.  Every response sends
+            # Content-Length (see _send), which is what HTTP/1.1
+            # keep-alive requires; clients sending Connection: close
+            # (urllib does) keep the old one-shot behavior.
+            protocol_version = "HTTP/1.1"
+            #: socket read timeout: bounds how long an idle keep-alive
+            #: connection can pin its handler thread after the client
+            #: went away without closing
+            timeout = 120
+            #: small request/response ping-pong over a persistent
+            #: connection is exactly the pattern Nagle + delayed-ACK
+            #: penalizes — answers must leave NOW
+            disable_nagle_algorithm = True
+
             def log_message(self, *args):     # keep serving logs clean
                 pass
 
+            def date_time_string(self, timestamp=None):
+                # per-second cache of the Date header (RFC format via
+                # the stdlib formatter, computed once a second instead
+                # of once a response)
+                if timestamp is not None:
+                    return super().date_time_string(timestamp)
+                t = int(time.time())
+                if _date_cache[0] != t:
+                    _date_cache[1] = super().date_time_string(t)
+                    _date_cache[0] = t
+                return _date_cache[1]
+
+            def _read_headers_fast(self) -> _FastHeaders:
+                """Request headers into a :class:`_FastHeaders` dict
+                with the stdlib's bounds (64 KiB line, 100 headers;
+                folded continuation lines appended, duplicate names
+                first-wins like ``email.Message.get``)."""
+                headers = _FastHeaders()
+                last = None
+                count = 0
+                while True:
+                    line = self.rfile.readline(65537)
+                    if len(line) > 65536:
+                        raise _http_client.LineTooLong("header line")
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    count += 1
+                    if count > 100:
+                        raise _http_client.HTTPException(
+                            "got more than 100 headers")
+                    s = line.decode("iso-8859-1").rstrip("\r\n")
+                    if s[:1] in " \t":
+                        # obs-fold continuation of the previous field
+                        if last is not None:
+                            headers[last] += " " + s.strip()
+                        continue
+                    key, sep, value = s.partition(":")
+                    if not sep:
+                        continue           # junk line: skip, as email
+                        #                    .parser tolerates it
+                    key = key.strip().lower()
+                    if key not in headers:
+                        headers[key] = value.strip()
+                        last = key
+                    else:
+                        # duplicate dropped (first-wins) — a fold
+                        # following it must NOT append to the RETAINED
+                        # first value
+                        last = None
+                return headers
+
+            def parse_request(self):
+                """CPython 3.10 ``BaseHTTPRequestHandler.
+                parse_request`` with ONE change: headers parse through
+                :meth:`_read_headers_fast` instead of the
+                ``email.parser`` MIME machinery (the behavior pins —
+                request-line validation, HTTP/0.9 and 2.0 handling,
+                ``Connection``/``Expect`` semantics, the ``//`` path
+                reduction — are copied verbatim)."""
+                self.command = None
+                self.request_version = version = \
+                    self.default_request_version
+                self.close_connection = True
+                requestline = str(self.raw_requestline, "iso-8859-1")
+                requestline = requestline.rstrip("\r\n")
+                self.requestline = requestline
+                words = requestline.split()
+                if len(words) == 0:
+                    return False
+                if len(words) >= 3:     # enough to determine version
+                    version = words[-1]
+                    try:
+                        if not version.startswith("HTTP/"):
+                            raise ValueError
+                        base_version_number = version.split("/", 1)[1]
+                        version_number = base_version_number.split(".")
+                        if len(version_number) != 2:
+                            raise ValueError
+                        version_number = (int(version_number[0]),
+                                          int(version_number[1]))
+                    except (ValueError, IndexError):
+                        self.send_error(
+                            HTTPStatus.BAD_REQUEST,
+                            "Bad request version (%r)" % version)
+                        return False
+                    if version_number >= (1, 1) \
+                            and self.protocol_version >= "HTTP/1.1":
+                        self.close_connection = False
+                    if version_number >= (2, 0):
+                        self.send_error(
+                            HTTPStatus.HTTP_VERSION_NOT_SUPPORTED,
+                            "Invalid HTTP version (%s)"
+                            % base_version_number)
+                        return False
+                    self.request_version = version
+                if not 2 <= len(words) <= 3:
+                    self.send_error(
+                        HTTPStatus.BAD_REQUEST,
+                        "Bad request syntax (%r)" % requestline)
+                    return False
+                command, path = words[:2]
+                if len(words) == 2:
+                    self.close_connection = True
+                    if command != "GET":
+                        self.send_error(
+                            HTTPStatus.BAD_REQUEST,
+                            "Bad HTTP/0.9 request type (%r)" % command)
+                        return False
+                self.command, self.path = command, path
+                if self.path.startswith("//"):
+                    # gh-87389 open-redirect hardening, as upstream
+                    self.path = "/" + self.path.lstrip("/")
+                try:
+                    self.headers = self._read_headers_fast()
+                except _http_client.LineTooLong as err:
+                    self.send_error(
+                        HTTPStatus.REQUEST_HEADER_FIELDS_TOO_LARGE,
+                        "Line too long", str(err))
+                    return False
+                except _http_client.HTTPException as err:
+                    self.send_error(
+                        HTTPStatus.REQUEST_HEADER_FIELDS_TOO_LARGE,
+                        "Too many headers", str(err))
+                    return False
+                conntype = self.headers.get("Connection", "")
+                if conntype.lower() == "close":
+                    self.close_connection = True
+                elif (conntype.lower() == "keep-alive"
+                        and self.protocol_version >= "HTTP/1.1"):
+                    self.close_connection = False
+                expect = self.headers.get("Expect", "")
+                if (expect.lower() == "100-continue"
+                        and self.protocol_version >= "HTTP/1.1"
+                        and self.request_version >= "HTTP/1.1"):
+                    if not self.handle_expect_100():
+                        return False
+                return True
+
             def _route(self) -> str:
-                path = self.path.split("?")[0].rstrip("/")
+                path = self.path
+                if path in _ROUTES:     # hot case: no query, no slash
+                    return path
+                path = path.split("?")[0].rstrip("/")
                 return path if path in _ROUTES else "other"
 
             def _send(self, code: int, body: bytes, ctype: str,
@@ -273,13 +514,93 @@ class ServingServer:
                     self.send_header("X-Request-Id", rid)
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(body)
+                if self.close_connection:
+                    # under HTTP/1.1 a reply without this header
+                    # advertises reuse — a client pipelining its next
+                    # request onto a socket we are about to close
+                    # would see a spurious reset (the 413/400/501/403
+                    # legs all close without reading the body)
+                    self.send_header("Connection", "close")
+                # one syscall per response: ride the body on the
+                # header buffer end_headers() flushes (wfile is
+                # unbuffered, so a separate body write would be a
+                # second segment — and with keep-alive ping-pong,
+                # a second chance at a TCP stall).  HTTP/0.9 requests
+                # have no status line or headers (the stdlib writers
+                # above were all no-ops and no buffer exists) — the
+                # body goes out bare, as the ancient protocol wants
+                if self.request_version != "HTTP/0.9":
+                    self._headers_buffer.append(b"\r\n")
+                    self._headers_buffer.append(body)
+                    self.flush_headers()
+                else:
+                    self.wfile.write(body)
 
             def _reply(self, code: int, obj: dict,
                        headers: dict | None = None):
                 self._send(code, json.dumps(obj, default=float).encode(),
                            "application/json", headers)
+
+            def _read_body(self) -> bytes | None:
+                """Read the Content-Length-bounded request body ONCE
+                (both POST legs thread the bytes — and the parsed
+                dict — from here).  Replies itself and returns None on
+                a junk/oversized length; any reply made WITHOUT
+                consuming the body also closes the connection, so the
+                unread bytes can never be misread as the next
+                keep-alive request's head."""
+                if self.headers.get("Transfer-Encoding"):
+                    # chunked (or any transfer coding) is not spoken
+                    # here: silently reading Content-Length=0 would
+                    # leave the chunk bytes in the buffer to be parsed
+                    # as the NEXT request's head — a desync, and
+                    # behind a proxy a request-smuggling vector.
+                    # Refuse loudly and drop the connection.
+                    self.close_connection = True
+                    self._reply(501, {
+                        "error": "Transfer-Encoding is not supported; "
+                                 "send a Content-Length body"})
+                    return None
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                except (TypeError, ValueError):
+                    self.close_connection = True
+                    self._reply(400, {"error": "bad request: junk "
+                                               "Content-Length"})
+                    return None
+                if n < 0:
+                    self.close_connection = True
+                    self._reply(400, {"error": "bad request: negative "
+                                               "Content-Length"})
+                    return None
+                if n > outer.max_body:
+                    # bounded admission extends to the body: a huge
+                    # request must 413, not OOM the server
+                    self.close_connection = True
+                    self._reply(413, {
+                        "error": f"body of {n} bytes exceeds the "
+                                 f"{outer.max_body}-byte limit"})
+                    return None
+                return self.rfile.read(n) if n > 0 else b""
+
+            def _reply_outputs(self, y: np.ndarray,
+                               binary: bool) -> None:
+                """The 200 leg, content-negotiated: binary tensor for
+                ``Accept: application/x-znicz-tensor``, else JSON
+                bytes BYTE-IDENTICAL to the historical
+                ``json.dumps({"outputs": y.tolist()})`` — built by the
+                single-buffer encoder (serving.wire).  The encode is
+                its own span so the flight-recorder stage breakdown
+                prices it next to queue/dispatch/forward."""
+                with tracing.span("server.encode"):
+                    if binary:
+                        body = wire.encode_tensor(
+                            np.ascontiguousarray(y, np.float32))
+                        ctype = wire.CONTENT_TYPE
+                    else:
+                        body = wire.encode_json_outputs(y)
+                        ctype = "application/json"
+                self._send(200, body, ctype)
 
             def _admin_authorized(self) -> bool:
                 """True when no admin token is configured, or the
@@ -305,6 +626,13 @@ class ServingServer:
                     outer.admin_token.encode("utf-8"))
 
             def do_GET(self):
+                if self.headers.get("Content-Length") \
+                        or self.headers.get("Transfer-Encoding"):
+                    # no GET route reads a body: leftover body bytes
+                    # on a kept-alive connection would be parsed as
+                    # the NEXT request's head (desync / smuggling) —
+                    # answer, then drop the connection
+                    self.close_connection = True
                 path = self.path.split("?")[0].rstrip("/")
                 if (path in ("/statusz", "/debug/flightrecorder",
                              "/debug/threadz")
@@ -374,6 +702,9 @@ class ServingServer:
                     self._admin_reload()
                     return
                 if route != "/predict":
+                    # body never read on this leg — keep-alive framing
+                    # would misread it as the next request's head
+                    self.close_connection = True
                     self._reply(404, {"error": f"no route {self.path!r}"})
                     return
                 # the request id lives in a contextvar for the rest of
@@ -387,9 +718,10 @@ class ServingServer:
                 self._rec_shape = self._rec_rows = None
                 self._rec_error = None
                 self._model_name = None
-                with tracing.request(rid):
-                    with tracing.span("server.predict"):
-                        self._predict()
+                with tracing.collect(rid) as collected:
+                    with tracing.request(rid):
+                        with tracing.span("server.predict"):
+                            self._predict()
                 dt_ms = (time.monotonic() - t0) * 1e3
                 outer._latency.observe(dt_ms)
                 # flight record, AFTER the handler span closed so the
@@ -408,12 +740,14 @@ class ServingServer:
                     # judge
                     zoo_mod.note_model_request(self._model_name, code,
                                                dt_ms)
-                # since=t0: a retry reusing its first attempt's
-                # X-Request-Id must not inherit that attempt's spans —
-                # stage timings would double-count
-                spans = [s.to_dict() for s in
-                         tracing.recent_spans(request_id=rid,
-                                              since=t0)]
+                # the collector gathered this request's own spans in
+                # O(own spans) — no per-request ring rescan.  The
+                # since=t0 filter still applies: a straggler span of a
+                # PRIOR attempt reusing this X-Request-Id (its batch
+                # finishing late) must not double-count into this
+                # attempt's stage timings
+                spans = [s.to_dict() for s in collected
+                         if s._t0 >= t0]
                 flightrecorder.RECORDER.record(
                     "request", duration_ms=dt_ms,
                     outcome="ok" if code < 400 else "error",
@@ -440,20 +774,16 @@ class ServingServer:
                 missing/wrong ``X-Admin-Token`` when the server has
                 one configured."""
                 if not self._admin_authorized():
+                    self.close_connection = True   # body left unread
                     self._reply(403, {
                         "error": "admin token required (supply "
                                  "X-Admin-Token)"})
                     return
+                raw = self._read_body()
+                if raw is None:
+                    return
                 try:
-                    n = int(self.headers.get("Content-Length", 0) or 0)
-                    if n > outer.max_body:
-                        self._reply(413, {
-                            "error": f"body of {n} bytes exceeds the "
-                                     f"{outer.max_body}-byte limit"})
-                        return
-                    payload = json.loads(self.rfile.read(n) or b"{}")
-                    if not isinstance(payload, dict):
-                        raise ValueError("body must be a JSON object")
+                    payload = _json_object(raw)
                     model = payload.get("model")
                     if model is not None and not isinstance(model, str):
                         raise ValueError("'model' must be a path string")
@@ -501,17 +831,35 @@ class ServingServer:
                                       **outer.reload_status(name)})
 
             def _predict(self):
+                raw = self._read_body()
+                if raw is None:
+                    return
+                # content negotiation for the RESPONSE is independent
+                # of the request format: a JSON client may ask for
+                # binary outputs and vice versa
+                want_binary = wire.CONTENT_TYPE in (
+                    self.headers.get("Accept") or "")
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    if n > outer.max_body:
-                        # bounded admission extends to the body: a
-                        # huge request must 413, not OOM the server
-                        self._reply(413, {
-                            "error": f"body of {n} bytes exceeds the "
-                                     f"{outer.max_body}-byte limit"})
-                        return
-                    payload = json.loads(self.rfile.read(n) or b"{}")
-                    x = np.asarray(payload["inputs"], np.float32)
+                    ctype = (self.headers.get("Content-Type") or "")
+                    ctype = ctype.split(";", 1)[0].strip().lower()
+                    binary_in = ctype == wire.CONTENT_TYPE
+                    if binary_in:
+                        # zero-copy leg: one bounds-checked
+                        # np.frombuffer over the raw bytes — request
+                        # fields travel as headers only (the payload
+                        # IS the tensor), so `payload` stays empty
+                        # and the field precedence below is unchanged
+                        payload = {}
+                        x = wire.decode_tensor(raw)
+                        if x.dtype != np.float32:
+                            x = x.astype(np.float32)
+                    else:
+                        # parse ONCE; the dict threads through the
+                        # rest of the leg (model/deadline fields)
+                        payload = _json_object(raw)
+                        x = np.asarray(payload["inputs"], np.float32)
+                    _wire_requests.inc(
+                        format="binary" if binary_in else "json")
                     if x.ndim == 1:
                         x = x[None]
                     self._rec_rows = int(len(x))
@@ -595,6 +943,23 @@ class ServingServer:
                                       "retry_after_s": e.retry_after},
                                 {"Retry-After": str(e.retry_after)})
                     return
+                # response memoization (serving.memo): an identical
+                # input under an unchanged generation answers from the
+                # per-model LRU without touching the batcher or the
+                # device.  Keyed AFTER admission — quota policy still
+                # governs the tenant's call rate — and BEFORE the
+                # residency touch: a memo hit must not page an evicted
+                # model back in to not use it.
+                cache = entry.response_cache
+                ckey = None
+                if cache is not None:
+                    memo_gen = _memo_generation(entry.engine)
+                    if memo_gen is not None:
+                        ckey = cache.key_for(memo_gen, x)
+                        y = cache.get(ckey)
+                        if y is not None:
+                            self._reply_outputs(y, want_binary)
+                            return
                 # residency: the request that wakes a cold model pays
                 # its page-in here (single-flight — a concurrent
                 # eviction race parks on the generation lock), and
@@ -658,6 +1023,9 @@ class ServingServer:
                     if not np.isfinite(y).all():
                         # bare NaN/Infinity tokens are not valid JSON —
                         # strict clients would choke on a 200 body
+                        # (the binary format COULD carry them, but one
+                        # contract across both formats beats a format-
+                        # dependent error surface)
                         self._rec_error = ("model produced non-finite "
                                            "outputs")
                         self._reply(500, {
@@ -665,9 +1033,24 @@ class ServingServer:
                                      "outputs (inf/nan) for these "
                                      "inputs"})
                     else:
-                        self._reply(200, {"outputs": y.tolist()})
+                        if ckey is not None:
+                            # memoize only finite, served answers — a
+                            # 500 must re-judge on the next attempt
+                            # (ckey is None when the cache is off OR
+                            # bypassed for a mixed-generation fleet)
+                            cache.put(ckey, y)
+                        self._reply_outputs(y, want_binary)
 
-        self.server = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            #: accept-backlog depth: the stdlib default of 5 turns a
+            #: burst of simultaneous NEW connections (a fleet's
+            #: clients reconnecting after a rollout, the barrier-
+            #: released e2e tests) into kernel connection resets under
+            #: load — observed as a rare pre-existing
+            #: ConnectionResetError flake in the concurrency tests
+            request_queue_size = 128
+
+        self.server = Server((host, port), Handler)
         # collector registration comes AFTER the bind: if the socket
         # constructor raises (port in use), __init__ unwinds and
         # stop() — the only unregister site — never runs, which would
@@ -908,6 +1291,11 @@ class ServingServer:
         m = self.batcher.metrics()
         m["engine"] = self.engine.metrics()
         m["overload"] = self.overload_status(bm=m)
+        rc = self.zoo.resolve().response_cache
+        if rc is not None:
+            # only when memoization is ON: the pre-memo JSON surface
+            # must not grow keys under scrapers pinned to it
+            m["response_cache"] = rc.metrics()
         slo = self.slo_status()
         if slo is not None:
             m["slo"] = slo
@@ -1116,6 +1504,27 @@ def main(argv=None) -> int:
                         "is slow)")
     p.add_argument("--max-body-mb", type=float, default=64.0,
                    help="largest accepted /predict body (413 beyond)")
+    p.add_argument("--quantize", default="none",
+                   choices=("none", "int8"),
+                   help="int8 quantized serving for the fc-heavy "
+                        "families: per-generation symmetric "
+                        "per-channel int8 weight copies with fp32 "
+                        "accumulation, VERIFIED at load against the "
+                        "fp32 forward on a seeded batch — a tolerance "
+                        "breach falls back to fp32 (counted in "
+                        "quantize_fallback_total).  Per-model "
+                        "override: --model NAME=PATH,quantize=int8")
+    p.add_argument("--memoize", type=int, default=0, metavar="N",
+                   help="response memoization: keep up to N recent "
+                        "(generation, input-digest) → output entries "
+                        "PER MODEL and answer repeat inputs without a "
+                        "device call (0 = off, the historical "
+                        "contract; a hot reload swaps the key space, "
+                        "so a new generation never serves its "
+                        "predecessor's outputs)")
+    p.add_argument("--memoize-mb", type=float, default=32.0,
+                   help="byte bound per model's response cache "
+                        "(entries evict LRU-first under either bound)")
     p.add_argument("--default-deadline-ms", type=float, default=None,
                    help="end-to-end deadline attached to requests "
                         "that send neither X-Deadline-Ms nor a body "
@@ -1302,7 +1711,7 @@ def main(argv=None) -> int:
         shed_target_ms = (args.shed_target_ms
                           if args.shed_target_ms > 0 else None)
 
-    def _make_engine(_i, path):
+    def _make_engine(_i, path, quantize):
         # per-replica construction: breaker/retry/cache must be FRESH
         # per engine — a shared breaker would collapse the failure
         # domains --replicas exists to separate.  Same delay budget as
@@ -1312,6 +1721,7 @@ def main(argv=None) -> int:
         return ServingEngine(
             path, backend=args.backend,
             buckets=buckets, cache_size=args.cache_size, tp=args.tp,
+            quantize=quantize,
             retry=RetryPolicy(max_attempts=args.retry_attempts,
                               base_delay_s=0.02, max_delay_s=0.25,
                               budget=budget),
@@ -1324,20 +1734,35 @@ def main(argv=None) -> int:
     if args.hedge and args.replicas < 2:
         p.error("--hedge needs --replicas >= 2 (a hedge goes to "
                 "ANOTHER replica)")
+    if args.tp > 1:
+        # the per-SPEC quantize option must hit the same clean
+        # argparse error as the global flag, not a raw ValueError
+        # traceback out of the engine constructor
+        quantized = [nm for nm, (_p, opts) in specs.items()
+                     if opts.get("quantize", args.quantize) != "none"]
+        if args.quantize != "none" or quantized:
+            which = (" (models: " + ", ".join(sorted(quantized)) + ")"
+                     if quantized else "")
+            p.error(f"quantize=int8 cannot combine with --tp > 1: "
+                    f"the Megatron shardings split fp32 weights and "
+                    f"an int8 shard layout is not implemented{which}")
 
-    def _build_engine(path):
-        # the topology knobs (--tp/--replicas/--hedge) apply per
-        # model: each zoo entry is its own replica set / TP engine —
-        # hedges and retries still share the ONE process budget
+    def _build_engine(path, quantize=None):
+        # the topology knobs (--tp/--replicas/--hedge and --quantize)
+        # apply per model: each zoo entry is its own replica set / TP
+        # engine — hedges and retries still share the ONE process
+        # budget.  A per-spec quantize= beats the global flag.
+        quantize = args.quantize if quantize is None else quantize
         if args.replicas > 1:
             from .replicas import EngineReplicaSet
             hedge = (overload.HedgePolicy(after_ms=args.hedge_after_ms,
                                           budget=budget)
                      if args.hedge else None)
             return EngineReplicaSet(
-                lambda i, _p=path: _make_engine(i, _p),
+                lambda i, _p=path, _q=quantize: _make_engine(i, _p,
+                                                             _q),
                 args.replicas, hedge=hedge)
-        return _make_engine(0, path)
+        return _make_engine(0, path, quantize)
 
     if single_mode:
         zoo = None
@@ -1349,7 +1774,8 @@ def main(argv=None) -> int:
                                  if args.memory_budget_mb else None))
         for nm in order:
             path, opts = specs[nm]
-            zoo.add(nm, engine=_build_engine(path),
+            zoo.add(nm, engine=_build_engine(path,
+                                             opts.get("quantize")),
                     criticality=opts.get("criticality", "default"),
                     deadline_ms=opts.get("deadline_ms"),
                     quota_rps=opts.get("quota_rps"),
@@ -1404,7 +1830,9 @@ def main(argv=None) -> int:
                       max_body_mb=args.max_body_mb,
                       admin_token=args.admin_token,
                       default_deadline_ms=args.default_deadline_ms,
-                      shed_target_ms=shed_target_ms)
+                      shed_target_ms=shed_target_ms,
+                      memo_entries=args.memoize,
+                      memo_mb=args.memoize_mb)
         server = (ServingServer(engine, **kwargs) if zoo is None
                   else ServingServer(zoo=zoo, **kwargs))
         server.start()

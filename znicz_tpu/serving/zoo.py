@@ -203,6 +203,11 @@ class ModelEntry:
         #: the entry's own micro-batcher — attached by the server
         #: (which owns the batching knobs); None until then
         self.batcher = None
+        #: the entry's generation-keyed response memoization cache
+        #: (serving.memo.ResponseCache) — attached by the server when
+        #: ``--memoize`` is on; None = every request takes the full
+        #: batcher/device path (the historical contract)
+        self.response_cache = None
 
     def predict(self, x):
         """The batcher's dispatch target: one per-tenant chaos site in
@@ -475,7 +480,7 @@ class ModelZoo:
         for name, e in items:
             eng = e.engine
             dev_fn = getattr(eng, "device_ms_total", None)
-            rows.append({
+            row = {
                 "model": name,
                 "default": name == default,
                 "device_ms": (round(dev_fn(), 1)
@@ -489,7 +494,13 @@ class ModelZoo:
                 "idle_s": round(now - used.get(name, now), 1),
                 "queue_depth": (e.batcher.queue_depth()
                                 if e.batcher is not None else 0),
-                "state": eng.resilience_state()})
+                "state": eng.resilience_state()}
+            if e.response_cache is not None:
+                # memoization is opt-in: the row only grows the key
+                # when a cache is attached, so probers pinned to the
+                # pre-memo table see an unchanged shape
+                row["response_cache"] = e.response_cache.metrics()
+            rows.append(row)
         if self.labeled_metrics:
             # refresh on every scrape path (healthz/statusz/metrics/
             # collector): evictions also write it, but a budget-less
@@ -536,9 +547,9 @@ def parse_model_spec(spec: str) -> tuple:
     """One ``--model`` value → ``(name | None, path, options)``.
 
     Grammar: ``NAME=PATH[,criticality=C][,deadline-ms=N]
-    [,quota-rps=N][,quota-burst=N][,default]``.  A bare ``PATH``
-    (no ``name=`` prefix) keeps the single-model CLI contract —
-    ``(None, path, {})``."""
+    [,quota-rps=N][,quota-burst=N][,quantize=int8|none][,default]``.
+    A bare ``PATH`` (no ``name=`` prefix) keeps the single-model CLI
+    contract — ``(None, path, {})``."""
     head = spec.split(",", 1)[0]
     if "=" not in head or not _NAME_RE.match(head.split("=", 1)[0]):
         return None, spec, {}
@@ -558,6 +569,11 @@ def parse_model_spec(spec: str) -> tuple:
         k = k.replace("-", "_")
         if k == "criticality":
             opts["criticality"] = v
+        elif k == "quantize":
+            if v not in ("none", "int8"):
+                raise ValueError(f"--model {spec!r}: quantize must be "
+                                 f"'int8' or 'none', got {v!r}")
+            opts["quantize"] = v
         elif k in ("deadline_ms", "quota_rps", "quota_burst"):
             opts[k] = float(v)
         else:

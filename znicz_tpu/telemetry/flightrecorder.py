@@ -45,7 +45,7 @@ from .registry import REGISTRY
 
 #: spans whose durations make up the request stage breakdown
 _STAGE_SPANS = ("server.predict", "batcher.dispatch", "engine.forward",
-                "compile")
+                "compile", "server.encode")
 
 _records_g = REGISTRY.gauge(
     "flightrecorder_records",
@@ -150,6 +150,12 @@ def stage_breakdown(spans: list, rows: int | None = None) -> dict:
         out["device_ms"] = round(device_ms, 3)
     if "compile" in by_name:
         out["compile_ms"] = round(by_name["compile"], 3)
+    if "server.encode" in by_name:
+        # the response-serialization share (JSON buffer encoder or
+        # binary tensor header+bytes) — the before/after figure for
+        # the wire-protocol work rides the same breakdown as
+        # queue/dispatch/forward
+        out["encode_ms"] = round(by_name["server.encode"], 3)
     if "batcher.dispatch" in by_name:
         out["dispatch_ms"] = round(by_name["batcher.dispatch"], 3)
         if "server.predict" in by_name:
@@ -203,20 +209,26 @@ class FlightRecorder:
         with self._lock:
             self._seq += 1
             rec["seq"] = self._seq
-            if len(self._recent) == self._recent.maxlen:
+            n0 = len(self._recent)
+            if n0 == self._recent.maxlen:
                 _dropped.inc(ring="recent")
             self._recent.append(rec)
+            # gauge writes only on a length CHANGE: once a ring fills
+            # (steady state on the serve hot path) its length never
+            # moves again, and three labeled gauge sets per request
+            # are measurable at bench request rates
+            if len(self._recent) != n0:
+                _records_g.set(len(self._recent), ring="recent")
             if slow:
                 if len(self._slow) == self._slow.maxlen:
                     _dropped.inc(ring="slow")
                 self._slow.append(rec)
+                _records_g.set(len(self._slow), ring="slow")
             if failed:
                 if len(self._errors) == self._errors.maxlen:
                     _dropped.inc(ring="error")
                 self._errors.append(rec)
-            _records_g.set(len(self._recent), ring="recent")
-            _records_g.set(len(self._slow), ring="slow")
-            _records_g.set(len(self._errors), ring="error")
+                _records_g.set(len(self._errors), ring="error")
         _recorded.inc(kind=kind)
         return rec
 
@@ -330,3 +342,12 @@ class FlightRecorder:
 
 #: the process-wide default recorder the serving/debug surfaces share
 RECORDER = FlightRecorder()
+# publish the empty-ring lengths ONCE for the process singleton:
+# record() only writes the gauges on a length change, so the series
+# must exist (at 0) before the first record — but zeroing inside
+# FlightRecorder.__init__ would let a test-local recorder clobber the
+# live singleton's gauge, which the skip-on-unchanged write could
+# then never repair for a ring already at capacity
+for _ring in ("recent", "slow", "error"):
+    _records_g.set(0, ring=_ring)
+del _ring
