@@ -621,11 +621,14 @@ def bench_serve(args) -> int:
     import urllib.error
     import urllib.request
 
+    n_fleet = max(0, getattr(args, "fleet", 0))
     result = {"metric": "serve_requests_per_sec_per_core",
               "value": None, "unit": "req/s/core",
               "vs_baseline": None}
     tmp = tempfile.mkdtemp(prefix="znicz_bench_serve_")
     proc = None
+    fleet_procs = []
+    backend_urls = []
     try:
         model = args.serve_model
         width = args.serve_width
@@ -634,37 +637,73 @@ def bench_serve(args) -> int:
             model = os.path.join(tmp, "demo.znn")
             width = 4
             _write_demo_znn(model)
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "znicz_tpu", "serve",
-             "--model", model, "--port", str(port),
-             "--max-wait-ms", "1", "--warmup-shape", str(width)]
-            # repeat traffic only pays off with the response cache on;
-            # a pure-unique run serves WITHOUT memoization so the two
-            # trajectories measure different levers, not one
-            + (["--memoize", "4096"]
-               if args.repeat_fraction > 0 else []),
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        url = f"http://127.0.0.1:{port}/"
-        for _ in range(240):
-            try:
-                with urllib.request.urlopen(url + "healthz",
-                                            timeout=2) as r:
-                    health = json.loads(r.read())
-                break
-            except Exception:
-                if proc.poll() is not None:
-                    out = proc.stdout.read().decode(errors="replace")
-                    result["error"] = (f"serve exited "
-                                       f"rc={proc.returncode}: "
-                                       + out[-400:])
+
+        def free_port() -> int:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        def boot_serve(serve_port: int) -> subprocess.Popen:
+            return subprocess.Popen(
+                [sys.executable, "-m", "znicz_tpu", "serve",
+                 "--model", model, "--port", str(serve_port),
+                 "--max-wait-ms", "1", "--warmup-shape", str(width)]
+                # repeat traffic only pays off with the response cache
+                # on; a pure-unique run serves WITHOUT memoization so
+                # the two trajectories measure different levers
+                + (["--memoize", "4096"]
+                   if args.repeat_fraction > 0 else []),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+        def wait_health(wait_url: str, wait_proc,
+                        what: str) -> dict | None:
+            for _ in range(240):
+                try:
+                    with urllib.request.urlopen(wait_url + "healthz",
+                                                timeout=2) as r:
+                        return json.loads(r.read())
+                except Exception:
+                    if wait_proc.poll() is not None:
+                        out = wait_proc.stdout.read().decode(
+                            errors="replace")
+                        result["error"] = (
+                            f"{what} exited "
+                            f"rc={wait_proc.returncode}: " + out[-400:])
+                        return None
+                    time.sleep(0.5)
+            result["error"] = f"{what} never answered /healthz"
+            return None
+
+        if n_fleet:
+            # fleet mode: N serve backends behind a REAL route
+            # process — the router's forwarding overhead is IN the
+            # measurement, which is the point (the fleetxN trajectory
+            # prices the fabric against the single-process rows)
+            ports = [free_port() for _ in range(n_fleet)]
+            port = free_port()
+            backend_urls = [f"http://127.0.0.1:{pt}/" for pt in ports]
+            fleet_procs = [boot_serve(pt) for pt in ports]
+            health = None
+            for burl, bproc in zip(backend_urls, fleet_procs):
+                health = wait_health(burl, bproc, "fleet backend")
+                if health is None:
                     return _emit(result)
-                time.sleep(0.5)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "znicz_tpu", "route",
+                 "--port", str(port)]
+                + [f for i, u in enumerate(backend_urls)
+                   for f in ("--backend", f"{u},name=b{i}")],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            url = f"http://127.0.0.1:{port}/"
+            if wait_health(url, proc, "route") is None:
+                return _emit(result)
         else:
-            result["error"] = "serve never answered /healthz"
-            return _emit(result)
+            port = free_port()
+            proc = boot_serve(port)
+            url = f"http://127.0.0.1:{port}/"
+            health = wait_health(url, proc, "serve")
+            if health is None:
+                return _emit(result)
         import http.client
 
         import numpy as np
@@ -731,7 +770,14 @@ def bench_serve(args) -> int:
                 i += n_clients
             conn.close()
 
-        dev0 = _scrape_device_ms(url)
+        def device_ms_now() -> float:
+            # fleet mode: the chip time lives in the BACKENDS — sum
+            # their ledgers (the router itself runs no device code)
+            if n_fleet:
+                return sum(_scrape_device_ms(u) for u in backend_urls)
+            return _scrape_device_ms(url)
+
+        dev0 = device_ms_now()
         threads = [threading.Thread(target=client, args=(ci,),
                                     daemon=True)
                    for ci in range(n_clients)]
@@ -743,13 +789,16 @@ def bench_serve(args) -> int:
         for t in threads:
             t.join(30.0)
         duration_s = time.monotonic() - t_start
-        device_ms = _scrape_device_ms(url) - dev0
-        proc.send_signal(signal.SIGINT)
-        try:
-            proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+        device_ms = device_ms_now() - dev0
+        for p_ in [proc] + fleet_procs:
+            p_.send_signal(signal.SIGINT)
+        for p_ in [proc] + fleet_procs:
+            try:
+                p_.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p_.kill()
         proc = None
+        fleet_procs = []
         codes = collections.Counter(c for _l, c in answers)
         # quantiles cover ANSWERED requests only (the _serve_row
         # contract): a hung/dropped request's "latency" is just the
@@ -771,7 +820,12 @@ def bench_serve(args) -> int:
         rev = _git_rev()
         if rev:
             result["rev"] = rev
-        result["sharding"] = "1x1"
+        # the topology is part of a serve measurement's identity,
+        # exactly like the mesh scheme on the training side: fleetxN
+        # rows only pair with fleetxN rows in decide_levers
+        result["sharding"] = f"fleetx{n_fleet}" if n_fleet else "1x1"
+        if n_fleet:
+            result["fleet"] = n_fleet
         result["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                      time.gmtime())
         if codes.get(-1):
@@ -783,8 +837,8 @@ def bench_serve(args) -> int:
         result["error"] = (result["error"]
                            + f" serve bench failed: {e!r}").strip()[:600]
     finally:
-        if proc is not None:
-            proc.kill()
+        for p_ in ([proc] if proc is not None else []) + fleet_procs:
+            p_.kill()
         shutil.rmtree(tmp, ignore_errors=True)
     return _emit(result)
 
@@ -1614,6 +1668,14 @@ def main(argv=None) -> int:
                         "binary (application/x-znicz-tensor, the "
                         "zero-copy path); stamped into the transcript "
                         "row so trajectories pair like-for-like")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="serve bench: boot N serve backends behind a "
+                        "real `route` process and drive the traffic "
+                        "through the ROUTER — the row stamps "
+                        "sharding='fleetxN' (device-ms summed across "
+                        "backends), so the fabric's forwarding "
+                        "overhead vs the single-process rows is a "
+                        "measured trajectory (docs/fleet.md)")
     p.add_argument("--repeat-fraction", type=float, default=0.0,
                    help="serve bench: fraction [0,1] of requests "
                         "reusing ONE fixed input (the rest are "

@@ -834,6 +834,14 @@ class TestDeadlineDiscipline:
                      rel="znicz_tpu/resilience/mod.py")
         assert rules_of(found) == ["deadline-discipline"]
 
+    def test_fleet_modules_in_scope(self, tmp_path):
+        # the router tier's forward/probe hops are request path too —
+        # an unbounded wait there wedges every backend behind it
+        found = lint(tmp_path, DEADLINE_BAD, [DeadlineDisciplineRule()],
+                     rel="znicz_tpu/fleet/mod.py")
+        assert rules_of(found) == ["deadline-discipline"]
+        assert len(found) == 4
+
     def test_blocking_get_block_true_without_timeout(self, tmp_path):
         found = lint(tmp_path, """
     def loop(q):

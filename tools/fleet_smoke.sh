@@ -1,0 +1,160 @@
+#!/bin/bash
+# Fleet-fabric smoke (ISSUE 14 acceptance, operator-runnable):
+#
+#   1. `python -m znicz_tpu chaos --scenario fleet` — three REAL
+#      `serve` processes behind a REAL `route` process: one backend
+#      SIGKILLed mid-burst then restarted (zero raw 500s, zero hangs,
+#      ejection + re-admission observed, Retry-After on every
+#      refusal), one rolling promote-one-then-fleet walked to
+#      completion (every backend on the new generation, byte-identical
+#      post-roll outputs) and one deliberately regressed candidate
+#      rolled back FLEET-WIDE by the mid-walk burn-rate judgment.
+#
+#   2. a real `python -m znicz_tpu route` process over two `serve`
+#      backends: weighted routing honors a live POST /admin/weight
+#      (weight 0 drains a backend), the binary wire format passes
+#      through byte-compatibly, /healthz aggregates per-backend rows,
+#      /metrics carries the fleet_*{backend=...} families, /statusz
+#      renders the backend table, and SIGTERM exits rc 0.
+#
+# Registered beside tools/chaos_smoke.sh / tools/zoo_smoke.sh.
+#
+# Usage:  bash tools/fleet_smoke.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: chaos --scenario fleet =="
+JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario fleet || exit 1
+
+echo "== phase 2: a real route process over two serve backends =="
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, signal, socket, subprocess, sys, tempfile, time
+import urllib.error, urllib.request
+import numpy as np
+
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_healthz(url, proc, what):
+    for _ in range(240):
+        try:
+            with urllib.request.urlopen(url + "healthz", timeout=2) as r:
+                return json.loads(r.read())
+        except Exception:
+            if proc.poll() is not None:
+                print(f"FAIL {what} exited rc={proc.returncode}")
+                print(proc.stdout.read().decode(errors="replace")[-400:])
+                sys.exit(1)
+            time.sleep(0.25)
+    print(f"FAIL {what} never answered /healthz")
+    sys.exit(1)
+
+
+with tempfile.TemporaryDirectory(prefix="znicz_fleet_smoke_") as tmp:
+    from znicz_tpu.resilience.chaos import _write_demo_znn
+    from znicz_tpu.serving import wire
+
+    model = os.path.join(tmp, "m.znn")
+    _write_demo_znn(model)
+    ports = [free_port(), free_port()]
+    rport = free_port()
+    backends = []
+    for port in ports:
+        backends.append(subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "serve",
+             "--model", model, "--port", str(port),
+             "--max-wait-ms", "1", "--warmup-shape", "4"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    for port, proc in zip(ports, backends):
+        wait_healthz(f"http://127.0.0.1:{port}/", proc, f"backend {port}")
+    router = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "route",
+         "--port", str(rport), "--probe-interval-s", "0.3",
+         "--backend", f"http://127.0.0.1:{ports[0]},name=b0",
+         "--backend", f"http://127.0.0.1:{ports[1]},name=b1,weight=2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{rport}/"
+    health = wait_healthz(url, router, "router")
+    rows = {r["name"]: r for r in health["backends"]}
+    check(set(rows) == {"b0", "b1"}, "healthz aggregates both backends")
+    check(rows["b1"]["weight"] == 2.0, "spec weight honored")
+
+    x = np.asarray([[0.1, -0.2, 0.3, 0.4]], np.float32)
+
+    def post_json():
+        req = urllib.request.Request(
+            url + "predict", json.dumps({"inputs": x.tolist()}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read(), dict(r.headers)
+
+    def post_binary():
+        req = urllib.request.Request(
+            url + "predict", wire.encode_tensor(x),
+            {"Content-Type": wire.CONTENT_TYPE,
+             "Accept": wire.CONTENT_TYPE})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read(), dict(r.headers)
+
+    st, jbody, jh = post_json()
+    check(st == 200, "JSON predict 200 through the router")
+    st, bbody, bh = post_binary()
+    y = wire.decode_tensor(bbody)
+    check(st == 200 and y.shape == (1, 2), "binary pass-through 200, "
+                                           "decoded shape (1, 2)")
+    jy = json.loads(jbody)["outputs"]
+    check(np.allclose(jy, np.asarray(y, np.float64), atol=1e-6),
+          "JSON and binary answers agree through the router")
+
+    # live weight admin: drain b0, all traffic lands on b1
+    req = urllib.request.Request(
+        url + "admin/weight",
+        json.dumps({"backend": "b0", "weight": 0}).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        check(r.status == 200, "POST /admin/weight 200")
+    seen = set()
+    for _ in range(12):
+        _st, _b, h = post_json()
+        seen.add(h.get("X-Fleet-Backend"))
+    check(seen == {"b1"}, f"weight 0 drains b0 (answering: {sorted(seen)})")
+
+    req = urllib.request.Request(url + "metrics",
+                                 headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        text = r.read().decode()
+    for fam in ("fleet_requests_total", "fleet_backend_healthy",
+                "fleet_backend_weight", "fleet_forward_latency_ms",
+                "fleet_backend_ejections_total"):
+        check(fam in text, f"{fam} in the Prometheus scrape")
+    with urllib.request.urlopen(url + "statusz", timeout=10) as r:
+        sz = r.read().decode()
+    check("backends" in sz and "b0" in sz and "b1" in sz,
+          "/statusz renders the backend table")
+
+    router.send_signal(signal.SIGTERM)
+    rc = router.wait(timeout=20)
+    check(rc == 0, f"router SIGTERM exit rc {rc}")
+    for proc in backends:
+        proc.send_signal(signal.SIGTERM)
+    for proc in backends:
+        proc.wait(timeout=20)
+
+print()
+if fails:
+    print(f"fleet smoke: {len(fails)} failure(s)")
+    sys.exit(1)
+print("fleet smoke: all checks passed")
+PY

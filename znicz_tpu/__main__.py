@@ -45,8 +45,24 @@ Examples::
         # section, and slo_burn_rate / slo_budget_remaining /
         # slo_alerts_total join the scrape
         # (docs/observability.md "SLO engine")
+    python -m znicz_tpu route --backend http://127.0.0.1:8101 \
+            --backend http://127.0.0.1:8102 --port 8200
+        # fleet router tier (znicz_tpu.fleet; docs/fleet.md): spread
+        # POST /predict over N independent `serve` backends with
+        # weighted routing (live via POST /admin/weight), per-backend
+        # circuit breakers + ejection/re-admission + failover, the
+        # X-Deadline-Ms/X-Criticality/X-Request-Id wire contract
+        # re-issued per hop (deadline decremented by hop latency),
+        # JSON + binary payload pass-through, and aggregated
+        # /healthz + /metrics (fleet_*{backend=...}) + /statusz
+    python -m znicz_tpu promote --candidates DIR \
+            --url http://127.0.0.1:8200/ --fleet
+        # promote-one-then-fleet over a router: canary ONE backend
+        # (weight-reduced), SLO-watch it, then walk the remaining
+        # backends with weighted traffic splitting and fleet-wide
+        # rollback on a mid-walk burn-rate breach (fleet.rollout)
     python -m znicz_tpu chaos \
-            [--scenario reload|promote|overload|zoo|slo|wire]
+            [--scenario reload|promote|overload|zoo|slo|wire|fleet]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
         # --scenario reload drills corrupt-artifact rollback;
@@ -139,6 +155,11 @@ def main(argv=None) -> int:
         # workflow module) — see znicz_tpu/serving/server.py
         from .serving.server import main as serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "route":
+        # the fleet router tier: spread /predict over N serve
+        # backends — see znicz_tpu/fleet and docs/fleet.md
+        from .fleet.router import main as route_main
+        return route_main(argv[1:])
     if argv and argv[0] == "chaos":
         # fault-injection smoke of the serving stack — see
         # znicz_tpu/resilience/chaos.py and tools/chaos_smoke.sh

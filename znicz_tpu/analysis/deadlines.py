@@ -39,8 +39,11 @@ import ast
 
 from .core import Rule, dotted as _dotted
 
-#: root-relative path prefixes this rule patrols (the request path)
-SCOPE_PREFIXES = ("znicz_tpu/serving/", "znicz_tpu/resilience/")
+#: root-relative path prefixes this rule patrols (the request path —
+#: the fleet router's forward/probe hops are as much a part of it as
+#: the serving front they fan out to)
+SCOPE_PREFIXES = ("znicz_tpu/serving/", "znicz_tpu/resilience/",
+                  "znicz_tpu/fleet/")
 
 
 def _has_timeout_kw(node: ast.Call) -> bool:

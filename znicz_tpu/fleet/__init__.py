@@ -1,0 +1,27 @@
+"""Fleet-scale serving fabric: a router tier over N serve processes.
+
+The cross-process layer of the serving stack (docs/fleet.md):
+
+* :mod:`~znicz_tpu.fleet.router` — ``python -m znicz_tpu route``, a
+  thin frontend spreading ``/predict`` over N independent ``serve``
+  backends with weighted routing, per-backend circuit breakers
+  (ejection/re-admission), transport-failure failover, the PR 10
+  deadline/criticality/request-id headers as the wire contract on
+  every hop, JSON + binary payload pass-through, and aggregated
+  ``/healthz`` · ``/metrics`` (``fleet_*{backend=...}`` families) ·
+  ``/statusz`` surfaces.
+* :mod:`~znicz_tpu.fleet.rollout` — promote-one-then-fleet:
+  :class:`FleetTarget` plugs the fleet into the PR 6 promotion
+  controller (canary ONE backend through verify→canary→SLO-watch,
+  then walk the rest with weighted traffic splitting,
+  generation-skew tolerance, and fleet-wide rollback on a mid-walk
+  burn-rate breach).
+
+This is the modern rebuild of the paper's VELES master–slave topology
+(the Twisted/ZeroMQ master fanning work to slave processes) on
+JAX-era serving primitives.
+"""
+
+from .router import (Backend, BackendDown, FleetRouter,  # noqa: F401
+                     parse_backend_spec)
+from .rollout import FleetTarget, merge_samples  # noqa: F401

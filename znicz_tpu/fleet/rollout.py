@@ -1,0 +1,359 @@
+"""Promote-one-then-fleet: rolling deployment over the router tier.
+
+PR 6's :class:`~znicz_tpu.promotion.controller.PromotionController`
+drives ONE target through verify → export → canary → SLO watch.  This
+module is the fleet-shaped target that plugs into it unchanged:
+
+* :meth:`FleetTarget.reload` canaries the **first** backend only —
+  optionally dropping its router weight first (``canary_weight``), so
+  the candidate generation sees a controlled slice of live traffic
+  (0.0 = a *dark* canary that serves no router traffic during the
+  watch; judgment then happens on the walk).
+* :meth:`FleetTarget.sample` reads the canary backend's ``/metrics``
+  — the controller's SLO watch judges the one backend actually
+  serving the candidate.
+* :meth:`FleetTarget.finalize` is the **fleet walk** the controller
+  calls after a clean watch (the duck-typed hook targets may omit):
+  restore the canary's weight, then roll the remaining backends one
+  at a time — each one's weight is reduced while it swaps and
+  settles (weighted traffic splitting), and after each swap the
+  fleet-aggregated burn rate (PR 12's
+  :class:`~znicz_tpu.promotion.slo.BurnRatePolicy` arithmetic over
+  the SUM of every backend's sample) is re-judged.  A mid-walk breach
+  rolls every already-walked backend — canary included — back to the
+  previous artifact and restores weights: the fleet converges, it
+  never wedges half-rolled.
+
+Generation skew is tolerated by construction: mid-walk the fleet
+serves MIXED generations (each backend answers from its own
+consistent generation — the router holds no response cache, so a new
+generation can never serve a predecessor's bytes), and the post-roll
+invariant is byte-identical outputs across every backend
+(``chaos --scenario fleet`` pins both).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+from ..promotion.controller import HttpTarget
+from ..promotion.slo import BurnRatePolicy, SLOSample
+
+
+def merge_samples(samples) -> SLOSample:
+    """Sum N backends' :class:`SLOSample` s into one fleet sample:
+    cumulative bucket counts, request and 5xx counters all add;
+    ``breaker_state`` keeps the WORST state across the fleet (one
+    open engine breaker is a fleet-level signal)."""
+    rank = {None: 0, "closed": 1, "half_open": 2, "open": 3}
+    latency_cum: dict = {}
+    count = requests = errors = 0.0
+    worst = None
+    for s in samples:
+        for edge, v in s.latency_cum.items():
+            latency_cum[edge] = latency_cum.get(edge, 0.0) + v
+        count += s.latency_count
+        requests += s.requests
+        errors += s.errors_5xx
+        if rank.get(s.breaker_state, 0) > rank.get(worst, 0):
+            worst = s.breaker_state
+    return SLOSample(at=time.time(), latency_cum=latency_cum,
+                     latency_count=count, requests=requests,
+                     errors_5xx=errors, breaker_state=worst)
+
+
+class FleetTarget:
+    """Promotion target spanning N serve backends behind one router.
+
+    Duck-type-compatible with
+    :class:`~znicz_tpu.promotion.controller.HttpTarget` where the
+    controller touches a target (``attach``/``reload``/``sample``)
+    plus the optional ``finalize`` walk hook.  Backends are driven
+    through their own ``/admin/reload`` + ``/metrics`` surfaces; the
+    router is only consulted for traffic weights (``POST
+    /admin/weight``) — ``router_url=None`` degrades to a walk without
+    traffic splitting."""
+
+    def __init__(self, backend_urls, *, router_url: str | None = None,
+                 admin_token: str | None = None, timeout_s: float = 60.0,
+                 canary_weight: float | None = 0.25,
+                 walk_weight: float | None = None,
+                 walk_policy: BurnRatePolicy | None = None,
+                 settle_s: float = 2.0,
+                 probe_interval_s: float = 0.25):
+        if not backend_urls:
+            raise ValueError("a fleet target needs at least one "
+                             "backend url")
+        self.urls = [u if u.endswith("/") else u + "/"
+                     for u in backend_urls]
+        self.router_url = (None if router_url is None else
+                           (router_url if router_url.endswith("/")
+                            else router_url + "/"))
+        self.admin_token = admin_token
+        self.timeout_s = float(timeout_s)
+        #: router-weight multiplier for the canarying backend during
+        #: the controller's watch (None = leave weights alone;
+        #: 0.0 = dark canary — no router traffic until the walk)
+        self.canary_weight = canary_weight
+        #: weight multiplier for each backend while IT swaps and
+        #: settles mid-walk (defaults to canary_weight)
+        self.walk_weight = (walk_weight if walk_weight is not None
+                            else canary_weight)
+        self.walk_policy = (walk_policy if walk_policy is not None
+                            else BurnRatePolicy(
+                                objective="availability", target=0.999,
+                                window_s=60.0, probe_interval_s=0.5,
+                                max_burn_rate=2.0, min_samples=5))
+        self.settle_s = float(settle_s)
+        self.probe_interval_s = float(probe_interval_s)
+        self._targets = [HttpTarget(u, admin_token=admin_token,
+                                    timeout_s=timeout_s)
+                         for u in self.urls]
+        #: router backend name + base weight per backend url, fetched
+        #: lazily from the router's /healthz (None entries: the
+        #: router does not front that url — weights are skipped)
+        self._names: dict | None = None
+        self._status_lock = threading.Lock()
+        self._status = {"state": "idle", "walked": 0,
+                        "fleet_size": len(self.urls),
+                        "last_outcome": None}
+
+    @classmethod
+    def from_router(cls, router_url: str, **kwargs) -> "FleetTarget":
+        """Discover the backend urls from a running router's
+        ``/healthz`` and build a target over them (the
+        ``promote --fleet`` CLI path)."""
+        url = router_url if router_url.endswith("/") else \
+            router_url + "/"
+        with urllib.request.urlopen(url + "healthz",
+                                    timeout=30) as r:
+            health = json.loads(r.read())
+        rows = health.get("backends") or []
+        if not rows:
+            raise ValueError(f"router {router_url} reports no "
+                             f"backends")
+        return cls([row["url"] for row in rows], router_url=url,
+                   **kwargs)
+
+    # -- controller protocol ----------------------------------------------
+    def attach(self, status_fn) -> None:
+        # the controller's status lives in its own process; a REMOTE
+        # router cannot render it (same stance as HttpTarget.attach)
+        pass
+
+    def status(self) -> dict:
+        """The walk's own status (attachable to an in-process
+        router's /healthz via ``router.attach_rollout``)."""
+        with self._status_lock:
+            return dict(self._status)
+
+    def _set_status(self, **fields) -> None:
+        with self._status_lock:
+            self._status.update(fields)
+
+    def reload(self, path: str) -> dict:
+        """Canary stage: swap the FIRST backend only (weight-reduced
+        when the router is known), leaving the rest of the fleet on
+        the old generation."""
+        self._set_status(state="canarying", walked=0,
+                         candidate=path)
+        if self.canary_weight is not None:
+            self._set_weight(0, self.canary_weight)
+        return self._targets[0].reload(path)
+
+    def sample(self):
+        """The controller's watch judges the canary backend — the one
+        process actually serving the candidate generation."""
+        return self._targets[0].sample()
+
+    def conclude(self, outcome: str) -> None:
+        """Controller hook, fired once per concluded attempt WHATEVER
+        the outcome: restore the canary backend's router weight and
+        settle the status.  Without this, any failed outcome —
+        canary_failed, a watch breach (whose rollback re-enters
+        :meth:`reload` and re-applies the reduction), aborted — would
+        leave backend 0 serving at canary weight (0 = fully drained)
+        indefinitely.  Idempotent: the clean-walk path has already
+        restored it."""
+        if self.canary_weight is not None:
+            self._set_weight(0, None)
+        self._set_status(state="idle", last_outcome=outcome,
+                         walking=None)
+
+    def fleet_sample(self) -> SLOSample:
+        """The walk's judgment input: every backend's sample, summed."""
+        return merge_samples(t.sample() for t in self._targets)
+
+    # -- the walk ----------------------------------------------------------
+    def finalize(self, path: str, previous: str | None = None) -> dict:
+        """Walk the remaining backends onto ``path`` after the canary
+        watch passed.  Never raises: any failure rolls the walked
+        prefix (canary included) back to ``previous`` and reports
+        ``{"outcome": "rolled_back" | "rollback_failed", ...}``; a
+        complete walk reports ``{"outcome": "ok", "walked": N}``."""
+        try:
+            return self._finalize(path, previous)
+        except Exception as e:       # belt: an unexpected walk crash
+            #                          must still try to converge.
+            #                          The status tracks walk depth;
+            #                          +1 covers a reload that landed
+            #                          before the crash was recorded
+            depth = min(len(self._targets),
+                        int(self.status().get("walked") or 1) + 1)
+            rolled = self._roll_back(previous, walked=depth)
+            self._set_status(state="idle",
+                             last_outcome="rollback_failed"
+                             if not rolled else "rolled_back")
+            return {"outcome": ("rolled_back" if rolled
+                                else "rollback_failed"),
+                    "error": f"fleet walk crashed: {e!r}"}
+
+    def _start_sample(self) -> SLOSample | None:
+        """The walk's baseline, scrape-tolerantly: a transient
+        /metrics failure on one backend must not read as a fleet
+        incident (the same stance as :meth:`_settle`)."""
+        for _attempt in range(3):
+            try:
+                return self.fleet_sample()
+            except Exception:
+                time.sleep(self.probe_interval_s)
+        return None
+
+    def _finalize(self, path: str, previous: str | None) -> dict:
+        self._set_status(state="walking", walked=1)
+        if self.canary_weight is not None:
+            self._set_weight(0, None)        # canary back to full
+        policy = self.walk_policy
+        start = self._start_sample()
+        if start is None:
+            # the fleet cannot be judged at all: the controller's
+            # unjudgeable-watch stance applies — roll the CANARY back
+            # (the only backend on the candidate; the unwalked rest
+            # still serve the previous generation untouched)
+            rolled = self._roll_back(previous, walked=1)
+            self._set_status(state="idle", walked=0,
+                             last_outcome="rolled_back")
+            return {"outcome": ("rolled_back" if rolled
+                                else "rollback_failed"),
+                    "walked": 1,
+                    "error": "fleet /metrics unreadable at walk "
+                             "start — an unjudgeable candidate must "
+                             "not front steady-state traffic"}
+        walked = 1                           # the canary is live
+        for i in range(1, len(self._targets)):
+            self._set_status(walked=walked,
+                             walking=self.urls[i])
+            if self.walk_weight is not None:
+                self._set_weight(i, self.walk_weight)
+            try:
+                rec = self._targets[i].reload(path)
+            except Exception as e:
+                rec = {"outcome": "reload_raised", "error": repr(e)}
+            if rec.get("outcome") != "ok":
+                rolled = self._roll_back(previous, walked=walked)
+                self._set_weight(i, None)
+                self._set_status(state="idle", walked=0,
+                                 last_outcome="rolled_back")
+                return {"outcome": ("rolled_back" if rolled
+                                    else "rollback_failed"),
+                        "walked": walked,
+                        "error": f"backend {i} reload "
+                                 f"{rec.get('outcome')}: "
+                                 f"{rec.get('error')}"}
+            walked += 1
+            breaches = self._settle(policy, start)
+            self._set_weight(i, None)
+            if breaches:
+                rolled = self._roll_back(previous, walked=walked)
+                self._set_status(state="idle", walked=0,
+                                 last_outcome="rolled_back")
+                return {"outcome": ("rolled_back" if rolled
+                                    else "rollback_failed"),
+                        "walked": walked, "breaches": breaches}
+        self._set_status(state="idle", walked=walked,
+                         last_outcome="ok", walking=None)
+        return {"outcome": "ok", "walked": walked}
+
+    def _settle(self, policy, start) -> list:
+        """Hold ``settle_s`` after one backend swapped, re-judging the
+        fleet-aggregated burn every ``probe_interval_s`` — the
+        mid-walk SLO gate.  Returns the breaches (empty = clean)."""
+        deadline = time.monotonic() + self.settle_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return []
+            time.sleep(min(self.probe_interval_s, remaining))
+            try:
+                breaches = policy.evaluate(start, self.fleet_sample())
+            except Exception:
+                # an unreadable scrape is not a breach; the next tick
+                # (or the next walk step) re-judges
+                continue
+            if breaches:
+                return breaches
+
+    def _roll_back(self, previous: str | None, walked: int) -> bool:
+        """Reload ``previous`` on every backend of the walked prefix
+        (newest-swapped first, canary last).  True when every reload
+        landed ``ok``; False (rollback_failed) when ``previous`` is
+        unknown or any backend refused — the fleet is then mixed and
+        the operator owns the next move (the controller ledgers it)."""
+        if previous is None:
+            return False
+        ok = True
+        for i in range(min(walked, len(self._targets)) - 1, -1, -1):
+            try:
+                rec = self._targets[i].reload(previous)
+                ok = ok and rec.get("outcome") == "ok"
+            except Exception:
+                ok = False
+        return ok
+
+    # -- router weight control --------------------------------------------
+    def _backend_names(self) -> dict:
+        """url -> (router backend name, base weight), fetched once
+        from the router's /healthz; {} without a router."""
+        if self._names is not None:
+            return self._names
+        if self.router_url is None:
+            self._names = {}
+            return self._names
+        try:
+            with urllib.request.urlopen(self.router_url + "healthz",
+                                        timeout=30) as r:
+                health = json.loads(r.read())
+            self._names = {row["url"]: (row["name"], row["weight"])
+                           for row in health.get("backends") or []}
+        except Exception:
+            # do NOT cache the failure: an unreachable router at this
+            # instant must not disable traffic splitting for every
+            # later walk step
+            return {}
+        return self._names
+
+    def _set_weight(self, index: int, multiplier: float | None) -> None:
+        """Scale backend ``index``'s router weight by ``multiplier``
+        of its base (None = restore the base weight).  Best-effort:
+        a router that cannot be reached must not fail the promotion —
+        the walk still converges, just without traffic splitting."""
+        entry = self._backend_names().get(self.urls[index])
+        if entry is None:
+            return
+        name, base = entry
+        weight = base if multiplier is None else base * multiplier
+        body = json.dumps({"backend": name,
+                           "weight": weight}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.admin_token is not None:
+            headers["X-Admin-Token"] = self.admin_token
+        req = urllib.request.Request(
+            self.router_url + "admin/weight", body, headers)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+        except Exception:
+            pass

@@ -1,0 +1,873 @@
+"""Fleet router: one thin frontend spreading /predict over N backends.
+
+Everything before this tier lived in ONE process — the zoo, the
+overload defenses, the SLO engine, the fast wire path all scale a
+single host.  This module is the cross-process tier the "millions of
+users" story needs (ROADMAP "Fleet-scale serving fabric"): a router
+process (``python -m znicz_tpu route``) fronting N independent
+``serve`` processes, the modern rebuild of the paper's VELES
+master–slave topology (a Twisted/ZeroMQ master fanning work to slave
+processes) on plain HTTP/1.1 keep-alive.
+
+Routing (``POST /predict``):
+
+* **Weighted spread** — smooth weighted round-robin (the nginx
+  algorithm: deterministic, no RNG on the request path) over the
+  backends whose circuit breaker admits traffic.  Weights are live
+  (``POST /admin/weight``) — the rolling-promotion walk
+  (:mod:`znicz_tpu.fleet.rollout`) uses them to split traffic toward
+  or away from a canarying backend.
+* **Per-backend circuit breakers** — PR 2/8's breaker + sick-replica
+  ejection lifted to the process boundary: a backend whose forwards
+  fail at the transport level trips its breaker and drops out of
+  rotation (*ejection*); after the cooldown a single half-open probe
+  (live request or the background prober) re-admits it.  A dead
+  backend costs its in-flight requests one failover, not an outage.
+* **Failover** — a transport-level forward failure (connection
+  refused/reset, timeout) retries the SAME request on the next
+  healthy backend while the deadline allows; ``/predict`` is
+  idempotent by contract, so a request half-served by a killed
+  backend re-runs safely.  Only when every candidate is refused does
+  the client see a 503 — always with an honest ``Retry-After``
+  (the 200-or-503 contract, never a hang, never a raw 500).
+* **Wire contract on every hop** — the PR 10 headers travel across
+  the router: ``X-Request-Id`` is accepted/generated here and
+  forwarded, so one id names the flight records in BOTH processes
+  (the router records a ``router.forward`` span per hop);
+  ``X-Deadline-Ms`` is re-issued to the backend as the *remaining*
+  budget — decremented by the observed hop latency — and a request
+  whose budget is already gone answers 504 at this hop instead of
+  burning a backend slot; ``X-Criticality`` / ``X-Model`` forward
+  unchanged (empty/whitespace values read as unset, the same pins the
+  serving front carries).  Bodies pass through as raw bytes — JSON and
+  the PR 13 binary tensor format (``application/x-znicz-tensor``)
+  route identically, the router never parses a payload.
+
+Aggregated surfaces: ``GET /healthz`` (fleet verdict + one row per
+backend: breaker state, weight, generation, last probe), ``GET
+/metrics`` (JSON fleet view; Prometheus text carries the
+``fleet_*{backend=...}`` families — docs/observability.md), ``GET
+/statusz`` (the human one-pager, docs/fleet.md).
+
+Degradation contract (pinned by ``chaos --scenario fleet``): a killed
+backend mid-burst yields zero raw 500s and zero hangs — ejection plus
+failover, with ``Retry-After``'d 503s only for genuinely lost
+capacity.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client as _http_client
+import json
+import threading
+import time
+import urllib.parse
+
+from ..resilience import overload
+from ..resilience.breaker import CircuitBreaker
+from ..serving.server import (DeepBacklogHTTPServer, FastHTTPHandler,
+                              _json_object)
+from ..telemetry import buildinfo, debugz, flightrecorder, tracing
+from ..telemetry.registry import (DEFAULT_LATENCY_BUCKETS_MS,
+                                  PROMETHEUS_CONTENT_TYPE, REGISTRY)
+
+#: routes with their own label value in requests_total/errors_total
+#: (same bounded-cardinality rule as the serving front)
+_ROUTES = ("/predict", "/healthz", "/metrics", "/statusz",
+           "/admin/weight")
+
+_fleet_requests = REGISTRY.counter(
+    "fleet_requests_total",
+    "requests the router forwarded to a backend, by backend name and "
+    "the HTTP status the backend answered (transport failures are not "
+    "counted here — see fleet_failovers_total)")
+_fleet_failovers = REGISTRY.counter(
+    "fleet_failovers_total",
+    "transport-level forward failures (connection refused/reset, "
+    "timeout) per backend — each one either failed over to another "
+    "backend or became a Retry-After'd 503")
+_fleet_forward_hist = REGISTRY.histogram(
+    "fleet_forward_latency_ms",
+    "router→backend hop wall time (connect-or-reuse + backend answer "
+    "+ read), per backend, milliseconds",
+    buckets=DEFAULT_LATENCY_BUCKETS_MS)
+
+
+class BackendDown(Exception):
+    """Transport-level forward failure — the request never got an
+    HTTP answer from this backend (vs. an HTTP error status, which is
+    the backend's answer and passes through)."""
+
+
+class Backend:
+    """One serve process the router fronts.
+
+    Holds the backend's base weight (live-adjustable — the rollout
+    walk splits traffic by writing it), its circuit breaker (the
+    ejection/re-admission state machine), a small keep-alive
+    connection pool, and the most recent ``/healthz`` snapshot the
+    background prober cached."""
+
+    def __init__(self, url: str, *, name: str | None = None,
+                 weight: float = 1.0,
+                 breaker: CircuitBreaker | None = None,
+                 timeout_s: float = 60.0, pool_size: int = 32):
+        if not url.startswith(("http://", "https://")):
+            raise ValueError(f"backend url must be http(s)://, "
+                             f"got {url!r}")
+        self.url = url if url.endswith("/") else url + "/"
+        parts = urllib.parse.urlsplit(self.url)
+        if parts.hostname is None or parts.port is None:
+            raise ValueError(f"backend url needs an explicit "
+                             f"host:port, got {url!r}")
+        self.host = parts.hostname
+        self.port = parts.port
+        self.name = name or f"{self.host}:{self.port}"
+        self.timeout_s = float(timeout_s)
+        self.pool_size = int(pool_size)
+        self.breaker = breaker if breaker is not None else \
+            CircuitBreaker(failure_threshold=3, cooldown_s=2.0)
+        self._lock = threading.Lock()
+        self._weight = float(weight)
+        self._pool: collections.deque = collections.deque()
+        self._health: dict = {}
+        self._health_at: float | None = None    # monotonic stamp
+        #: smooth-WRR accumulator — owned (and locked) by the router's
+        #: pick loop, not by this object
+        self.wrr_current = 0.0
+
+    # -- weight (live-adjustable: the rollout walk writes it) -------------
+    @property
+    def weight(self) -> float:
+        with self._lock:
+            return self._weight
+
+    def set_weight(self, weight: float) -> None:
+        if weight < 0:
+            raise ValueError(f"weight must be >= 0, got {weight}")
+        with self._lock:
+            self._weight = float(weight)
+
+    # -- cached health snapshot (the prober writes it) ---------------------
+    def set_health(self, snapshot: dict) -> None:
+        with self._lock:
+            self._health = dict(snapshot)
+            self._health_at = time.monotonic()
+
+    def health(self) -> tuple[dict, float | None]:
+        """(last /healthz snapshot, age in seconds) — ({}, None) until
+        the first probe lands."""
+        with self._lock:
+            snap = dict(self._health)
+            at = self._health_at
+        age = None if at is None else time.monotonic() - at
+        return snap, age
+
+    # -- the wire ----------------------------------------------------------
+    def _acquire(self) -> tuple:
+        """(connection, came_from_pool)."""
+        with self._lock:
+            if self._pool:
+                return self._pool.pop(), True
+        return self._new_conn(), False
+
+    def _new_conn(self):
+        return _http_client.HTTPConnection(self.host, self.port,
+                                           timeout=self.timeout_s)
+
+    def _release(self, conn, reusable: bool) -> None:
+        if reusable:
+            with self._lock:
+                if len(self._pool) < self.pool_size:
+                    self._pool.append(conn)
+                    return
+        conn.close()
+
+    def _exchange(self, conn, method: str, path: str,
+                  body: bytes | None,
+                  headers: dict) -> tuple[int, bytes, dict]:
+        conn.request(method, path, body, headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        self._release(conn, not resp.will_close)
+        return resp.status, data, dict(resp.getheaders())
+
+    def forward(self, method: str, path: str, body: bytes | None,
+                headers: dict) -> tuple[int, bytes, dict]:
+        """One HTTP exchange over a pooled keep-alive connection.
+        Returns ``(status, body, response headers)``; raises
+        :class:`BackendDown` on a transport-level failure (the
+        connection is dropped, never returned to the pool).  A
+        failure on a POOLED connection gets ONE fresh-connection
+        retry first: an idle keep-alive socket the backend timed out
+        is a staleness artifact of this pool, not evidence the
+        backend is down — without the retry it would count toward
+        ejecting a healthy backend."""
+        conn, pooled = self._acquire()
+        try:
+            return self._exchange(conn, method, path, body, headers)
+        except (OSError, _http_client.HTTPException) as e:
+            conn.close()
+            if not pooled:
+                raise BackendDown(f"backend {self.name}: "
+                                  f"{type(e).__name__}: {e}") from e
+        conn = self._new_conn()
+        try:
+            return self._exchange(conn, method, path, body, headers)
+        except (OSError, _http_client.HTTPException) as e:
+            conn.close()
+            raise BackendDown(f"backend {self.name}: "
+                              f"{type(e).__name__}: {e}") from e
+
+    def close(self) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, collections.deque()
+        for conn in pool:
+            conn.close()
+
+    def metrics(self) -> dict:
+        snap, age = self.health()
+        return {"name": self.name, "url": self.url,
+                "weight": self.weight,
+                "breaker": self.breaker.metrics(),
+                "generation": snap.get("model_generation"),
+                "backend_rev": snap.get("rev"),
+                "backend_status": snap.get("status"),
+                "probe_age_s": (round(age, 1)
+                                if age is not None else None)}
+
+
+def parse_backend_spec(spec: str) -> tuple[str, dict]:
+    """``URL[,weight=W][,name=N]`` → (url, options) for the route CLI
+    (same comma-option grammar as the serve CLI's --model specs)."""
+    parts = spec.split(",")
+    url = parts[0].strip()
+    if not url:
+        raise ValueError(f"empty backend url in spec {spec!r}")
+    opts: dict = {}
+    for part in parts[1:]:
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        key = key.strip()
+        if not sep or key not in ("weight", "name"):
+            raise ValueError(
+                f"bad backend option {part!r} in {spec!r} "
+                f"(expected weight=W or name=N)")
+        if key == "weight":
+            try:
+                opts["weight"] = float(value)
+            except ValueError:
+                raise ValueError(f"weight must be a number, "
+                                 f"got {value!r}") from None
+            if opts["weight"] < 0:
+                raise ValueError(f"weight must be >= 0, got {value}")
+        else:
+            opts["name"] = value.strip()
+    return url, opts
+
+
+class FleetRouter:
+    """The router process: N :class:`Backend` s behind one HTTP front
+    (start()/stop()/url — the same lifecycle shape as
+    :class:`~znicz_tpu.serving.server.ServingServer`)."""
+
+    def __init__(self, backends, *, host: str = "127.0.0.1",
+                 port: int = 0, default_deadline_ms: float | None = None,
+                 probe_interval_s: float = 2.0,
+                 admin_token: str | None = None,
+                 max_body_mb: float = 64.0, max_hops: int = 2):
+        if not backends:
+            raise ValueError("a router needs at least one backend")
+        names = [b.name for b in backends]
+        if len(set(names)) != len(names):
+            raise ValueError(f"backend names must be unique, "
+                             f"got {names}")
+        self.backends: list[Backend] = list(backends)
+        self.by_name = {b.name: b for b in self.backends}
+        self.default_deadline_ms = default_deadline_ms
+        self.probe_interval_s = float(probe_interval_s)
+        self.admin_token = admin_token
+        self.max_body = int(max_body_mb * 1e6)
+        #: transport-failure failover bound: how many DISTINCT
+        #: backends one request may try (>= 1; the deadline can stop
+        #: the loop earlier)
+        self.max_hops = max(1, int(max_hops))
+        self.rev = buildinfo.cached_rev()
+        self._wrr_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._stopped = False
+        self._requests = REGISTRY.counter(
+            "requests_total",
+            "HTTP requests answered, by route and status code")
+        self._errors = REGISTRY.counter(
+            "errors_total",
+            "HTTP responses with status >= 400, by route and status "
+            "code")
+        #: optional status() of an in-process rollout driver
+        #: (fleet.rollout.FleetTarget) — surfaced on /healthz, the
+        #: same attach idiom as ServingServer.attach_promotion
+        self.rollout_status = None
+        outer = self
+
+        class Handler(FastHTTPHandler):
+
+            def _route(self) -> str:
+                path = self.path
+                if path in _ROUTES:
+                    return path
+                path = path.split("?")[0].rstrip("/")
+                return path if path in _ROUTES else "other"
+
+            def _send(self, code: int, body: bytes, ctype: str,
+                      headers: dict | None = None):
+                self._status_code = code
+                route = self._route()
+                outer._requests.inc(route=route, code=str(code))
+                if code >= 400:
+                    outer._errors.inc(route=route, code=str(code))
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                rid = tracing.current_request_id()
+                if rid is not None:
+                    self.send_header("X-Request-Id", rid)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                if self.close_connection:
+                    self.send_header("Connection", "close")
+                if self.request_version != "HTTP/0.9":
+                    self._headers_buffer.append(b"\r\n")
+                    self._headers_buffer.append(body)
+                    self.flush_headers()
+                else:
+                    self.wfile.write(body)
+
+            def _reply(self, code: int, obj: dict,
+                       headers: dict | None = None):
+                self._send(code, json.dumps(obj, default=float).encode(),
+                           "application/json", headers)
+
+            def _read_body(self) -> bytes | None:
+                """Content-Length-bounded body read — the same
+                keep-alive framing pins as the serving front (501 on
+                Transfer-Encoding, 400 on junk lengths, 413 over the
+                bound; every early reply closes the connection so
+                unread bytes can't desync the next request)."""
+                if self.headers.get("Transfer-Encoding"):
+                    self.close_connection = True
+                    self._reply(501, {
+                        "error": "Transfer-Encoding is not supported; "
+                                 "send a Content-Length body"})
+                    return None
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                except (TypeError, ValueError):
+                    self.close_connection = True
+                    self._reply(400, {"error": "bad request: junk "
+                                               "Content-Length"})
+                    return None
+                if n < 0:
+                    self.close_connection = True
+                    self._reply(400, {"error": "bad request: negative "
+                                               "Content-Length"})
+                    return None
+                if n > outer.max_body:
+                    self.close_connection = True
+                    self._reply(413, {
+                        "error": f"body of {n} bytes exceeds the "
+                                 f"{outer.max_body}-byte limit"})
+                    return None
+                return self.rfile.read(n) if n > 0 else b""
+
+            def _admin_authorized(self) -> bool:
+                if outer.admin_token is None:
+                    return True
+                import hmac
+                supplied = self.headers.get("X-Admin-Token", "")
+                return hmac.compare_digest(
+                    supplied.encode("latin-1", "replace"),
+                    outer.admin_token.encode("utf-8"))
+
+            def do_GET(self):
+                if self.headers.get("Content-Length") \
+                        or self.headers.get("Transfer-Encoding"):
+                    self.close_connection = True
+                path = self.path.split("?")[0].rstrip("/")
+                if path == "/healthz":
+                    self._reply(200, outer.health())
+                elif path == "/statusz":
+                    self._send(200,
+                               debugz.fleet_statusz_text(outer).encode(),
+                               "text/plain; charset=utf-8")
+                elif path == "/metrics":
+                    query = (self.path.split("?", 1)[1]
+                             if "?" in self.path else "")
+                    accept = self.headers.get("Accept", "")
+                    want_text = ("format=prometheus" in query
+                                 or ("text/plain" in accept
+                                     and "format=json" not in query))
+                    if want_text:
+                        self._send(200,
+                                   REGISTRY.render_prometheus().encode(),
+                                   PROMETHEUS_CONTENT_TYPE)
+                    else:
+                        self._reply(200, outer.metrics())
+                else:
+                    self._reply(404, {"error": f"no route {self.path!r}"})
+
+            def do_POST(self):
+                route = self.path.split("?")[0].rstrip("/")
+                if route == "/admin/weight":
+                    self._admin_weight()
+                    return
+                if route != "/predict":
+                    self.close_connection = True   # body left unread
+                    self._reply(404, {"error": f"no route {self.path!r}"})
+                    return
+                rid = tracing.accept_request_id(
+                    self.headers.get("X-Request-Id"))
+                t0 = time.monotonic()
+                self._status_code = None
+                self._rec_error = None
+                self._rec_backend = None
+                with tracing.collect(rid) as collected:
+                    with tracing.request(rid):
+                        with tracing.span("router.predict"):
+                            self._predict(t0)
+                dt_ms = (time.monotonic() - t0) * 1e3
+                code = self._status_code or 500
+                spans = [s.to_dict() for s in collected
+                         if s._t0 >= t0]
+                flightrecorder.RECORDER.record(
+                    "request", duration_ms=dt_ms,
+                    outcome="ok" if code < 400 else "error",
+                    error=self._rec_error, request_id=rid, code=code,
+                    backend=self._rec_backend,
+                    stages=flightrecorder.stage_breakdown(spans),
+                    spans=spans)
+
+            def _admin_weight(self):
+                """``POST /admin/weight`` — live traffic-split
+                control: ``{"backend": name, "weight": W}``.  The
+                rolling-promotion walk drives this to shift traffic
+                toward/away from a canarying backend; token-gated
+                exactly like the serving front's /admin/reload."""
+                if not self._admin_authorized():
+                    self.close_connection = True
+                    self._reply(403, {
+                        "error": "admin token required (supply "
+                                 "X-Admin-Token)"})
+                    return
+                raw = self._read_body()
+                if raw is None:
+                    return
+                try:
+                    payload = _json_object(raw)
+                    name = payload["backend"]
+                    weight = float(payload["weight"])
+                except Exception as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                backend = outer.by_name.get(name)
+                if backend is None:
+                    self._reply(404, {
+                        "error": f"no backend named {name!r} "
+                                 f"(backends: "
+                                 f"{sorted(outer.by_name)})"})
+                    return
+                try:
+                    backend.set_weight(weight)
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                self._reply(200, {"backend": name, "weight": weight})
+
+            def _predict(self, t0: float):
+                raw = self._read_body()
+                if raw is None:
+                    return
+                try:
+                    # the hop's header policy, re-pinned here: empty/
+                    # whitespace values read as UNSET (a header-
+                    # clearing proxy must not turn every request into
+                    # a 400/404), junk values are the client's 400
+                    model = self.headers.get("X-Model")
+                    if model is not None:
+                        model = model.strip() or None
+                    crit = self.headers.get("X-Criticality")
+                    if crit is not None:
+                        crit = crit.strip().lower() or None
+                        if crit is not None \
+                                and crit not in overload.CRITICALITIES:
+                            raise ValueError(
+                                f"X-Criticality {crit!r}; expected "
+                                f"one of {overload.CRITICALITIES}")
+                    dl_raw = self.headers.get("X-Deadline-Ms")
+                    if dl_raw is not None:
+                        dl_raw = dl_raw.strip() or None
+                    deadline_ms = (float(dl_raw) if dl_raw is not None
+                                   else None)
+                except Exception as e:
+                    self._rec_error = f"bad request: {e}"
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                if deadline_ms is None:
+                    deadline_ms = outer.default_deadline_ms
+                deadline = overload.Deadline.from_ms(
+                    deadline_ms, crit or "default")
+                fwd = {"Content-Type":
+                       (self.headers.get("Content-Type")
+                        or "application/json"),
+                       "X-Request-Id":
+                       tracing.current_request_id() or ""}
+                accept = self.headers.get("Accept")
+                if accept:
+                    fwd["Accept"] = accept
+                if model is not None:
+                    fwd["X-Model"] = model
+                if crit is not None:
+                    fwd["X-Criticality"] = crit
+                tried: set = set()
+                last_err: str | None = None
+                while len(tried) < outer.max_hops:
+                    if deadline.at is not None \
+                            and deadline.remaining_ms() <= 0.0:
+                        # the budget died in (or before) the router —
+                        # forwarding would burn a backend slot on an
+                        # answer nobody is waiting for
+                        overload.note_deadline("router")
+                        self._rec_error = "deadline exceeded at router"
+                        self._reply(504, {
+                            "error": "deadline exceeded at the "
+                                     "router hop"})
+                        return
+                    backend = outer.pick(exclude=tried)
+                    if backend is None:
+                        break
+                    if deadline.at is not None:
+                        # re-issue the REMAINING budget to the
+                        # backend: the hop's own latency (and any
+                        # earlier failed hop) is already spent
+                        fwd["X-Deadline-Ms"] = (
+                            f"{max(0.0, deadline.remaining_ms()):.1f}")
+                    t_f = time.monotonic()
+                    try:
+                        with tracing.span("router.forward",
+                                          backend=backend.name):
+                            status, data, rheaders = backend.forward(
+                                "POST", "/predict", raw, fwd)
+                    except BackendDown as e:
+                        backend.breaker.record_failure()
+                        _fleet_failovers.inc(backend=backend.name)
+                        tried.add(backend.name)
+                        last_err = str(e)
+                        continue
+                    dt = (time.monotonic() - t_f) * 1e3
+                    _fleet_forward_hist.observe(dt,
+                                                backend=backend.name)
+                    backend.breaker.record_success()
+                    _fleet_requests.inc(backend=backend.name,
+                                        code=str(status))
+                    self._rec_backend = backend.name
+                    if status >= 500:
+                        self._rec_error = (f"backend {backend.name} "
+                                           f"answered {status}")
+                    out = {"X-Fleet-Backend": backend.name}
+                    ra = rheaders.get("Retry-After")
+                    if ra is not None:
+                        out["Retry-After"] = ra
+                    self._send(status, data,
+                               rheaders.get("Content-Type",
+                                            "application/json"), out)
+                    return
+                # lost capacity: every candidate is ejected, cooling
+                # down, or just failed under us — an honest refusal,
+                # never a hang and never a raw 500
+                ra = outer.retry_after()
+                msg = ("no healthy backend available"
+                       + (f" (last error: {last_err})" if last_err
+                          else ""))
+                self._rec_error = msg
+                self._reply(503, {"error": msg, "retry_after_s": ra},
+                            {"Retry-After": str(ra)})
+
+        self.server = DeepBacklogHTTPServer((host, port), Handler)
+        REGISTRY.register_collector(self._collect_fleet)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True,
+                                        name="znicz-fleet-router")
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        daemon=True,
+                                        name="znicz-fleet-prober")
+
+    # -- routing ----------------------------------------------------------
+    def pick(self, exclude=()) -> Backend | None:
+        """The next backend by smooth weighted round-robin over the
+        candidates whose breaker admits traffic (deterministic — no
+        RNG on the request path).  ``exclude`` holds names this
+        request already failed on.  Consumes one breaker
+        ``allow()`` per considered candidate; the chosen backend's
+        outcome MUST be recorded (the forward loop does)."""
+        with self._wrr_lock:
+            cands = [(b, b.weight) for b in self.backends
+                     if b.name not in exclude]
+            weighted = [(b, w) for b, w in cands if w > 0]
+            if not weighted:
+                # every candidate is weighted out (a mid-walk dark
+                # canary fleet-wide would be operator error): fall
+                # back to equal weights rather than refusing traffic
+                weighted = [(b, 1.0) for b, _w in cands]
+            total = sum(w for _b, w in weighted)
+            for b, w in weighted:
+                b.wrr_current += w
+            ranked = sorted(weighted, key=lambda bw: -bw[0].wrr_current)
+            if ranked:
+                ranked[0][0].wrr_current -= total
+        for b, _w in ranked:
+            if b.breaker.allow():
+                return b
+        return None
+
+    def retry_after(self) -> int:
+        """Honest come-back time when no backend can take the
+        request: the soonest any breaker could admit a probe,
+        bounded [1, 30] seconds."""
+        soonest = min((b.breaker.retry_after() for b in self.backends),
+                      default=1.0)
+        return max(1, min(30, int(soonest) + (0 if soonest ==
+                                              int(soonest) else 1)))
+
+    # -- background prober -------------------------------------------------
+    def _probe_loop(self) -> None:
+        """Probe each backend's /healthz on a fixed cadence: keeps the
+        aggregated /healthz fresh and gives an ejected backend a
+        re-admission path even when no live request is willing to be
+        its half-open probe."""
+        while not self._stop_event.wait(self.probe_interval_s):
+            for b in self.backends:
+                if self._stop_event.is_set():
+                    return
+                self.probe_backend(b)
+
+    def probe_backend(self, backend: Backend) -> bool:
+        """One /healthz probe, feeding the breaker (success closes a
+        half-open circuit — re-admission; failure trips/keeps it
+        open).  Respects the breaker's own probe pacing: an open
+        circuit inside its cooldown is not hammered."""
+        if not backend.breaker.allow():
+            return False
+        try:
+            status, data, _h = backend.forward("GET", "/healthz", None,
+                                               {})
+            snap = json.loads(data)
+            if status != 200 or not isinstance(snap, dict):
+                raise BackendDown(f"healthz answered {status}")
+        except (BackendDown, ValueError) as e:
+            backend.breaker.record_failure()
+            backend.set_health({"status": "unreachable",
+                                "error": str(e)[:200]})
+            return False
+        backend.breaker.record_success()
+        backend.set_health(snap)
+        return True
+
+    # -- aggregated surfaces ----------------------------------------------
+    def attach_rollout(self, status_fn) -> None:
+        """Surface a rollout driver's ``status()`` on ``/healthz`` —
+        the same idiom as ``ServingServer.attach_promotion``."""
+        self.rollout_status = status_fn
+
+    def backend_rows(self) -> list[dict]:
+        return [b.metrics() for b in self.backends]
+
+    def health(self) -> dict:
+        rows = self.backend_rows()
+        healthy = sum(1 for b in self.backends
+                      if b.breaker.state != "open")
+        status = ("ok" if healthy == len(self.backends)
+                  else "degraded" if healthy else "unhealthy")
+        out = {"status": status, "role": "router",
+               "backends": rows,
+               "healthy_backends": healthy,
+               "backend_count": len(self.backends),
+               "rev": self.rev,
+               "uptime_s": round(debugz.process_uptime_s(), 1)}
+        rs = self.rollout_status
+        if rs is not None:
+            try:
+                out["rollout"] = rs()
+            except Exception:
+                out["rollout"] = {"state": "unknown"}
+        if status != "ok":
+            out["retry_after_s"] = self.retry_after()
+        return out
+
+    def metrics(self) -> dict:
+        return {"role": "router", "rev": self.rev,
+                "backends": self.backend_rows(),
+                "requests": {
+                    "requests_total": int(self._requests.total()),
+                    "errors_total": int(self._errors.total()),
+                    "requests_by_route_code": self._requests.as_dict(),
+                    "errors_by_route_code": self._errors.as_dict()},
+                "fleet_requests_by_backend_code":
+                    _fleet_requests.as_dict(),
+                "failovers_by_backend": _fleet_failovers.as_dict()}
+
+    def _collect_fleet(self):
+        """Registry collector: the per-backend gauge families
+        (healthy/weight/generation) plus the breaker-trip counter,
+        sampled at scrape time — the ``fleet_*{backend=...}``
+        inventory in docs/observability.md."""
+        healthy, weights, gens, trips = [], [], [], []
+        for b in self.backends:
+            labels = {"backend": b.name}
+            healthy.append((labels,
+                            0.0 if b.breaker.state == "open" else 1.0))
+            weights.append((labels, float(b.weight)))
+            snap, _age = b.health()
+            gen = snap.get("model_generation")
+            if gen is not None:
+                gens.append((labels, float(gen)))
+            trips.append((labels,
+                          float(b.breaker.metrics().get("trips", 0))))
+        fams = [
+            ("gauge", "fleet_backend_healthy",
+             "whether the router considers this backend routable "
+             "(1) or ejected by its circuit breaker (0)", healthy),
+            ("gauge", "fleet_backend_weight",
+             "live routing weight per backend (the rolling-promotion "
+             "walk shifts these to split traffic)", weights),
+            ("counter", "fleet_backend_ejections_total",
+             "circuit-breaker trips per backend at the router tier "
+             "(closed/half_open -> open transitions)", trips)]
+        if gens:
+            fams.append((
+                "gauge", "fleet_backend_generation",
+                "serving generation per backend from its last "
+                "/healthz probe — mixed values mid-roll are the "
+                "generation skew the walk tolerates", gens))
+        return fams
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        self._thread.start()
+        self._prober.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        self._stop_event.set()
+        REGISTRY.unregister_collector(self._collect_fleet)
+        self.server.shutdown()
+        self.server.server_close()
+        self._prober.join(5.0)
+        for b in self.backends:
+            b.close()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}/"
+
+
+def main(argv=None) -> int:
+    """CLI entry for ``python -m znicz_tpu route``."""
+    import argparse
+    import os
+    import signal
+
+    p = argparse.ArgumentParser(
+        prog="znicz_tpu route",
+        description="fleet router: spread /predict over N serve "
+                    "backends with weighted routing, per-backend "
+                    "circuit breakers and failover (docs/fleet.md)")
+    p.add_argument("--backend", action="append", metavar="SPEC",
+                   required=True,
+                   help="one serve backend: URL[,weight=W][,name=N] — "
+                        "repeatable (e.g. "
+                        "http://127.0.0.1:8101,weight=2,name=b0)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--probe-interval-s", type=float, default=2.0,
+                   help="background /healthz probe cadence per "
+                        "backend (keeps the aggregated /healthz fresh "
+                        "and re-admits recovered backends)")
+    p.add_argument("--default-deadline-ms", type=float, default=None,
+                   help="end-to-end deadline attached to requests "
+                        "that send no X-Deadline-Ms (forwarded to the "
+                        "backend as the remaining budget)")
+    p.add_argument("--forward-timeout-s", type=float, default=60.0,
+                   help="per-hop socket timeout for backend forwards")
+    p.add_argument("--max-hops", type=int, default=2,
+                   help="distinct backends one request may try when "
+                        "transport-level forwards fail (failover "
+                        "bound)")
+    p.add_argument("--breaker-threshold", type=int, default=3,
+                   help="consecutive transport failures before a "
+                        "backend is ejected from rotation")
+    p.add_argument("--breaker-cooldown-s", type=float, default=2.0,
+                   help="seconds an ejected backend stays out before "
+                        "a half-open probe may re-admit it")
+    p.add_argument("--max-body-mb", type=float, default=64.0)
+    p.add_argument("--admin-token", default=None,
+                   help="require this token (X-Admin-Token) on "
+                        "POST /admin/weight; defaults to "
+                        "$ZNICZ_ADMIN_TOKEN")
+    args = p.parse_args(argv)
+    token = args.admin_token if args.admin_token is not None \
+        else os.environ.get("ZNICZ_ADMIN_TOKEN") or None
+    backends = []
+    for i, spec in enumerate(args.backend):
+        try:
+            url, opts = parse_backend_spec(spec)
+            backends.append(Backend(
+                url, name=opts.get("name", f"b{i}"),
+                weight=opts.get("weight", 1.0),
+                timeout_s=args.forward_timeout_s,
+                breaker=CircuitBreaker(
+                    failure_threshold=args.breaker_threshold,
+                    cooldown_s=args.breaker_cooldown_s)))
+        except ValueError as e:
+            p.error(str(e))
+    router = None
+    try:
+        router = FleetRouter(
+            backends, host=args.host, port=args.port,
+            default_deadline_ms=args.default_deadline_ms,
+            probe_interval_s=args.probe_interval_s,
+            admin_token=token, max_body_mb=args.max_body_mb,
+            max_hops=args.max_hops)
+        router.start()
+        print(f"routing {len(backends)} backend(s) "
+              f"{[b.name for b in backends]} at {router.url} "
+              f"(POST /predict, GET /healthz, GET /metrics, "
+              f"GET /statusz, POST /admin/weight)", flush=True)
+        stop = threading.Event()
+
+        def _arm():
+            signal.signal(signal.SIGINT, lambda *_: stop.set())
+            signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        _arm()
+        while not stop.is_set():
+            # short ticks so signal handlers run promptly even if a
+            # native lib clobbers the process sigaction — the same
+            # idiom (and reason) as the serve CLI's loop
+            stop.wait(0.5)
+            _arm()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if router is not None:
+            router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

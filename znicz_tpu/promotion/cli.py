@@ -33,7 +33,26 @@ def main(argv=None) -> int:
                         "files into")
     p.add_argument("--url", required=True,
                    help="base URL of the serving replica to drive "
-                        "(e.g. http://127.0.0.1:8100/)")
+                        "(e.g. http://127.0.0.1:8100/); with --fleet, "
+                        "the ROUTER whose backends are walked")
+    p.add_argument("--fleet", action="store_true",
+                   help="promote-one-then-fleet: --url names a fleet "
+                        "router (python -m znicz_tpu route) — its "
+                        "backends are discovered from /healthz, ONE "
+                        "is canaried (weight-reduced) and watched, "
+                        "then the rest are walked with weighted "
+                        "traffic splitting and fleet-wide rollback "
+                        "on a mid-walk burn-rate breach "
+                        "(docs/fleet.md)")
+    p.add_argument("--canary-weight", type=float, default=0.25,
+                   help="fleet mode: the canary backend's router "
+                        "weight multiplier during the watch (0 = "
+                        "dark canary — no router traffic until the "
+                        "walk; judgment then happens mid-walk)")
+    p.add_argument("--walk-settle-s", type=float, default=2.0,
+                   help="fleet mode: how long each walked backend "
+                        "settles under fleet-aggregated burn-rate "
+                        "judgment before the next one rolls")
     p.add_argument("--admin-token", default=None,
                    help="X-Admin-Token for POST /admin/reload "
                         "(defaults to $ZNICZ_ADMIN_TOKEN)")
@@ -85,9 +104,21 @@ def main(argv=None) -> int:
         max_error_rate=(args.max_error_rate
                         if args.max_error_rate >= 0 else None),
         min_samples=args.min_samples)
+    if args.fleet:
+        from ..fleet.rollout import FleetTarget
+        try:
+            target = FleetTarget.from_router(
+                args.url, admin_token=token,
+                canary_weight=args.canary_weight,
+                settle_s=args.walk_settle_s)
+        except Exception as e:
+            p.error(f"--fleet could not discover backends from "
+                    f"{args.url}: {e}")
+    else:
+        target = HttpTarget(args.url, admin_token=token)
     controller = PromotionController(
         DirectorySource(args.candidates),
-        HttpTarget(args.url, admin_token=token),
+        target,
         deploy_dir=deploy, policy=policy, ledger=args.ledger,
         poll_interval_s=args.poll_interval_s,
         max_consecutive_failures=args.max_failures)

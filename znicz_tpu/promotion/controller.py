@@ -8,9 +8,14 @@ live serving fleet, autonomously:
 
     idle ──poll──▶ verifying ──▶ exporting ──▶ canarying ──▶ watching
                       │              │             │            │
-                      ▼              ▼             ▼            ├─ clean ──▶ promoted → idle
+                      ▼              ▼             ▼            ├─ clean ──▶ [walking]* ──▶ promoted → idle
                 verify_failed  export_failed  canary_failed     └─ breach ─▶ rolled_back
                       └──────────────┴─────────────┴──── failure streak ──▶ crash_loop (fail-fast)
+
+    * fleet targets only: a target with a ``finalize`` hook
+      (``znicz_tpu.fleet.rollout.FleetTarget``) walks its remaining
+      backends after the clean watch — a mid-walk breach rolls the
+      whole fleet back (docs/fleet.md "Rolling promotion")
 
 Every stage reuses a prior PR's machinery instead of re-implementing
 it: candidates are durability-verified (PR 5) before export, the
@@ -360,7 +365,49 @@ class PromotionController:
         if breaches:
             extra["breaches"] = breaches
             return self._rollback(candidate, breaches, extra)
+        walked = self._walk_fleet(candidate, deployed, extra)
+        if walked is not None:
+            return walked
         return PROMOTED, None, extra
+
+    def _walk_fleet(self, candidate, deployed: str, extra: dict):
+        """The promote-one-then-fleet hook: a target exposing
+        ``finalize(path, previous=)`` (``znicz_tpu.fleet.rollout.
+        FleetTarget``) walks the REST of its fleet after the canary
+        watch passed — weighted traffic splitting, mid-walk SLO
+        judgment, fleet-wide rollback on breach all live in the
+        target; the controller only ledgers the verdict.  Returns
+        None on a clean walk (single-target EngineTarget/HttpTarget
+        have no ``finalize`` — the hook is a no-op for them) or the
+        ``(outcome, reason, extra)`` tuple of a failed one."""
+        fin = getattr(self.target, "finalize", None)
+        if fin is None:
+            return None
+        self._set_state("walking", candidate)
+        with self._lock:
+            prev = self._previous
+        try:
+            walk = fin(deployed, previous=prev)
+        except Exception as e:
+            # finalize's contract is "never raise" (it rolls back
+            # internally); a crash here means the fleet may be mixed
+            walk = {"outcome": "rollback_failed",
+                    "error": f"fleet walk raised: {e!r}"}
+        extra["walk"] = walk
+        if walk.get("outcome") == "ok":
+            return None
+        for b in walk.get("breaches") or []:
+            count_breach(b)
+        self.ledger.append("fleet_rollback", candidate=candidate.name,
+                           to=prev,
+                           walked=walk.get("walked"),
+                           breaches=walk.get("breaches"),
+                           error=walk.get("error"))
+        why = walk.get("error") or (f"mid-walk SLO breach: "
+                                    f"{walk.get('breaches')}")
+        if walk.get("outcome") == "rolled_back":
+            return ROLLED_BACK, why, extra
+        return ROLLBACK_FAILED, why, extra
 
     def _export(self, candidate, seq: int) -> str:
         """The export step: materialize the candidate's raw bytes and
@@ -442,6 +489,16 @@ class PromotionController:
     def _conclude(self, candidate, outcome: str, reason, extra):
         """Bookkeeping shared by every outcome: metrics, ledger,
         streak accounting, crash-loop fail-fast."""
+        done = getattr(self.target, "conclude", None)
+        if done is not None:
+            # duck-typed fleet hook, fired WHATEVER the outcome: a
+            # FleetTarget restores the canary's traffic weight here —
+            # a failed canary/watch must not leave its backend
+            # drained at canary weight (single targets have no hook)
+            try:
+                done(outcome)
+            except Exception:
+                log.exception("target conclude hook failed")
         _promotions.inc(outcome=outcome)
         self.ledger.append("outcome", outcome=outcome,
                            candidate=candidate.name, reason=reason,

@@ -1246,6 +1246,363 @@ def _slo_scenario(args) -> int:
     return 1 if bad else 0
 
 
+def _write_poison_znn(path: str, fin: int = 4, hidden: int = 3,
+                      classes: int = 2) -> None:
+    """A deliberately regressed candidate that the engine's
+    zeros-batch reload canary CANNOT catch: the saturated first layer
+    maps an all-zeros canary batch to zeros (finite logits), while any
+    real input whose elements sum away from zero saturates tanh to
+    ±1 and the ±3e38 second-layer weights overflow the logit
+    accumulation to inf − inf = NaN — the serving front answers those
+    as 500s, which is exactly the live-traffic-only regression the
+    fleet walk's burn-rate judgment must roll back."""
+    from ..export import ACT, KIND, _commit_znn, _pack_layer, \
+        _write_header
+    w1 = np.full((fin, hidden), 100.0, np.float32)
+    b1 = np.zeros(hidden, np.float32)
+    w2 = np.stack([np.full(hidden, 3e38, np.float32),
+                   np.full(hidden, -3e38, np.float32)] * (classes // 2),
+                  axis=1)
+    with open(path + ".tmp", "wb") as fh:
+        _write_header(fh, 3)
+        _pack_layer(fh, KIND["fc"], ACT["tanh"], [fin, hidden], w1, b1)
+        _pack_layer(fh, KIND["fc"], ACT["linear"], [hidden, classes],
+                    w2)
+        _pack_layer(fh, KIND["softmax"], 0, [])
+    _commit_znn(path)
+
+
+def _fleet_scenario(args) -> int:
+    """``--scenario fleet`` — the fleet-fabric acceptance
+    (docs/fleet.md): three REAL ``serve`` processes behind a REAL
+    ``route`` process; one backend SIGKILLed mid-burst (zero raw
+    500s, zero hangs — ejection + failover, Retry-After'd 503s only
+    for lost capacity) then restarted (re-admission observed); one
+    rolling promotion walked to completion (every backend on the new
+    generation, byte-identical post-roll outputs) and one
+    deliberately regressed candidate rolled back FLEET-WIDE by the
+    mid-walk burn-rate judgment before the walk completes."""
+    import collections
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import threading
+
+    from ..fleet.rollout import FleetTarget
+    from ..promotion import (DirectorySource, PromotionController,
+                             SLOPolicy)
+    from ..promotion.slo import BurnRatePolicy
+    from ..serving import wire as wire_mod
+
+    bad: list[str] = []
+    x = [[0.1, -0.2, 0.3, 0.4]]
+    n_backends = 3
+    tmp = tempfile.mkdtemp(prefix="znicz_chaos_fleet_")
+    procs: dict[int, subprocess.Popen] = {}
+    router_proc = None
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def boot_backend(port: int, model: str) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "serve",
+             "--model", model, "--port", str(port),
+             "--max-wait-ms", "1", "--warmup-shape", "4"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def wait_healthz(url: str, proc, what: str,
+                     tries: int = 240) -> bool:
+        for _ in range(tries):
+            try:
+                with urllib.request.urlopen(url + "healthz",
+                                            timeout=2) as r:
+                    json.loads(r.read())
+                return True
+            except Exception:
+                if proc is not None and proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    bad.append(f"{what} exited rc={proc.returncode}: "
+                               f"{out[-300:]}")
+                    return False
+                time.sleep(0.25)
+        bad.append(f"{what} never answered /healthz")
+        return False
+
+    def router_health() -> dict:
+        with urllib.request.urlopen(router_url + "healthz",
+                                    timeout=10) as r:
+            return json.loads(r.read())
+
+    try:
+        v1 = os.path.join(tmp, "v1.znn")
+        _write_demo_znn(v1, seed=5)
+        ports = [free_port() for _ in range(n_backends)]
+        rport = free_port()
+        backend_urls = [f"http://127.0.0.1:{p}/" for p in ports]
+        router_url = f"http://127.0.0.1:{rport}/"
+        for i, port in enumerate(ports):
+            procs[i] = boot_backend(port, v1)
+        for i, port in enumerate(ports):
+            if not wait_healthz(backend_urls[i], procs[i],
+                                f"backend {i}"):
+                return 1
+        router_proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "route",
+             "--port", str(rport), "--probe-interval-s", "0.3",
+             "--breaker-threshold", "2",
+             "--breaker-cooldown-s", "1.0"]
+            + [f for i, u in enumerate(backend_urls)
+               for f in ("--backend", f"{u},name=b{i}")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        if not wait_healthz(router_url, router_proc, "router"):
+            return 1
+
+        # ---- phase 1: SIGKILL one backend mid-burst, then restart it
+        answers: list[tuple] = []       # (code, retry_after_present)
+        mu = threading.Lock()
+        stop = threading.Event()
+        bin_body = wire_mod.encode_tensor(np.asarray(x, np.float32))
+
+        def client(ci: int):
+            # every other client drives the binary pass-through leg —
+            # the router must route both formats identically
+            binary = ci % 2 == 1
+            n = 0
+            while not stop.is_set():
+                try:
+                    if binary:
+                        req = urllib.request.Request(
+                            router_url + "predict", bin_body,
+                            {"Content-Type": wire_mod.CONTENT_TYPE,
+                             "Accept": wire_mod.CONTENT_TYPE})
+                        with urllib.request.urlopen(req,
+                                                    timeout=15) as r:
+                            r.read()
+                            code, headers = r.status, dict(r.headers)
+                    else:
+                        code, _body, headers = _post(
+                            router_url, {"inputs": x}, timeout=15)
+                except urllib.error.HTTPError as e:
+                    code, headers = e.code, dict(e.headers)
+                    e.read()
+                except Exception:
+                    code, headers = -1, {}    # hang/conn error = bad
+                with mu:
+                    answers.append((code,
+                                    "Retry-After" in headers))
+                n += 1
+                stop.wait(0.005)
+
+        threads = [threading.Thread(target=client, args=(ci,),
+                                    daemon=True) for ci in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        procs[1].kill()                 # SIGKILL, not a drain: the
+        procs[1].wait(timeout=15)       # fabric must absorb a CRASH
+        # ejection: poll until the router reports b1 out of rotation
+        ejected = False
+        for _ in range(40):
+            rows = {r["name"]: r for r in router_health()["backends"]}
+            if rows["b1"]["breaker"]["state"] == "open":
+                ejected = True
+                break
+            time.sleep(0.25)
+        if not ejected:
+            bad.append("killed backend b1 was never ejected (breaker "
+                       "never opened at the router)")
+        time.sleep(1.0)
+        # restart on the same port: the fabric must RE-admit it
+        procs[1] = boot_backend(ports[1], v1)
+        wait_healthz(backend_urls[1], procs[1], "restarted backend 1")
+        readmitted = False
+        for _ in range(60):
+            rows = {r["name"]: r for r in router_health()["backends"]}
+            if rows["b1"]["breaker"]["state"] == "closed":
+                readmitted = True
+                break
+            time.sleep(0.25)
+        if not readmitted:
+            bad.append("restarted backend b1 was never re-admitted")
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(20.0)
+        codes = collections.Counter(code for code, _ra in answers)
+        print(json.dumps({"phase": "kill-burst",
+                          "codes": dict(sorted(codes.items())),
+                          "ejected": ejected,
+                          "readmitted": readmitted}))
+        if codes.get(-1):
+            bad.append(f"{codes[-1]} request(s) hung or died on a "
+                       f"connection error during the kill burst")
+        if codes.get(500):
+            bad.append(f"{codes[500]} raw 500(s) during the kill "
+                       f"burst")
+        for code, ra in answers:
+            if code in (429, 503) and not ra:
+                bad.append(f"a {code} refusal carried no Retry-After")
+                break
+        # traffic reaches the re-admitted backend again
+        seen = set()
+        for _ in range(30):
+            code, _b, headers = _post(router_url, {"inputs": x},
+                                      timeout=15)
+            seen.add(headers.get("X-Fleet-Backend"))
+        if "b1" not in seen:
+            bad.append(f"re-admitted backend b1 got no traffic "
+                       f"(answering backends: {sorted(seen)})")
+
+        # ---- phase 2 + 3: promote-one-then-fleet, then a regressed
+        # candidate rolled back fleet-wide mid-walk.  The controller
+        # runs in THIS process; every reload/weight/metrics call is a
+        # real HTTP hop to the subprocesses.
+        cands = os.path.join(tmp, "cands")
+        deploy = os.path.join(tmp, "deploy")
+        os.makedirs(cands)
+        stop = threading.Event()
+        answers = []
+        threads = [threading.Thread(target=client, args=(ci,),
+                                    daemon=True) for ci in range(4)]
+        for t in threads:
+            t.start()
+
+        def make_controller(canary_weight: float):
+            walk_policy = BurnRatePolicy(
+                objective="availability", target=0.99,
+                window_s=60.0, probe_interval_s=0.1,
+                fast_window_s=0.6, max_burn_rate=2.0, min_samples=5)
+            target = FleetTarget(
+                backend_urls, router_url=router_url,
+                canary_weight=canary_weight,
+                walk_policy=walk_policy, settle_s=1.0,
+                probe_interval_s=0.1)
+            return PromotionController(
+                DirectorySource(cands), target, deploy_dir=deploy,
+                policy=SLOPolicy(window_s=1.0, probe_interval_s=0.25,
+                                 min_samples=3, max_p99_ms=5000.0,
+                                 max_error_rate=0.5),
+                poll_interval_s=0.05,
+                ledger=os.path.join(deploy, "promotions.jsonl"))
+
+        time.sleep(0.5)
+        v2 = os.path.join(cands, "v2.znn")
+        _write_demo_znn(v2, seed=23)
+        outcome = make_controller(canary_weight=0.25).run_once()
+        print(json.dumps({"phase": "rolling-promotion",
+                          "outcome": outcome}))
+        if outcome != "promoted":
+            bad.append(f"rolling promotion concluded {outcome!r}, "
+                       f"expected 'promoted'")
+        stop.set()
+        for t in threads:
+            t.join(20.0)
+        clean = collections.Counter(c for c, _ra in answers)
+        if clean.get(-1):
+            bad.append(f"{clean[-1]} request(s) hung during the "
+                       f"clean rolling promotion")
+        if clean.get(500):
+            bad.append("raw 500(s) during the CLEAN rolling "
+                       "promotion — the walk broke live traffic")
+        # byte-compares run QUIESCED (traffic stopped, in-flight
+        # batches drained): live coalescing can pad the probe into a
+        # different bucket whose executable differs in low-order bits
+        # — the PR 7 lesson, re-learned at fleet scale
+        time.sleep(0.5)
+        gens, outs = [], []
+        for url in backend_urls:
+            code, body, _h = _post(url, {"inputs": x}, timeout=15)
+            outs.append((code, json.dumps(body, sort_keys=True)))
+            with urllib.request.urlopen(url + "healthz",
+                                        timeout=10) as r:
+                gens.append(json.loads(r.read())["model_generation"])
+        if any(g != gens[0] or g < 2 for g in gens):
+            bad.append(f"post-roll generations diverge: {gens}")
+        if len(set(outs)) != 1 or outs[0][0] != 200:
+            bad.append(f"post-roll outputs are not byte-identical "
+                       f"200s across the fleet: {outs}")
+        v2_answer = outs[0]
+
+        # the regressed candidate: dark canary (weight 0 during the
+        # watch — no router traffic reaches it, so the min-samples
+        # gate passes it to the WALK, which is the judgment under
+        # test), then the walk's fleet-aggregated burn rate must
+        # catch the 500s and roll every backend back
+        stop = threading.Event()
+        answers = []
+        threads = [threading.Thread(target=client, args=(ci,),
+                                    daemon=True) for ci in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        v3 = os.path.join(cands, "v3.znn")
+        _write_poison_znn(v3)
+        outcome = make_controller(canary_weight=0.0).run_once()
+        print(json.dumps({"phase": "regressed-candidate",
+                          "outcome": outcome}))
+        if outcome != "rolled_back":
+            bad.append(f"regressed candidate concluded {outcome!r}, "
+                       f"expected 'rolled_back'")
+        walk_rec = None
+        with open(os.path.join(deploy, "promotions.jsonl")) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                if rec.get("event") == "fleet_rollback":
+                    walk_rec = rec
+        if walk_rec is None:
+            bad.append("no fleet_rollback event in the ledger")
+        elif not walk_rec.get("walked") \
+                or walk_rec["walked"] >= n_backends:
+            bad.append(f"fleet rollback fired at walked="
+                       f"{walk_rec.get('walked')}, expected mid-walk "
+                       f"(1..{n_backends - 1})")
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(20.0)
+        regress = collections.Counter(c for c, _ra in answers)
+        print(json.dumps({"phase": "regression-traffic",
+                          "codes": dict(sorted(regress.items()))}))
+        if regress.get(-1):
+            bad.append(f"{regress[-1]} request(s) hung during the "
+                       f"regressed-candidate phase")
+        if not regress.get(500):
+            bad.append("the regressed candidate never produced a "
+                       "500 — the rollback rolled back nothing "
+                       "observable")
+        # post-rollback, quiesced: the whole fleet answers v2's
+        # exact bytes
+        time.sleep(0.5)
+        for url in backend_urls:
+            code, body, _h = _post(url, {"inputs": x}, timeout=15)
+            if (code, json.dumps(body, sort_keys=True)) != v2_answer:
+                bad.append(f"post-rollback answer on {url} is not "
+                           f"byte-identical to v2's")
+        print(json.dumps({"scenario": "fleet", "ok": not bad,
+                          "violations": bad}))
+        return 1 if bad else 0
+    finally:
+        if router_proc is not None:
+            router_proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 15.0
+        for proc in [router_proc] + list(procs.values()):
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1,
+                                      deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _admin_reload_named(url: str, name: str, model: str,
                         timeout: float = 60.0):
     """(status, body) of a synchronous per-model ``POST
@@ -1280,7 +1637,7 @@ def main(argv=None) -> int:
     p.add_argument("--retry-attempts", type=int, default=2)
     p.add_argument("--scenario", default="breaker",
                    choices=("breaker", "reload", "promote", "overload",
-                            "zoo", "slo", "wire"),
+                            "zoo", "slo", "wire", "fleet"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -1312,7 +1669,13 @@ def main(argv=None) -> int:
                         "either format, junk binary answers 400 "
                         "fast, cross-format parity, and a reload "
                         "swaps the memo key space (docs/serving.md "
-                        "'Wire protocol')")
+                        "'Wire protocol'); fleet: three REAL serve "
+                        "processes behind a REAL route process — one "
+                        "SIGKILLed mid-burst then restarted (zero "
+                        "raw 500s/hangs, ejection + re-admission), "
+                        "one rolling promotion walked to completion "
+                        "and a regressed candidate rolled back "
+                        "fleet-wide mid-walk (docs/fleet.md)")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -1371,6 +1734,8 @@ def main(argv=None) -> int:
         return _slo_scenario(args)
     if args.scenario == "wire":
         return _wire_scenario(args)
+    if args.scenario == "fleet":
+        return _fleet_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

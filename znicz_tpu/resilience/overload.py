@@ -60,7 +60,7 @@ _deadline_exceeded = REGISTRY.counter(
     "deadline_exceeded_total",
     "requests rejected or expired by end-to-end deadline enforcement, "
     "by the stage that refused the doomed work (admission | queue | "
-    "dispatch | forward | retry)")
+    "dispatch | forward | retry | router)")
 _budget_tokens = REGISTRY.gauge(
     "retry_budget_tokens",
     "tokens left in the process-wide retry budget (refilled as a "

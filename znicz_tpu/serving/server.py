@@ -178,6 +178,181 @@ class _FastHeaders(dict):
 _date_cache: list = [None, ""]
 
 
+class FastHTTPHandler(BaseHTTPRequestHandler):
+    """Keep-alive HTTP/1.1 handler base with the fast header path.
+
+    Hoisted from the serving front so the fleet router
+    (:mod:`znicz_tpu.fleet.router`) — which fronts N of these servers
+    and pays the same per-request parse costs — shares ONE copy of the
+    machinery instead of drifting its own: persistent connections,
+    single-write responses (subclasses build on the stdlib writers),
+    the cached ``Date`` header, and the ``email.parser``-free request
+    header parse.  Behavior pins (request-line validation, HTTP/0.9
+    and 2.0 handling, ``Connection``/``Expect`` semantics, the ``//``
+    path reduction) are copied verbatim from CPython 3.10.
+    """
+
+    # persistent connections: a closed-loop client pays TCP setup +
+    # thread spawn ONCE instead of per request — on the measured
+    # request path (bench.py serve) connection churn was a top
+    # non-forward cost.  Every response must send Content-Length,
+    # which is what HTTP/1.1 keep-alive requires; clients sending
+    # Connection: close (urllib does) keep the old one-shot behavior.
+    protocol_version = "HTTP/1.1"
+    #: socket read timeout: bounds how long an idle keep-alive
+    #: connection can pin its handler thread after the client
+    #: went away without closing
+    timeout = 120
+    #: small request/response ping-pong over a persistent connection
+    #: is exactly the pattern Nagle + delayed-ACK penalizes — answers
+    #: must leave NOW
+    disable_nagle_algorithm = True
+
+    def log_message(self, *args):         # keep serving logs clean
+        pass
+
+    def date_time_string(self, timestamp=None):
+        # per-second cache of the Date header (RFC format via the
+        # stdlib formatter, computed once a second instead of once a
+        # response)
+        if timestamp is not None:
+            return super().date_time_string(timestamp)
+        t = int(time.time())
+        if _date_cache[0] != t:
+            _date_cache[1] = super().date_time_string(t)
+            _date_cache[0] = t
+        return _date_cache[1]
+
+    def _read_headers_fast(self) -> _FastHeaders:
+        """Request headers into a :class:`_FastHeaders` dict with the
+        stdlib's bounds (64 KiB line, 100 headers; folded continuation
+        lines appended, duplicate names first-wins like
+        ``email.Message.get``)."""
+        headers = _FastHeaders()
+        last = None
+        count = 0
+        while True:
+            line = self.rfile.readline(65537)
+            if len(line) > 65536:
+                raise _http_client.LineTooLong("header line")
+            if line in (b"\r\n", b"\n", b""):
+                break
+            count += 1
+            if count > 100:
+                raise _http_client.HTTPException(
+                    "got more than 100 headers")
+            s = line.decode("iso-8859-1").rstrip("\r\n")
+            if s[:1] in " \t":
+                # obs-fold continuation of the previous field
+                if last is not None:
+                    headers[last] += " " + s.strip()
+                continue
+            key, sep, value = s.partition(":")
+            if not sep:
+                continue           # junk line: skip, as email
+                #                    .parser tolerates it
+            key = key.strip().lower()
+            if key not in headers:
+                headers[key] = value.strip()
+                last = key
+            else:
+                # duplicate dropped (first-wins) — a fold following it
+                # must NOT append to the RETAINED first value
+                last = None
+        return headers
+
+    def parse_request(self):
+        """CPython 3.10 ``BaseHTTPRequestHandler.parse_request`` with
+        ONE change: headers parse through :meth:`_read_headers_fast`
+        instead of the ``email.parser`` MIME machinery."""
+        self.command = None
+        self.request_version = version = self.default_request_version
+        self.close_connection = True
+        requestline = str(self.raw_requestline, "iso-8859-1")
+        requestline = requestline.rstrip("\r\n")
+        self.requestline = requestline
+        words = requestline.split()
+        if len(words) == 0:
+            return False
+        if len(words) >= 3:         # enough to determine version
+            version = words[-1]
+            try:
+                if not version.startswith("HTTP/"):
+                    raise ValueError
+                base_version_number = version.split("/", 1)[1]
+                version_number = base_version_number.split(".")
+                if len(version_number) != 2:
+                    raise ValueError
+                version_number = (int(version_number[0]),
+                                  int(version_number[1]))
+            except (ValueError, IndexError):
+                self.send_error(
+                    HTTPStatus.BAD_REQUEST,
+                    "Bad request version (%r)" % version)
+                return False
+            if version_number >= (1, 1) \
+                    and self.protocol_version >= "HTTP/1.1":
+                self.close_connection = False
+            if version_number >= (2, 0):
+                self.send_error(
+                    HTTPStatus.HTTP_VERSION_NOT_SUPPORTED,
+                    "Invalid HTTP version (%s)"
+                    % base_version_number)
+                return False
+            self.request_version = version
+        if not 2 <= len(words) <= 3:
+            self.send_error(
+                HTTPStatus.BAD_REQUEST,
+                "Bad request syntax (%r)" % requestline)
+            return False
+        command, path = words[:2]
+        if len(words) == 2:
+            self.close_connection = True
+            if command != "GET":
+                self.send_error(
+                    HTTPStatus.BAD_REQUEST,
+                    "Bad HTTP/0.9 request type (%r)" % command)
+                return False
+        self.command, self.path = command, path
+        if self.path.startswith("//"):
+            # gh-87389 open-redirect hardening, as upstream
+            self.path = "/" + self.path.lstrip("/")
+        try:
+            self.headers = self._read_headers_fast()
+        except _http_client.LineTooLong as err:
+            self.send_error(
+                HTTPStatus.REQUEST_HEADER_FIELDS_TOO_LARGE,
+                "Line too long", str(err))
+            return False
+        except _http_client.HTTPException as err:
+            self.send_error(
+                HTTPStatus.REQUEST_HEADER_FIELDS_TOO_LARGE,
+                "Too many headers", str(err))
+            return False
+        conntype = self.headers.get("Connection", "")
+        if conntype.lower() == "close":
+            self.close_connection = True
+        elif (conntype.lower() == "keep-alive"
+                and self.protocol_version >= "HTTP/1.1"):
+            self.close_connection = False
+        expect = self.headers.get("Expect", "")
+        if (expect.lower() == "100-continue"
+                and self.protocol_version >= "HTTP/1.1"
+                and self.request_version >= "HTTP/1.1"):
+            if not self.handle_expect_100():
+                return False
+        return True
+
+
+class DeepBacklogHTTPServer(ThreadingHTTPServer):
+    #: accept-backlog depth: the stdlib default of 5 turns a burst of
+    #: simultaneous NEW connections (a fleet's clients reconnecting
+    #: after a rollout, the barrier-released e2e tests) into kernel
+    #: connection resets under load — observed as a rare pre-existing
+    #: ConnectionResetError flake in the concurrency tests
+    request_queue_size = 128
+
+
 def _memo_generation(engine) -> int | None:
     """The generation a memo key may safely pin — or ``None`` for a
     MIXED-generation replica set (mid-roll, or a roll stopped by a
@@ -333,164 +508,10 @@ class ServingServer:
             buckets=DEFAULT_LATENCY_BUCKETS_MS)
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            # persistent connections: a closed-loop client pays TCP
-            # setup + thread spawn ONCE instead of per request — on
-            # the measured request path (bench.py serve) connection
-            # churn was a top non-forward cost.  Every response sends
-            # Content-Length (see _send), which is what HTTP/1.1
-            # keep-alive requires; clients sending Connection: close
-            # (urllib does) keep the old one-shot behavior.
-            protocol_version = "HTTP/1.1"
-            #: socket read timeout: bounds how long an idle keep-alive
-            #: connection can pin its handler thread after the client
-            #: went away without closing
-            timeout = 120
-            #: small request/response ping-pong over a persistent
-            #: connection is exactly the pattern Nagle + delayed-ACK
-            #: penalizes — answers must leave NOW
-            disable_nagle_algorithm = True
-
-            def log_message(self, *args):     # keep serving logs clean
-                pass
-
-            def date_time_string(self, timestamp=None):
-                # per-second cache of the Date header (RFC format via
-                # the stdlib formatter, computed once a second instead
-                # of once a response)
-                if timestamp is not None:
-                    return super().date_time_string(timestamp)
-                t = int(time.time())
-                if _date_cache[0] != t:
-                    _date_cache[1] = super().date_time_string(t)
-                    _date_cache[0] = t
-                return _date_cache[1]
-
-            def _read_headers_fast(self) -> _FastHeaders:
-                """Request headers into a :class:`_FastHeaders` dict
-                with the stdlib's bounds (64 KiB line, 100 headers;
-                folded continuation lines appended, duplicate names
-                first-wins like ``email.Message.get``)."""
-                headers = _FastHeaders()
-                last = None
-                count = 0
-                while True:
-                    line = self.rfile.readline(65537)
-                    if len(line) > 65536:
-                        raise _http_client.LineTooLong("header line")
-                    if line in (b"\r\n", b"\n", b""):
-                        break
-                    count += 1
-                    if count > 100:
-                        raise _http_client.HTTPException(
-                            "got more than 100 headers")
-                    s = line.decode("iso-8859-1").rstrip("\r\n")
-                    if s[:1] in " \t":
-                        # obs-fold continuation of the previous field
-                        if last is not None:
-                            headers[last] += " " + s.strip()
-                        continue
-                    key, sep, value = s.partition(":")
-                    if not sep:
-                        continue           # junk line: skip, as email
-                        #                    .parser tolerates it
-                    key = key.strip().lower()
-                    if key not in headers:
-                        headers[key] = value.strip()
-                        last = key
-                    else:
-                        # duplicate dropped (first-wins) — a fold
-                        # following it must NOT append to the RETAINED
-                        # first value
-                        last = None
-                return headers
-
-            def parse_request(self):
-                """CPython 3.10 ``BaseHTTPRequestHandler.
-                parse_request`` with ONE change: headers parse through
-                :meth:`_read_headers_fast` instead of the
-                ``email.parser`` MIME machinery (the behavior pins —
-                request-line validation, HTTP/0.9 and 2.0 handling,
-                ``Connection``/``Expect`` semantics, the ``//`` path
-                reduction — are copied verbatim)."""
-                self.command = None
-                self.request_version = version = \
-                    self.default_request_version
-                self.close_connection = True
-                requestline = str(self.raw_requestline, "iso-8859-1")
-                requestline = requestline.rstrip("\r\n")
-                self.requestline = requestline
-                words = requestline.split()
-                if len(words) == 0:
-                    return False
-                if len(words) >= 3:     # enough to determine version
-                    version = words[-1]
-                    try:
-                        if not version.startswith("HTTP/"):
-                            raise ValueError
-                        base_version_number = version.split("/", 1)[1]
-                        version_number = base_version_number.split(".")
-                        if len(version_number) != 2:
-                            raise ValueError
-                        version_number = (int(version_number[0]),
-                                          int(version_number[1]))
-                    except (ValueError, IndexError):
-                        self.send_error(
-                            HTTPStatus.BAD_REQUEST,
-                            "Bad request version (%r)" % version)
-                        return False
-                    if version_number >= (1, 1) \
-                            and self.protocol_version >= "HTTP/1.1":
-                        self.close_connection = False
-                    if version_number >= (2, 0):
-                        self.send_error(
-                            HTTPStatus.HTTP_VERSION_NOT_SUPPORTED,
-                            "Invalid HTTP version (%s)"
-                            % base_version_number)
-                        return False
-                    self.request_version = version
-                if not 2 <= len(words) <= 3:
-                    self.send_error(
-                        HTTPStatus.BAD_REQUEST,
-                        "Bad request syntax (%r)" % requestline)
-                    return False
-                command, path = words[:2]
-                if len(words) == 2:
-                    self.close_connection = True
-                    if command != "GET":
-                        self.send_error(
-                            HTTPStatus.BAD_REQUEST,
-                            "Bad HTTP/0.9 request type (%r)" % command)
-                        return False
-                self.command, self.path = command, path
-                if self.path.startswith("//"):
-                    # gh-87389 open-redirect hardening, as upstream
-                    self.path = "/" + self.path.lstrip("/")
-                try:
-                    self.headers = self._read_headers_fast()
-                except _http_client.LineTooLong as err:
-                    self.send_error(
-                        HTTPStatus.REQUEST_HEADER_FIELDS_TOO_LARGE,
-                        "Line too long", str(err))
-                    return False
-                except _http_client.HTTPException as err:
-                    self.send_error(
-                        HTTPStatus.REQUEST_HEADER_FIELDS_TOO_LARGE,
-                        "Too many headers", str(err))
-                    return False
-                conntype = self.headers.get("Connection", "")
-                if conntype.lower() == "close":
-                    self.close_connection = True
-                elif (conntype.lower() == "keep-alive"
-                        and self.protocol_version >= "HTTP/1.1"):
-                    self.close_connection = False
-                expect = self.headers.get("Expect", "")
-                if (expect.lower() == "100-continue"
-                        and self.protocol_version >= "HTTP/1.1"
-                        and self.request_version >= "HTTP/1.1"):
-                    if not self.handle_expect_100():
-                        return False
-                return True
+        class Handler(FastHTTPHandler):
+            # keep-alive + fast header parse come from the shared
+            # FastHTTPHandler base (also the fleet router's handler
+            # base — one copy of the wire machinery, two tiers)
 
             def _route(self) -> str:
                 path = self.path
@@ -1041,16 +1062,7 @@ class ServingServer:
                             cache.put(ckey, y)
                         self._reply_outputs(y, want_binary)
 
-        class Server(ThreadingHTTPServer):
-            #: accept-backlog depth: the stdlib default of 5 turns a
-            #: burst of simultaneous NEW connections (a fleet's
-            #: clients reconnecting after a rollout, the barrier-
-            #: released e2e tests) into kernel connection resets under
-            #: load — observed as a rare pre-existing
-            #: ConnectionResetError flake in the concurrency tests
-            request_queue_size = 128
-
-        self.server = Server((host, port), Handler)
+        self.server = DeepBacklogHTTPServer((host, port), Handler)
         # collector registration comes AFTER the bind: if the socket
         # constructor raises (port in use), __init__ unwinds and
         # stop() — the only unregister site — never runs, which would

@@ -270,3 +270,54 @@ def statusz_text(server=None, *, recorder=None, extra: dict | None = None
                   "/debug/flightrecorder /debug/threadz "
                   "(kill -USR1 <pid> dumps threads to stderr)", ""]
     return "\n".join(lines)
+
+
+def fleet_statusz_text(router, *, recorder=None) -> str:
+    """The fleet router's ``/statusz`` one-pager: one row per backend
+    (breaker state, weight, generation, last probe), the rollout
+    driver's state when attached, and the router's own flight-recorder
+    summary.  Text, like :func:`statusz_text`: it exists to be curl'd
+    by a human mid-incident (docs/fleet.md)."""
+    rec = recorder if recorder is not None else flightrecorder.RECORDER
+    lines = ["znicz-tpu fleet /statusz", "=" * 24, ""]
+    lines.append(f"rev: {router.rev or 'unknown'}")
+    lines.append(f"uptime_s: {process_uptime_s():.1f} "
+                 f"(started at {started_at():.3f})")
+    health = router.health()
+    lines.append(f"fleet: {health['status']}  "
+                 f"healthy={health['healthy_backends']}/"
+                 f"{health['backend_count']}")
+    lines += ["", "backends", "-" * 8]
+    lines.append(f"  {'name':<16} {'weight':>7} {'breaker':<10} "
+                 f"{'gen':>4} {'probe_age_s':>11} {'status':<12} url")
+    for r in router.backend_rows():
+        age = r.get("probe_age_s")
+        lines.append(
+            f"  {r['name']:<16} {r['weight']:>7.2f} "
+            f"{r['breaker']['state']:<10} "
+            f"{r['generation'] if r['generation'] is not None else '?':>4} "
+            f"{age if age is not None else '-':>11} "
+            f"{(r.get('backend_status') or '?'):<12} {r['url']}")
+    rs = router.rollout_status
+    if rs is not None:
+        try:
+            lines.append("rollout: " + _fmt_kv(rs()))
+        except Exception:
+            lines.append("rollout: <status probe failed>")
+    counts = rec.counts()
+    lines += ["", "flight recorder", "-" * 15]
+    lines.append(_fmt_kv(counts))
+    slowest = rec.slowest(10)
+    if slowest:
+        lines.append("slowest retained forwards:")
+        lines.append(f"  {'seq':>6} {'ms':>10} {'outcome':<8} "
+                     f"{'backend':<16} detail")
+        for r in slowest:
+            lines.append(f"  {r['seq']:>6} "
+                         f"{(r['duration_ms'] or 0):>10.2f} "
+                         f"{r['outcome']:<8} "
+                         f"{(r.get('backend') or '-'):<16} "
+                         f"{r.get('request_id') or ''}")
+    lines += ["", "endpoints: /healthz /metrics /statusz "
+                  "POST /admin/weight", ""]
+    return "\n".join(lines)
