@@ -441,6 +441,48 @@ class TestHandlerSafety:
 """, [HandlerSafetyRule()])
         assert len(found) == 1 and "file I/O" in found[0].message
 
+    def test_capture_writer_shape_is_a_dispatch_path(self, tmp_path):
+        """The online capture tap's writer-thread shape (ISSUE 15): a
+        class pumping a queue from Thread(target=self._writer_loop) is
+        a dispatch path — a sleep in its loop stalls every captured
+        record behind it; the bounded Event.wait twin stays silent."""
+        found = lint(tmp_path, """
+    import threading
+    import time
+
+    class CaptureLog:
+        def __init__(self):
+            self._writer = threading.Thread(
+                target=self._writer_loop)
+
+        def _writer_loop(self):
+            while True:
+                time.sleep(0.2)          # unbounded pacing by sleep
+                self._drain()
+
+        def _drain(self):
+            return []
+""", [HandlerSafetyRule()])
+        assert rules_of(found) == ["handler-blocking"]
+        assert "dispatch-thread" in found[0].message
+        assert lint(tmp_path, """
+    import threading
+
+    class CaptureLog:
+        def __init__(self):
+            self._wake = threading.Event()
+            self._writer = threading.Thread(
+                target=self._writer_loop)
+
+        def _writer_loop(self):
+            while True:
+                self._wake.wait(0.2)     # bounded: interruptible
+                self._drain()
+
+        def _drain(self):
+            return []
+""", [HandlerSafetyRule()]) == []
+
     def test_unbounded_join_on_dispatch_thread(self, tmp_path):
         found = lint(tmp_path, """
     import threading
@@ -839,6 +881,15 @@ class TestDeadlineDiscipline:
         # an unbounded wait there wedges every backend behind it
         found = lint(tmp_path, DEADLINE_BAD, [DeadlineDisciplineRule()],
                      rel="znicz_tpu/fleet/mod.py")
+        assert rules_of(found) == ["deadline-discipline"]
+        assert len(found) == 4
+
+    def test_online_modules_in_scope(self, tmp_path):
+        # the live-data loop patrols too: the capture tap runs ON the
+        # request path, and the replay tailer/trainer promise bounded
+        # waits (ISSUE 15) — an unbounded wait there is the same bug
+        found = lint(tmp_path, DEADLINE_BAD, [DeadlineDisciplineRule()],
+                     rel="znicz_tpu/online/mod.py")
         assert rules_of(found) == ["deadline-discipline"]
         assert len(found) == 4
 

@@ -1,0 +1,397 @@
+"""The live-data loop (docs/online.md): capture ring bounds and
+torn-tail tolerance, seeded replay determinism, cold-log honest
+degradation, bless/refuse rounds, Kohonen online parity with the batch
+trainer's math, CheckpointSource pickup of an online-blessed step, the
+capture tap's fail-open contract under an injected ``capture.append``
+fault, and the ``online-train`` CLI binding."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu import durability
+from znicz_tpu.export import read_znn
+from znicz_tpu.online import capture as cap_mod
+from znicz_tpu.online.capture import CaptureLog, read_records, \
+    segment_files
+from znicz_tpu.online.replay import (ReplayLoader, ReplayReader,
+                                     records_to_arrays)
+from znicz_tpu.online.som import OnlineSom, read_som_znn
+from znicz_tpu.online.trainer import OnlineTrainer, spec_from_znn
+from znicz_tpu.ops import kohonen as som_ops
+from znicz_tpu.resilience import faults
+from znicz_tpu.serving.zoo import write_demo_model
+
+#: a fixed 13->3 logit rule so captured "served outputs" carry
+#: LEARNABLE chosen labels (argmax) — random labels would (rightly)
+#: refuse at blessing
+_RULE = np.linspace(-1.0, 1.0, 13 * 3).reshape(13, 3).astype(np.float32)
+
+
+def _fill(log: CaptureLog, n: int, seed: int = 0,
+          model: str | None = None, features: int = 13) -> None:
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        x = rng.standard_normal((1, features)).astype(np.float32)
+        y = (x[:, :_RULE.shape[0]] @ _RULE if features >= 13
+             else np.tile(x.sum(axis=1, keepdims=True), (1, 3)))
+        log.append(x, y.astype(np.float32), model=model)
+    assert log.flush(20.0), "capture writer did not settle"
+
+
+class TestCaptureRing:
+    def test_byte_budget_honored(self, tmp_path):
+        log = CaptureLog(str(tmp_path), max_bytes=8192,
+                         segment_bytes=1024)
+        try:
+            _fill(log, 200, seed=1)
+            m = log.metrics()
+            assert m["bytes"] <= 8192, m
+            assert m["segments_deleted"] > 0, \
+                "200 records under an 8 KiB budget must have trimmed"
+            # files on disk agree with the accounting
+            disk = sum(os.path.getsize(p)
+                       for p in segment_files(str(tmp_path)))
+            assert disk <= 8192, disk
+        finally:
+            log.close()
+
+    def test_restart_appends_after_existing_ring(self, tmp_path):
+        log = CaptureLog(str(tmp_path), max_bytes=65536)
+        _fill(log, 5, seed=2)
+        log.close()
+        first = set(segment_files(str(tmp_path)))
+        log2 = CaptureLog(str(tmp_path), max_bytes=65536)
+        try:
+            _fill(log2, 5, seed=3)
+            # the restarted writer opened a NEW segment sequence
+            assert set(segment_files(str(tmp_path))) > first
+            reader = ReplayReader(str(tmp_path), seed=0)
+            reader.poll()
+            assert reader.pending() == 10
+        finally:
+            log2.close()
+
+    def test_fail_open_under_injected_append_fault(self, tmp_path):
+        """The capture.append chaos site: an error fault is a counted
+        drop — append returns False, never raises (the request path's
+        fail-open contract)."""
+        log = CaptureLog(str(tmp_path), max_bytes=65536)
+        try:
+            plan = faults.FaultPlan([faults.FaultSpec(
+                "capture.append", times=3,
+                message="test: tap failure")], seed=1)
+            x = np.ones((1, 4), np.float32)
+            with plan:
+                results = [log.append(x, x) for _ in range(5)]
+            assert results == [False, False, False, True, True]
+            assert plan.snapshot()["capture.append:error"] == 3
+            m = log.metrics()
+            assert m["dropped_error"] == 3
+            assert log.flush(10.0)
+            assert log.metrics()["records"] == 2
+        finally:
+            log.close()
+
+    def test_sampling_is_seeded_and_counted(self, tmp_path):
+        drops = []
+        for run in range(2):
+            d = tmp_path / f"s{run}"
+            log = CaptureLog(str(d), max_bytes=65536, sample=0.5,
+                             seed=9)
+            _fill(log, 40, seed=4)
+            m = log.metrics()
+            drops.append((m["records"], m["dropped_sampled"]))
+            log.close()
+        assert drops[0] == drops[1], "sampling must replay per seed"
+        assert drops[0][0] + drops[0][1] == 40
+        assert 0 < drops[0][0] < 40
+
+    def test_torn_tail_detected_and_tolerated(self, tmp_path):
+        log = CaptureLog(str(tmp_path), max_bytes=65536)
+        _fill(log, 6, seed=5)
+        log.close()
+        (seg,) = segment_files(str(tmp_path))
+        blob = open(seg, "rb").read()
+        # a half-written final record: frame claims more bytes than
+        # exist -> "partial" (the writer might still be mid-append)
+        torn = blob + blob[: cap_mod.REC_HEADER.size + 4]
+        open(seg, "wb").write(torn)
+        records, offset, status = read_records(seg)
+        assert len(records) == 6 and status == "partial"
+        assert offset == len(blob)
+        # a crc-rotted record mid-file stops consumption AT the rot
+        # (the length field itself may be garbage)
+        rotten = bytearray(blob)
+        rotten[cap_mod.REC_HEADER.size + 3] ^= 0xFF   # inside rec 0
+        open(seg, "wb").write(bytes(rotten))
+        records, _off, status = read_records(seg)
+        assert records == [] and status == "torn"
+
+    def test_reader_writes_off_stale_partial_tail(self, tmp_path):
+        """An incomplete tail on a segment the writer rolled PAST can
+        never complete — the tailer counts it torn and moves on."""
+        log = CaptureLog(str(tmp_path), max_bytes=65536,
+                         segment_bytes=600)
+        _fill(log, 12, seed=6)      # several small segments
+        log.close()
+        segs = segment_files(str(tmp_path))
+        assert len(segs) >= 2
+        # truncate an OLDER segment mid-record
+        with open(segs[0], "rb") as fh:
+            blob = fh.read()
+        open(segs[0], "wb").write(blob[: len(blob) - 3])
+        reader = ReplayReader(str(tmp_path), seed=0)
+        reader.poll()
+        st = reader.status()
+        assert st["torn"] == 1
+        assert st["pending"] == 11      # every complete record loaded
+
+
+class TestReplay:
+    def test_window_shuffle_deterministic_under_seed(self, tmp_path):
+        log = CaptureLog(str(tmp_path), max_bytes=262144)
+        _fill(log, 60, seed=7)
+        log.close()
+
+        def draw(seed):
+            r = ReplayReader(str(tmp_path), seed=seed)
+            out = []
+            for _ in range(4):
+                batch = r.take(10, timeout_s=0.0)
+                out.append([rec.x.tobytes() for rec in batch])
+            return out
+
+        assert draw(5) == draw(5), "same log + seed must replay " \
+                                   "bit-identically"
+        assert draw(5) != draw(6)
+
+    def test_cold_log_degrades_without_blocking(self, tmp_path):
+        reader = ReplayReader(str(tmp_path / "nothing"), seed=0)
+        t0 = time.monotonic()
+        out = reader.take(32, timeout_s=0.3)
+        dt = time.monotonic() - t0
+        assert out == []
+        assert dt < 5.0, f"cold-log take blocked {dt:.1f}s"
+
+    def test_tailer_picks_up_live_appends(self, tmp_path):
+        log = CaptureLog(str(tmp_path), max_bytes=262144)
+        try:
+            _fill(log, 8, seed=8)
+            reader = ReplayReader(str(tmp_path), seed=0)
+            assert reader.poll() == 8
+            _fill(log, 5, seed=9)
+            assert reader.poll() == 5   # only the NEW records
+        finally:
+            log.close()
+
+    def test_window_bound_drops_oldest(self, tmp_path):
+        log = CaptureLog(str(tmp_path), max_bytes=262144)
+        _fill(log, 30, seed=10)
+        log.close()
+        reader = ReplayReader(str(tmp_path), seed=0, window=10)
+        reader.poll()
+        st = reader.status()
+        assert st["pending"] == 10 and st["dropped"] == 20
+
+    def test_loader_protocol_holdback_split(self, tmp_path):
+        from znicz_tpu.backends import Device
+        log = CaptureLog(str(tmp_path), max_bytes=262144)
+        _fill(log, 32, seed=11)
+        log.close()
+        loader = ReplayLoader(str(tmp_path), minibatch_size=8,
+                              holdback_every=4, seed=0)
+        loader.initialize(device=Device.create("numpy"))
+        # 32 rows, every 4th held back -> 8 validation / 24 train
+        assert loader.class_lengths == [0, 8, 24]
+        loader.run()
+        assert loader.minibatch_data.mem.shape[1] == 13
+        assert loader.minibatch_labels.mem.dtype == np.int32
+
+
+@pytest.fixture(scope="module")
+def wine_znn(tmp_path_factory):
+    path = tmp_path_factory.mktemp("online_model") / "wine.znn"
+    write_demo_model(str(path), "wine", seed=7)
+    return str(path)
+
+
+class TestOnlineTrainerRounds:
+    def test_bless_refuse_and_checkpoint_pickup(self, tmp_path,
+                                                wine_znn):
+        """One trainer exercises the whole round ladder (amortizing
+        the jit): starved on a cold log, blessed on learnable traffic
+        (candidate + manifest'd checkpoint step), REFUSED on a
+        poisoned round (no export, params reverted), and the blessed
+        step is picked up by promotion.CheckpointSource through the
+        trainer's own exporter."""
+        capdir = tmp_path / "cap"
+        cands = tmp_path / "cands"
+        ckpts = tmp_path / "ckpts"
+        log = CaptureLog(str(capdir), max_bytes=262144)
+        trainer = OnlineTrainer(
+            wine_znn, str(capdir), candidates_dir=str(cands),
+            checkpoint_dir=str(ckpts), round_samples=64,
+            min_round_samples=16, holdback_every=8,
+            poll_timeout_s=0.2, seed=3)
+        try:
+            # cold log: honest degradation, no blocking
+            out = trainer.run_round()
+            assert out["outcome"] == "starved"
+            # learnable traffic -> blessed
+            _fill(log, 80, seed=12)
+            out = trainer.run_round()
+            assert out["outcome"] == "blessed", out
+            cand = out["candidate"]
+            assert os.path.isfile(cand)
+            # candidate committed atomically: manifest + loadable
+            assert durability.read_manifest(cand) is not None
+            layers = read_znn(cand)
+            assert [lay.kind for lay in layers] == ["fc", "fc",
+                                                    "softmax"]
+            # blessed step carries the durability manifest (the bless
+            # mark CheckpointSource keys on)
+            step_dir = out["checkpoint"]
+            assert durability.read_manifest(step_dir) is not None
+            # poisoned round: genuinely regressed held-back eval must
+            # refuse, export nothing, and revert the live params
+            _fill(log, 80, seed=13)
+            n_cands = len(os.listdir(cands))
+            out = trainer.run_round(poison_labels=True)
+            assert out["outcome"] == "refused", out
+            assert len(os.listdir(cands)) == n_cands
+            live = [np.asarray(w) for (w, _b) in trainer.trainer.params]
+            blessed = [p[0] for (p, _v) in trainer._blessed]
+            for a, b in zip(live, blessed):
+                np.testing.assert_array_equal(a, b)
+            # CheckpointSource pickup of the online-blessed step
+            from znicz_tpu.promotion.sources import CheckpointSource
+            src = CheckpointSource(str(ckpts),
+                                   trainer.checkpoint_exporter)
+            candidate, skipped = src.poll()
+            assert candidate is not None and skipped == []
+            assert candidate.name == f"step-{trainer.step}"
+            dst = tmp_path / "exported.znn"
+            src.materialize(candidate, str(dst))
+            restored = read_znn(str(dst))
+            # the exported step IS the blessed params, bit for bit
+            np.testing.assert_array_equal(restored[0].w, blessed[0])
+        finally:
+            log.close()
+            trainer.close()
+
+    def test_warm_start_reads_the_served_artifact(self, wine_znn):
+        spec, params, vels = spec_from_znn(wine_znn)
+        assert [lay.kind for lay in spec.layers] == ["fc", "fc"]
+        assert spec.loss == "softmax"
+        served = read_znn(wine_znn)
+        np.testing.assert_array_equal(params[0][0], served[0].w)
+        assert all(np.all(v == 0) for (v, _b) in vels if v is not None)
+
+    def test_non_fc_chain_refused(self, tmp_path):
+        som = tmp_path / "som.znn"
+        write_demo_model(str(som), "kohonen", seed=7)
+        with pytest.raises(ValueError, match="online.som"):
+            spec_from_znn(str(som))
+
+
+class TestKohonenOnlineParity:
+    def test_online_matches_batch_trainer_on_same_stream(self,
+                                                         tmp_path):
+        """The online SOM's update IS the batch trainer's: the same
+        stream through OnlineSom.apply_batch and through the batch
+        math (forward winners + som_update under the KohonenTrainer
+        schedules, round-for-epoch) lands on BIT-IDENTICAL float32
+        weights."""
+        som_znn = tmp_path / "som.znn"
+        write_demo_model(str(som_znn), "kohonen", seed=7)
+        som = OnlineSom(str(som_znn), str(tmp_path / "cap"),
+                        candidates_dir=str(tmp_path / "cands"),
+                        learning_rate=0.3, sigma_min=0.5,
+                        decay_rounds=10.0, seed=0)
+        w_ref = read_som_znn(str(som_znn))
+        coords = som_ops.grid_coords(*som.grid_shape)
+        rng = np.random.default_rng(3)
+        for r in range(5):
+            batch = rng.standard_normal((16, 6)).astype(np.float32)
+            # the batch trainer's step at epoch r (numpy_run math)
+            lr = 0.3 * np.exp(-r / 10.0)
+            sigma = max(som.sigma0 * np.exp(-r / 10.0), 0.5)
+            w_ref, _diff = som_ops.np_train_step(w_ref, batch, coords,
+                                                 lr, sigma)
+            w_ref = w_ref.astype(np.float32)
+            som.apply_batch(batch)
+            som.round_no = r + 1
+            np.testing.assert_array_equal(som.weights, w_ref)
+
+    def test_som_round_blesses_on_clustered_stream(self, tmp_path):
+        som_znn = tmp_path / "som.znn"
+        write_demo_model(str(som_znn), "kohonen", seed=7)
+        capdir = tmp_path / "cap"
+        log = CaptureLog(str(capdir), max_bytes=262144)
+        rng = np.random.default_rng(5)
+        centers = (2.0 * rng.standard_normal((4, 6))).astype(
+            np.float32)
+        for i in range(120):
+            x = (centers[i % 4]
+                 + 0.1 * rng.standard_normal(6)).astype(
+                np.float32)[None]
+            log.append(x, -x)
+        assert log.flush(20.0)
+        log.close()
+        som = OnlineSom(str(som_znn), str(capdir),
+                        candidates_dir=str(tmp_path / "cands"),
+                        round_samples=64, min_round_samples=16,
+                        poll_timeout_s=0.5, seed=1)
+        out = som.run_round()
+        assert out["outcome"] == "blessed", out
+        # the exported candidate IS the adapted codebook
+        np.testing.assert_array_equal(
+            read_som_znn(out["candidate"]), som.weights)
+
+
+class TestOnlineCLI:
+    def test_cli_drives_one_blessed_round(self, tmp_path, wine_znn):
+        from znicz_tpu.online import cli
+        capdir = tmp_path / "cap"
+        cands = tmp_path / "cands"
+        log = CaptureLog(str(capdir), max_bytes=262144)
+        _fill(log, 60, seed=14)
+        log.close()
+        rc = cli.main(["--model", wine_znn,
+                       "--capture-dir", str(capdir),
+                       "--candidates", str(cands),
+                       "--rounds", "1", "--round-samples", "48",
+                       "--min-round-samples", "16",
+                       "--poll-timeout-s", "1"])
+        assert rc == 0
+        assert any(n.endswith(".znn") for n in os.listdir(cands))
+
+    def test_cli_requires_an_output(self, tmp_path, wine_znn):
+        from znicz_tpu.online import cli
+        with pytest.raises(SystemExit) as e:
+            cli.main(["--model", wine_znn,
+                      "--capture-dir", str(tmp_path)])
+        assert e.value.code == 2
+
+    def test_cli_exits_2_when_everything_starves(self, tmp_path,
+                                                 wine_znn):
+        from znicz_tpu.online import cli
+        rc = cli.main(["--model", wine_znn,
+                       "--capture-dir", str(tmp_path / "empty"),
+                       "--candidates", str(tmp_path / "cands"),
+                       "--rounds", "1", "--poll-timeout-s", "0.1",
+                       "--idle-wait-s", "0.1"])
+        assert rc == 2
+
+
+def test_records_to_arrays_stacks_multi_row_requests():
+    from znicz_tpu.online.capture import CaptureRecord
+    recs = [CaptureRecord(None, np.ones((2, 3), np.float32),
+                          np.zeros((2, 4), np.float32)),
+            CaptureRecord(None, np.full((1, 3), 2.0, np.float32),
+                          np.ones((1, 4), np.float32))]
+    x, y = records_to_arrays(recs)
+    assert x.shape == (3, 3) and y.shape == (3, 4)

@@ -1,12 +1,21 @@
 #!/bin/bash
-# Build a demo model zoo: one tiny .znn per model family (mnist, wine,
-# kohonen — distinct layer chains AND input widths, see
-# znicz_tpu/serving/zoo.py DEMO_SHAPES), each committed through the
-# real atomic export path with a sha256 manifest, so multi-tenant
-# tests, smoke drills and manual `serve --zoo` runs all have real
-# multi-family inputs.
+# Build the model zoo: the three tiny demo heads (mnist, wine, kohonen
+# — distinct layer chains AND input widths, znicz_tpu/serving/zoo.py
+# DEMO_SHAPES) PLUS two REAL trained families exported from the actual
+# training paths (ROADMAP model-zoo depth):
 #
-# Usage:  bash tools/make_zoo.sh [DIR]          (default: ./zoo)
+#   autoencoder — the MNIST conv autoencoder (conv/pool encoder
+#                 mirrored by depool/deconv decoder, MSE), briefly
+#                 trained then exported: the DECODER path as a
+#                 servable workload (input shape 28x28x1, output 784)
+#   mnist_rbm   — greedy CD-1 stacked-RBM pretraining + sigmoid-MLP
+#                 fine-tune, exported (input 784 flat, output 10)
+#
+# Every artifact commits through the real atomic export path with a
+# sha256 manifest.  Pass --demo-only to skip the trained pair (CI
+# speed knob).
+#
+# Usage:  bash tools/make_zoo.sh [DIR] [--demo-only]   (default: ./zoo)
 #
 # Then:   python -m znicz_tpu serve --zoo DIR --port 8100
 #         curl -s localhost:8100/predict -H 'X-Model: wine' \
@@ -14,18 +23,34 @@
 set -eu -o pipefail
 cd "$(dirname "$0")/.."
 
-DIR="${1:-zoo}"
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$DIR" <<'PY'
+DIR="zoo"
+MODE="full"
+for arg in "$@"; do
+    case "$arg" in
+        --demo-only) MODE="--demo-only" ;;
+        --*) echo "make_zoo.sh: unknown option '$arg'" \
+                  "(usage: make_zoo.sh [DIR] [--demo-only])" >&2
+             exit 2 ;;
+        *) DIR="$arg" ;;
+    esac
+done
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$DIR" "$MODE" <<'PY'
 import json
 import sys
 
-from znicz_tpu.serving.zoo import DEMO_SHAPES, make_demo_zoo
+from znicz_tpu.serving.zoo import (DEMO_SHAPES, TRAINED_SAMPLE_SHAPES,
+                                   make_demo_zoo, make_full_zoo)
 
-directory = sys.argv[1]
-paths = make_demo_zoo(directory)
+directory, mode = sys.argv[1], sys.argv[2]
+if mode == "--demo-only":
+    paths = make_demo_zoo(directory)
+else:
+    paths = make_full_zoo(directory)
+shapes = {**{f: (n,) for f, n in DEMO_SHAPES.items()},
+          **TRAINED_SAMPLE_SHAPES}
 for family, path in sorted(paths.items()):
     print(json.dumps({"model": family, "path": path,
-                      "input_features": DEMO_SHAPES[family]}))
+                      "sample_shape": list(shapes[family])}))
 print(f"zoo of {len(paths)} model families in {directory!r} — serve "
       f"with:  python -m znicz_tpu serve --zoo {directory}")
 PY

@@ -1,0 +1,143 @@
+#!/bin/bash
+# Live-data-loop smoke (ISSUE 15 acceptance, operator-runnable):
+#
+#   1. `python -m znicz_tpu chaos --scenario online` — the in-process
+#      closed-loop drill: a capturing server under live traffic, the
+#      continual trainer replaying the capture ring in bless/refuse
+#      rounds, the stock promotion controller deploying each blessed
+#      candidate under transient faults; a poisoned round refused at
+#      blessing, a blessed-but-toxic candidate rolled back by the SLO
+#      watch (byte-identical post-rollback outputs), the capture tap
+#      fault-injected fail-open, the ring byte budget held, plus the
+#      Kohonen serve-and-train phase (the paper's online unit).
+#
+#   2. THREE REAL PROCESSES close the loop over plain files and HTTP:
+#      `serve --capture-dir` captures its own traffic, `online-train`
+#      replays it into blessed candidate exports, `promote --once`
+#      canaries + SLO-watches one onto the live server — asserted by
+#      the server's /healthz generation moving and answers changing.
+#
+# Registered beside tools/chaos_smoke.sh / tools/promote_smoke.sh.
+#
+# Usage:  bash tools/online_smoke.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: chaos --scenario online =="
+JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario online || exit 1
+
+echo "== phase 2: real serve + online-train + promote processes =="
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, signal, socket, subprocess, sys, tempfile, time
+import urllib.request
+
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url + "predict", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+def healthz(url):
+    with urllib.request.urlopen(url + "healthz", timeout=10) as r:
+        return json.loads(r.read())
+
+
+with tempfile.TemporaryDirectory(prefix="znicz_online_smoke_") as tmp:
+    from znicz_tpu.serving.zoo import write_demo_model
+    model = os.path.join(tmp, "wine.znn")
+    write_demo_model(model, "wine", seed=7)
+    cap = os.path.join(tmp, "capture")
+    cands = os.path.join(tmp, "candidates")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    serve = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "serve",
+         "--model", model, "--port", str(port),
+         "--capture-dir", cap, "--capture-mb", "8",
+         "--max-wait-ms", "1", "--buckets", "1,4"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    url = f"http://127.0.0.1:{port}/"
+    try:
+        for _ in range(240):
+            try:
+                urllib.request.urlopen(url + "healthz", timeout=2)
+                break
+            except Exception:
+                time.sleep(0.25)
+        import numpy as np
+        rng = np.random.default_rng(3)
+        xs = rng.standard_normal((64, 13)).astype("float32")
+        n200 = 0
+        for i in range(400):
+            st, _b = post(url, {"inputs": [xs[i % 64].tolist()]})
+            n200 += (st == 200)
+        check(n200 == 400, f"400/400 traffic answers 200 ({n200})")
+        check(os.path.isdir(cap) and any(
+            n.endswith(".zcap") for n in os.listdir(cap)),
+            "the capture ring has segment files")
+        gen0 = healthz(url).get("model_generation")
+        # the REAL online-train process: 2 blessed rounds then exit
+        rc = subprocess.run(
+            [sys.executable, "-m", "znicz_tpu", "online-train",
+             "--model", model, "--capture-dir", cap,
+             "--candidates", cands, "--rounds", "2",
+             "--round-samples", "96", "--min-round-samples", "32",
+             "--poll-timeout-s", "10"],
+            timeout=300, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        sys.stdout.write(rc.stdout)
+        check(rc.returncode == 0,
+              f"online-train exited 0 (rc={rc.returncode})")
+        exported = sorted(n for n in os.listdir(cands)
+                          if n.endswith(".znn")) if \
+            os.path.isdir(cands) else []
+        check(len(exported) >= 1,
+              f"blessed candidates exported ({exported})")
+        # the REAL promote process: one candidate through canary +
+        # SLO watch onto the live server — with traffic flowing so
+        # the watch window judges real samples
+        promote = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "promote",
+             "--candidates", cands, "--url", url, "--once",
+             "--window-s", "3", "--probe-interval-s", "0.5",
+             "--min-samples", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        deadline = time.monotonic() + 300
+        while promote.poll() is None and time.monotonic() < deadline:
+            try:
+                post(url, {"inputs": [xs[0].tolist()]})
+            except Exception:
+                pass
+            time.sleep(0.05)
+        out = promote.communicate(timeout=30)[0]
+        sys.stdout.write(out)
+        check(promote.returncode == 0 and "promoted" in out,
+              f"promote --once promoted a self-trained candidate "
+              f"(rc={promote.returncode})")
+        gen1 = healthz(url).get("model_generation")
+        check(gen1 == (gen0 or 0) + 1,
+              f"the live server's generation moved ({gen0} -> {gen1})")
+        st, _b = post(url, {"inputs": [xs[0].tolist()]})
+        check(st == 200, "the promoted generation serves 200s")
+        serve.send_signal(signal.SIGTERM)
+        rcode = serve.wait(timeout=60)
+        check(rcode == 0, f"serve exited 0 after SIGTERM (rc={rcode})")
+    finally:
+        if serve.poll() is None:
+            serve.kill()
+print("PASS" if not fails else f"FAIL: {fails}")
+sys.exit(1 if fails else 0)
+PY

@@ -13,11 +13,14 @@
 #      ONLY its own model's generation.
 #
 #   2. a REAL `python -m znicz_tpu serve --zoo DIR` process (built by
-#      tools/make_zoo.sh) serves all three families concurrently under
-#      a memory budget: routing by header/body/default answers the
-#      right output widths, an unknown model 404s, a per-model quota
-#      429s with Retry-After, and /healthz + /statusz show the
-#      per-model table.
+#      tools/make_zoo.sh) serves all FIVE families concurrently under
+#      a memory budget — the three demo heads plus the two REAL
+#      trained families (autoencoder decoder path, RBM-pretrained
+#      MLP): routing by header/body/default answers the right output
+#      widths per family (incl. the conv AE's 784-float
+#      reconstruction and the RBM MLP's 10 classes), an unknown model
+#      404s, a per-model quota 429s with Retry-After, and /healthz +
+#      /statusz show the per-model table.
 #
 # Registered beside tools/chaos_smoke.sh / tools/overload_smoke.sh.
 #
@@ -54,9 +57,11 @@ def post(url, payload, headers=None):
 
 
 with tempfile.TemporaryDirectory(prefix="znicz_zoo_smoke_") as tmp:
-    from znicz_tpu.serving.zoo import DEMO_SHAPES, make_demo_zoo
+    from znicz_tpu.serving.zoo import (DEMO_SHAPES,
+                                       TRAINED_SAMPLE_SHAPES,
+                                       make_full_zoo)
     zoo_dir = os.path.join(tmp, "zoo")
-    make_demo_zoo(zoo_dir)
+    make_full_zoo(zoo_dir)
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -89,6 +94,23 @@ with tempfile.TemporaryDirectory(prefix="znicz_zoo_smoke_") as tmp:
                                  "model": "kohonen"})
         check(st == 200 and len(body["outputs"][0]) == 4,
               "body model=kohonen answers the SOM head (4 units)")
+        # the trained families, e2e per family: the conv autoencoder
+        # answers a 784-float reconstruction of its NHWC input (the
+        # decoder path — depool/deconv — running in serving), the
+        # RBM-pretrained MLP its 10 softmax classes
+        ae = [[[[0.1]] * 28] * 28]            # (1, 28, 28, 1)
+        st, body, _ = post(url, {"inputs": ae},
+                           {"X-Model": "autoencoder"})
+        flat = [v for row in body.get("outputs", []) for v in
+                (row if isinstance(row, list) else [row])]
+        check(st == 200 and len(flat) % 784 == 0 and len(flat) > 0,
+              f"X-Model: autoencoder answers the decoder-path "
+              f"reconstruction (st={st}, {len(flat)} floats)")
+        st, body, _ = post(url, {"inputs": [[0.1] * 784]},
+                           {"X-Model": "mnist_rbm"})
+        check(st == 200 and len(body["outputs"][0]) == 10,
+              "X-Model: mnist_rbm answers the RBM-pretrained MLP "
+              "head (10 classes)")
         st, _b, _h = post(url, {"inputs": x["wine"]},
                           {"X-Model": "ghost"})
         check(st == 404, f"unknown model answers 404 (got {st})")
@@ -105,9 +127,10 @@ with tempfile.TemporaryDirectory(prefix="znicz_zoo_smoke_") as tmp:
         health = json.loads(
             urllib.request.urlopen(url + "healthz", timeout=10).read())
         models = {r["model"]: r for r in health.get("models", [])}
-        check(set(models) == {"mnist", "wine", "kohonen"}
+        check(set(models) == {"mnist", "wine", "kohonen",
+                              "autoencoder", "mnist_rbm"}
               and health.get("default_model") == "wine",
-              "healthz carries the per-model table + default")
+              "healthz carries the five-family table + default")
         # the ~1KB budget holds at most one model's weights: after
         # touching all three, at most one stays resident
         check(sum(r["resident"] for r in models.values()) <= 1,

@@ -89,6 +89,20 @@ Examples::
         # export directory, verify + canary-deploy each new candidate
         # to a running `serve` replica, SLO-watch the live telemetry,
         # auto-rollback on regression (znicz_tpu.promotion)
+    python -m znicz_tpu serve --model m.znn --capture-dir cap
+        # + traffic tap: every served /predict answer appends (input,
+        # outputs) to a bounded fsync'd segment ring — fail-open (a
+        # capture failure never fails an answer) and sampled
+        # (--capture-sample); the continual trainer replays it
+        # (docs/online.md)
+    python -m znicz_tpu online-train --model m.znn \
+            --capture-dir cap --candidates cands
+        # continual trainer sidecar: fine-tune the served model (fc
+        # chain, or Kohonen ONLINE mode for a SOM head) on replayed
+        # capture traffic in bounded rounds, judge each round against
+        # a held-back slice, export only blessed candidates — which
+        # `promote [--fleet]` then canaries/watches/rolls out with
+        # zero new promotion code (docs/online.md)
     python -m znicz_tpu lint [--format json|text] [--baseline ...]
         # zlint: AST-based concurrency & JAX-hygiene analyzer over the
         # package (znicz_tpu.analysis; docs/static_analysis.md); exits
@@ -170,6 +184,12 @@ def main(argv=None) -> int:
         # znicz_tpu/promotion and docs/promotion.md
         from .promotion.cli import main as promote_main
         return promote_main(argv[1:])
+    if argv and argv[0] == "online-train":
+        # the continual trainer sidecar: replayed capture traffic →
+        # bounded bless/refuse rounds → candidates for `promote` —
+        # see znicz_tpu/online and docs/online.md
+        from .online.cli import main as online_main
+        return online_main(argv[1:])
     if argv and argv[0] == "lint":
         # static analysis gate — znicz_tpu/analysis, tools/lint.sh
         from .analysis.cli import main as lint_main

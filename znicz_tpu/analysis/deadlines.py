@@ -9,8 +9,11 @@ real here: the graceful-drain work audited exactly these (a
 forever; an ``Event.wait()`` with no bound turns a lost notify into a
 hung request).
 
-Scope: modules under ``znicz_tpu/serving/`` and
-``znicz_tpu/resilience/`` — the request path.  Flagged calls:
+Scope: modules under ``znicz_tpu/serving/``, ``znicz_tpu/resilience/``,
+``znicz_tpu/fleet/`` and ``znicz_tpu/online/`` — the request path plus
+the live-data loop riding it (the capture tap runs on the request
+path; the replay tailer's bounded-poll contract is exactly a deadline
+discipline).  Flagged calls:
 
 * ``X.wait()`` with no arguments and no ``timeout=`` — ``Event``/
   ``Condition``/``subprocess`` waits block forever (the bounded forms
@@ -41,9 +44,11 @@ from .core import Rule, dotted as _dotted
 
 #: root-relative path prefixes this rule patrols (the request path —
 #: the fleet router's forward/probe hops are as much a part of it as
-#: the serving front they fan out to)
+#: the serving front they fan out to; the online subsystem's capture
+#: tap rides the request path and its replay tailer feeds a trainer
+#: whose rounds promise bounded waits, so it patrols too)
 SCOPE_PREFIXES = ("znicz_tpu/serving/", "znicz_tpu/resilience/",
-                  "znicz_tpu/fleet/")
+                  "znicz_tpu/fleet/", "znicz_tpu/online/")
 
 
 def _has_timeout_kw(node: ast.Call) -> bool:

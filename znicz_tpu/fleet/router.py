@@ -43,6 +43,15 @@ Routing (``POST /predict``):
   the PR 13 binary tensor format (``application/x-znicz-tensor``)
   route identically, the router never parses a payload.
 
+* **Response memoization** (``--memoize``; the PR 13 serving-tier pin
+  lifted one tier) — a repeat request body under the fleet's single
+  backend-reported generation answers at the router with NO backend
+  hop (``fleet_response_cache_*`` families, ``X-Fleet-Cache: hit``).
+  Keyed per generation and bypassed entirely on a mixed-generation
+  fleet (mid-roll); a store only lands when the answering backend's
+  ``X-Model-Generation`` header confirms the keyed generation, so a
+  swap between health probes cannot poison the cache.
+
 Aggregated surfaces: ``GET /healthz`` (fleet verdict + one row per
 backend: breaker state, weight, generation, last probe), ``GET
 /metrics`` (JSON fleet view; Prometheus text carries the
@@ -64,8 +73,13 @@ import threading
 import time
 import urllib.parse
 
+import hashlib
+
+import numpy as np
+
 from ..resilience import overload
 from ..resilience.breaker import CircuitBreaker
+from ..serving.memo import ResponseCache
 from ..serving.server import (DeepBacklogHTTPServer, FastHTTPHandler,
                               _json_object)
 from ..telemetry import buildinfo, debugz, flightrecorder, tracing
@@ -92,6 +106,21 @@ _fleet_forward_hist = REGISTRY.histogram(
     "router→backend hop wall time (connect-or-reuse + backend answer "
     "+ read), per backend, milliseconds",
     buckets=DEFAULT_LATENCY_BUCKETS_MS)
+_fleet_cache_hits = REGISTRY.counter(
+    "fleet_response_cache_hits_total",
+    "/predict answers served from the ROUTER-tier response "
+    "memoization cache — no backend hop at all (route --memoize; "
+    "keyed on the fleet's single backend-reported generation, "
+    "bypassed on mixed-generation fleets)")
+_fleet_cache_misses = REGISTRY.counter(
+    "fleet_response_cache_misses_total",
+    "router-tier response-cache lookups that went on to a backend "
+    "forward (the hit/(hit+miss) ratio is the fabric traffic the "
+    "cache absorbs)")
+_fleet_cache_bytes = REGISTRY.gauge(
+    "fleet_response_cache_bytes",
+    "bytes of memoized responses retained at the router tier "
+    "(bounded by route --memoize / --memoize-mb, LRU-evicted)")
 
 
 class BackendDown(Exception):
@@ -163,6 +192,15 @@ class Backend:
             at = self._health_at
         age = None if at is None else time.monotonic() - at
         return snap, age
+
+    def observe_generation(self, generation: int) -> None:
+        """Fold a generation observed on a LIVE answer
+        (``X-Model-Generation``) into the cached health snapshot — a
+        backend that hot-swapped between probes breaks the router
+        cache's consensus NOW instead of at the next probe tick."""
+        with self._lock:
+            if self._health.get("model_generation") != generation:
+                self._health["model_generation"] = generation
 
     # -- the wire ----------------------------------------------------------
     def _acquire(self) -> tuple:
@@ -238,6 +276,36 @@ class Backend:
                                 if age is not None else None)}
 
 
+def _memo_key(generation: int, model: str | None, ctype: str,
+              accept: str, body: bytes) -> bytes:
+    """Router-tier cache key: the fleet generation, the routing model,
+    BOTH wire formats (the request's Content-Type decides how the
+    backend reads the body; the Accept decides what it answers), and
+    the raw body bytes.  The router never parses payloads, so two
+    JSON bodies that differ only in whitespace key separately — a
+    cache miss, never a wrong answer."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr((int(generation), model or "", ctype,
+                   accept)).encode())
+    h.update(body)
+    return h.digest()
+
+
+def _pack_response(ctype: str, body: bytes) -> np.ndarray:
+    """(content-type, body) as one uint8 array — the ResponseCache
+    stores arrays and accounts their nbytes, so the router's cached
+    responses ride the same LRU/byte-budget machinery as serving's."""
+    cb = ctype.encode("latin-1", "replace")
+    head = len(cb).to_bytes(4, "little")
+    return np.frombuffer(head + cb + body, np.uint8)
+
+
+def _unpack_response(arr: np.ndarray) -> tuple[str, bytes]:
+    blob = arr.tobytes()
+    n = int.from_bytes(blob[:4], "little")
+    return blob[4:4 + n].decode("latin-1"), blob[4 + n:]
+
+
 def parse_backend_spec(spec: str) -> tuple[str, dict]:
     """``URL[,weight=W][,name=N]`` → (url, options) for the route CLI
     (same comma-option grammar as the serve CLI's --model specs)."""
@@ -278,7 +346,8 @@ class FleetRouter:
                  port: int = 0, default_deadline_ms: float | None = None,
                  probe_interval_s: float = 2.0,
                  admin_token: str | None = None,
-                 max_body_mb: float = 64.0, max_hops: int = 2):
+                 max_body_mb: float = 64.0, max_hops: int = 2,
+                 memo_entries: int = 0, memo_mb: float = 32.0):
         if not backends:
             raise ValueError("a router needs at least one backend")
         names = [b.name for b in backends]
@@ -295,6 +364,21 @@ class FleetRouter:
         #: backends one request may try (>= 1; the deadline can stop
         #: the loop earlier)
         self.max_hops = max(1, int(max_hops))
+        #: router-tier response memoization (route --memoize; the
+        #: PR 13 serving-tier pin lifted one tier): ONE cache for the
+        #: whole fleet, reusing serving.memo.ResponseCache with the
+        #: fleet_response_cache_* instruments.  Keyed on the fleet's
+        #: single backend-reported generation — mixed generations
+        #: (mid-roll) bypass it entirely; a store only lands when the
+        #: answering backend's X-Model-Generation confirms the keyed
+        #: generation, so a hot swap between health probes cannot
+        #: poison the cache (the observed skew breaks consensus
+        #: immediately via Backend.observe_generation).
+        self.response_cache = (ResponseCache(
+            max_entries=memo_entries, max_bytes=int(memo_mb * 1e6),
+            instruments=(_fleet_cache_hits, _fleet_cache_misses,
+                         _fleet_cache_bytes))
+            if memo_entries > 0 else None)
         self.rev = buildinfo.cached_rev()
         self._wrr_lock = threading.Lock()
         self._stop_event = threading.Event()
@@ -518,6 +602,31 @@ class FleetRouter:
                     deadline_ms = outer.default_deadline_ms
                 deadline = overload.Deadline.from_ms(
                     deadline_ms, crit or "default")
+                # router-tier memoization: a repeat body under the
+                # fleet's ONE confirmed generation answers here with
+                # no backend hop at all.  Mixed or unknown generations
+                # (mid-roll, probes not landed) bypass — correctness
+                # beats hit rate during a roll, the same stance as the
+                # serving tier's replica-set pin.
+                cache = outer.response_cache
+                ckey = None
+                memo_gen = None
+                if cache is not None:
+                    memo_gen = outer.memo_generation()
+                    if memo_gen is not None:
+                        ckey = _memo_key(
+                            memo_gen, model,
+                            self.headers.get("Content-Type")
+                            or "application/json",
+                            self.headers.get("Accept") or "", raw)
+                        hit = cache.get(ckey)
+                        if hit is not None:
+                            ctype, body = _unpack_response(hit)
+                            self._send(200, body, ctype,
+                                       {"X-Fleet-Cache": "hit",
+                                        "X-Model-Generation":
+                                            str(memo_gen)})
+                            return
                 fwd = {"Content-Type":
                        (self.headers.get("Content-Type")
                         or "application/json"),
@@ -575,6 +684,28 @@ class FleetRouter:
                     if status >= 500:
                         self._rec_error = (f"backend {backend.name} "
                                            f"answered {status}")
+                    resp_gen = rheaders.get("X-Model-Generation")
+                    if resp_gen is not None:
+                        try:
+                            resp_gen = int(resp_gen)
+                        except ValueError:
+                            resp_gen = None
+                    if ckey is not None and status == 200 \
+                            and resp_gen == memo_gen:
+                        # store ONLY answers the backend stamped with
+                        # the keyed generation: a swap between health
+                        # probes must not file a new generation's
+                        # bytes under the old key space
+                        cache.put(ckey,
+                                  _pack_response(
+                                      rheaders.get("Content-Type",
+                                                   "application/json"),
+                                      data))
+                    elif resp_gen is not None:
+                        # observed skew: fold it into the cached
+                        # health snapshot NOW — consensus breaks and
+                        # the cache bypasses until probes re-converge
+                        backend.observe_generation(resp_gen)
                     out = {"X-Fleet-Backend": backend.name}
                     ra = rheaders.get("Retry-After")
                     if ra is not None:
@@ -631,6 +762,23 @@ class FleetRouter:
             if b.breaker.allow():
                 return b
         return None
+
+    def memo_generation(self) -> int | None:
+        """The fleet's single memoizable generation: every routable
+        backend's last-reported ``model_generation`` must agree and be
+        known — anything else (mid-roll skew, probes not landed, an
+        ejected backend is ignored) returns None and the response
+        cache bypasses.  Correctness beats hit rate during a roll."""
+        gens: set = set()
+        for b in self.backends:
+            if b.breaker.state == "open":
+                continue              # ejected: not serving traffic
+            snap, _age = b.health()
+            gens.add(snap.get("model_generation"))
+        if len(gens) != 1:
+            return None
+        gen = gens.pop()
+        return int(gen) if gen is not None else None
 
     def retry_after(self) -> int:
         """Honest come-back time when no backend can take the
@@ -707,16 +855,23 @@ class FleetRouter:
         return out
 
     def metrics(self) -> dict:
-        return {"role": "router", "rev": self.rev,
-                "backends": self.backend_rows(),
-                "requests": {
-                    "requests_total": int(self._requests.total()),
-                    "errors_total": int(self._errors.total()),
-                    "requests_by_route_code": self._requests.as_dict(),
-                    "errors_by_route_code": self._errors.as_dict()},
-                "fleet_requests_by_backend_code":
-                    _fleet_requests.as_dict(),
-                "failovers_by_backend": _fleet_failovers.as_dict()}
+        out = {"role": "router", "rev": self.rev,
+               "backends": self.backend_rows(),
+               "requests": {
+                   "requests_total": int(self._requests.total()),
+                   "errors_total": int(self._errors.total()),
+                   "requests_by_route_code": self._requests.as_dict(),
+                   "errors_by_route_code": self._errors.as_dict()},
+               "fleet_requests_by_backend_code":
+                   _fleet_requests.as_dict(),
+               "failovers_by_backend": _fleet_failovers.as_dict()}
+        if self.response_cache is not None:
+            # opt-in block, same rule as the serving tier: the
+            # pre-memo JSON surface must not grow keys
+            out["response_cache"] = {
+                **self.response_cache.metrics(),
+                "generation": self.memo_generation()}
+        return out
 
     def _collect_fleet(self):
         """Registry collector: the per-backend gauge families
@@ -816,6 +971,19 @@ def main(argv=None) -> int:
                    help="seconds an ejected backend stays out before "
                         "a half-open probe may re-admit it")
     p.add_argument("--max-body-mb", type=float, default=64.0)
+    p.add_argument("--memoize", type=int, default=0, metavar="N",
+                   help="router-tier response memoization: keep up to "
+                        "N recent (generation, body) -> response "
+                        "entries and answer repeat requests with NO "
+                        "backend hop (0 = off).  Keyed on the fleet's "
+                        "single backend-reported generation "
+                        "(X-Model-Generation); a mixed-generation "
+                        "fleet — mid-roll — bypasses the cache "
+                        "entirely (docs/fleet.md)")
+    p.add_argument("--memoize-mb", type=float, default=32.0,
+                   help="byte bound of the router response cache "
+                        "(entries evict LRU-first under either "
+                        "bound)")
     p.add_argument("--admin-token", default=None,
                    help="require this token (X-Admin-Token) on "
                         "POST /admin/weight; defaults to "
@@ -843,7 +1011,8 @@ def main(argv=None) -> int:
             default_deadline_ms=args.default_deadline_ms,
             probe_interval_s=args.probe_interval_s,
             admin_token=token, max_body_mb=args.max_body_mb,
-            max_hops=args.max_hops)
+            max_hops=args.max_hops, memo_entries=args.memoize,
+            memo_mb=args.memoize_mb)
         router.start()
         print(f"routing {len(backends)} backend(s) "
               f"{[b.name for b in backends]} at {router.url} "

@@ -42,6 +42,15 @@ mid-burst — zero raw 500s, the critical tenant never shed, page-in
 byte-identity, page-in p99 bounded by the warmup compile cost, and
 per-model reload isolation all asserted.
 
+The ``--scenario online`` drill (tools/online_smoke.sh) is the
+live-data-loop acceptance (docs/online.md): a capturing server under
+live traffic, the continual trainer replaying the capture ring in
+bless/refuse rounds, the stock promotion controller deploying each
+blessed candidate under transient faults — a poisoned round refused
+at blessing, a blessed-but-toxic candidate rolled back by the SLO
+watch with byte-identical outputs, the ``capture.append`` fail-open
+contract fault-injected, plus the Kohonen serve-and-train phase.
+
 Exit code 0 when every invariant holds — tools/chaos_smoke.sh wires
 this into CI-ish usage.  The same ``FaultPlan`` mechanism drives the
 pytest ``chaos`` marker; this mode exists so an operator can smoke a
@@ -366,6 +375,396 @@ def _promote_scenario(args) -> int:
             "requests": len(answered), "outcomes": outcomes + [outcome],
             "promotion": controller.status(),
             "ledger_events": len(entries)}))
+    return 1 if bad else 0
+
+
+def _online_scenario(args) -> int:
+    """``--scenario online`` — the live-data-loop acceptance
+    (docs/online.md): a REAL capturing server, a REAL continual
+    trainer and a REAL promotion watcher close the whole loop in one
+    drill.
+
+    Phase A (fc fine-tune): live traffic flows through a server whose
+    tap appends to the capture ring; the OnlineTrainer replays it in
+    bounded rounds (held-back bless judgment, TrainerCheckpointer
+    steps, candidate exports) and the stock PromotionController
+    canary-deploys each blessed candidate — under transient faults at
+    ``engine.forward``, ``promotion.export`` and
+    ``promotion.slo_probe``.  Then a poisoned round (shuffled labels,
+    exploded lr ⇒ genuinely regressed held-back eval) must be REFUSED
+    at blessing (no candidate appears), and a blessed-but-toxic
+    candidate (clean eval, latency-faulted in production) must be
+    rolled back by the SLO watch with byte-identical post-rollback
+    outputs.  The capture tap's fail-open contract is fault-injected
+    (``capture.append``) under live traffic.  Asserted: zero non-200
+    answers for the whole run, ≥N promotions whose candidates were
+    trained IN THIS RUN from replayed traffic, the refused round
+    exported nothing, the ring honored its byte budget, and blessed
+    checkpoint steps carry durability manifests.
+
+    Phase B (Kohonen serve-and-train, the paper's online unit): a
+    served SOM head adapts online to clustered replay traffic
+    (quantization error improving), its blessed codebook exports,
+    promotes onto the live server, and the post-adaptation artifact
+    round-trips export → promotion → byte-identical serving.
+    """
+    import collections
+    import threading
+
+    from .. import durability
+    from ..online.capture import CaptureLog
+    from ..online.som import OnlineSom, read_som_znn
+    from ..online.trainer import OnlineTrainer
+    from ..promotion import (DirectorySource, EngineTarget,
+                             PromotionController, SLOPolicy)
+    from ..serving.engine import ServingEngine
+    from ..serving.server import ServingServer
+    from ..serving.zoo import write_demo_model
+
+    bad: list[str] = []
+
+    def policy():
+        return SLOPolicy(
+            window_s=args.watch_s,
+            probe_interval_s=max(0.1, args.watch_s / 6.0),
+            max_p99_ms=args.max_p99_ms, max_error_rate=0.05,
+            min_samples=3)
+
+    class Traffic:
+        """Seeded live-traffic loop against one server; every answer
+        code is collected — the zero-non-200 assertion's evidence."""
+
+        def __init__(self, url: str, make_input):
+            self.url = url
+            self.make_input = make_input
+            self.codes: list[int] = []
+            self.mu = threading.Lock()
+            self.stop = threading.Event()
+            self.thread = threading.Thread(target=self._run,
+                                           daemon=True)
+
+        def _run(self):
+            i = 0
+            while not self.stop.is_set():
+                try:
+                    status, _b, _h = _post(self.url,
+                                           {"inputs":
+                                            self.make_input(i)},
+                                           timeout=30.0)
+                except Exception:
+                    status = -1
+                with self.mu:
+                    self.codes.append(status)
+                i += 1
+                self.stop.wait(0.002)
+
+        def start(self):
+            self.thread.start()
+            return self
+
+        def finish(self) -> collections.Counter:
+            self.stop.set()
+            self.thread.join(10.0)
+            with self.mu:
+                return collections.Counter(c for c in self.codes
+                                           if c != 200)
+
+    cap_budget = 262_144
+    with tempfile.TemporaryDirectory(prefix="znicz_chaos_") as tmp:
+        # ---- phase A: the fc fine-tune loop -------------------------
+        v0 = os.path.join(tmp, "v0.znn")
+        _write_demo_znn(v0, seed=5)
+        capdir = os.path.join(tmp, "capture")
+        cands = os.path.join(tmp, "candidates")
+        ckpts = os.path.join(tmp, "checkpoints")
+        deploy = os.path.join(tmp, "deploy")
+        os.makedirs(cands)
+        capture = CaptureLog(capdir, max_bytes=cap_budget, sample=1.0)
+        engine = ServingEngine(v0, backend="jax", buckets=(1, 2))
+        server = ServingServer(engine, max_wait_ms=1.0,
+                               capture=capture).start()
+        controller = PromotionController(
+            DirectorySource(cands), EngineTarget(server=server),
+            deploy_dir=deploy, policy=policy(), poll_interval_s=0.1,
+            max_consecutive_failures=3)
+        pool = np.random.default_rng(11).standard_normal(
+            (64, 4)).astype(np.float32)
+        traffic = Traffic(server.url,
+                          lambda i: [pool[i % len(pool)].tolist()]
+                          ).start()
+        trainer = None
+        try:
+            # warm: let the first compiles land and the tap fill
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                with traffic.mu:
+                    if len(traffic.codes) >= 50:
+                        break
+                time.sleep(0.05)
+            # fail-open: the tap erroring under live traffic must not
+            # surface in a single answer
+            with traffic.mu:
+                before = len(traffic.codes)
+            plan = faults.FaultPlan([faults.FaultSpec(
+                "capture.append", times=8,
+                message="chaos: capture tap failure")], seed=3)
+            with plan:
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    if plan.snapshot().get(
+                            "capture.append:error", 0) >= 8:
+                        break
+                    time.sleep(0.05)
+            fired = plan.snapshot().get("capture.append:error", 0)
+            with traffic.mu:
+                during = traffic.codes[before:]
+            if fired < 8:
+                bad.append(f"capture.append fault fired {fired}x, "
+                           f"expected 8 — fail-open unproven")
+            if any(c != 200 for c in during):
+                bad.append(f"capture faults leaked into answers: "
+                           f"{collections.Counter(during)}")
+            drop_before = capture.metrics()["dropped_error"]
+            if drop_before < fired:
+                bad.append(f"only {drop_before} capture drops counted "
+                           f"for {fired} injected faults")
+            trainer = OnlineTrainer(
+                v0, capdir, candidates_dir=cands,
+                checkpoint_dir=ckpts, round_samples=96,
+                min_round_samples=32, holdback_every=8,
+                poll_timeout_s=15.0, seed=3)
+            promoted_cands = []
+            for k in range(args.promotions):
+                plan = faults.FaultPlan([
+                    faults.FaultSpec("engine.forward", times=1,
+                                     message="chaos: transient device "
+                                             "fault"),
+                    faults.FaultSpec("promotion.export", times=1,
+                                     message="chaos: export blip"),
+                    faults.FaultSpec("promotion.slo_probe", times=1,
+                                     message="chaos: probe blip")],
+                    seed=100 + k)
+                with plan:
+                    round_out = {"outcome": "starved"}
+                    for _ in range(8):       # bounded traffic wait
+                        round_out = trainer.run_round()
+                        if round_out["outcome"] != "starved":
+                            break
+                    outcome = controller.run_once()
+                print(json.dumps({"phase": f"online-promotion-{k + 1}",
+                                  "round": round_out,
+                                  "outcome": outcome,
+                                  "generation": engine.generation,
+                                  "fired": plan.snapshot()}))
+                if round_out["outcome"] != "blessed":
+                    bad.append(f"round {k + 1} outcome "
+                               f"{round_out['outcome']!r}, expected "
+                               f"'blessed'")
+                else:
+                    promoted_cands.append(round_out["candidate"])
+                if outcome != "promoted":
+                    bad.append(f"candidate {k + 1} outcome "
+                               f"{outcome!r}, expected 'promoted'")
+            # every promotion's candidate was trained IN THIS RUN from
+            # replayed traffic (the trainer's own export naming)
+            for path in promoted_cands:
+                if path is None or not os.path.basename(
+                        path).startswith("online-"):
+                    bad.append(f"promoted candidate {path!r} did not "
+                               f"come from the online trainer")
+            # blessed checkpoint steps carry durability manifests (the
+            # bless mark CheckpointSource keys on)
+            steps = [n for n in os.listdir(ckpts) if n.isdigit()] \
+                if os.path.isdir(ckpts) else []
+            if not steps:
+                bad.append("no blessed checkpoint steps on disk")
+            for n in steps:
+                if durability.read_manifest(
+                        os.path.join(ckpts, n)) is None:
+                    bad.append(f"checkpoint step {n} has no "
+                               f"durability manifest — not blessed")
+            x_probe = {"inputs": [pool[0].tolist()]}
+            status, body, _ = _post(server.url, x_probe)
+            y_good = body.get("outputs")
+            gen_good = engine.generation
+            if status != 200:
+                bad.append(f"post-promotions probe got {status}")
+            # the poisoned round: shuffled labels at an exploded lr —
+            # a genuine held-back regression the blessing must refuse,
+            # with NO candidate appearing for the watcher
+            n_cands = len(os.listdir(cands))
+            round_out = {"outcome": "starved"}
+            for _ in range(8):
+                round_out = trainer.run_round(poison_labels=True)
+                if round_out["outcome"] != "starved":
+                    break
+            print(json.dumps({"phase": "poisoned-round",
+                              "round": round_out}))
+            if round_out["outcome"] != "refused":
+                bad.append(f"poisoned round outcome "
+                           f"{round_out['outcome']!r}, expected "
+                           f"'refused'")
+            if len(os.listdir(cands)) != n_cands:
+                bad.append("the refused round exported a candidate")
+            if controller.run_once() is not None:
+                bad.append("the promotion watcher found work after a "
+                           "refused round")
+            # a blessed-but-toxic candidate: clean held-back eval, but
+            # latency-regressed in production — the SLO watch must
+            # roll it back and restore the previous bytes
+            round_out = {"outcome": "starved"}
+            for _ in range(8):
+                round_out = trainer.run_round()
+                if round_out["outcome"] != "starved":
+                    break
+            if round_out["outcome"] != "blessed":
+                bad.append(f"pre-toxic round outcome "
+                           f"{round_out['outcome']!r}, expected "
+                           f"'blessed'")
+            plan = faults.FaultPlan([faults.FaultSpec(
+                "engine.forward", kind="latency",
+                latency_s=args.bad_latency_s,
+                message="chaos: toxic candidate")], seed=7)
+            with plan:
+                outcome = controller.run_once()
+            print(json.dumps({"phase": "toxic-candidate",
+                              "outcome": outcome,
+                              "generation": engine.generation,
+                              "fired": plan.snapshot()}))
+            if outcome != "rolled_back":
+                bad.append(f"toxic candidate outcome {outcome!r}, "
+                           f"expected 'rolled_back'")
+            status, body, _ = _post(server.url, x_probe)
+            if status != 200:
+                bad.append(f"post-rollback probe got {status}")
+            elif body.get("outputs") != y_good:
+                bad.append("post-rollback outputs differ from the "
+                           "last promoted generation — rollback did "
+                           "not restore the previous bytes")
+            if engine.generation != gen_good + 2:
+                bad.append(f"generation {engine.generation} after "
+                           f"rollback, expected {gen_good + 2}")
+        finally:
+            non200 = traffic.finish()
+            server.stop()
+            capture.close()
+            if trainer is not None:
+                trainer.close()
+            engine.close()
+        if non200:
+            bad.append(f"non-200 answers under the online loop: "
+                       f"{dict(non200)}")
+        cap_m = capture.metrics()
+        if cap_m["bytes"] > cap_budget:
+            bad.append(f"capture ring holds {cap_m['bytes']} bytes, "
+                       f"budget {cap_budget}")
+        outs = [e for e in controller.ledger.entries()
+                if e.get("event") == "outcome"]
+        n_promoted = sum(1 for e in outs
+                         if e["outcome"] == "promoted")
+        n_rolled = sum(1 for e in outs
+                       if e["outcome"] == "rolled_back")
+        if n_promoted != args.promotions or n_rolled != 1:
+            bad.append(f"ledger outcomes: {n_promoted} promoted / "
+                       f"{n_rolled} rolled_back, expected "
+                       f"{args.promotions} / 1")
+        print(json.dumps({"phase": "fc-loop-summary", "ok": not bad,
+                          "violations": list(bad),
+                          "capture": cap_m,
+                          "trainer": trainer.status()
+                          if trainer is not None else None}))
+
+        # ---- phase B: Kohonen serve-and-train -----------------------
+        som_znn = os.path.join(tmp, "som.znn")
+        write_demo_model(som_znn, "kohonen", seed=7)
+        cap2 = os.path.join(tmp, "capture-som")
+        cands2 = os.path.join(tmp, "candidates-som")
+        deploy2 = os.path.join(tmp, "deploy-som")
+        capture2 = CaptureLog(cap2, max_bytes=cap_budget, sample=1.0)
+        engine2 = ServingEngine(som_znn, backend="jax", buckets=(1, 2))
+        server2 = ServingServer(engine2, max_wait_ms=1.0,
+                                capture=capture2).start()
+        controller2 = PromotionController(
+            DirectorySource(cands2), EngineTarget(server=server2),
+            deploy_dir=deploy2, policy=policy(), poll_interval_s=0.1,
+            max_consecutive_failures=3)
+        rng = np.random.default_rng(23)
+        centers = (2.5 * rng.standard_normal((4, 6))).astype(
+            np.float32)
+        jitter = rng.standard_normal((256, 6)).astype(np.float32)
+
+        def som_input(i):
+            row = centers[i % 4] + 0.15 * jitter[i % len(jitter)]
+            return [row.astype(np.float32).tolist()]
+
+        traffic2 = Traffic(server2.url, som_input).start()
+        try:
+            som = OnlineSom(som_znn, cap2, candidates_dir=cands2,
+                            round_samples=64, min_round_samples=16,
+                            holdback_every=8, poll_timeout_s=15.0,
+                            seed=5)
+            w0 = som.weights.copy()
+            blessed = 0
+            qes = []
+            for _ in range(10):
+                out = som.run_round()
+                if out["outcome"] == "blessed":
+                    blessed += 1
+                    qes.append(out["qe"])
+                if blessed >= 2:
+                    break
+            print(json.dumps({"phase": "som-adapt",
+                              "status": som.status(), "qes": qes}))
+            if blessed < 2:
+                bad.append(f"SOM blessed only {blessed} round(s) of "
+                           f"10, expected >= 2")
+            if np.array_equal(w0, som.weights):
+                bad.append("the served SOM never adapted — weights "
+                           "unchanged after online rounds")
+            outcome = controller2.run_once()
+            print(json.dumps({"phase": "som-promotion",
+                              "outcome": outcome,
+                              "generation": engine2.generation}))
+            if outcome != "promoted":
+                bad.append(f"SOM candidate outcome {outcome!r}, "
+                           f"expected 'promoted'")
+            # round-trip: the deployed artifact IS the adapted
+            # codebook, bit for bit, and serving it is deterministic
+            cand = os.path.join(cands2, f"som-{som.step:06d}.znn")
+            if not np.array_equal(read_som_znn(cand), som.weights):
+                bad.append("exported SOM candidate differs from the "
+                           "adapted codebook — the export round-trip "
+                           "is lossy")
+            probe = {"inputs": som_input(0)}
+            st1, b1, _ = _post(server2.url, probe)
+            st2, b2, _ = _post(server2.url, probe)
+            if st1 != 200 or st2 != 200 or b1 != b2:
+                bad.append(f"post-promotion SOM serving is not "
+                           f"byte-deterministic ({st1}/{st2})")
+            # ...and re-installing the SAME artifact answers the SAME
+            # bytes: export → promotion → serving is a fixed point
+            deployed = [os.path.join(deploy2, n)
+                        for n in sorted(os.listdir(deploy2))
+                        if n.endswith(".znn")]
+            rec = engine2.reload(deployed[-1])
+            if rec["outcome"] != "ok":
+                bad.append(f"re-reload of the deployed SOM artifact "
+                           f"failed: {rec}")
+            st3, b3, _ = _post(server2.url, probe)
+            if st3 != 200 or b3 != b1:
+                bad.append("re-installing the deployed SOM artifact "
+                           "changed the served bytes — the promotion "
+                           "round-trip is not byte-identical")
+        finally:
+            non200b = traffic2.finish()
+            server2.stop()
+            capture2.close()
+            engine2.close()
+        if non200b:
+            bad.append(f"non-200 answers under SOM serve-and-train: "
+                       f"{dict(non200b)}")
+        print(json.dumps({"scenario": "online", "ok": not bad,
+                          "violations": bad}))
     return 1 if bad else 0
 
 
@@ -1637,7 +2036,7 @@ def main(argv=None) -> int:
     p.add_argument("--retry-attempts", type=int, default=2)
     p.add_argument("--scenario", default="breaker",
                    choices=("breaker", "reload", "promote", "overload",
-                            "zoo", "slo", "wire", "fleet"),
+                            "zoo", "slo", "wire", "fleet", "online"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -1675,7 +2074,16 @@ def main(argv=None) -> int:
                         "raw 500s/hangs, ejection + re-admission), "
                         "one rolling promotion walked to completion "
                         "and a regressed candidate rolled back "
-                        "fleet-wide mid-walk (docs/fleet.md)")
+                        "fleet-wide mid-walk (docs/fleet.md); "
+                        "online: the live-data loop — capture tap on "
+                        "a real server, continual trainer replaying "
+                        "it in bless/refuse rounds, promotion watcher "
+                        "deploying blessed candidates; a poisoned "
+                        "round refused at blessing, a blessed-but-"
+                        "toxic candidate rolled back by the SLO "
+                        "watch, capture fail-open fault-injected, "
+                        "plus the Kohonen serve-and-train drill "
+                        "(docs/online.md)")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -1736,6 +2144,8 @@ def main(argv=None) -> int:
         return _wire_scenario(args)
     if args.scenario == "fleet":
         return _fleet_scenario(args)
+    if args.scenario == "online":
+        return _online_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

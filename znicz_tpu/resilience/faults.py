@@ -55,6 +55,13 @@ Instrumented sites (grow this list as subsystems adopt injection):
                        is the deterministic "one slow-but-not-sick
                        replica" the hedging drill keys on
                        (``chaos --scenario overload``)
+``capture.append``     the serving traffic tap's request-path enqueue
+                       (online.capture.CaptureLog.append) — the tap is
+                       FAIL-OPEN: an error fault here must surface as
+                       a counted capture_dropped_total{reason=error}
+                       drop, never as a failed or delayed /predict
+                       answer (``chaos --scenario online`` +
+                       tests/test_online.py pin this)
 =====================  ====================================================
 """
 

@@ -57,7 +57,8 @@ class ResponseCache:
 
     def __init__(self, max_entries: int = 1024,
                  max_bytes: int = 32_000_000,
-                 model: str | None = None):
+                 model: str | None = None,
+                 instruments: tuple | None = None):
         if int(max_entries) < 1 or int(max_bytes) < 1:
             raise ValueError(f"cache bounds must be >= 1, got "
                              f"max_entries={max_entries!r} "
@@ -67,6 +68,13 @@ class ResponseCache:
         #: label value for the registry families (None = the
         #: single-model surface: label-free series)
         self._labels = {} if model is None else {"model": model}
+        #: (hits counter, misses counter, bytes gauge) — default the
+        #: serving families; the fleet router reuses this cache with
+        #: its own fleet_response_cache_* instruments so the two
+        #: tiers' hit rates never mix in one series
+        self._hits, self._misses, self._bytes = (
+            instruments if instruments is not None
+            else (_hits, _misses, _bytes))
         self._lock = threading.Lock()
         self._od: collections.OrderedDict[bytes, np.ndarray] = \
             collections.OrderedDict()
@@ -95,9 +103,9 @@ class ResponseCache:
                 self._od.move_to_end(key)
                 self._stats["hits"] += 1
         if y is None:
-            _misses.inc(**self._labels)
+            self._misses.inc(**self._labels)
         else:
-            _hits.inc(**self._labels)
+            self._hits.inc(**self._labels)
         return y
 
     def put(self, key: bytes, y: np.ndarray) -> None:
@@ -123,13 +131,13 @@ class ResponseCache:
                 self._nbytes -= evicted.nbytes
                 self._stats["evictions"] += 1
             nbytes = self._nbytes
-        _bytes.set(nbytes, **self._labels)
+        self._bytes.set(nbytes, **self._labels)
 
     def clear(self) -> None:
         with self._lock:
             self._od.clear()
             self._nbytes = 0
-        _bytes.set(0, **self._labels)
+        self._bytes.set(0, **self._labels)
 
     def metrics(self) -> dict:
         with self._lock:

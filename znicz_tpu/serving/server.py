@@ -100,6 +100,15 @@ docs/serving.md "Wire protocol"):
   numbers as Prometheus text exposition v0.0.4, including the
   ``predict_latency_ms`` histogram and ``breaker_state``.
 
+Traffic tap (``--capture-dir``; docs/online.md): every SERVED
+``/predict`` answer appends one (input, outputs) record to a bounded
+fsync'd segment ring the continual trainer replays — fail-open by
+construction (the tap only enqueues; a capture failure of ANY kind is
+a counted drop, never a failed or delayed answer) and sampled
+(``--capture-sample``).  Served 200s also carry an
+``X-Model-Generation`` header — the backend-reported generation the
+fleet router's response memoization keys on.
+
 Request correlation: every ``POST /predict`` carries an
 ``X-Request-Id`` (client-supplied or generated) echoed in the response
 and threaded through the batcher/engine spans
@@ -385,7 +394,8 @@ class ServingServer:
                  shed_target_ms: float | None = None,
                  shed_interval_ms: float = 500.0,
                  memo_entries: int = 0,
-                 memo_mb: float = 32.0):
+                 memo_mb: float = 32.0,
+                 capture=None):
         knobs = (max_batch, max_wait_ms, max_queue, shed_target_ms)
         if batcher is not None and any(k is not None for k in knobs):
             # silently dropping the knobs would look like they applied
@@ -484,6 +494,14 @@ class ServingServer:
                         max_bytes=int(memo_mb * 1e6),
                         model=(entry.name if self._zoo_explicit
                                else None))
+        #: optional traffic tap (znicz_tpu.online.capture.CaptureLog;
+        #: ``serve --capture-dir``): every SERVED /predict answer —
+        #: memo hits included, they are real traffic — appends one
+        #: (input, outputs) record for the continual trainer to
+        #: replay.  Fail-open by the tap's own contract: append never
+        #: raises and never does file I/O on this thread.  Caller owns
+        #: the lifecycle (close), same rule as an attached SLO engine.
+        self.capture = capture
         #: the DEFAULT model's batcher — the single-model surface
         #: (metrics, statusz, overload status) keeps reading it
         self.batcher = zoo.resolve().batcher
@@ -604,15 +622,21 @@ class ServingServer:
                     return None
                 return self.rfile.read(n) if n > 0 else b""
 
-            def _reply_outputs(self, y: np.ndarray,
-                               binary: bool) -> None:
+            def _reply_outputs(self, y: np.ndarray, binary: bool,
+                               generation: int | None = None) -> None:
                 """The 200 leg, content-negotiated: binary tensor for
                 ``Accept: application/x-znicz-tensor``, else JSON
                 bytes BYTE-IDENTICAL to the historical
                 ``json.dumps({"outputs": y.tolist()})`` — built by the
                 single-buffer encoder (serving.wire).  The encode is
                 its own span so the flight-recorder stage breakdown
-                prices it next to queue/dispatch/forward."""
+                prices it next to queue/dispatch/forward.
+
+                ``generation`` rides out as ``X-Model-Generation`` —
+                the backend-reported generation the fleet router's
+                response memoization keys on (a stale health probe
+                must not let the router cache one generation's answer
+                under another's key; docs/fleet.md)."""
                 with tracing.span("server.encode"):
                     if binary:
                         body = wire.encode_tensor(
@@ -621,7 +645,23 @@ class ServingServer:
                     else:
                         body = wire.encode_json_outputs(y)
                         ctype = "application/json"
-                self._send(200, body, ctype)
+                headers = ({"X-Model-Generation": str(int(generation))}
+                           if generation is not None else None)
+                self._send(200, body, ctype, headers)
+
+            def _capture(self, entry, x: np.ndarray,
+                         y: np.ndarray) -> None:
+                """The traffic tap: one (input, outputs) record per
+                SERVED answer, enqueued AFTER the response bytes went
+                out.  append is fail-open by contract (no raise, no
+                file I/O on this thread) — a full disk or slow fsync
+                costs a dropped capture record, never a /predict
+                answer (pinned by the capture.append fault test)."""
+                cap = outer.capture
+                if cap is not None:
+                    cap.append(x, y,
+                               model=(entry.name if outer._zoo_explicit
+                                      else None))
 
             def _admin_authorized(self) -> bool:
                 """True when no admin token is configured, or the
@@ -979,7 +1019,9 @@ class ServingServer:
                         ckey = cache.key_for(memo_gen, x)
                         y = cache.get(ckey)
                         if y is not None:
-                            self._reply_outputs(y, want_binary)
+                            self._reply_outputs(y, want_binary,
+                                                generation=memo_gen)
+                            self._capture(entry, x, y)
                             return
                 # residency: the request that wakes a cold model pays
                 # its page-in here (single-flight — a concurrent
@@ -1060,7 +1102,9 @@ class ServingServer:
                             # (ckey is None when the cache is off OR
                             # bypassed for a mixed-generation fleet)
                             cache.put(ckey, y)
-                        self._reply_outputs(y, want_binary)
+                        self._reply_outputs(y, want_binary,
+                                            generation=entry.generation)
+                        self._capture(entry, x, y)
 
         self.server = DeepBacklogHTTPServer((host, port), Handler)
         # collector registration comes AFTER the bind: if the socket
@@ -1308,6 +1352,10 @@ class ServingServer:
             # only when memoization is ON: the pre-memo JSON surface
             # must not grow keys under scrapers pinned to it
             m["response_cache"] = rc.metrics()
+        if self.capture is not None:
+            # same opt-in rule as the response cache: the capture
+            # block only exists when the tap does
+            m["capture"] = self.capture.metrics()
         slo = self.slo_status()
         if slo is not None:
             m["slo"] = slo
@@ -1537,6 +1585,21 @@ def main(argv=None) -> int:
     p.add_argument("--memoize-mb", type=float, default=32.0,
                    help="byte bound per model's response cache "
                         "(entries evict LRU-first under either bound)")
+    p.add_argument("--capture-dir", default=None, metavar="DIR",
+                   help="traffic tap for the live-data loop: append "
+                        "every served /predict (input, outputs) pair "
+                        "to a bounded fsync'd segment ring in DIR — "
+                        "fail-open (a capture failure never fails or "
+                        "delays an answer; counted in "
+                        "capture_dropped_total), replayed by `python "
+                        "-m znicz_tpu online-train` (docs/online.md)")
+    p.add_argument("--capture-sample", type=float, default=1.0,
+                   help="fraction of served answers captured "
+                        "(seeded; the rest count as "
+                        "capture_dropped_total{reason=sampled})")
+    p.add_argument("--capture-mb", type=float, default=64.0,
+                   help="byte budget of the capture ring: past it the "
+                        "oldest closed segment files are deleted")
     p.add_argument("--default-deadline-ms", type=float, default=None,
                    help="end-to-end deadline attached to requests "
                         "that send neither X-Deadline-Ms nor a body "
@@ -1800,6 +1863,7 @@ def main(argv=None) -> int:
     profile_dir = args.profile_dir or profiler.dir_from_env()
     server = None
     slo_engine = None
+    capture = None
     try:
         # the trace starts BEFORE the server exists: the profiler's
         # session hooks every live Python thread, and hooking a
@@ -1834,6 +1898,20 @@ def main(argv=None) -> int:
         # construct THEN start: if start() unwinds (KeyboardInterrupt),
         # `server` must already be bound so the finally below can stop
         # it — a skipped stop() leaks the registry collector
+        if args.capture_dir:
+            # the traffic tap (docs/online.md): built before the
+            # server so the first served answer can already capture;
+            # closed in the finally below — the ring outlives the
+            # process (a restarted server appends after it)
+            from ..online.capture import CaptureLog
+            capture = CaptureLog(
+                args.capture_dir,
+                max_bytes=int(args.capture_mb * 1e6),
+                sample=args.capture_sample)
+            print(f"capturing served traffic into "
+                  f"{args.capture_dir} (sample "
+                  f"{args.capture_sample:g}, budget "
+                  f"{args.capture_mb:g} MB)", flush=True)
         kwargs = dict(host=args.host, port=args.port,
                       max_batch=args.max_batch,
                       max_wait_ms=args.max_wait_ms,
@@ -1844,7 +1922,8 @@ def main(argv=None) -> int:
                       default_deadline_ms=args.default_deadline_ms,
                       shed_target_ms=shed_target_ms,
                       memo_entries=args.memoize,
-                      memo_mb=args.memoize_mb)
+                      memo_mb=args.memoize_mb,
+                      capture=capture)
         server = (ServingServer(engine, **kwargs) if zoo is None
                   else ServingServer(zoo=zoo, **kwargs))
         server.start()
@@ -1952,6 +2031,10 @@ def main(argv=None) -> int:
             slo_engine.stop()
         if server is not None:
             server.stop()
+        if capture is not None:
+            # after server.stop(): no new appends, so the drain is
+            # bounded and the tail fsync covers the last answers
+            capture.close()
         closer()      # zoo.close() (every engine) or engine.close()
     return 0
 

@@ -65,6 +65,16 @@ _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 DEMO_SHAPES = {"mnist": 16, "wine": 13, "kohonen": 6}
 DEMO_FAMILIES = tuple(sorted(DEMO_SHAPES))
 
+#: REAL trained families (tools/make_zoo.sh; ROADMAP model-zoo depth):
+#: briefly-but-actually-trained workflows of the models/ package,
+#: exported through export_workflow — the autoencoder exercises the
+#: DECODER path (conv/pool encoder mirrored by depool/deconv) and
+#: mnist_rbm the RBM-pretrained sigmoid MLP.  name -> sample shape a
+#: /predict row must carry (the AE is a conv chain: NHWC, not flat)
+TRAINED_SAMPLE_SHAPES = {"autoencoder": (28, 28, 1),
+                         "mnist_rbm": (784,)}
+TRAINED_FAMILIES = tuple(sorted(TRAINED_SAMPLE_SHAPES))
+
 _resident = REGISTRY.gauge(
     "model_resident",
     "whether a zoo model's device weight copy is resident (1) or "
@@ -644,5 +654,70 @@ def make_demo_zoo(directory: str, families=DEMO_FAMILIES,
     for i, fam in enumerate(families):
         p = os.path.join(directory, f"{fam}.znn")
         write_demo_model(p, fam, seed=seed + i)
+        out[fam] = p
+    return out
+
+
+def write_trained_model(path: str, family: str, seed: int = 7,
+                        epochs: int = 1) -> str:
+    """A REAL (briefly) trained ``.znn`` of one ``znicz_tpu/models/``
+    family, exported through ``export_workflow``'s atomic publish.
+
+    ``autoencoder`` trains the MNIST conv autoencoder (conv 5×5×16 →
+    maxpool → depooling → deconv, MSE) — the decoder path the serving
+    engine replays winner offsets for; ``mnist_rbm`` runs the greedy
+    CD-1 stack pretraining and the sigmoid-MLP fine-tune.  Config
+    trees are shrunk (synthetic data, one epoch, small hidden sizes)
+    so ``tools/make_zoo.sh`` builds in seconds, then restored — the
+    point is real trained weights through the real training path, not
+    convergence."""
+    from .. import prng
+    from ..backends import Device
+    from ..config import root
+    from ..export import export_workflow
+
+    if family == "autoencoder":
+        from ..models import autoencoder as mod
+        cfg = root.mnist_ae
+        saved = cfg.to_dict()
+        cfg.update({"minibatch_size": 32})
+        cfg.synthetic.update({"n_train": 192, "n_valid": 32,
+                              "n_test": 0})
+        cfg.decision.update({"max_epochs": epochs,
+                             "fail_iterations": 5})
+        try:
+            prng.seed_all(seed)
+            wf = mod.run(device=Device.create("xla"), epochs=epochs)
+        finally:
+            cfg.update(saved)
+    elif family == "mnist_rbm":
+        from ..models import mnist_rbm as mod
+        cfg = root.mnist_rbm
+        saved = cfg.to_dict()
+        cfg.update({"minibatch_size": 32, "hidden": [32, 16]})
+        cfg.synthetic.update({"n_train": 384, "n_valid": 64,
+                              "n_test": 0})
+        cfg.pretrain.update({"epochs": 1})
+        cfg.decision.update({"max_epochs": epochs,
+                             "fail_iterations": 5})
+        try:
+            prng.seed_all(seed)
+            wf = mod.run(device=Device.create("xla"), epochs=epochs)
+        finally:
+            cfg.update(saved)
+    else:
+        raise ValueError(f"unknown trained family {family!r} "
+                         f"(have {TRAINED_FAMILIES})")
+    return export_workflow(wf, path)
+
+
+def make_full_zoo(directory: str, seed: int = 7) -> dict:
+    """The demo trio plus both trained families — what
+    ``tools/make_zoo.sh`` builds and ``tools/zoo_smoke.sh`` drills
+    per family."""
+    out = make_demo_zoo(directory, seed=seed)
+    for i, fam in enumerate(TRAINED_FAMILIES):
+        p = os.path.join(directory, f"{fam}.znn")
+        write_trained_model(p, fam, seed=seed + 10 + i)
         out[fam] = p
     return out

@@ -219,6 +219,30 @@ def statusz_text(server=None, *, recorder=None, extra: dict | None = None
                 budget = ov.get("retry_budget")
                 if budget:
                     lines.append("retry budget: " + _fmt_kv(budget))
+        capture = getattr(server, "capture", None)
+        if capture is not None:
+            # the traffic tap feeding the live-data loop: is the ring
+            # filling, dropping, or erroring — the first question when
+            # the continual trainer reports starved rounds
+            # (docs/online.md)
+            try:
+                cm = capture.metrics()
+            except Exception:
+                cm = None
+            if cm:
+                lines += ["", "traffic capture", "-" * 15]
+                lines.append(_fmt_kv({
+                    "dir": cm.get("directory"),
+                    "records": cm.get("records"),
+                    "bytes": cm.get("bytes"),
+                    "segments": cm.get("segments"),
+                    "sample": cm.get("sample")}))
+                lines.append(_fmt_kv({
+                    "queued": cm.get("queued"),
+                    "dropped_sampled": cm.get("dropped_sampled"),
+                    "dropped_backlog": cm.get("dropped_backlog"),
+                    "dropped_error": cm.get("dropped_error"),
+                    "fsync_errors": cm.get("fsync_errors")}))
         slo_fn = getattr(server, "slo_status", None)
         slo = slo_fn() if slo_fn is not None else None
         if slo and slo.get("slos"):
