@@ -622,6 +622,7 @@ def bench_serve(args) -> int:
     import urllib.request
 
     n_fleet = max(0, getattr(args, "fleet", 0))
+    place = bool(getattr(args, "placement", False))
     result = {"metric": "serve_requests_per_sec_per_core",
               "value": None, "unit": "req/s/core",
               "vs_baseline": None}
@@ -629,6 +630,10 @@ def bench_serve(args) -> int:
     proc = None
     fleet_procs = []
     backend_urls = []
+    if place and not n_fleet:
+        result["error"] = "--placement needs --fleet N (it shards a " \
+                          "zoo over a fleet)"
+        return _emit(result)
     try:
         model = args.serve_model
         width = args.serve_width
@@ -643,7 +648,20 @@ def bench_serve(args) -> int:
                 s.bind(("127.0.0.1", 0))
                 return s.getsockname()[1]
 
+        zoo_dir = os.path.join(tmp, "zoo")
+        if place:
+            # placement mode shards a multi-tenant zoo, not N copies
+            # of one model — that IS the footprint being measured
+            from znicz_tpu.serving import zoo as zoo_mod
+            zoo_mod.make_demo_zoo(zoo_dir)
+
         def boot_serve(serve_port: int) -> subprocess.Popen:
+            if place:
+                return subprocess.Popen(
+                    [sys.executable, "-m", "znicz_tpu", "serve",
+                     "--zoo", zoo_dir, "--port", str(serve_port),
+                     "--max-wait-ms", "1"],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
             return subprocess.Popen(
                 [sys.executable, "-m", "znicz_tpu", "serve",
                  "--model", model, "--port", str(serve_port),
@@ -691,12 +709,29 @@ def bench_serve(args) -> int:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "znicz_tpu", "route",
                  "--port", str(port)]
+                + (["--placement", "1",
+                    "--probe-interval-s", "0.3"] if place else [])
                 + [f for i, u in enumerate(backend_urls)
                    for f in ("--backend", f"{u},name=b{i}")],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
             url = f"http://127.0.0.1:{port}/"
             if wait_health(url, proc, "route") is None:
                 return _emit(result)
+            if place:
+                # measure the PLACED steady state, not the discovery
+                # transient: wait for the map to cover the zoo
+                from znicz_tpu.serving.zoo import DEMO_FAMILIES
+                for _ in range(80):
+                    h = wait_health(url, proc, "route")
+                    amap = ((h or {}).get("placement") or {}) \
+                        .get("assignments") or {}
+                    if set(amap) >= set(DEMO_FAMILIES):
+                        break
+                    time.sleep(0.25)
+                else:
+                    result["error"] = ("placement never covered the "
+                                       "demo zoo")
+                    return _emit(result)
         else:
             port = free_port()
             proc = boot_serve(port)
@@ -715,6 +750,19 @@ def bench_serve(args) -> int:
         headers = ({"Content-Type": wire_mod.CONTENT_TYPE,
                     "Accept": wire_mod.CONTENT_TYPE} if binary
                    else {"Content-Type": "application/json"})
+        tenants: list = []
+        tenant_bodies: dict = {}
+        if place:
+            # cycle the zoo's tenants: placement routing (X-Model →
+            # the tenant's placed backend) is the path under test
+            from znicz_tpu.serving.zoo import DEMO_SHAPES
+            tenants = sorted(DEMO_SHAPES)
+            for name in tenants:
+                tx = np.full((rows, DEMO_SHAPES[name]), 0.1,
+                             dtype=np.float32)
+                tenant_bodies[name] = (
+                    wire_mod.encode_tensor(tx) if binary
+                    else json.dumps({"inputs": tx.tolist()}).encode())
 
         def body_for(i: int) -> bytes:
             # i < 0 = the FIXED repeat payload; unique bodies perturb
@@ -731,15 +779,21 @@ def bench_serve(args) -> int:
         repeat_pct = int(round(args.repeat_fraction * 100))
         n_clients = max(1, args.serve_clients)
 
-        def post_conn(conn, body):
-            conn.request("POST", "/predict", body, headers)
+        def post_conn(conn, body, hdrs=None):
+            conn.request("POST", "/predict", body,
+                         hdrs if hdrs is not None else headers)
             r = conn.getresponse()
             r.read()
             return r.status
 
         warm = http.client.HTTPConnection("127.0.0.1", port,
                                           timeout=60)
-        post_conn(warm, fixed_body)   # one warm lap before the clock
+        if place:                     # one warm lap per tenant
+            for name in tenants:
+                post_conn(warm, tenant_bodies[name],
+                          dict(headers, **{"X-Model": name}))
+        else:
+            post_conn(warm, fixed_body)
         warm.close()
         answers = []                  # (latency_ms, code)
         mu = threading.Lock()
@@ -754,11 +808,17 @@ def bench_serve(args) -> int:
                                               timeout=30)
             i = ci
             while not stop.is_set():
-                body = (fixed_body if (i % 100) < repeat_pct
-                        else body_for(i))
+                if place:
+                    name = tenants[i % len(tenants)]
+                    body = tenant_bodies[name]
+                    hdrs = dict(headers, **{"X-Model": name})
+                else:
+                    body = (fixed_body if (i % 100) < repeat_pct
+                            else body_for(i))
+                    hdrs = None
                 t0 = time.monotonic()
                 try:
-                    code = post_conn(conn, body)
+                    code = post_conn(conn, body, hdrs)
                 except Exception:
                     conn.close()
                     conn = http.client.HTTPConnection("127.0.0.1",
@@ -772,7 +832,12 @@ def bench_serve(args) -> int:
 
         def device_ms_now() -> float:
             # fleet mode: the chip time lives in the BACKENDS — sum
-            # their ledgers (the router itself runs no device code)
+            # their ledgers (the router itself runs no device code);
+            # a zoo backend's ledger is per-tenant, so placement mode
+            # sums the healthz model rows instead of the engine total
+            if place:
+                return sum(_scrape_zoo_device_ms(u)
+                           for u in backend_urls)
             if n_fleet:
                 return sum(_scrape_device_ms(u) for u in backend_urls)
             return _scrape_device_ms(url)
@@ -790,6 +855,23 @@ def bench_serve(args) -> int:
             t.join(30.0)
         duration_s = time.monotonic() - t_start
         device_ms = device_ms_now() - dev0
+        fleet_resident = zoo_total = None
+        if place:
+            # the footprint claim, measured at the end of the burst:
+            # fleet resident bytes vs one zoo's total weight bytes
+            fleet_resident = 0
+            zoo_total = 0
+            for u in backend_urls:
+                try:
+                    with urllib.request.urlopen(u + "healthz",
+                                                timeout=10) as r:
+                        snap = json.loads(r.read())
+                except Exception:
+                    continue
+                fleet_resident += int(snap.get("resident_bytes") or 0)
+                zoo_total = max(zoo_total, sum(
+                    int(row.get("weight_bytes") or 0)
+                    for row in snap.get("models") or []))
         for p_ in [proc] + fleet_procs:
             p_.send_signal(signal.SIGINT)
         for p_ in [proc] + fleet_procs:
@@ -823,9 +905,15 @@ def bench_serve(args) -> int:
         # the topology is part of a serve measurement's identity,
         # exactly like the mesh scheme on the training side: fleetxN
         # rows only pair with fleetxN rows in decide_levers
-        result["sharding"] = f"fleetx{n_fleet}" if n_fleet else "1x1"
+        result["sharding"] = (f"fleetx{n_fleet}+place" if place
+                              else f"fleetx{n_fleet}" if n_fleet
+                              else "1x1")
         if n_fleet:
             result["fleet"] = n_fleet
+        if place:
+            result["placement"] = 1     # the replication factor
+            result["fleet_resident_bytes"] = fleet_resident
+            result["zoo_total_bytes"] = zoo_total
         result["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                      time.gmtime())
         if codes.get(-1):
@@ -841,6 +929,19 @@ def bench_serve(args) -> int:
             p_.kill()
         shutil.rmtree(tmp, ignore_errors=True)
     return _emit(result)
+
+
+def _scrape_zoo_device_ms(url: str) -> float:
+    """A multi-tenant backend's device-ms, summed over its healthz
+    model rows (the per-tenant ledger; 0.0 when unreachable)."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url + "healthz", timeout=10) as r:
+            snap = json.loads(r.read())
+        return sum(float(row.get("device_ms") or 0.0)
+                   for row in snap.get("models") or [])
+    except Exception:
+        return 0.0
 
 
 def _scrape_device_ms(url: str) -> float:
@@ -1676,6 +1777,14 @@ def main(argv=None) -> int:
                         "backends), so the fabric's forwarding "
                         "overhead vs the single-process rows is a "
                         "measured trajectory (docs/fleet.md)")
+    p.add_argument("--placement", action="store_true",
+                   help="serve bench with --fleet N: backends serve "
+                        "the demo ZOO and the router runs "
+                        "--placement 1 — traffic cycles the tenants, "
+                        "the row stamps sharding='fleetxN+place' plus "
+                        "fleet_resident_bytes/zoo_total_bytes, so the "
+                        "footprint win of placement over N-clones is "
+                        "measured, not asserted (docs/fleet.md)")
     p.add_argument("--repeat-fraction", type=float, default=0.0,
                    help="serve bench: fraction [0,1] of requests "
                         "reusing ONE fixed input (the rest are "

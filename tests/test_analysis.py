@@ -893,6 +893,54 @@ class TestDeadlineDiscipline:
         assert rules_of(found) == ["deadline-discipline"]
         assert len(found) == 4
 
+    # ISSUE 16: the autoscaler's spawn/retire path waits on real
+    # subprocesses and polls real /healthz endpoints — exactly this
+    # rule's target shape.  Pin that the new fleet modules are
+    # patrolled with the shapes they actually use.
+
+    AUTOSCALER_BAD = """
+    import urllib.request
+
+    def retire(proc, drained):
+        drained.wait()                       # lost notify -> wedge
+        proc.wait()                          # unbounded subprocess wait
+        urllib.request.urlopen("http://b/healthz")   # prober, no bound
+"""
+
+    AUTOSCALER_GOOD = """
+    import urllib.request
+
+    def retire(proc, drained, deadline_s):
+        drained.wait(deadline_s)
+        try:
+            proc.wait(timeout=deadline_s)    # bounded reap
+        except Exception:
+            proc.kill()
+            proc.wait(timeout=5.0)
+        with urllib.request.urlopen("http://b/healthz",
+                                    timeout=2.0) as r:
+            return r.read()
+"""
+
+    def test_autoscaler_subprocess_waits_patrolled(self, tmp_path):
+        found = lint(tmp_path, self.AUTOSCALER_BAD,
+                     [DeadlineDisciplineRule()],
+                     rel="znicz_tpu/fleet/autoscaler.py")
+        assert rules_of(found) == ["deadline-discipline"]
+        assert len(found) == 3          # wait / proc.wait / urlopen
+
+    def test_autoscaler_bounded_shapes_stay_silent(self, tmp_path):
+        assert lint(tmp_path, self.AUTOSCALER_GOOD,
+                    [DeadlineDisciplineRule()],
+                    rel="znicz_tpu/fleet/autoscaler.py") == []
+
+    def test_placement_module_patrolled(self, tmp_path):
+        found = lint(tmp_path, DEADLINE_BAD,
+                     [DeadlineDisciplineRule()],
+                     rel="znicz_tpu/fleet/placement.py")
+        assert rules_of(found) == ["deadline-discipline"]
+        assert len(found) == 4
+
     def test_blocking_get_block_true_without_timeout(self, tmp_path):
         found = lint(tmp_path, """
     def loop(q):

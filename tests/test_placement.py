@@ -1,0 +1,562 @@
+"""Placement-aware zoo sharding + elastic autoscaling (ISSUE 16).
+
+Pins the placement engine's pure policy (scoring determinism,
+weighted-rendezvous consistency under join/leave, pins, replication),
+the zoo's placement-hint eviction contract, the router's enforcement
+(route inside the set, degrade to any-healthy when the set cannot
+answer, the token-gated ``POST /admin/placement`` 403/400/404 gates),
+and the autoscaler's hysteresis state machine (no flap on a
+one-window blip, cooldown, scale-in only of managed backends) — the
+hysteresis tests inject sample/spawn/retire/clock so no processes are
+booted.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from znicz_tpu.fleet import (Autoscaler, Backend, FleetRouter,
+                             PlacementCandidate, PlacementEngine,
+                             rank_backends, score_weight)
+from znicz_tpu.promotion.slo import SLOSample
+from znicz_tpu.resilience.breaker import CircuitBreaker
+from znicz_tpu.resilience.chaos import _write_demo_znn
+from znicz_tpu.serving.engine import ServingEngine
+from znicz_tpu.serving.server import ServingServer
+
+X = [[0.1, -0.2, 0.3, 0.4]]
+
+
+def _post(url, path, payload, headers=None, timeout=60.0):
+    req = urllib.request.Request(
+        url + path, json.dumps(payload).encode(),
+        {"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("placement_model")
+    path = os.path.join(str(d), "m.znn")
+    _write_demo_znn(path, seed=5)
+    return path
+
+
+def _server(model_path):
+    return ServingServer(
+        ServingEngine(model_path, backend="jax", buckets=(1, 2)),
+        max_wait_ms=1.0).start()
+
+
+# -- scoring ----------------------------------------------------------------
+
+class TestScoring:
+    def test_rank_is_deterministic(self):
+        cands = [PlacementCandidate(f"b{i}") for i in range(5)]
+        first = rank_backends("mnist", cands)
+        assert first == rank_backends("mnist", list(reversed(cands)))
+        assert sorted(first) == [f"b{i}" for i in range(5)]
+
+    def test_different_models_spread(self):
+        # rendezvous hashing spreads tenants: over many models the
+        # top choice must not collapse onto one backend
+        cands = [PlacementCandidate(f"b{i}") for i in range(4)]
+        tops = {rank_backends(f"model-{i}", cands)[0]
+                for i in range(40)}
+        assert len(tops) == 4
+
+    def test_residency_affinity_boosts(self):
+        # the backend already holding the weights outranks an
+        # otherwise-identical one for THAT model only
+        score_res = score_weight(
+            "mnist", PlacementCandidate("a", resident={"mnist"}))
+        score_cold = score_weight("mnist", PlacementCandidate("b"))
+        assert score_res > score_cold
+        assert score_weight(
+            "wine", PlacementCandidate("a", resident={"mnist"})
+        ) == pytest.approx(score_weight(
+            "wine", PlacementCandidate("b")))
+
+    def test_busy_penalty_dispreferred_never_excluded(self):
+        busy = score_weight("m", PlacementCandidate("a", busy=3.0))
+        quiet = score_weight("m", PlacementCandidate("b", busy=0.0))
+        assert 0.0 < busy < quiet
+
+
+# -- the engine -------------------------------------------------------------
+
+class TestEngine:
+    MODELS = [f"model-{i}" for i in range(30)]
+
+    def cands(self, n):
+        return [PlacementCandidate(f"b{i}") for i in range(n)]
+
+    def test_replication_validation(self):
+        with pytest.raises(ValueError):
+            PlacementEngine(0)
+
+    def test_plan_is_stable(self):
+        e = PlacementEngine(1)
+        p1 = e.plan(self.MODELS, self.cands(4))
+        p2 = e.plan(self.MODELS, self.cands(4))
+        assert p1["assignments"] == p2["assignments"]
+        assert p2["moved"] == []
+        assert p2["generation"] == p1["generation"] + 1
+
+    def test_join_moves_a_bounded_fraction(self):
+        # the rendezvous property: a 5th backend joining steals only
+        # the tenants that rank it first (~1/5 of them), never
+        # reshuffles the fleet
+        e = PlacementEngine(1)
+        before = e.plan(self.MODELS, self.cands(4))["assignments"]
+        after = e.plan(self.MODELS, self.cands(5),
+                       cause="join")
+        moved = after["moved"]
+        assert 0 < len(moved) < len(self.MODELS) * 0.5
+        for m in self.MODELS:
+            if m not in moved:
+                assert after["assignments"][m] == before[m]
+        assert all(after["assignments"][m] == ["b4"] for m in moved)
+
+    def test_leave_only_moves_the_departed_backends_tenants(self):
+        e = PlacementEngine(1)
+        before = e.plan(self.MODELS, self.cands(5))["assignments"]
+        after = e.plan(self.MODELS, self.cands(4), cause="leave")
+        orphans = {m for m, v in before.items() if v == ["b4"]}
+        assert set(after["moved"]) == orphans
+
+    def test_replication_places_n_distinct_backends(self):
+        e = PlacementEngine(2)
+        plan = e.plan(self.MODELS, self.cands(4))
+        for names in plan["assignments"].values():
+            assert len(names) == 2
+            assert len(set(names)) == 2
+
+    def test_replication_clamped_to_membership(self):
+        e = PlacementEngine(3)
+        plan = e.plan(["m"], self.cands(2))
+        assert len(plan["assignments"]["m"]) == 2
+
+    def test_pins_beat_scoring_and_survive_recomputes(self):
+        e = PlacementEngine(1)
+        e.pin("model-0", ["b9"])
+        p = e.plan(self.MODELS, self.cands(4), cause="pin")
+        assert p["assignments"]["model-0"] == ["b9"]
+        p = e.plan(self.MODELS, self.cands(4))
+        assert p["assignments"]["model-0"] == ["b9"]
+        e.pin("model-0", None)          # null clears
+        p = e.plan(self.MODELS, self.cands(4))
+        assert p["assignments"]["model-0"] == ["b9"] or \
+            p["assignments"]["model-0"][0].startswith("b")
+        assert "model-0" not in e.pins()
+        with pytest.raises(ValueError):
+            e.pin("model-0", [])
+
+    def test_empty_membership_yields_empty_map(self):
+        e = PlacementEngine(1)
+        e.plan(self.MODELS, self.cands(3))
+        plan = e.plan(self.MODELS, [])
+        assert plan["assignments"] == {}
+        assert e.placed("model-0") == ()
+
+    def test_backend_models_inverts_the_map(self):
+        e = PlacementEngine(1)
+        plan = e.plan(self.MODELS, self.cands(3))["assignments"]
+        for b in ("b0", "b1", "b2"):
+            assert e.backend_models(b) == sorted(
+                m for m, v in plan.items() if b in v)
+
+
+# -- zoo placement hints ----------------------------------------------------
+
+class TestZooHints:
+    def test_hint_releases_non_placed_and_biases_eviction(self,
+                                                          tmp_path):
+        from znicz_tpu.serving import zoo as zoo_mod
+        paths = zoo_mod.make_demo_zoo(str(tmp_path))
+        zoo = zoo_mod.ModelZoo(labeled_metrics=False)
+        for name, p in sorted(paths.items()):
+            zoo.add(name, p, backend="jax", buckets=(1,))
+        for entry in zoo.entries():
+            entry.engine.ensure_weights()
+        assert all(e.engine.weights_resident()
+                   for e in zoo.entries())
+        out = zoo.set_placement_hint(["mnist", "nope"])
+        assert out["placed"] == ["mnist"]
+        assert sorted(out["released"]) == ["kohonen", "wine"]
+        assert out["unknown"] == ["nope"]
+        resident = {e.name: e.engine.weights_resident()
+                    for e in zoo.entries()}
+        assert resident == {"mnist": True, "wine": False,
+                            "kohonen": False}
+        # a degraded-mode page-in of a non-placed tenant evicts FIRST
+        # under budget pressure, even though it is the most recent
+        zoo.touch(zoo.resolve("wine"))
+        zoo.memory_budget = int(zoo.resident_bytes()) - 1
+        zoo.evict_to_budget(keep=None)
+        assert zoo.resolve("mnist").engine.weights_resident()
+        assert not zoo.resolve("wine").engine.weights_resident()
+        # clearing the hint restores pure LRU (no release)
+        out = zoo.set_placement_hint(None)
+        assert out["placed"] is None and out["released"] == []
+
+
+# -- router enforcement -----------------------------------------------------
+
+X16 = [[0.2] * 16]                      # the demo zoo's mnist family
+
+
+class TestRouterEnforcement:
+    @pytest.fixture()
+    def placed_fleet(self, tmp_path_factory):
+        from znicz_tpu.serving import zoo as zoo_mod
+        d = tmp_path_factory.mktemp("placement_zoo")
+        paths = zoo_mod.make_demo_zoo(str(d))
+        servers = []
+        for _ in range(2):
+            zoo = zoo_mod.ModelZoo(labeled_metrics=False)
+            for name, p in sorted(paths.items()):
+                zoo.add(name, p, backend="jax", buckets=(1,))
+            servers.append(ServingServer(zoo=zoo,
+                                         max_wait_ms=1.0).start())
+        router = FleetRouter(
+            [Backend(s.url, name=f"b{i}",
+                     breaker=CircuitBreaker(failure_threshold=2,
+                                            cooldown_s=30.0))
+             for i, s in enumerate(servers)],
+            probe_interval_s=30.0,      # recomputes driven by hand
+            admin_token="sesame",
+            placement=PlacementEngine(1)).start()
+        yield router, servers
+        router.stop()
+        for s in servers:
+            s.stop()
+
+    def _admin(self, router, payload, token="sesame"):
+        headers = {"X-Admin-Token": token} if token else {}
+        return _post(router.url, "admin/placement", payload, headers)
+
+    def test_placed_routing_and_header(self, placed_fleet):
+        router, _servers = placed_fleet
+        code, plan, _h = self._admin(router, {"model": "mnist",
+                                              "backends": ["b1"]})
+        assert code == 200
+        assert plan["assignments"]["mnist"] == ["b1"]
+        for _ in range(6):
+            # the router never parses bodies: the tenant rides X-Model
+            code, _b, headers = _post(router.url, "predict",
+                                      {"inputs": X16},
+                                      {"X-Model": "mnist"})
+            assert code == 200
+            assert headers.get("X-Fleet-Backend") == "b1"
+            assert headers.get("X-Fleet-Placement") == "placed"
+
+    def test_unplaced_model_routes_any(self, placed_fleet):
+        router, _servers = placed_fleet
+        code, _b, headers = _post(router.url, "predict",
+                                  {"inputs": [[0.1] * 13]},
+                                  {"X-Model": "wine"})
+        assert code == 200
+        assert headers.get("X-Fleet-Placement") == "any"
+
+    def test_empty_set_degrades_instead_of_refusing(self,
+                                                    placed_fleet):
+        from znicz_tpu.telemetry.registry import REGISTRY
+        router, _servers = placed_fleet
+        # pin the tenant to a backend name that is not in rotation
+        # (the admin surface refuses unknown names, so drive the
+        # engine directly): the placed set can never answer, the
+        # router must degrade rather than refuse
+        router.placement.pin("mnist", ["ghost"])
+        router.recompute_placement(cause="pin")
+        assert router.placement_status()["assignments"]["mnist"] \
+            == ["ghost"]
+        before = (REGISTRY.counter("placement_degraded_total")
+                  .as_dict() or {}).get("model=mnist", 0)
+        code, _b, headers = _post(router.url, "predict",
+                                  {"inputs": X16},
+                                  {"X-Model": "mnist"})
+        assert code == 200              # degraded, never refused
+        assert headers.get("X-Fleet-Placement") == "degraded"
+        after = (REGISTRY.counter("placement_degraded_total")
+                 .as_dict() or {}).get("model=mnist", 0)
+        assert after > before
+
+    def test_admin_gates_403_400_404(self, placed_fleet, model_path):
+        router, _servers = placed_fleet
+        # 403: wrong/missing token
+        code, body, _h = self._admin(router, {"action": "rebalance"},
+                                     token="wrong")
+        assert code == 403
+        # 400: junk bodies
+        for junk in ({"action": "explode"},
+                     {"model": 7},
+                     {"model": "demo", "backends": "b1"},
+                     {"model": "demo", "backends": []},
+                     {}):
+            code, body, _h = self._admin(router, junk)
+            assert code == 400, junk
+        # 404: placement disabled on this router
+        server = _server(model_path)
+        bare = FleetRouter([Backend(server.url, name="b0")],
+                           probe_interval_s=30.0).start()
+        try:
+            code, body, _h = _post(bare.url, "admin/placement",
+                                   {"action": "rebalance"})
+            assert code == 404
+        finally:
+            bare.stop()
+            server.stop()
+
+    def test_rebalance_returns_the_plan_and_health_reports_it(
+            self, placed_fleet):
+        router, _servers = placed_fleet
+        code, plan, _h = self._admin(router, {"action": "rebalance"})
+        assert code == 200
+        assert plan["cause"] == "admin"
+        with urllib.request.urlopen(router.url + "healthz",
+                                    timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["placement"]["generation"] == plan["generation"]
+
+    def test_membership_change_recomputes(self, placed_fleet,
+                                          model_path):
+        router, _servers = placed_fleet
+        gen0 = router.placement_status()["generation"]
+        extra = _server(model_path)
+        try:
+            router.add_backend(Backend(extra.url, name="b9"))
+            assert router.placement_status()["generation"] == gen0 + 1
+            with pytest.raises(KeyError):
+                router.remove_backend("nope")
+            router.remove_backend("b9")
+            assert router.placement_status()["generation"] == gen0 + 2
+        finally:
+            extra.stop()
+
+    def test_last_backend_never_removed(self, model_path):
+        server = _server(model_path)
+        router = FleetRouter([Backend(server.url, name="b0")],
+                             probe_interval_s=30.0).start()
+        try:
+            with pytest.raises(ValueError):
+                router.remove_backend("b0")
+        finally:
+            router.stop()
+            server.stop()
+
+
+# -- autoscaler hysteresis --------------------------------------------------
+
+class _FakeBackend:
+    def __init__(self, name):
+        self.name = name
+
+
+class _FakeRouter:
+    def __init__(self, names=("s0",)):
+        self.names = list(names)
+        self.status_fn = None
+
+    def backend_count(self):
+        return len(self.names)
+
+    def add_backend(self, backend):
+        self.names.append(backend.name)
+
+    def remove_backend(self, name):
+        if len(self.names) <= 1:
+            raise ValueError("refusing to remove the last backend")
+        self.names.remove(name)
+
+    def attach_autoscaler(self, fn):
+        self.status_fn = fn
+
+
+def _sample(at, requests, errors=0.0):
+    return SLOSample(at=at, latency_cum={}, latency_count=0.0,
+                     requests=requests, errors_5xx=errors)
+
+
+class _Harness:
+    """An Autoscaler wired to fakes: scripted samples, a controllable
+    clock, spawn/retire ledgers."""
+
+    def __init__(self, **kw):
+        self.router = _FakeRouter()
+        self.now = 1000.0
+        self.samples = []
+        self.spawned = []
+        self.retired = []
+
+        def spawn(index):
+            b = _FakeBackend(f"as{index}")
+            self.spawned.append(b.name)
+            return b, object()
+
+        def retire(backend, _handle):
+            self.retired.append(backend.name)
+
+        kw.setdefault("min_backends", 1)
+        kw.setdefault("max_backends", 3)
+        kw.setdefault("objective", "availability")
+        kw.setdefault("target", 0.999)
+        kw.setdefault("max_burn_rate", 2.0)
+        kw.setdefault("min_events", 5)
+        kw.setdefault("breach_windows", 2)
+        kw.setdefault("idle_windows", 3)
+        kw.setdefault("idle_rps", 0.5)
+        kw.setdefault("cooldown_s", 10.0)
+        self.scaler = Autoscaler(
+            self.router, spawn=spawn, retire=retire,
+            sample_fn=self._next_sample, clock=lambda: self.now, **kw)
+        self.requests = 0.0
+        self.errors = 0.0
+        self.scaler._prev = _sample(self.now, 0.0)   # baseline
+
+    def _next_sample(self):
+        return _sample(self.now, self.requests, self.errors)
+
+    def hot_tick(self):
+        """One window of heavy burning traffic."""
+        self.now += 1.0
+        self.requests += 100.0
+        self.errors += 50.0
+        return self.scaler.tick()
+
+    def idle_tick(self):
+        """One window of silence."""
+        self.now += 1.0
+        return self.scaler.tick()
+
+    def sleep(self, s):
+        self.now += s
+
+
+class TestAutoscalerHysteresis:
+    def test_validation(self):
+        router = _FakeRouter()
+        with pytest.raises(ValueError):
+            Autoscaler(router, min_backends=0)
+        with pytest.raises(ValueError):
+            Autoscaler(router, min_backends=3, max_backends=2)
+        with pytest.raises(ValueError):
+            Autoscaler(router, objective="latency")   # no threshold
+        with pytest.raises(ValueError):
+            Autoscaler(router, objective="nonsense")
+
+    def test_one_window_blip_never_flaps(self):
+        h = _Harness()
+        out = h.hot_tick()
+        assert out["action"] is None
+        assert out["hot_windows"] == 1
+        # the blip passes; idleness resets the hot streak
+        out = h.idle_tick()
+        assert out["action"] is None
+        assert out["hot_windows"] == 0
+        out = h.hot_tick()
+        assert out["action"] is None     # streak restarted, not 2 yet
+        assert h.spawned == []
+
+    def test_sustained_burn_scales_out_then_cooldown_holds(self):
+        h = _Harness()
+        h.hot_tick()
+        out = h.hot_tick()
+        assert out["action"] == "scale_out:as0"
+        assert h.router.backend_count() == 2
+        assert h.spawned == ["as0"]
+        # still burning, but inside the cooldown: no second boot
+        out = h.hot_tick()
+        assert out["action"] is None
+        assert out["cooldown_remaining_s"] > 0
+        # the burn persisted THROUGH the cooldown, so the streak is
+        # already past breach_windows: the first post-cooldown hot
+        # window boots again, up to max
+        h.sleep(20.0)
+        out = h.hot_tick()
+        assert out["action"] == "scale_out:as1"
+        assert h.router.backend_count() == 3
+        # at max_backends: burning forever adds nothing
+        h.sleep(20.0)
+        for _ in range(4):
+            out = h.hot_tick()
+        assert out["action"] is None
+        assert h.router.backend_count() == 3
+
+    def test_min_events_gate_reads_quiet_not_burning(self):
+        h = _Harness(min_events=50)
+        h.now += 1.0
+        h.requests += 10.0              # < min_events: proves nothing
+        h.errors += 10.0
+        out = h.scaler.tick()
+        assert out["burn_rate"] == 0.0
+        assert out["hot_windows"] == 0
+
+    def test_idle_windows_scale_in_only_managed(self):
+        h = _Harness()
+        # no managed backends: idling forever never drains the
+        # operator's static floor
+        for _ in range(6):
+            out = h.idle_tick()
+        assert out["action"] is None
+        assert h.router.backend_count() == 1
+        # boot one, then idle it away
+        h.hot_tick()
+        assert h.hot_tick()["action"] == "scale_out:as0"
+        h.sleep(20.0)
+        out = None
+        for _ in range(3):
+            out = h.idle_tick()
+        assert out["action"] == "scale_in:as0"
+        assert h.retired == ["as0"]
+        assert h.router.backend_count() == 1
+        # back at the floor: more idleness does nothing
+        h.sleep(20.0)
+        for _ in range(4):
+            out = h.idle_tick()
+        assert out["action"] is None
+        assert h.router.backend_count() == 1
+
+    def test_scale_in_is_lifo(self):
+        h = _Harness(cooldown_s=0.0)
+        h.hot_tick()
+        h.hot_tick()                    # boots as0
+        h.hot_tick()
+        h.hot_tick()                    # boots as1
+        assert h.spawned == ["as0", "as1"]
+        for _ in range(3):
+            out = h.idle_tick()
+        assert out["action"] == "scale_in:as1"
+
+    def test_failed_spawn_cools_down_and_reports(self):
+        router = _FakeRouter()
+
+        def bad_spawn(_index):
+            raise RuntimeError("no capacity")
+
+        scaler = Autoscaler(router, spawn=bad_spawn,
+                            retire=lambda b, h: None,
+                            breach_windows=1, cooldown_s=10.0,
+                            min_events=1, clock=lambda: 1000.0)
+        scaler._prev = _sample(999.0, 0.0)
+        scaler._sample_fn = lambda: _sample(1000.0, 100.0, 100.0)
+        out = scaler.tick(now=1000.0)
+        assert out["action"] is None
+        assert "scale-out failed" in out["last_error"]
+        assert out["cooldown_remaining_s"] > 0
+
+    def test_shutdown_drains_every_managed_backend(self):
+        h = _Harness(cooldown_s=0.0, max_backends=4)
+        for _ in range(6):
+            h.hot_tick()
+        assert h.router.backend_count() >= 3
+        h.scaler.shutdown()
+        assert h.router.backend_count() == 1
+        assert set(h.retired) == set(h.spawned)

@@ -1,0 +1,167 @@
+#!/bin/bash
+# Placement + autoscaling smoke (ISSUE 16 acceptance,
+# operator-runnable):
+#
+#   1. `python -m znicz_tpu chaos --scenario placement` — three REAL
+#      multi-tenant `serve` processes (the demo zoo on each) behind a
+#      REAL `route --placement 1` process: the map covers every
+#      tenant, steady-state traffic routes INSIDE placement sets,
+#      fleet resident bytes stay ≤ (1 + replication) × one zoo's
+#      weight bytes (the hint push releases non-placed copies), and
+#      SIGKILLing the hot tenant's owner mid-burst heals via
+#      re-placement with zero raw 500s and zero hangs.
+#
+#   2. a real `python -m znicz_tpu route --autoscale` process: boots
+#      its own `serve` floor, scales OUT on an induced burn (a
+#      latency objective with a sub-microsecond threshold makes every
+#      request "bad", so sustained traffic = sustained burn), scales
+#      IN through the graceful drain once traffic stops, and SIGTERM
+#      exits rc 0 with every managed backend drained.
+#
+# Registered beside tools/fleet_smoke.sh / tools/zoo_smoke.sh.
+#
+# Usage:  bash tools/placement_smoke.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: chaos --scenario placement =="
+JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario placement || exit 1
+
+echo "== phase 2: a real route --autoscale process =="
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import json, signal, socket, subprocess, sys, tempfile, time
+import urllib.error, urllib.request
+import os
+
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def healthz(url):
+    with urllib.request.urlopen(url + "healthz", timeout=5) as r:
+        return json.loads(r.read())
+
+
+with tempfile.TemporaryDirectory(prefix="znicz_place_smoke_") as tmp:
+    from znicz_tpu.resilience.chaos import _write_demo_znn
+
+    model = os.path.join(tmp, "m.znn")
+    _write_demo_znn(model)
+    rport = free_port()
+    url = f"http://127.0.0.1:{rport}/"
+    # latency objective, threshold 1e-4 ms: EVERY answered request is
+    # "bad", so live traffic burns the whole budget — deterministic
+    # scale-out; stopped traffic reads idle — deterministic scale-in
+    router = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "route",
+         "--port", str(rport), "--autoscale",
+         "--min-backends", "1", "--max-backends", "2",
+         "--autoscale-interval-s", "0.5",
+         "--autoscale-objective", "latency",
+         "--autoscale-threshold-ms", "0.0001",
+         "--autoscale-target", "0.9",
+         "--autoscale-min-events", "3",
+         "--breach-windows", "2",
+         "--idle-windows", "4", "--idle-rps", "0.5",
+         "--autoscale-cooldown-s", "1.0",
+         "--drain-timeout-s", "15", "--boot-timeout-s", "180",
+         "--probe-interval-s", "0.3",
+         "--serve-arg=--model", f"--serve-arg={model}",
+         "--serve-arg=--max-wait-ms", "--serve-arg=1",
+         "--serve-arg=--warmup-shape", "--serve-arg=4"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    h = None
+    for _ in range(360):
+        try:
+            h = healthz(url)
+            break
+        except Exception:
+            if router.poll() is not None:
+                print(f"FAIL router exited rc={router.returncode}")
+                print(router.stdout.read().decode(errors="replace")[-600:])
+                sys.exit(1)
+            time.sleep(0.5)
+    check(h is not None, "route --autoscale answers /healthz")
+    if h is None:
+        router.kill()
+        sys.exit(1)
+    asz = h.get("autoscale") or {}
+    check(asz.get("backends") == 1,
+          f"boots the min floor (backends={asz.get('backends')})")
+    check(asz.get("managed"),
+          f"the floor is autoscaler-managed ({asz.get('managed')})")
+
+    body = json.dumps({"inputs": [[0.1, -0.2, 0.3, 0.4]]}).encode()
+
+    def post():
+        req = urllib.request.Request(
+            url + "predict", body, {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+            return r.status
+
+    check(post() == 200, "predict 200 through the autoscaled fleet")
+
+    # induce the burn: sustained traffic, every request past the
+    # threshold; poll until the fleet scales out (boots take seconds)
+    scaled_out = False
+    deadline = time.monotonic() + 180.0
+    while time.monotonic() < deadline:
+        for _ in range(25):
+            try:
+                post()
+            except Exception:
+                pass
+        try:
+            asz = healthz(url).get("autoscale") or {}
+        except Exception:
+            asz = {}
+        if asz.get("scale_outs", 0) >= 1 and asz.get("backends") == 2:
+            scaled_out = True
+            break
+    check(scaled_out,
+          f"scale-out on sustained burn (autoscale={asz})")
+
+    # stop traffic: idle windows accumulate, the booted backend is
+    # retired through the graceful drain
+    scaled_in = False
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        try:
+            asz = healthz(url).get("autoscale") or {}
+        except Exception:
+            asz = {}
+        if asz.get("scale_ins", 0) >= 1 and asz.get("backends") == 1:
+            scaled_in = True
+            break
+        time.sleep(0.5)
+    check(scaled_in,
+          f"scale-in drain once traffic stops (autoscale={asz})")
+    check(post() == 200, "predict still 200 after the scale-in")
+
+    router.send_signal(signal.SIGTERM)
+    try:
+        rc = router.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        router.kill()
+        rc = router.wait(timeout=10)
+    check(rc == 0, f"router SIGTERM exit rc {rc} (managed floor drained)")
+
+print()
+if fails:
+    print(f"placement smoke: {len(fails)} failure(s)")
+    sys.exit(1)
+print("placement smoke: all checks passed")
+PY

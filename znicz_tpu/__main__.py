@@ -55,6 +55,23 @@ Examples::
         # re-issued per hop (deadline decremented by hop latency),
         # JSON + binary payload pass-through, and aggregated
         # /healthz + /metrics (fleet_*{backend=...}) + /statusz
+    python -m znicz_tpu route --backend ... --placement 1
+        # + placement-aware zoo sharding: each zoo tenant is assigned
+        # to a scored subset of backends (weighted rendezvous —
+        # residency affinity, busy penalty, cache-warm consistency,
+        # --placement N = replication factor), the router routes a
+        # tenant only inside its set (failing over in-set first,
+        # degrading to any-healthy rather than refusing), pushes
+        # eviction hints down to every backend zoo, and re-places
+        # live via POST /admin/placement (pin/rebalance; docs/fleet.md)
+    python -m znicz_tpu autoscale --serve-arg=--zoo --serve-arg=DIR \
+            --min-backends 1 --max-backends 4
+        # elastic fleet (= route --autoscale): boots real `serve`
+        # processes, scales OUT on sustained SLO burn at the router
+        # tier (fleet_request_latency_ms + errors), scales IN through
+        # the graceful drain, with hysteresis + cooldown so a
+        # one-window blip never flaps the fleet; placement re-runs on
+        # every membership change (fleet.autoscaler; docs/fleet.md)
     python -m znicz_tpu promote --candidates DIR \
             --url http://127.0.0.1:8200/ --fleet
         # promote-one-then-fleet over a router: canary ONE backend
@@ -62,7 +79,7 @@ Examples::
         # backends with weighted traffic splitting and fleet-wide
         # rollback on a mid-walk burn-rate breach (fleet.rollout)
     python -m znicz_tpu chaos \
-            [--scenario reload|promote|overload|zoo|slo|wire|fleet]
+            [--scenario reload|promote|overload|zoo|slo|wire|fleet|placement]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
         # --scenario reload drills corrupt-artifact rollback;
@@ -174,6 +191,12 @@ def main(argv=None) -> int:
         # backends — see znicz_tpu/fleet and docs/fleet.md
         from .fleet.router import main as route_main
         return route_main(argv[1:])
+    if argv and argv[0] == "autoscale":
+        # elastic fleet: `route --autoscale` under its own name —
+        # boots/drains serve processes on the SLO burn signal — see
+        # znicz_tpu/fleet/autoscaler.py and docs/fleet.md
+        from .fleet.autoscaler import main as autoscale_main
+        return autoscale_main(argv[1:])
     if argv and argv[0] == "chaos":
         # fault-injection smoke of the serving stack — see
         # znicz_tpu/resilience/chaos.py and tools/chaos_smoke.sh

@@ -16,6 +16,15 @@ The cross-process layer of the serving stack (docs/fleet.md):
   then walk the rest with weighted traffic splitting,
   generation-skew tolerance, and fleet-wide rollback on a mid-walk
   burn-rate breach).
+* :mod:`~znicz_tpu.fleet.placement` — the router decides where
+  models live: weighted-rendezvous (cache-affinity) assignment of
+  each zoo tenant to a scored subset of backends, residency-/load-
+  aware scoring, replication factor, pins, live re-placement via
+  ``POST /admin/placement``.
+* :mod:`~znicz_tpu.fleet.autoscaler` — elastic fleet:
+  ``route --autoscale`` boots and drains real serve processes on the
+  SLO burn-rate signal, re-running placement on every membership
+  change.
 
 This is the modern rebuild of the paper's VELES master–slave topology
 (the Twisted/ZeroMQ master fanning work to slave processes) on
@@ -25,3 +34,6 @@ JAX-era serving primitives.
 from .router import (Backend, BackendDown, FleetRouter,  # noqa: F401
                      parse_backend_spec)
 from .rollout import FleetTarget, merge_samples  # noqa: F401
+from .placement import (PlacementCandidate,  # noqa: F401
+                        PlacementEngine, rank_backends, score_weight)
+from .autoscaler import Autoscaler, ServeLauncher  # noqa: F401

@@ -1,0 +1,444 @@
+"""Elastic fleet autoscaling on the burn-rate signal.
+
+The placement engine (:mod:`znicz_tpu.fleet.placement`) decides where
+tenants live on a FIXED membership; this module makes the membership
+itself elastic.  ``python -m znicz_tpu route --autoscale`` (alias:
+``python -m znicz_tpu autoscale``) runs an :class:`Autoscaler` loop
+inside the router process that boots and drains REAL ``serve``
+processes:
+
+* **Scale-out on sustained burn** — each tick samples the router's
+  own request-path signals (``requests_total`` / ``errors_total`` on
+  the ``/predict`` route plus the ``fleet_request_latency_ms``
+  histogram) and computes the window's error-budget burn with the
+  PR 12 arithmetic (:func:`znicz_tpu.telemetry.sloengine.burn_between`
+  — the same code the pager and the canary judge run).  Only
+  ``breach_windows`` CONSECUTIVE burning windows trigger a boot: a
+  one-window blip is hysteresis-filtered, exactly like the burn-rate
+  canary's fast+slow gate.
+* **Scale-in through graceful drain** — ``idle_windows`` consecutive
+  quiet windows (no burn, request rate under ``idle_rps``) retire the
+  most recently booted managed backend: it leaves the router's
+  rotation first, then receives SIGTERM and drains via the PR 10
+  graceful path (503 + Retry-After, bounded batcher drain, exit 0).
+  Only backends the autoscaler itself booted are ever retired — the
+  operator's static ``--backend`` floor is never drained.
+* **Placement follows membership** — ``FleetRouter.add_backend`` /
+  ``remove_backend`` re-run placement on every membership change, so
+  tenants re-shard onto the new capacity (and off the draining one)
+  automatically.
+* **Cooldown** — after any action the loop holds ``cooldown_s``
+  before acting again: a boot takes seconds to absorb load, and
+  judging its effect mid-boot would flap.
+
+Families: ``autoscale_backends``, ``autoscale_events_total
+{direction}``, ``autoscale_burn_rate`` (docs/observability.md).  The
+loop's state is surfaced on the router's ``/healthz``/``/statusz``
+via ``router.attach_autoscaler`` — the same attach idiom as the
+rollout driver.
+
+Testability: the sampling, spawning and retiring are all injectable
+(``sample_fn`` / ``spawn`` / ``retire``), and :meth:`Autoscaler.tick`
+is a plain method — tier-1 tests drive the hysteresis state machine
+with fake samples and no processes (tests/test_placement.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from ..promotion.slo import SLOSample, _route_code_sum
+from ..resilience.breaker import CircuitBreaker
+from ..telemetry import sloengine
+from ..telemetry.registry import (DEFAULT_LATENCY_BUCKETS_MS, REGISTRY)
+from .router import Backend, BackendDown
+
+_backends_g = REGISTRY.gauge(
+    "autoscale_backends",
+    "backends currently in the router's rotation while the "
+    "autoscaler loop runs (static --backend floor plus booted "
+    "managed ones)")
+_events = REGISTRY.counter(
+    "autoscale_events_total",
+    "autoscaler membership actions, by direction (out = booted a "
+    "serve process on sustained burn | in = drained one on sustained "
+    "idle)")
+_burn_g = REGISTRY.gauge(
+    "autoscale_burn_rate",
+    "error-budget burn rate of the autoscaler's last sampling window "
+    "over the router's own request-path signals (the scale-out "
+    "trigger, sloengine.burn_between arithmetic)")
+
+
+def router_sample() -> SLOSample:
+    """Snapshot the ROUTER-tier SLO signals from the process-wide
+    registry: the router's ``/predict`` request/5xx counters and its
+    end-to-end request latency histogram.  Same normalized shape the
+    promotion watch speaks, so :func:`sloengine.burn_between` applies
+    unchanged.  Instrument lookups are get-or-create — sampled before
+    the first request it reads zeros."""
+    hist = REGISTRY.histogram("fleet_request_latency_ms",
+                              buckets=DEFAULT_LATENCY_BUCKETS_MS)
+    h = hist.as_dict()
+    if "buckets" not in h:
+        h = {"buckets": {}, "count": 0.0}
+    latency_cum = {sloengine._edge_of(k): float(v)
+                   for k, v in h["buckets"].items()}
+    requests = _route_code_sum(
+        REGISTRY.counter("requests_total").as_dict(), "/predict")
+    errors = _route_code_sum(
+        REGISTRY.counter("errors_total").as_dict(), "/predict",
+        min_code=500)
+    return SLOSample(at=time.time(), latency_cum=latency_cum,
+                     latency_count=float(h["count"]),
+                     requests=requests, errors_5xx=errors)
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class ServeLauncher:
+    """Boots/drains real ``python -m znicz_tpu serve`` subprocesses.
+
+    ``serve_args`` is the argument tail every booted backend gets
+    (``--zoo DIR --memory-budget-mb 64`` …); the launcher owns the
+    port, the log file, and the bounded healthz boot wait."""
+
+    def __init__(self, serve_args, *, host: str = "127.0.0.1",
+                 log_dir: str | None = None,
+                 boot_timeout_s: float = 60.0,
+                 forward_timeout_s: float = 60.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 2.0):
+        self.serve_args = list(serve_args)
+        self.host = host
+        self.log_dir = log_dir
+        self.boot_timeout_s = float(boot_timeout_s)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+
+    def _log_file(self, name: str):
+        if self.log_dir is None:
+            return subprocess.DEVNULL
+        os.makedirs(self.log_dir, exist_ok=True)
+        return open(os.path.join(self.log_dir, f"{name}.log"), "ab")
+
+    def spawn(self, index: int) -> tuple[Backend, subprocess.Popen]:
+        """Boot one serve process and wait (bounded) for its /healthz;
+        returns a routable :class:`Backend` + the process handle.  A
+        boot that never answers is killed and raised — a half-up
+        backend must not enter rotation."""
+        port = _free_port(self.host)
+        name = f"as{index}"
+        cmd = [sys.executable, "-m", "znicz_tpu", "serve",
+               "--host", self.host, "--port", str(port)] \
+            + self.serve_args
+        log = self._log_file(name)
+        proc = subprocess.Popen(cmd, stdout=log, stderr=log)
+        if log is not subprocess.DEVNULL:
+            log.close()                    # the child holds its own fd
+        backend = Backend(
+            f"http://{self.host}:{port}/", name=name,
+            timeout_s=self.forward_timeout_s,
+            breaker=CircuitBreaker(
+                failure_threshold=self.breaker_threshold,
+                cooldown_s=self.breaker_cooldown_s))
+        deadline = time.monotonic() + self.boot_timeout_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"serve backend {name} exited rc={proc.returncode} "
+                    f"before answering /healthz (cmd: {' '.join(cmd)})")
+            try:
+                status, data, _h = backend.forward("GET", "/healthz",
+                                                   None, {})
+                if status == 200:
+                    snap = json.loads(data)
+                    if isinstance(snap, dict):
+                        backend.set_health(snap)
+                    return backend, proc
+            except (BackendDown, ValueError):
+                pass
+            time.sleep(0.2)
+        proc.kill()
+        proc.wait(timeout=10)
+        raise RuntimeError(f"serve backend {name} did not answer "
+                           f"/healthz within {self.boot_timeout_s}s")
+
+    def retire(self, backend: Backend, proc: subprocess.Popen, *,
+               drain_timeout_s: float = 20.0) -> int | None:
+        """SIGTERM → the serve process's graceful drain (PR 10: 503 +
+        Retry-After, bounded batcher drain, exit 0); SIGKILL only if
+        the drain window is exhausted.  Returns the exit code."""
+        backend.close()
+        if proc.poll() is not None:
+            return proc.returncode
+        proc.send_signal(signal.SIGTERM)
+        try:
+            return proc.wait(timeout=drain_timeout_s + 10.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return proc.wait(timeout=10)
+
+
+class Autoscaler:
+    """The tick-driven scale state machine (module docstring).
+
+    ``spawn(index) -> (backend, handle)`` and ``retire(backend,
+    handle)`` default to a :class:`ServeLauncher`'s; ``sample_fn``
+    defaults to :func:`router_sample`.  All three are injectable so
+    the hysteresis logic is testable without processes."""
+
+    def __init__(self, router, *, launcher: ServeLauncher | None = None,
+                 spawn=None, retire=None,
+                 min_backends: int = 1, max_backends: int = 4,
+                 interval_s: float = 5.0,
+                 objective: str = "availability", target: float = 0.999,
+                 threshold_ms: float | None = None,
+                 max_burn_rate: float = 2.0, min_events: int = 5,
+                 breach_windows: int = 2, idle_windows: int = 6,
+                 idle_rps: float = 0.5, cooldown_s: float = 30.0,
+                 drain_timeout_s: float = 20.0,
+                 sample_fn=None, clock=time.monotonic):
+        if int(min_backends) < 1:
+            raise ValueError(f"min_backends must be >= 1, "
+                             f"got {min_backends!r}")
+        if int(max_backends) < int(min_backends):
+            raise ValueError(f"max_backends ({max_backends}) must be "
+                             f">= min_backends ({min_backends})")
+        if objective not in sloengine.OBJECTIVES:
+            raise ValueError(f"objective {objective!r}; expected one "
+                             f"of {sloengine.OBJECTIVES}")
+        if objective == "latency" and threshold_ms is None:
+            raise ValueError("a latency-objective autoscaler needs "
+                             "threshold_ms")
+        self.router = router
+        self.launcher = launcher
+        self._spawn = spawn if spawn is not None else (
+            launcher.spawn if launcher is not None else None)
+        self._retire = retire if retire is not None else (
+            (lambda b, p: launcher.retire(
+                b, p, drain_timeout_s=drain_timeout_s))
+            if launcher is not None else None)
+        self.min_backends = int(min_backends)
+        self.max_backends = int(max_backends)
+        self.interval_s = float(interval_s)
+        self.objective = objective
+        self.budget = 1.0 - float(target)
+        self.threshold_ms = threshold_ms
+        self.max_burn_rate = float(max_burn_rate)
+        self.min_events = int(min_events)
+        self.breach_windows = max(1, int(breach_windows))
+        self.idle_windows = max(1, int(idle_windows))
+        self.idle_rps = float(idle_rps)
+        self.cooldown_s = float(cooldown_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._sample_fn = sample_fn if sample_fn is not None \
+            else router_sample
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._managed: list[tuple] = []       # (backend, handle), LIFO
+        self._spawned = 0
+        self._prev: SLOSample | None = None
+        self._hot = 0
+        self._idle = 0
+        self._cooldown_until: float | None = None
+        self._last = {"burn_rate": 0.0, "request_rate": 0.0,
+                      "events": 0.0}
+        self._scale_outs = 0
+        self._scale_ins = 0
+        self._last_error: str | None = None
+        self._stop_event = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- membership bookkeeping -------------------------------------------
+    def adopt(self, backend, handle) -> None:
+        """Track an already-booted backend as managed (the CLI boots
+        the min-floor before the router exists, then adopts here)."""
+        with self._lock:
+            self._managed.append((backend, handle))
+            self._spawned += 1
+
+    def managed_names(self) -> list[str]:
+        with self._lock:
+            return [b.name for b, _h in self._managed]
+
+    def _next_index(self) -> int:
+        with self._lock:
+            self._spawned += 1
+            return self._spawned - 1
+
+    # -- the state machine -------------------------------------------------
+    def tick(self, now: float | None = None) -> dict:
+        """One sampling window: measure burn + request rate, advance
+        the hysteresis counters, maybe act.  Never raises — a failed
+        boot/drain is recorded in ``last_error`` and retried on a
+        later tick (the loop must outlive one bad action)."""
+        now = self._clock() if now is None else now
+        sample = self._sample_fn()
+        prev, self._prev = self._prev, sample
+        burn = rate = events = 0.0
+        if prev is not None:
+            burn, events = sloengine.burn_between(
+                prev, sample, budget=self.budget,
+                objective=self.objective,
+                threshold_ms=self.threshold_ms,
+                min_events=self.min_events)
+            dt = max(1e-9, sample.at - prev.at)
+            rate = max(0.0, sample.requests - prev.requests) / dt
+        hot = prev is not None and burn >= self.max_burn_rate
+        idle = prev is not None and not hot and rate < self.idle_rps
+        self._hot = self._hot + 1 if hot else 0
+        self._idle = self._idle + 1 if idle else 0
+        _burn_g.set(burn)
+        self._last = {"burn_rate": round(burn, 4),
+                      "request_rate": round(rate, 3),
+                      "events": events}
+        action = None
+        cooling = (self._cooldown_until is not None
+                   and now < self._cooldown_until)
+        total = self.router.backend_count()
+        if not cooling:
+            if self._hot >= self.breach_windows \
+                    and total < self.max_backends:
+                action = self._scale_out(now)
+            elif self._idle >= self.idle_windows \
+                    and total > self.min_backends \
+                    and self.managed_names():
+                action = self._scale_in(now)
+        _backends_g.set(float(self.router.backend_count()))
+        return {"action": action, **self.status()}
+
+    def _acted(self, now: float) -> None:
+        self._hot = self._idle = 0
+        self._cooldown_until = now + self.cooldown_s
+
+    def _scale_out(self, now: float) -> str | None:
+        if self._spawn is None:
+            self._last_error = "no spawn path configured"
+            return None
+        try:
+            backend, handle = self._spawn(self._next_index())
+        except Exception as e:
+            self._last_error = f"scale-out failed: {e}"
+            self._acted(now)   # cooldown anyway: don't hammer boots
+            return None
+        try:
+            self.router.add_backend(backend)
+        except Exception as e:
+            self._last_error = f"add_backend failed: {e}"
+            if self._retire is not None:
+                try:
+                    self._retire(backend, handle)
+                except Exception:
+                    pass
+            self._acted(now)
+            return None
+        with self._lock:
+            self._managed.append((backend, handle))
+        self._scale_outs += 1
+        self._last_error = None
+        _events.inc(direction="out")
+        self._acted(now)
+        return f"scale_out:{backend.name}"
+
+    def _scale_in(self, now: float) -> str | None:
+        with self._lock:
+            if not self._managed:
+                return None
+            backend, handle = self._managed.pop()
+        try:
+            self.router.remove_backend(backend.name)
+        except Exception as e:
+            self._last_error = f"remove_backend failed: {e}"
+        try:
+            if self._retire is not None:
+                self._retire(backend, handle)
+        except Exception as e:
+            self._last_error = f"scale-in drain failed: {e}"
+            self._acted(now)
+            return None
+        self._scale_ins += 1
+        self._last_error = None
+        _events.inc(direction="in")
+        self._acted(now)
+        return f"scale_in:{backend.name}"
+
+    # -- surfaces ----------------------------------------------------------
+    def status(self) -> dict:
+        now = self._clock()
+        cooldown = (max(0.0, self._cooldown_until - now)
+                    if self._cooldown_until is not None else 0.0)
+        return {"backends": self.router.backend_count(),
+                "min_backends": self.min_backends,
+                "max_backends": self.max_backends,
+                "managed": self.managed_names(),
+                "burn_rate": self._last["burn_rate"],
+                "request_rate": self._last["request_rate"],
+                "hot_windows": self._hot,
+                "idle_windows": self._idle,
+                "cooldown_remaining_s": round(cooldown, 1),
+                "scale_outs": self._scale_outs,
+                "scale_ins": self._scale_ins,
+                "last_error": self._last_error}
+
+    # -- lifecycle ---------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop_event.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:     # the loop must survive a tick
+                self._last_error = f"tick failed: {e}"
+
+    def start(self) -> "Autoscaler":
+        self.router.attach_autoscaler(self.status)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="znicz-fleet-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    def shutdown(self) -> None:
+        """Stop the loop and drain EVERY managed backend (the CLI's
+        SIGTERM path — the router's static floor is left alone)."""
+        self.stop()
+        while True:
+            with self._lock:
+                if not self._managed:
+                    return
+                backend, handle = self._managed.pop()
+            try:
+                self.router.remove_backend(backend.name)
+            except Exception:
+                pass
+            try:
+                if self._retire is not None:
+                    self._retire(backend, handle)
+            except Exception as e:
+                self._last_error = f"shutdown drain failed: {e}"
+
+
+def main(argv=None) -> int:
+    """``python -m znicz_tpu autoscale`` — the route CLI with
+    ``--autoscale`` pre-set (one flag namespace, documented on
+    ``route --help``)."""
+    from .router import main as route_main
+    args = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--autoscale" not in args:
+        args = args + ["--autoscale"]
+    return route_main(args)

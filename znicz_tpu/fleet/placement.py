@@ -1,0 +1,258 @@
+"""Placement engine: the router decides where models live.
+
+PR 14's router spreads every request over N identical backends, so
+fleet weight footprint is N × the whole zoo and each backend's
+weight-residency LRU (PR 11) thrashes identically.  This module is
+the paper's master-side scheduling instinct (the VELES master decides
+*where work lives*, not just how to fan it out) rebuilt for the
+serving fleet: each registry entry — the routable unit — is assigned
+to a scored **subset** of backends, and the router only routes a
+tenant inside its subset.
+
+* **Weighted rendezvous (HRW) assignment** — for every (model,
+  backend) pair a deterministic hash draw is scaled by the pair's
+  score and the lowest ``replication`` draws win.  Rendezvous hashing
+  is what makes the assignment *consistent*: a backend joining or
+  leaving only moves the tenants that ranked it, never reshuffles the
+  fleet — a tenant's memo/executable caches stay warm across
+  membership churn (cache affinity).
+* **Residency-/load-aware scoring** — the score multiplies an
+  affinity boost for backends already holding the tenant's device
+  weights (the ``model_resident{model}`` signal, read from the
+  healthz rows the prober already caches) by a busy penalty derived
+  from the backend's device-time burn rate (the
+  ``model_device_ms_total{model}`` / ``engine_busy_ratio`` lineage).
+  Residency boosting is deliberately self-reinforcing: once placed
+  and paged in, a tenant stays put until a pin, a departure, or a
+  large load skew moves it.
+* **Replication factor** — each tenant lives on ``replication``
+  backends (primary first), so fleet resident bytes converge to
+  ~replication × the zoo instead of N ×; the chaos ``placement``
+  drill pins the ≤ (1 + replication) × bound (the slack is one
+  in-transition copy).
+* **Pins** — ``POST /admin/placement`` can pin a tenant to explicit
+  backends; pins survive recomputes and beat scoring.
+
+The engine is pure policy: it owns no HTTP and no sockets.  The
+router feeds it candidates (name, residency set, busy ratio), applies
+the returned map on the request path, and pushes per-backend
+placement *hints* down to each zoo's eviction pass
+(``ModelZoo.set_placement_hint``) so the footprint bound is enforced,
+not hoped for.  Families: ``placement_generation``,
+``placement_models``, ``placement_rebalance_total{cause}``,
+``placement_moves_total``, ``placement_degraded_total{model}``
+(docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import threading
+import time
+
+from ..telemetry.registry import REGISTRY
+
+_generation_g = REGISTRY.gauge(
+    "placement_generation",
+    "ordinal of the placement map currently enforced by the router "
+    "(bumps on every recompute — rebalance, membership change, pin)")
+_models_g = REGISTRY.gauge(
+    "placement_models",
+    "tenants the current placement map assigns (models discovered "
+    "from backend healthz probes, plus pinned names)")
+_rebalances = REGISTRY.counter(
+    "placement_rebalance_total",
+    "placement recomputes, by cause (admin | join | leave | "
+    "discovery | pin)")
+_moves = REGISTRY.counter(
+    "placement_moves_total",
+    "tenants whose placed backend set changed across a recompute — "
+    "each move is a cold memo/executable cache somewhere, so a noisy "
+    "series here means the scoring is churning")
+_degraded = REGISTRY.counter(
+    "placement_degraded_total",
+    "requests the router had to route OUTSIDE the tenant's placement "
+    "set because no placed backend could take them (degrade-to-any-"
+    "healthy, never refuse), by model")
+
+
+def note_degraded(model: str | None) -> None:
+    """Count one routed-outside-the-set request (the router's pick
+    loop calls this; bounded label set — zoo names plus _default)."""
+    _degraded.inc(model=model or "_default")
+
+
+class PlacementCandidate:
+    """One backend as the scorer sees it: its name, the tenants whose
+    device weights it currently holds (residency affinity), and its
+    busy ratio (device-time burn fraction, [0, 1]-ish)."""
+
+    __slots__ = ("name", "resident", "busy")
+
+    def __init__(self, name: str, *, resident=(), busy: float = 0.0):
+        self.name = str(name)
+        self.resident = frozenset(resident)
+        self.busy = max(0.0, float(busy))
+
+
+def _draw(model: str, backend: str) -> float:
+    """Deterministic uniform draw in (0, 1) for one (model, backend)
+    pair — blake2b, not ``hash()``: placement must agree across
+    processes and PYTHONHASHSEED."""
+    h = hashlib.blake2b(f"{model}\x00{backend}".encode(),
+                        digest_size=8).digest()
+    return (int.from_bytes(h, "big") + 1) / (2.0 ** 64 + 2)
+
+
+def score_weight(model: str, cand: PlacementCandidate, *,
+                 affinity_boost: float = 4.0,
+                 busy_penalty: float = 1.0) -> float:
+    """The (model, backend) score the rendezvous draw is scaled by:
+    > 0 always (a busy backend is dispreferred, never excluded —
+    exclusion is the breaker's job, at request time)."""
+    w = affinity_boost if model in cand.resident else 1.0
+    return w / (1.0 + busy_penalty * cand.busy)
+
+
+def rank_backends(model: str, candidates, *,
+                  affinity_boost: float = 4.0,
+                  busy_penalty: float = 1.0) -> list[str]:
+    """Every candidate name ranked best-first for ``model`` by
+    weighted rendezvous: key = -ln(draw)/weight, lowest wins (the
+    classic WRH construction — E[share] proportional to weight,
+    deterministic given the inputs)."""
+    keyed = []
+    for cand in candidates:
+        w = score_weight(model, cand, affinity_boost=affinity_boost,
+                         busy_penalty=busy_penalty)
+        keyed.append((-math.log(_draw(model, cand.name)) / w,
+                      cand.name))
+    return [name for _k, name in sorted(keyed)]
+
+
+class PlacementEngine:
+    """Scoring + assignment state (pure policy; the router enforces).
+
+    ``plan()`` recomputes the full map; the engine tracks the plan
+    generation, the move count against the previous map, and the pin
+    table.  Thread-safe: the router recomputes from admin handlers,
+    the prober thread, and membership changes."""
+
+    def __init__(self, replication: int = 1, *,
+                 affinity_boost: float = 4.0,
+                 busy_penalty: float = 1.0):
+        if int(replication) < 1:
+            raise ValueError(f"replication must be >= 1, "
+                             f"got {replication!r}")
+        self.replication = int(replication)
+        self.affinity_boost = float(affinity_boost)
+        self.busy_penalty = float(busy_penalty)
+        self._lock = threading.Lock()
+        self._pins: dict[str, tuple[str, ...]] = {}
+        self._map: dict[str, tuple[str, ...]] = {}
+        self._generation = 0
+        self._last_cause: str | None = None
+        self._moves_total = 0
+        self._computed_at: float | None = None
+
+    # -- pins --------------------------------------------------------------
+    def pin(self, model: str, backends) -> None:
+        """Pin ``model`` to an explicit backend list (beats scoring,
+        survives recomputes); ``backends=None`` clears the pin."""
+        with self._lock:
+            if backends is None:
+                self._pins.pop(model, None)
+            else:
+                names = tuple(str(b) for b in backends)
+                if not names:
+                    raise ValueError("a pin needs at least one "
+                                     "backend (null clears the pin)")
+                self._pins[model] = names
+
+    def pins(self) -> dict:
+        with self._lock:
+            return dict(self._pins)
+
+    # -- the plan ----------------------------------------------------------
+    def plan(self, models, candidates, *, cause: str = "manual") -> dict:
+        """Assign every model to its top-``replication`` backends.
+
+        ``models``: iterable of tenant names (the union the router
+        discovered from backend healthz probes); ``candidates``:
+        :class:`PlacementCandidate` s for the current membership.
+        Returns the new plan (also retained for :meth:`assignments` /
+        :meth:`status`); an empty candidate list yields an empty map
+        — the router then routes unrestricted, which is the honest
+        degradation."""
+        cands = list(candidates)
+        with self._lock:
+            pins = dict(self._pins)
+            previous = dict(self._map)
+        new: dict[str, tuple[str, ...]] = {}
+        if cands:
+            take = min(self.replication, len(cands))
+            for model in sorted(set(models) | set(pins)):
+                pinned = pins.get(model)
+                if pinned:
+                    # a pin names backends verbatim — entries naming a
+                    # departed backend are kept (the pin is the
+                    # operator's intent) but enforcement skips them
+                    # via the healthy-membership filter at pick time
+                    new[model] = pinned
+                else:
+                    ranked = rank_backends(
+                        model, cands,
+                        affinity_boost=self.affinity_boost,
+                        busy_penalty=self.busy_penalty)
+                    new[model] = tuple(ranked[:take])
+        moved = sorted(m for m in set(previous) | set(new)
+                       if set(previous.get(m, ()))
+                       != set(new.get(m, ())))
+        with self._lock:
+            self._map = new
+            self._generation += 1
+            self._last_cause = cause
+            self._moves_total += len(moved)
+            self._computed_at = time.time()
+            gen = self._generation
+        _rebalances.inc(cause=cause)
+        if moved:
+            _moves.inc(len(moved))
+        _generation_g.set(float(gen))
+        _models_g.set(float(len(new)))
+        return {"generation": gen, "cause": cause,
+                "assignments": {m: list(v) for m, v in new.items()},
+                "moved": moved, "replication": self.replication}
+
+    def assignments(self) -> dict[str, tuple[str, ...]]:
+        with self._lock:
+            return dict(self._map)
+
+    def placed(self, model: str | None) -> tuple[str, ...]:
+        """The backend names ``model`` is placed on (empty tuple =
+        unplaced: route anywhere, that is not a degradation)."""
+        if model is None:
+            return ()
+        with self._lock:
+            return self._map.get(model, ())
+
+    def backend_models(self, backend: str) -> list[str]:
+        """The tenants placed on one backend — the eviction hint the
+        router pushes down to that backend's zoo."""
+        with self._lock:
+            return sorted(m for m, names in self._map.items()
+                          if backend in names)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "replication": self.replication,
+                "generation": self._generation,
+                "assignments": {m: list(v)
+                                for m, v in sorted(self._map.items())},
+                "pins": {m: list(v)
+                         for m, v in sorted(self._pins.items())},
+                "last_cause": self._last_cause,
+                "moves_total": self._moves_total,
+                "computed_at": self._computed_at}

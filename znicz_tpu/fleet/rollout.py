@@ -180,6 +180,11 @@ class FleetTarget:
         restored it."""
         if self.canary_weight is not None:
             self._set_weight(0, None)
+        # a concluded attempt may have shifted residency (reloads
+        # page weights in) — ask the router's placement tier to
+        # re-score so the map respects the post-walk world (PR 16;
+        # no-op on routers without --placement)
+        self._request_rebalance()
         self._set_status(state="idle", last_outcome=outcome,
                          walking=None)
 
@@ -210,6 +215,10 @@ class FleetTarget:
             return {"outcome": ("rolled_back" if rolled
                                 else "rollback_failed"),
                     "error": f"fleet walk crashed: {e!r}"}
+        finally:
+            # whatever the walk's outcome, generations and residency
+            # moved under the placement map — refresh it (PR 16)
+            self._request_rebalance()
 
     def _start_sample(self) -> SLOSample | None:
         """The walk's baseline, scrape-tolerantly: a transient
@@ -352,6 +361,27 @@ class FleetTarget:
             headers["X-Admin-Token"] = self.admin_token
         req = urllib.request.Request(
             self.router_url + "admin/weight", body, headers)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+        except Exception:
+            pass
+
+    def _request_rebalance(self) -> None:
+        """Ask the router's placement tier to re-score (``POST
+        /admin/placement {"action": "rebalance"}``).  Best-effort,
+        like :meth:`_set_weight`: no router, a router without
+        ``--placement`` (404), or a transient refusal must not fail
+        the promotion — the prober's discovery recompute converges
+        the map anyway, just later."""
+        if self.router_url is None:
+            return
+        body = json.dumps({"action": "rebalance"}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.admin_token is not None:
+            headers["X-Admin-Token"] = self.admin_token
+        req = urllib.request.Request(
+            self.router_url + "admin/placement", body, headers)
         try:
             with urllib.request.urlopen(req, timeout=30) as r:
                 r.read()

@@ -77,6 +77,7 @@ import hashlib
 
 import numpy as np
 
+from . import placement as placement_mod
 from ..resilience import overload
 from ..resilience.breaker import CircuitBreaker
 from ..serving.memo import ResponseCache
@@ -89,7 +90,7 @@ from ..telemetry.registry import (DEFAULT_LATENCY_BUCKETS_MS,
 #: routes with their own label value in requests_total/errors_total
 #: (same bounded-cardinality rule as the serving front)
 _ROUTES = ("/predict", "/healthz", "/metrics", "/statusz",
-           "/admin/weight")
+           "/admin/weight", "/admin/placement")
 
 _fleet_requests = REGISTRY.counter(
     "fleet_requests_total",
@@ -121,6 +122,12 @@ _fleet_cache_bytes = REGISTRY.gauge(
     "fleet_response_cache_bytes",
     "bytes of memoized responses retained at the router tier "
     "(bounded by route --memoize / --memoize-mb, LRU-evicted)")
+_fleet_request_hist = REGISTRY.histogram(
+    "fleet_request_latency_ms",
+    "end-to-end POST /predict wall time AT THE ROUTER (memo hits, "
+    "forwards, failovers and refusals all observe) — the e2e signal "
+    "the autoscaler's latency-objective burn judges, milliseconds",
+    buckets=DEFAULT_LATENCY_BUCKETS_MS)
 
 
 class BackendDown(Exception):
@@ -162,6 +169,12 @@ class Backend:
         self._pool: collections.deque = collections.deque()
         self._health: dict = {}
         self._health_at: float | None = None    # monotonic stamp
+        #: device-time burn between the last two snapshots: an EWMA of
+        #: Δ(Σ model device_ms)/Δwall in [0, ~1] per device — the
+        #: engine_busy_ratio signal observed from the healthz rows the
+        #: prober already fetches (placement's load input)
+        self._busy = 0.0
+        self._device_ms: float | None = None
         #: smooth-WRR accumulator — owned (and locked) by the router's
         #: pick loop, not by this object
         self.wrr_current = 0.0
@@ -179,10 +192,37 @@ class Backend:
             self._weight = float(weight)
 
     # -- cached health snapshot (the prober writes it) ---------------------
+    @staticmethod
+    def _snapshot_device_ms(snapshot: dict) -> float | None:
+        rows = snapshot.get("models")
+        if not isinstance(rows, list):
+            return None
+        total, seen = 0.0, False
+        for r in rows:
+            if isinstance(r, dict) and r.get("device_ms") is not None:
+                total += float(r["device_ms"])
+                seen = True
+        return total if seen else None
+
     def set_health(self, snapshot: dict) -> None:
+        dev = self._snapshot_device_ms(snapshot)
         with self._lock:
+            prev_dev, prev_at = self._device_ms, self._health_at
             self._health = dict(snapshot)
             self._health_at = time.monotonic()
+            if dev is not None:
+                if prev_dev is not None and prev_at is not None:
+                    dt = self._health_at - prev_at
+                    if dt > 0 and dev >= prev_dev:
+                        ratio = (dev - prev_dev) / (dt * 1e3)
+                        self._busy = 0.5 * self._busy + 0.5 * ratio
+                self._device_ms = dev
+
+    def busy_ratio(self) -> float:
+        """Smoothed device-time burn fraction from the last probes
+        (0.0 until two snapshots with device_ms rows landed)."""
+        with self._lock:
+            return self._busy
 
     def health(self) -> tuple[dict, float | None]:
         """(last /healthz snapshot, age in seconds) — ({}, None) until
@@ -264,6 +304,18 @@ class Backend:
         for conn in pool:
             conn.close()
 
+    def resident_models(self) -> list[str] | None:
+        """Tenant names whose device weights the backend reported
+        resident on its last probe (None = single-model backend, no
+        zoo rows) — placement's affinity input."""
+        snap, _age = self.health()
+        rows = snap.get("models")
+        if not isinstance(rows, list):
+            return None
+        return sorted(r["model"] for r in rows
+                      if isinstance(r, dict) and r.get("model")
+                      and r.get("resident"))
+
     def metrics(self) -> dict:
         snap, age = self.health()
         return {"name": self.name, "url": self.url,
@@ -272,6 +324,12 @@ class Backend:
                 "generation": snap.get("model_generation"),
                 "backend_rev": snap.get("rev"),
                 "backend_status": snap.get("status"),
+                # the placement-relevant residency state, visible at
+                # the router tier (scraped from backend healthz):
+                # bytes on device + which tenants hold them
+                "resident_bytes": snap.get("resident_bytes"),
+                "resident_models": self.resident_models(),
+                "busy_ratio": round(self.busy_ratio(), 4),
                 "probe_age_s": (round(age, 1)
                                 if age is not None else None)}
 
@@ -347,7 +405,9 @@ class FleetRouter:
                  probe_interval_s: float = 2.0,
                  admin_token: str | None = None,
                  max_body_mb: float = 64.0, max_hops: int = 2,
-                 memo_entries: int = 0, memo_mb: float = 32.0):
+                 memo_entries: int = 0, memo_mb: float = 32.0,
+                 placement: "placement_mod.PlacementEngine | None"
+                 = None):
         if not backends:
             raise ValueError("a router needs at least one backend")
         names = [b.name for b in backends]
@@ -356,6 +416,18 @@ class FleetRouter:
                              f"got {names}")
         self.backends: list[Backend] = list(backends)
         self.by_name = {b.name: b for b in self.backends}
+        #: placement enforcement (docs/fleet.md): when an engine is
+        #: attached, /predict routes a tenant only to its placed
+        #: backends — failing over INSIDE the set first, then
+        #: degrading to any healthy backend (never refusing because a
+        #: set is empty).  None = the historical spread-over-everyone
+        #: behavior, unchanged.
+        self.placement = placement
+        self._placement_lock = threading.Lock()
+        #: (models, membership) key of the last computed plan — the
+        #: prober recomputes when discovery changes it
+        self._placement_key: tuple | None = None
+        self._default_model: str | None = None
         self.default_deadline_ms = default_deadline_ms
         self.probe_interval_s = float(probe_interval_s)
         self.admin_token = admin_token
@@ -394,6 +466,9 @@ class FleetRouter:
         #: (fleet.rollout.FleetTarget) — surfaced on /healthz, the
         #: same attach idiom as ServingServer.attach_promotion
         self.rollout_status = None
+        #: optional status() of an in-process autoscaler loop
+        #: (fleet.autoscaler.Autoscaler) — same attach idiom
+        self.autoscale_status = None
         outer = self
 
         class Handler(FastHTTPHandler):
@@ -507,6 +582,9 @@ class FleetRouter:
                 if route == "/admin/weight":
                     self._admin_weight()
                     return
+                if route == "/admin/placement":
+                    self._admin_placement()
+                    return
                 if route != "/predict":
                     self.close_connection = True   # body left unread
                     self._reply(404, {"error": f"no route {self.path!r}"})
@@ -522,6 +600,9 @@ class FleetRouter:
                         with tracing.span("router.predict"):
                             self._predict(t0)
                 dt_ms = (time.monotonic() - t0) * 1e3
+                # the router's own e2e latency signal (memo hits and
+                # refusals included) — the autoscaler's burn input
+                _fleet_request_hist.observe(dt_ms)
                 code = self._status_code or 500
                 spans = [s.to_dict() for s in collected
                          if s._t0 >= t0]
@@ -568,6 +649,74 @@ class FleetRouter:
                     self._reply(400, {"error": str(e)})
                     return
                 self._reply(200, {"backend": name, "weight": weight})
+
+            def _admin_placement(self):
+                """``POST /admin/placement`` — live re-placement
+                control, token-gated exactly like /admin/weight.
+                Body is one of: ``{"action": "rebalance"}`` (recompute
+                over the current membership + discovered tenants),
+                ``{"model": m, "backends": [names]}`` (pin a tenant —
+                beats scoring, survives recomputes), ``{"model": m,
+                "backends": null}`` (clear the pin).  403 without the
+                token, 400 on junk, 404 on an unknown backend name or
+                on a router running without a placement engine."""
+                if not self._admin_authorized():
+                    self.close_connection = True
+                    self._reply(403, {
+                        "error": "admin token required (supply "
+                                 "X-Admin-Token)"})
+                    return
+                raw = self._read_body()
+                if raw is None:
+                    return
+                if outer.placement is None:
+                    self._reply(404, {
+                        "error": "placement is not enabled on this "
+                                 "router (route --placement N)"})
+                    return
+                try:
+                    payload = _json_object(raw)
+                    action = payload.get("action")
+                    model = payload.get("model")
+                    if action is None and model is None:
+                        raise ValueError(
+                            "expected {'action': 'rebalance'} or "
+                            "{'model': ..., 'backends': [...]|null}")
+                    if action is not None and action != "rebalance":
+                        raise ValueError(
+                            f"unknown action {action!r} (only "
+                            f"'rebalance')")
+                    if model is not None \
+                            and not isinstance(model, str):
+                        raise ValueError("'model' must be a name "
+                                         "string")
+                    pin = payload.get("backends")
+                    if model is not None and pin is not None and (
+                            not isinstance(pin, list)
+                            or not pin
+                            or not all(isinstance(n, str)
+                                       for n in pin)):
+                        raise ValueError(
+                            "'backends' must be a non-empty list of "
+                            "backend names, or null to clear the pin")
+                except Exception as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                if model is not None and pin is not None:
+                    unknown = [n for n in pin
+                               if n not in outer.by_name]
+                    if unknown:
+                        self._reply(404, {
+                            "error": f"no backend named "
+                                     f"{unknown[0]!r} (backends: "
+                                     f"{sorted(outer.by_name)})"})
+                        return
+                if model is not None:
+                    outer.placement.pin(model, pin)
+                    plan = outer.recompute_placement(cause="pin")
+                else:
+                    plan = outer.recompute_placement(cause="admin")
+                self._reply(200, plan)
 
             def _predict(self, t0: float):
                 raw = self._read_body()
@@ -641,6 +790,7 @@ class FleetRouter:
                     fwd["X-Criticality"] = crit
                 tried: set = set()
                 last_err: str | None = None
+                pick_mode = "any"
                 while len(tried) < outer.max_hops:
                     if deadline.at is not None \
                             and deadline.remaining_ms() <= 0.0:
@@ -653,7 +803,8 @@ class FleetRouter:
                             "error": "deadline exceeded at the "
                                      "router hop"})
                         return
-                    backend = outer.pick(exclude=tried)
+                    backend, pick_mode = outer.pick_for(model,
+                                                        exclude=tried)
                     if backend is None:
                         break
                     if deadline.at is not None:
@@ -707,6 +858,11 @@ class FleetRouter:
                         # the cache bypasses until probes re-converge
                         backend.observe_generation(resp_gen)
                     out = {"X-Fleet-Backend": backend.name}
+                    if outer.placement is not None:
+                        # placed = inside the tenant's set; degraded =
+                        # the set could not take it and any-healthy
+                        # answered; any = unplaced tenant
+                        out["X-Fleet-Placement"] = pick_mode
                     ra = rheaders.get("Retry-After")
                     if ra is not None:
                         out["Retry-After"] = ra
@@ -735,17 +891,84 @@ class FleetRouter:
                                         daemon=True,
                                         name="znicz-fleet-prober")
 
+    # -- membership (live: the autoscaler adds/removes) --------------------
+    def _backend_list(self) -> list[Backend]:
+        with self._wrr_lock:
+            return list(self.backends)
+
+    def backend_count(self) -> int:
+        with self._wrr_lock:
+            return len(self.backends)
+
+    def add_backend(self, backend: Backend) -> None:
+        """Join one backend to the rotation (the autoscaler's
+        scale-out path); placement re-runs on the new membership."""
+        with self._wrr_lock:
+            if backend.name in self.by_name:
+                raise ValueError(f"backend name {backend.name!r} "
+                                 f"already in rotation")
+            self.backends.append(backend)
+            self.by_name[backend.name] = backend
+        self.recompute_placement(cause="join")
+
+    def remove_backend(self, name: str) -> Backend:
+        """Drop one backend from the rotation (scale-in: callers then
+        drain the process); placement re-runs without it.  The last
+        backend cannot leave — a router with nothing to route to
+        answers nothing but 503s, which is an outage, not a scale-in."""
+        with self._wrr_lock:
+            if name not in self.by_name:
+                raise KeyError(f"no backend named {name!r}")
+            if len(self.backends) <= 1:
+                raise ValueError("cannot remove the last backend")
+            backend = self.by_name.pop(name)
+            self.backends.remove(backend)
+        self.recompute_placement(cause="leave")
+        return backend
+
     # -- routing ----------------------------------------------------------
-    def pick(self, exclude=()) -> Backend | None:
-        """The next backend by smooth weighted round-robin over the
-        candidates whose breaker admits traffic (deterministic — no
-        RNG on the request path).  ``exclude`` holds names this
-        request already failed on.  Consumes one breaker
-        ``allow()`` per considered candidate; the chosen backend's
-        outcome MUST be recorded (the forward loop does)."""
+    def pick(self, exclude=(), model: str | None = None
+             ) -> Backend | None:
+        """The next backend for one request (see :meth:`pick_for`)."""
+        return self.pick_for(model, exclude)[0]
+
+    def pick_for(self, model: str | None, exclude=()
+                 ) -> tuple[Backend | None, str]:
+        """(backend, mode) for one request.  With a placement engine
+        attached and ``model`` placed, candidates are restricted to
+        the placement set first (mode ``placed``); only when no
+        placed backend can take the request does the pick degrade to
+        the whole rotation (mode ``degraded`` — counted per model in
+        ``placement_degraded_total``, never a refusal).  Unplaced
+        tenants and placement-less routers route over everyone
+        (mode ``any``)."""
+        key = model
+        if key is None:
+            with self._placement_lock:
+                key = self._default_model
+        placed = (self.placement.placed(key)
+                  if self.placement is not None else ())
+        if placed:
+            b = self._wrr_pick(exclude, restrict=set(placed))
+            if b is not None:
+                return b, "placed"
+            placement_mod.note_degraded(key)
+            b = self._wrr_pick(exclude)
+            return b, "degraded"
+        return self._wrr_pick(exclude), "any"
+
+    def _wrr_pick(self, exclude=(), restrict=None) -> Backend | None:
+        """Smooth weighted round-robin over the candidates whose
+        breaker admits traffic (deterministic — no RNG on the request
+        path).  ``exclude`` holds names this request already failed
+        on; ``restrict`` (a name set) limits candidates to a
+        placement set.  Consumes one breaker ``allow()`` per
+        considered candidate; the chosen backend's outcome MUST be
+        recorded (the forward loop does)."""
         with self._wrr_lock:
             cands = [(b, b.weight) for b in self.backends
-                     if b.name not in exclude]
+                     if b.name not in exclude
+                     and (restrict is None or b.name in restrict)]
             weighted = [(b, w) for b, w in cands if w > 0]
             if not weighted:
                 # every candidate is weighted out (a mid-walk dark
@@ -770,7 +993,7 @@ class FleetRouter:
         ejected backend is ignored) returns None and the response
         cache bypasses.  Correctness beats hit rate during a roll."""
         gens: set = set()
-        for b in self.backends:
+        for b in self._backend_list():
             if b.breaker.state == "open":
                 continue              # ejected: not serving traffic
             snap, _age = b.health()
@@ -784,10 +1007,101 @@ class FleetRouter:
         """Honest come-back time when no backend can take the
         request: the soonest any breaker could admit a probe,
         bounded [1, 30] seconds."""
-        soonest = min((b.breaker.retry_after() for b in self.backends),
+        soonest = min((b.breaker.retry_after()
+                       for b in self._backend_list()),
                       default=1.0)
         return max(1, min(30, int(soonest) + (0 if soonest ==
                                               int(soonest) else 1)))
+
+    # -- placement ---------------------------------------------------------
+    def _placement_inputs(self) -> tuple[list, list, str | None]:
+        """(models, candidates, default model) from the cached probe
+        snapshots — the scoring inputs of docs/fleet.md: per-tenant
+        residency (model_resident lineage) and the backend's
+        device-time burn (model_device_ms_total / engine_busy_ratio
+        lineage), all read from the healthz rows the prober already
+        fetches."""
+        models: set = set()
+        candidates = []
+        default = None
+        for b in self._backend_list():
+            snap, _age = b.health()
+            if b.breaker.state == "open":
+                # ejected backends are not placement candidates: an
+                # owner dying must move its tenants to live backends
+                # on the next discovery sweep (the heal the chaos
+                # placement drill pins), not leave them pointing at a
+                # corpse.  Its discovered TENANTS still count — a
+                # model only it held must stay in the map (degraded
+                # routing answers it meanwhile)
+                if isinstance(snap.get("models"), list):
+                    for r in snap["models"]:
+                        if isinstance(r, dict) and r.get("model"):
+                            models.add(r["model"])
+                continue
+            rows = snap.get("models")
+            resident: set = set()
+            if isinstance(rows, list):
+                for r in rows:
+                    if isinstance(r, dict) and r.get("model"):
+                        models.add(r["model"])
+                        if r.get("resident"):
+                            resident.add(r["model"])
+            if default is None and snap.get("default_model"):
+                default = snap["default_model"]
+            candidates.append(placement_mod.PlacementCandidate(
+                b.name, resident=resident, busy=b.busy_ratio()))
+        return sorted(models), candidates, default
+
+    def recompute_placement(self, cause: str = "manual") -> dict:
+        """Re-run the placement plan over the current membership and
+        discovered tenants, then push per-backend eviction hints down
+        to each zoo (best-effort — a backend that misses a hint still
+        converges through routing).  No-op without an engine."""
+        if self.placement is None:
+            return {}
+        models, candidates, default = self._placement_inputs()
+        plan = self.placement.plan(models, candidates, cause=cause)
+        with self._placement_lock:
+            self._default_model = default
+            self._placement_key = (tuple(models),
+                                   tuple(sorted(c.name
+                                                for c in candidates)))
+        self._push_placement_hints()
+        return plan
+
+    def _push_placement_hints(self) -> None:
+        """Tell each backend's zoo which tenants it owns
+        (``POST /admin/placement`` on the SERVE surface →
+        ``ModelZoo.set_placement_hint``): non-placed device copies are
+        released immediately and evict first under budget pressure —
+        the fleet footprint bound is enforced at the source, not
+        hoped for.  Best-effort per backend, bounded by the forward
+        timeout."""
+        if self.placement is None:
+            return
+        headers = {"Content-Type": "application/json"}
+        if self.admin_token is not None:
+            headers["X-Admin-Token"] = self.admin_token
+        for b in self._backend_list():
+            snap, _age = b.health()
+            if not isinstance(snap.get("models"), list):
+                continue               # single-model backend: no zoo
+            body = json.dumps(
+                {"models":
+                 self.placement.backend_models(b.name)}).encode()
+            try:
+                b.forward("POST", "/admin/placement", body, headers)
+            except BackendDown:
+                pass                   # the prober will eject it
+
+    def placement_status(self) -> dict | None:
+        if self.placement is None:
+            return None
+        out = self.placement.status()
+        with self._placement_lock:
+            out["default_model"] = self._default_model
+        return out
 
     # -- background prober -------------------------------------------------
     def _probe_loop(self) -> None:
@@ -796,10 +1110,29 @@ class FleetRouter:
         re-admission path even when no live request is willing to be
         its half-open probe."""
         while not self._stop_event.wait(self.probe_interval_s):
-            for b in self.backends:
+            for b in self._backend_list():
                 if self._stop_event.is_set():
                     return
                 self.probe_backend(b)
+            self._maybe_recompute_placement()
+
+    def _maybe_recompute_placement(self) -> None:
+        """Discovery: recompute when the probe sweep changed the
+        (tenants, membership) key — a new zoo entry appeared, a
+        backend joined/left between sweeps.  Score drift alone never
+        recomputes: cache affinity beats marginal balance."""
+        if self.placement is None:
+            return
+        models, candidates, _default = self._placement_inputs()
+        key = (tuple(models),
+               tuple(sorted(c.name for c in candidates)))
+        with self._placement_lock:
+            stale = key != self._placement_key
+        if stale and models:
+            try:
+                self.recompute_placement(cause="discovery")
+            except Exception:
+                pass                   # next sweep retries
 
     def probe_backend(self, backend: Backend) -> bool:
         """One /healthz probe, feeding the breaker (success closes a
@@ -829,21 +1162,38 @@ class FleetRouter:
         the same idiom as ``ServingServer.attach_promotion``."""
         self.rollout_status = status_fn
 
+    def attach_autoscaler(self, status_fn) -> None:
+        """Surface an autoscaler loop's ``status()`` on ``/healthz``
+        and ``/statusz`` — same idiom as :meth:`attach_rollout`."""
+        self.autoscale_status = status_fn
+
     def backend_rows(self) -> list[dict]:
-        return [b.metrics() for b in self.backends]
+        return [b.metrics() for b in self._backend_list()]
 
     def health(self) -> dict:
-        rows = self.backend_rows()
-        healthy = sum(1 for b in self.backends
+        backends = self._backend_list()
+        rows = [b.metrics() for b in backends]
+        healthy = sum(1 for b in backends
                       if b.breaker.state != "open")
-        status = ("ok" if healthy == len(self.backends)
+        status = ("ok" if healthy == len(backends)
                   else "degraded" if healthy else "unhealthy")
         out = {"status": status, "role": "router",
                "backends": rows,
                "healthy_backends": healthy,
-               "backend_count": len(self.backends),
+               "backend_count": len(backends),
                "rev": self.rev,
                "uptime_s": round(debugz.process_uptime_s(), 1)}
+        ps = self.placement_status()
+        if ps is not None:
+            # opt-in block, the zoo-surface rule: the placement-less
+            # /healthz shape must not grow keys
+            out["placement"] = ps
+        a_s = self.autoscale_status
+        if a_s is not None:
+            try:
+                out["autoscale"] = a_s()
+            except Exception:
+                out["autoscale"] = {"state": "unknown"}
         rs = self.rollout_status
         if rs is not None:
             try:
@@ -879,7 +1229,7 @@ class FleetRouter:
         sampled at scrape time — the ``fleet_*{backend=...}``
         inventory in docs/observability.md."""
         healthy, weights, gens, trips = [], [], [], []
-        for b in self.backends:
+        for b in self._backend_list():
             labels = {"backend": b.name}
             healthy.append((labels,
                             0.0 if b.breaker.state == "open" else 1.0))
@@ -923,7 +1273,7 @@ class FleetRouter:
         self.server.shutdown()
         self.server.server_close()
         self._prober.join(5.0)
-        for b in self.backends:
+        for b in self._backend_list():
             b.close()
 
     @property
@@ -944,10 +1294,12 @@ def main(argv=None) -> int:
                     "backends with weighted routing, per-backend "
                     "circuit breakers and failover (docs/fleet.md)")
     p.add_argument("--backend", action="append", metavar="SPEC",
-                   required=True,
+                   default=[],
                    help="one serve backend: URL[,weight=W][,name=N] — "
                         "repeatable (e.g. "
-                        "http://127.0.0.1:8101,weight=2,name=b0)")
+                        "http://127.0.0.1:8101,weight=2,name=b0); "
+                        "optional with --autoscale (the launcher "
+                        "boots the floor)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8200)
     p.add_argument("--probe-interval-s", type=float, default=2.0,
@@ -986,9 +1338,83 @@ def main(argv=None) -> int:
                         "bound)")
     p.add_argument("--admin-token", default=None,
                    help="require this token (X-Admin-Token) on "
-                        "POST /admin/weight; defaults to "
+                        "POST /admin/weight and POST "
+                        "/admin/placement; defaults to "
                         "$ZNICZ_ADMIN_TOKEN")
+    p.add_argument("--placement", type=int, default=0, metavar="R",
+                   help="placement-aware routing: assign each zoo "
+                        "tenant to R backends (weighted rendezvous, "
+                        "residency-/load-scored) and route it only "
+                        "there, degrading to any-healthy when the "
+                        "set cannot answer (0 = off; docs/fleet.md)")
+    g = p.add_argument_group(
+        "autoscaling (route --autoscale / python -m znicz_tpu "
+        "autoscale)")
+    g.add_argument("--autoscale", action="store_true",
+                   help="run the elastic autoscaler loop: boot serve "
+                        "processes on sustained burn, drain them "
+                        "gracefully on sustained idle (docs/fleet.md)")
+    g.add_argument("--serve-arg", action="append", default=[],
+                   metavar="ARG",
+                   help="one argument appended to every booted "
+                        "'serve' process (repeatable; e.g. "
+                        "--serve-arg=--zoo --serve-arg=zoo_dir)")
+    g.add_argument("--min-backends", type=int, default=1,
+                   help="membership floor: never drain below this "
+                        "(static --backend entries count toward it "
+                        "and are never drained themselves)")
+    g.add_argument("--max-backends", type=int, default=4,
+                   help="membership ceiling: never boot above this")
+    g.add_argument("--autoscale-interval-s", type=float, default=5.0,
+                   help="sampling-window length of the scale loop")
+    g.add_argument("--autoscale-objective", default="availability",
+                   help="burn objective judged per window "
+                        "(availability | latency)")
+    g.add_argument("--autoscale-target", type=float, default=0.999,
+                   help="SLO target the burn budget derives from")
+    g.add_argument("--autoscale-threshold-ms", type=float,
+                   default=None,
+                   help="latency-objective threshold (required when "
+                        "the objective is latency)")
+    g.add_argument("--autoscale-max-burn", type=float, default=2.0,
+                   help="burn rate a window must reach to count as "
+                        "hot")
+    g.add_argument("--autoscale-min-events", type=int, default=5,
+                   help="fewer events than this in a window proves "
+                        "nothing (burns 0, same stance as the SLO "
+                        "engine)")
+    g.add_argument("--breach-windows", type=int, default=2,
+                   help="CONSECUTIVE hot windows before a scale-out "
+                        "(the hysteresis: one blip never boots)")
+    g.add_argument("--idle-windows", type=int, default=6,
+                   help="consecutive quiet windows before a "
+                        "scale-in")
+    g.add_argument("--idle-rps", type=float, default=0.5,
+                   help="request rate under which a no-burn window "
+                        "counts as quiet")
+    g.add_argument("--autoscale-cooldown-s", type=float, default=30.0,
+                   help="hold-down after any membership action")
+    g.add_argument("--drain-timeout-s", type=float, default=20.0,
+                   help="graceful-drain window granted to a retiring "
+                        "backend before SIGKILL")
+    g.add_argument("--boot-timeout-s", type=float, default=60.0,
+                   help="how long a booting backend may take to "
+                        "answer /healthz before the boot fails")
+    g.add_argument("--autoscale-log-dir", default=None,
+                   help="directory for booted backends' logs "
+                        "(default: discard)")
     args = p.parse_args(argv)
+    if not args.backend and not args.autoscale:
+        p.error("at least one --backend is required (or --autoscale, "
+                "which boots its own)")
+    if args.autoscale and not args.serve_arg and \
+            len(args.backend) < max(1, args.min_backends):
+        p.error("--autoscale needs --serve-arg ... to know how to "
+                "boot backends (e.g. --serve-arg=--zoo "
+                "--serve-arg=DIR), or enough static --backend "
+                "entries to cover --min-backends")
+    if args.placement < 0:
+        p.error("--placement must be >= 0")
     token = args.admin_token if args.admin_token is not None \
         else os.environ.get("ZNICZ_ADMIN_TOKEN") or None
     backends = []
@@ -1004,20 +1430,66 @@ def main(argv=None) -> int:
                     cooldown_s=args.breaker_cooldown_s)))
         except ValueError as e:
             p.error(str(e))
+    engine = (placement_mod.PlacementEngine(args.placement)
+              if args.placement > 0 else None)
+    launcher = None
+    scaler = None
+    booted = []
     router = None
     try:
+        if args.autoscale:
+            from .autoscaler import Autoscaler, ServeLauncher
+            launcher = ServeLauncher(
+                args.serve_arg, host=args.host,
+                log_dir=args.autoscale_log_dir,
+                boot_timeout_s=args.boot_timeout_s,
+                forward_timeout_s=args.forward_timeout_s,
+                breaker_threshold=args.breaker_threshold,
+                breaker_cooldown_s=args.breaker_cooldown_s)
+            # boot the floor BEFORE the router: it needs >= 1 backend
+            while len(backends) + len(booted) < max(1,
+                                                    args.min_backends):
+                b, proc = launcher.spawn(len(booted))
+                booted.append((b, proc))
+                print(f"autoscale: booted floor backend {b.name} "
+                      f"at {b.url}", flush=True)
         router = FleetRouter(
-            backends, host=args.host, port=args.port,
+            backends + [b for b, _p in booted],
+            host=args.host, port=args.port,
             default_deadline_ms=args.default_deadline_ms,
             probe_interval_s=args.probe_interval_s,
             admin_token=token, max_body_mb=args.max_body_mb,
             max_hops=args.max_hops, memo_entries=args.memoize,
-            memo_mb=args.memoize_mb)
+            memo_mb=args.memoize_mb, placement=engine)
         router.start()
-        print(f"routing {len(backends)} backend(s) "
-              f"{[b.name for b in backends]} at {router.url} "
-              f"(POST /predict, GET /healthz, GET /metrics, "
-              f"GET /statusz, POST /admin/weight)", flush=True)
+        if args.autoscale:
+            scaler = Autoscaler(
+                router, launcher=launcher,
+                min_backends=max(1, args.min_backends),
+                max_backends=args.max_backends,
+                interval_s=args.autoscale_interval_s,
+                objective=args.autoscale_objective,
+                target=args.autoscale_target,
+                threshold_ms=args.autoscale_threshold_ms,
+                max_burn_rate=args.autoscale_max_burn,
+                min_events=args.autoscale_min_events,
+                breach_windows=args.breach_windows,
+                idle_windows=args.idle_windows,
+                idle_rps=args.idle_rps,
+                cooldown_s=args.autoscale_cooldown_s,
+                drain_timeout_s=args.drain_timeout_s)
+            for b, proc in booted:
+                scaler.adopt(b, proc)
+            scaler.start()
+        names = [b.name for b in router._backend_list()]
+        print(f"routing {len(names)} backend(s) {names} at "
+              f"{router.url} (POST /predict, GET /healthz, "
+              f"GET /metrics, GET /statusz, POST /admin/weight, "
+              f"POST /admin/placement"
+              + (f"; placement replication={args.placement}"
+                 if engine is not None else "")
+              + ("; autoscale on" if scaler is not None else "")
+              + ")", flush=True)
         stop = threading.Event()
 
         def _arm():
@@ -1033,6 +1505,17 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if scaler is not None:
+            # drain every managed backend gracefully (SIGTERM → the
+            # serve drain path → exit 0), THEN stop routing
+            scaler.shutdown()
+        elif booted:
+            for b, proc in booted:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except Exception:
+                    proc.kill()
         if router is not None:
             router.stop()
     return 0

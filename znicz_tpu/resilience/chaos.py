@@ -2002,6 +2002,289 @@ def _fleet_scenario(args) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _placement_scenario(args) -> int:
+    """``--scenario placement`` — the placement-fabric acceptance
+    (docs/fleet.md "Placement"): three REAL multi-tenant ``serve``
+    processes (the demo zoo on each) behind a REAL ``route
+    --placement 1`` process.  Asserted:
+
+    * the router discovers every tenant and places each on exactly
+      ``replication`` backends; steady-state traffic routes INSIDE
+      the placement set (``X-Fleet-Placement: placed``, answering
+      backend ∈ the tenant's set);
+    * fleet-wide resident bytes stay ≤ (1 + replication) × one zoo's
+      total weight bytes — the hint push releases non-placed copies,
+      so the footprint is ~replication ×, not N × (the slack is one
+      in-transition copy);
+    * SIGKILLing the backend that owns the hot tenant mid-burst
+      yields ZERO raw 500s and zero hangs (degraded routing bridges
+      the gap) and the map HEALS: the next discovery sweep re-places
+      the tenant on live backends only;
+    * the healed tenant keeps answering 200s, and the footprint bound
+      still holds afterwards.
+    """
+    import collections
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import threading
+
+    from ..serving import zoo as zoo_mod
+
+    bad: list[str] = []
+    inputs = {"mnist": [[0.2] * 16], "wine": [[0.1] * 13],
+              "kohonen": [[0.3] * 6]}
+    n_backends = 3
+    replication = 1
+    tmp = tempfile.mkdtemp(prefix="znicz_chaos_place_")
+    procs: dict[int, subprocess.Popen] = {}
+    router_proc = None
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def boot_backend(port: int, zoo_dir: str) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "serve",
+             "--zoo", zoo_dir, "--port", str(port),
+             "--max-wait-ms", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def wait_healthz(url: str, proc, what: str,
+                     tries: int = 240) -> bool:
+        for _ in range(tries):
+            try:
+                with urllib.request.urlopen(url + "healthz",
+                                            timeout=2) as r:
+                    json.loads(r.read())
+                return True
+            except Exception:
+                if proc is not None and proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    bad.append(f"{what} exited rc={proc.returncode}: "
+                               f"{out[-300:]}")
+                    return False
+                time.sleep(0.25)
+        bad.append(f"{what} never answered /healthz")
+        return False
+
+    def router_health() -> dict:
+        with urllib.request.urlopen(router_url + "healthz",
+                                    timeout=10) as r:
+            return json.loads(r.read())
+
+    def assignments() -> dict:
+        return (router_health().get("placement") or {}) \
+            .get("assignments") or {}
+
+    def fleet_footprint() -> tuple[int, int]:
+        """(fleet resident bytes, one zoo's total weight bytes) from
+        the live backends' /healthz."""
+        resident = 0
+        zoo_total = 0
+        for i, url in enumerate(backend_urls):
+            if procs[i].poll() is not None:
+                continue
+            try:
+                with urllib.request.urlopen(url + "healthz",
+                                            timeout=10) as r:
+                    snap = json.loads(r.read())
+            except Exception:
+                continue
+            resident += int(snap.get("resident_bytes") or 0)
+            total = sum(int(row.get("weight_bytes") or 0)
+                        for row in snap.get("models") or [])
+            zoo_total = max(zoo_total, total)
+        return resident, zoo_total
+
+    try:
+        zoo_dir = os.path.join(tmp, "zoo")
+        os.makedirs(zoo_dir)
+        zoo_mod.make_demo_zoo(zoo_dir)
+        ports = [free_port() for _ in range(n_backends)]
+        rport = free_port()
+        backend_urls = [f"http://127.0.0.1:{p}/" for p in ports]
+        router_url = f"http://127.0.0.1:{rport}/"
+        for i, port in enumerate(ports):
+            procs[i] = boot_backend(port, zoo_dir)
+        for i, port in enumerate(ports):
+            if not wait_healthz(backend_urls[i], procs[i],
+                                f"backend {i}"):
+                return 1
+        router_proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "route",
+             "--port", str(rport), "--placement", str(replication),
+             "--probe-interval-s", "0.3",
+             "--breaker-threshold", "2",
+             "--breaker-cooldown-s", "1.0"]
+            + [f for i, u in enumerate(backend_urls)
+               for f in ("--backend", f"{u},name=b{i}")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        if not wait_healthz(router_url, router_proc, "router"):
+            return 1
+
+        # ---- phase 1: the map covers every tenant at `replication`
+        amap: dict = {}
+        for _ in range(80):
+            amap = assignments()
+            if set(amap) >= set(inputs) \
+                    and all(len(v) == replication
+                            for v in amap.values()):
+                break
+            time.sleep(0.25)
+        print(json.dumps({"phase": "discovery", "assignments": amap}))
+        if set(amap) < set(inputs):
+            bad.append(f"placement never covered the zoo: {amap}")
+            return 1
+
+        # steady-state: every tenant answers, routed INSIDE its set
+        modes = collections.Counter()
+        for _round in range(10):
+            for model in inputs:
+                code, _b, headers = _post(
+                    router_url, {"inputs": inputs[model]},
+                    timeout=30, headers={"X-Model": model})
+                if code != 200:
+                    bad.append(f"steady-state {model} answered {code}")
+                    break
+                modes[headers.get("X-Fleet-Placement")] += 1
+                who = headers.get("X-Fleet-Backend")
+                if headers.get("X-Fleet-Placement") == "placed" \
+                        and who not in amap[model]:
+                    bad.append(f"{model} marked 'placed' but answered "
+                               f"by {who} ∉ {amap[model]}")
+        print(json.dumps({"phase": "steady-state",
+                          "modes": dict(modes)}))
+        if not modes.get("placed") \
+                or modes.get("placed", 0) < sum(modes.values()) * 0.8:
+            bad.append(f"steady-state traffic was not placement-"
+                       f"routed: modes={dict(modes)}")
+
+        # ---- phase 2: the footprint bound (the hint push must have
+        # released non-placed copies by now; give one sweep of slack)
+        time.sleep(1.0)
+        resident, zoo_total = fleet_footprint()
+        bound = (1 + replication) * zoo_total
+        print(json.dumps({"phase": "footprint",
+                          "fleet_resident_bytes": resident,
+                          "zoo_total_bytes": zoo_total,
+                          "bound_bytes": bound}))
+        if zoo_total <= 0:
+            bad.append("could not size the zoo from backend healthz")
+        elif resident > bound:
+            bad.append(f"fleet resident bytes {resident} exceed the "
+                       f"(1+replication) x zoo bound {bound} — "
+                       f"placement hints are not shrinking residency")
+
+        # ---- phase 3: SIGKILL the hot tenant's owner mid-burst
+        hot = "mnist"
+        owner = amap[hot][0]
+        owner_i = int(owner[1:])        # b0/b1/b2 -> port index
+        answers: list[tuple] = []
+        mu = threading.Lock()
+        stop = threading.Event()
+
+        def client(model: str):
+            while not stop.is_set():
+                try:
+                    code, _b, headers = _post(
+                        router_url, {"inputs": inputs[model]},
+                        timeout=15, headers={"X-Model": model})
+                except urllib.error.HTTPError as e:
+                    code, headers = e.code, dict(e.headers)
+                    e.read()
+                except Exception:
+                    code, headers = -1, {}
+                with mu:
+                    answers.append((code, "Retry-After" in headers))
+                stop.wait(0.005)
+
+        threads = [threading.Thread(target=client, args=(m,),
+                                    daemon=True)
+                   for m in (hot,) * 4 + ("wine", "kohonen")]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        procs[owner_i].kill()           # a CRASH, not a drain
+        procs[owner_i].wait(timeout=15)
+        healed = False
+        for _ in range(80):
+            placed = assignments().get(hot) or []
+            if placed and owner not in placed:
+                healed = True
+                break
+            time.sleep(0.25)
+        time.sleep(1.0)                 # keep bursting post-heal
+        stop.set()
+        for t in threads:
+            t.join(20.0)
+        codes = collections.Counter(code for code, _ra in answers)
+        print(json.dumps({"phase": "kill-burst", "owner": owner,
+                          "healed": healed,
+                          "codes": dict(sorted(codes.items()))}))
+        if not healed:
+            bad.append(f"placement never healed: {hot} still mapped "
+                       f"to the killed backend {owner}")
+        if codes.get(-1):
+            bad.append(f"{codes[-1]} request(s) hung or died on a "
+                       f"connection error during the kill burst")
+        if codes.get(500):
+            bad.append(f"{codes[500]} raw 500(s) during the kill "
+                       f"burst")
+        for code, ra in answers:
+            if code in (429, 503) and not ra:
+                bad.append(f"a {code} refusal carried no Retry-After")
+                break
+
+        # post-heal: the hot tenant answers from its NEW set, and the
+        # footprint bound still holds on the surviving fleet
+        amap = assignments()
+        code, _b, headers = _post(router_url,
+                                  {"inputs": inputs[hot]},
+                                  timeout=30, headers={"X-Model": hot})
+        if code != 200:
+            bad.append(f"post-heal {hot} answered {code}")
+        elif headers.get("X-Fleet-Placement") == "placed" \
+                and headers.get("X-Fleet-Backend") \
+                not in (amap.get(hot) or []):
+            bad.append(f"post-heal {hot} 'placed' answer came from "
+                       f"{headers.get('X-Fleet-Backend')} ∉ "
+                       f"{amap.get(hot)}")
+        time.sleep(1.0)
+        resident, zoo_total = fleet_footprint()
+        bound = (1 + replication) * zoo_total
+        print(json.dumps({"phase": "footprint-post-heal",
+                          "fleet_resident_bytes": resident,
+                          "zoo_total_bytes": zoo_total,
+                          "bound_bytes": bound}))
+        if zoo_total > 0 and resident > bound:
+            bad.append(f"post-heal fleet resident bytes {resident} "
+                       f"exceed the bound {bound}")
+        print(json.dumps({"scenario": "placement", "ok": not bad,
+                          "violations": bad}))
+        return 1 if bad else 0
+    finally:
+        if router_proc is not None:
+            router_proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 15.0
+        for proc in [router_proc] + list(procs.values()):
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1,
+                                      deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _admin_reload_named(url: str, name: str, model: str,
                         timeout: float = 60.0):
     """(status, body) of a synchronous per-model ``POST
@@ -2036,7 +2319,8 @@ def main(argv=None) -> int:
     p.add_argument("--retry-attempts", type=int, default=2)
     p.add_argument("--scenario", default="breaker",
                    choices=("breaker", "reload", "promote", "overload",
-                            "zoo", "slo", "wire", "fleet", "online"),
+                            "zoo", "slo", "wire", "fleet", "online",
+                            "placement"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -2083,7 +2367,15 @@ def main(argv=None) -> int:
                         "toxic candidate rolled back by the SLO "
                         "watch, capture fail-open fault-injected, "
                         "plus the Kohonen serve-and-train drill "
-                        "(docs/online.md)")
+                        "(docs/online.md); placement: three "
+                        "multi-tenant serve processes behind a route "
+                        "--placement process — the map covers every "
+                        "tenant, traffic routes inside placement "
+                        "sets, fleet resident bytes stay ≤ "
+                        "(1+replication) x one zoo, and SIGKILLing "
+                        "the hot tenant's owner mid-burst heals via "
+                        "re-placement with zero raw 500s "
+                        "(docs/fleet.md)")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -2146,6 +2438,8 @@ def main(argv=None) -> int:
         return _fleet_scenario(args)
     if args.scenario == "online":
         return _online_scenario(args)
+    if args.scenario == "placement":
+        return _placement_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

@@ -149,8 +149,8 @@ from .memo import ResponseCache
 #: anything else pools under "other" (label cardinality stays bounded
 #: no matter what paths clients probe)
 _ROUTES = ("/predict", "/healthz", "/metrics", "/admin/reload",
-           "/statusz", "/alertz", "/debug/flightrecorder",
-           "/debug/threadz")
+           "/admin/placement", "/statusz", "/alertz",
+           "/debug/flightrecorder", "/debug/threadz")
 
 _wire_requests = REGISTRY.counter(
     "wire_requests_total",
@@ -762,6 +762,9 @@ class ServingServer:
                 if route == "/admin/reload":
                     self._admin_reload()
                     return
+                if route == "/admin/placement":
+                    self._admin_placement()
+                    return
                 if route != "/predict":
                     # body never read on this leg — keep-alive framing
                     # would misread it as the next request's head
@@ -890,6 +893,44 @@ class ServingServer:
                 else:
                     self._reply(202, {"status": "reload started",
                                       **outer.reload_status(name)})
+
+            def _admin_placement(self):
+                """``POST /admin/placement`` — the fleet router's
+                eviction hint (PR 16).
+
+                Body: ``{"models": ["a", "b"]}`` = the tenants PLACED
+                on this backend, or ``{"models": null}`` to clear the
+                hint.  Non-placed device copies release immediately
+                and evict first under budget pressure
+                (``ModelZoo.set_placement_hint``); unknown names are
+                reported, not fatal — the router's registry view may
+                briefly lead or lag ours.  403 = missing/wrong
+                ``X-Admin-Token`` when one is configured, 400 = junk
+                body."""
+                if not self._admin_authorized():
+                    self.close_connection = True   # body left unread
+                    self._reply(403, {
+                        "error": "admin token required (supply "
+                                 "X-Admin-Token)"})
+                    return
+                raw = self._read_body()
+                if raw is None:
+                    return
+                try:
+                    payload = _json_object(raw)
+                    models = payload.get("models")
+                    if models is not None and (
+                            not isinstance(models, list)
+                            or not all(isinstance(m, str)
+                                       for m in models)):
+                        raise ValueError("'models' must be a list of "
+                                         "model-name strings, or null "
+                                         "to clear the hint")
+                except Exception as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                self._reply(200, {"status": "ok",
+                                  **outer.zoo.set_placement_hint(models)})
 
             def _predict(self):
                 raw = self._read_body()
@@ -1301,6 +1342,10 @@ class ServingServer:
             # it already makes
             out["models"] = self.zoo.status()
             out["default_model"] = self.zoo.default_name
+            # device bytes actually held, fleet-visible: the router's
+            # placement tier sums this across backends to prove the
+            # ≤ (1 + replication) × zoo footprint bound (PR 16)
+            out["resident_bytes"] = self.zoo.resident_bytes()
         ps = self.promotion_status
         if ps is not None:
             try:

@@ -278,6 +278,13 @@ class ModelZoo:
         #: paged nothing in itself
         self._dirty = False
         self._pagein_ms = collections.deque(maxlen=int(pagein_window))
+        #: the fleet placement layer's eviction hint (PR 16): the
+        #: tenants PLACED on this backend.  None = no placement tier
+        #: above us (the historical pure-LRU behavior); a set biases
+        #: eviction to drop non-placed device copies first — they are
+        #: only ever served here in degraded mode, so their bytes are
+        #: the cheapest to give back
+        self._placement_hint: frozenset | None = None
 
     # -- registration -----------------------------------------------------
     def add(self, name: str, model=None, *, engine=None,
@@ -431,8 +438,15 @@ class ModelZoo:
         evicted = 0
         for _round in range(len(self) + 1):
             with self._lock:
-                order = sorted(self._entries,
-                               key=lambda n: self._last_used.get(n, 0.0))
+                hint = self._placement_hint
+                # placement-aware victim order: non-placed tenants
+                # evict first regardless of recency (degraded-mode
+                # strays), then the plain LRU order among peers
+                order = sorted(
+                    self._entries,
+                    key=lambda n: (0 if hint is None or n not in hint
+                                   else 1,
+                                   self._last_used.get(n, 0.0)))
                 entries = dict(self._entries)
             resident = [(n, entries[n]) for n in order
                         if entries[n].engine.weights_resident()]
@@ -454,6 +468,42 @@ class ModelZoo:
                     _evictions.inc(model=name)
                     _resident.set(0.0, model=name)
         return evicted
+
+    def set_placement_hint(self, models) -> dict:
+        """Accept the fleet placement layer's eviction hint: the
+        tenants PLACED on this backend (``POST /admin/placement`` on
+        the serve surface; the router pushes one after every
+        recompute).  ``models=None`` clears the hint and restores pure
+        LRU.  Non-placed device copies are released immediately — the
+        footprint bound is enforced the moment the map changes, not on
+        the next budget-pressure eviction — and any that survive (a
+        release racing a page-in) evict first under pressure via the
+        biased victim order in :meth:`evict_to_budget`.  A model can
+        still be *served* here in degraded mode; it just pays its
+        page-in again."""
+        if models is None:
+            with self._lock:
+                self._placement_hint = None
+            return {"placed": None, "released": [], "unknown": []}
+        names = [str(m) for m in models]
+        with self._lock:
+            known = set(self._entries)
+            hint = frozenset(n for n in names if n in known)
+            self._placement_hint = hint
+            entries = dict(self._entries)
+        released = []
+        for name, entry in sorted(entries.items()):
+            if name in hint:
+                continue
+            if entry.engine.release_weights():
+                released.append(name)
+                if self.labeled_metrics:
+                    _evictions.inc(model=name)
+                    _resident.set(0.0, model=name)
+        if self.labeled_metrics:
+            _resident_bytes.set(self.resident_bytes())
+        return {"placed": sorted(hint), "released": released,
+                "unknown": sorted(set(names) - known)}
 
     # -- reload -----------------------------------------------------------
     def reload(self, name: str | None = None, path: str | None = None,

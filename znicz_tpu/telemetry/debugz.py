@@ -328,6 +328,31 @@ def fleet_statusz_text(router, *, recorder=None) -> str:
             lines.append("rollout: " + _fmt_kv(rs()))
         except Exception:
             lines.append("rollout: <status probe failed>")
+    if getattr(router, "placement", None) is not None:
+        # the placement map, tenant by tenant — mid-incident the
+        # question is "where does model X live RIGHT NOW"
+        try:
+            ps = router.placement_status()
+            lines += ["", "placement", "-" * 9]
+            lines.append(
+                f"  replication={ps['replication']} "
+                f"generation={ps['generation']} "
+                f"cause={ps['last_cause'] or '-'} "
+                f"moves_total={ps['moves_total']}")
+            for model, names in sorted(
+                    (ps.get("assignments") or {}).items()):
+                pin = " (pinned)" if model in (ps.get("pins") or {}) \
+                    else ""
+                lines.append(f"  {model:<24} -> "
+                             f"{', '.join(names) or '-'}{pin}")
+        except Exception:
+            lines.append("placement: <status probe failed>")
+    asf = getattr(router, "autoscale_status", None)
+    if asf is not None:
+        try:
+            lines.append("autoscale: " + _fmt_kv(asf()))
+        except Exception:
+            lines.append("autoscale: <status probe failed>")
     counts = rec.counts()
     lines += ["", "flight recorder", "-" * 15]
     lines.append(_fmt_kv(counts))
@@ -343,5 +368,5 @@ def fleet_statusz_text(router, *, recorder=None) -> str:
                          f"{(r.get('backend') or '-'):<16} "
                          f"{r.get('request_id') or ''}")
     lines += ["", "endpoints: /healthz /metrics /statusz "
-                  "POST /admin/weight", ""]
+                  "POST /admin/weight POST /admin/placement", ""]
     return "\n".join(lines)
