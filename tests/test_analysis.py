@@ -941,6 +941,57 @@ class TestDeadlineDiscipline:
         assert rules_of(found) == ["deadline-discipline"]
         assert len(found) == 4
 
+    # ISSUE 17: restart reconciliation waits on journaled orphan
+    # processes and re-probes their /healthz — a single unbounded
+    # wait there stretches the router's advertised Retry-After into
+    # a lie.  Pin the statestore/reconcile shapes both ways.
+
+    STATESTORE_BAD = """
+    import urllib.request
+
+    def reconcile(handle, settled):
+        settled.wait()                       # unbounded settle wait
+        handle.wait()                        # orphan reap, no bound
+        urllib.request.urlopen("http://b/healthz")   # probe, no bound
+"""
+
+    STATESTORE_GOOD = """
+    import subprocess
+    import time
+    import urllib.request
+
+    def reconcile(handle, deadline_s, probe_timeout_s):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:   # the reconcile slice
+            try:
+                with urllib.request.urlopen(
+                        "http://b/healthz",
+                        timeout=probe_timeout_s) as r:
+                    return r.read()
+            except OSError:
+                pass
+            if handle.poll() is not None:
+                break
+            time.sleep(0.2)
+        try:
+            return handle.wait(timeout=deadline_s)   # bounded reap
+        except subprocess.TimeoutExpired:
+            handle.kill()
+            return handle.wait(timeout=5.0)
+"""
+
+    def test_statestore_reconcile_waits_patrolled(self, tmp_path):
+        found = lint(tmp_path, self.STATESTORE_BAD,
+                     [DeadlineDisciplineRule()],
+                     rel="znicz_tpu/fleet/statestore.py")
+        assert rules_of(found) == ["deadline-discipline"]
+        assert len(found) == 3          # wait / handle.wait / urlopen
+
+    def test_statestore_bounded_reconcile_stays_silent(self, tmp_path):
+        assert lint(tmp_path, self.STATESTORE_GOOD,
+                    [DeadlineDisciplineRule()],
+                    rel="znicz_tpu/fleet/statestore.py") == []
+
     def test_blocking_get_block_true_without_timeout(self, tmp_path):
         found = lint(tmp_path, """
     def loop(q):

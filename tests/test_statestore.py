@@ -1,0 +1,434 @@
+"""Crash-safe control plane (fleet.statestore + reconcile + gray).
+
+Pins the ISSUE 17 robustness contracts at unit scale (the end-to-end
+crash drill is ``chaos --scenario controlplane``):
+
+* the journal replays through a torn tail at EVERY byte offset —
+  everything before the tear folds, the tear never crashes a restart;
+* pid-reuse safety — a journaled pid whose kernel start-time identity
+  changed belongs to an unrelated process and is never signalled;
+* gray-failure hysteresis — one slow predict cannot demote; sustained
+  gray decays the effective weight, ejects through the breaker, and
+  recovers through healthy ticks;
+* reconciliation verdicts (adopted / dead / stale_pid / stale_args /
+  replaced / invalid) and the honest 503 + Retry-After window.
+"""
+
+import http.server
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from znicz_tpu.fleet import (Backend, FleetRouter, GrayPolicy,
+                             OrphanProcess, ServeLauncher, StateStore,
+                             pid_alive, process_identity,
+                             reconcile_children)
+from znicz_tpu.resilience.breaker import CircuitBreaker
+
+
+def _sleep_child():
+    """A real reparent-able process to journal pids against."""
+    return subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(120)"])
+
+
+@pytest.fixture
+def child():
+    proc = _sleep_child()
+    yield proc
+    if proc.poll() is None:
+        proc.kill()
+    proc.wait(timeout=10)
+
+
+# -- journal + replay --------------------------------------------------------
+
+class TestJournal:
+    def test_append_replay_folds_last_write_wins(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        store.append("weight", backend="b0", weight=2.0)
+        store.append("weight", backend="b0", weight=3.5)
+        store.append("pin", model="demo", backends=["b0", "b1"])
+        store.append("pin", model="old", backends=["b9"])
+        store.append("pin", model="old", backends=None)   # cleared
+        store.append("join", backend="b1", url="http://h:1/")
+        store.append("boot", backend="as0", pid=123, port=70,
+                     url="http://127.0.0.1:70/", args=["--model", "m"],
+                     identity="42")
+        store.append("adopt", backend="as0", pid=123, port=70,
+                     url="http://127.0.0.1:70/", args=["--model", "m"],
+                     identity="43")
+        store.append("boot", backend="as1", pid=124, port=71,
+                     url="http://127.0.0.1:71/", args=[], identity="9")
+        store.append("drain", backend="as1")
+        store.append("leave", backend="b1")
+        st = store.replay()
+        assert st.weights == {"b0": 3.5}
+        assert st.pins == {"demo": ["b0", "b1"]}
+        assert st.members == {}                 # joined then left
+        # adopt refreshed as0 (new identity); drain removed as1
+        assert set(st.children) == {"as0"}
+        assert st.children["as0"]["identity"] == "43"
+        assert st.records == 11
+
+    def test_missing_journal_is_empty_history(self, tmp_path):
+        store = StateStore(str(tmp_path / "never_created"))
+        assert store.entries() == []
+        assert store.replay().records == 0
+
+    def test_torn_tail_tolerated_at_every_byte_offset(self, tmp_path):
+        """Crash mid-append: for EVERY truncation point inside the
+        final record the durable prefix replays intact and nothing
+        raises — the exact promise an fsync'd-per-record journal
+        makes."""
+        store = StateStore(str(tmp_path))
+        store.append("weight", backend="b0", weight=2.0)
+        store.append("pin", model="demo", backends=["b0"])
+        store.append("weight", backend="b0", weight=9.0)
+        data = store_path_bytes = open(store.path, "rb").read()
+        tail_start = data.rstrip(b"\n").rfind(b"\n") + 1
+        for cut in range(tail_start, len(data) + 1):
+            torn = StateStore(str(tmp_path / f"cut{cut}"))
+            os.makedirs(torn.state_dir, exist_ok=True)
+            with open(torn.path, "wb") as fh:
+                fh.write(store_path_bytes[:cut])
+            st = torn.replay()                  # must never raise
+            assert st.pins == {"demo": ["b0"]}
+            # a flat JSON object only parses at full length (the one
+            # "}" is the final byte), so the verdict is deterministic:
+            # the torn record is dropped, the full one folds
+            tail_complete = cut >= len(data) - 1
+            assert st.records == (3 if tail_complete else 2)
+            assert st.weights == {
+                "b0": 9.0 if tail_complete else 2.0}
+
+    def test_junk_mid_file_skipped_not_fatal(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        store.append("weight", backend="b0", weight=2.0)
+        with open(store.path, "a") as fh:
+            fh.write("NOT JSON AT ALL\n")
+            fh.write('["an", "array", "not", "an", "object"]\n')
+        store.append("weight", backend="b1", weight=4.0)
+        st = store.replay()
+        assert st.records == 2
+        assert st.weights == {"b0": 2.0, "b1": 4.0}
+
+    def test_status_surface(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        store.append("boot", backend="as0", pid=1, port=2,
+                     url="http://127.0.0.1:2/", args=[], identity="x")
+        s = store.status()
+        assert s["path"] == store.path
+        assert s["records"] == 1 and s["children"] == ["as0"]
+
+
+# -- pid-reuse safety --------------------------------------------------------
+
+class TestProcessIdentity:
+    def test_identity_stable_and_distinct_per_process(self, child):
+        mine = process_identity(os.getpid())
+        assert mine is not None
+        assert process_identity(os.getpid()) == mine
+        theirs = process_identity(child.pid)
+        assert theirs is not None and theirs != mine
+
+    def test_dead_pid_reads_none_and_not_alive(self, child):
+        child.kill()
+        child.wait(timeout=10)
+        assert not pid_alive(child.pid)
+        assert process_identity(child.pid) is None
+
+    def test_orphan_refuses_recycled_pid(self, child):
+        """A live pid whose identity differs from the record is an
+        unrelated process wearing a recycled number: poll() says gone
+        and no signal is ever delivered."""
+        orphan = OrphanProcess(child.pid, identity="definitely-not-it")
+        assert orphan.poll() == -1
+        orphan.terminate()                      # must be a no-op
+        orphan.kill()
+        time.sleep(0.1)
+        assert child.poll() is None, \
+            "a recycled pid was signalled"
+
+    def test_orphan_tracks_real_child(self, child):
+        orphan = OrphanProcess(child.pid, process_identity(child.pid))
+        assert orphan.poll() is None
+        with pytest.raises(subprocess.TimeoutExpired):
+            orphan.wait(timeout=0.3)
+        orphan.terminate()
+        child.wait(timeout=10)   # reap the zombie (init would, for a
+        #                          genuinely reparented orphan)
+        assert orphan.wait(timeout=10) == -1
+        assert orphan.poll() == -1
+
+
+# -- gray-failure hysteresis (pure state machine, no sockets) ---------------
+
+POLICY = GrayPolicy(strikes=3, decay=0.5, eject_below=0.05,
+                    recover=2.0)
+
+
+class TestGrayHysteresis:
+    def _backend(self, weight=1.0):
+        return Backend("http://127.0.0.1:1/", name="g0", weight=weight)
+
+    def test_one_gray_tick_cannot_demote(self):
+        b = self._backend()
+        assert b.gray_step(True, POLICY) is None
+        assert b.gray_factor() == 1.0
+        assert b.effective_weight() == 1.0
+
+    def test_healthy_tick_resets_strikes(self):
+        b = self._backend()
+        b.gray_step(True, POLICY)
+        b.gray_step(True, POLICY)
+        b.gray_step(False, POLICY)              # hysteresis resets
+        b.gray_step(True, POLICY)
+        assert b.gray_step(True, POLICY) is None
+        assert b.gray_factor() == 1.0
+
+    def test_sustained_gray_decays_then_ejects(self):
+        b = self._backend(weight=2.0)
+        events = [b.gray_step(True, POLICY) for _ in range(8)]
+        assert events[:2] == [None, None]       # strikes building
+        assert events[2] == "demoted"           # threshold crossed
+        assert "ejected" in events[3:]
+        assert b.gray_factor() == 0.0
+        # the OPERATOR weight is untouched; only the factor zeroes
+        assert b.weight == 2.0 and b.effective_weight() == 0.0
+
+    def test_recovery_regrows_to_full_weight(self):
+        b = self._backend()
+        while b.gray_step(True, POLICY) != "ejected":
+            pass
+        events = []
+        for _ in range(12):
+            events.append(b.gray_step(False, POLICY))
+            if events[-1] == "recovered":
+                break
+        assert "recovered" in events
+        assert b.gray_factor() == 1.0
+        assert b.effective_weight() == 1.0
+
+    def test_ewma_folds_outcomes_and_latency(self):
+        b = self._backend()
+        for _ in range(10):
+            b.note_predict(False, 400.0, alpha=0.3)
+        ok, ms, obs = b.predict_ewma()
+        assert obs == 10 and ok < POLICY.ok_floor and ms > 150.0
+        for _ in range(20):
+            b.note_predict(True, 2.0, alpha=0.3)
+        ok, ms, _obs = b.predict_ewma()
+        assert ok > POLICY.ok_floor and ms < 50.0
+
+
+# -- reconciliation verdicts -------------------------------------------------
+
+class _Answerer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def _send(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        self._send(200 if self.path == "/healthz" else 404,
+                   {"status": "ok"})
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        # ANY http status proves the predict path answers — adoption
+        # must not demand a 200 from an empty-inputs canary
+        self._send(400, {"error": "canary"})
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def answerer():
+    srv = _Answerer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/"
+    srv.shutdown()
+    srv.server_close()
+
+
+class _SpyLauncher(ServeLauncher):
+    def __init__(self, serve_args):
+        super().__init__(serve_args, forward_timeout_s=5.0,
+                         breaker_threshold=2, breaker_cooldown_s=0.5)
+        self.retired = []
+
+    def retire(self, backend, proc, *, drain_timeout_s=20.0):
+        self.retired.append(backend.name)
+        return super().retire(backend, proc,
+                              drain_timeout_s=drain_timeout_s)
+
+
+class _SpyRouter:
+    def __init__(self):
+        self.added = []
+
+    def add_backend(self, backend):
+        self.added.append(backend)
+
+
+class _SpyScaler:
+    def __init__(self, store=None):
+        self.statestore = store
+        self.adopted = []
+
+    def adopt(self, backend, handle, *, journal="boot"):
+        self.adopted.append((backend.name, handle.pid, journal))
+
+
+def _rec(pid, url, args, identity):
+    return {"pid": pid, "port": 7, "url": url, "args": list(args),
+            "identity": identity}
+
+
+class TestReconcileChildren:
+    ARGS = ["--model", "m.znn", "--max-wait-ms", "1"]
+
+    def _run(self, children, store=None):
+        router, scaler = _SpyRouter(), _SpyScaler(store)
+        launcher = _SpyLauncher(self.ARGS)
+        out = reconcile_children(router, scaler, launcher, children,
+                                 deadline_s=4.0, poll_interval_s=0.05)
+        return out, router, scaler, launcher
+
+    def test_invalid_and_dead_records_drain(self, tmp_path, child):
+        child.kill()
+        child.wait(timeout=10)
+        store = StateStore(str(tmp_path))
+        out, router, scaler, _l = self._run(
+            {"as0": {"url": "http://127.0.0.1:1/"},      # no pid
+             "as1": _rec(child.pid, "http://127.0.0.1:1/",
+                         self.ARGS, None)},               # pid gone
+            store)
+        assert out == {"invalid": 1, "dead": 1}
+        assert router.added == [] and scaler.adopted == []
+        # both journaled away so the NEXT restart stops asking
+        drains = [e for e in store.entries() if e["kind"] == "drain"]
+        assert {e["backend"] for e in drains} == {"as0", "as1"}
+        assert all(e["source"] == "reconcile" for e in drains)
+
+    def test_recycled_pid_never_signalled(self, child):
+        out, router, _s, launcher = self._run(
+            {"as0": _rec(child.pid, "http://127.0.0.1:1/",
+                         self.ARGS, identity="not-the-same")})
+        assert out == {"stale_pid": 1}
+        assert launcher.retired == [], \
+            "reconcile retired (signalled) a recycled pid"
+        time.sleep(0.1)
+        assert child.poll() is None and router.added == []
+
+    def test_stale_args_drained_not_adopted(self, child):
+        out, router, _s, launcher = self._run(
+            {"as0": _rec(child.pid, "http://127.0.0.1:1/",
+                         ["--model", "OTHER.znn"],
+                         process_identity(child.pid))})
+        assert out == {"stale_args": 1}
+        assert launcher.retired == ["as0"] and router.added == []
+        assert child.poll() is not None     # SIGTERM'd by the drain
+
+    def test_half_dead_child_replaced(self, child):
+        # alive, right generation, but nothing listens on its url:
+        # healthz never answers inside the slice -> replaced
+        out, router, _s, launcher = self._run(
+            {"as0": _rec(child.pid, "http://127.0.0.1:1/",
+                         self.ARGS, process_identity(child.pid))})
+        assert out == {"replaced": 1}
+        assert launcher.retired == ["as0"] and router.added == []
+
+    def test_alive_answering_child_adopted_in_place(self, tmp_path,
+                                                    child, answerer):
+        store = StateStore(str(tmp_path))
+        out, router, scaler, launcher = self._run(
+            {"as0": _rec(child.pid, answerer, self.ARGS,
+                         process_identity(child.pid))},
+            store)
+        assert out == {"adopted": 1}
+        assert launcher.retired == []
+        assert [b.name for b in router.added] == ["as0"]
+        assert scaler.adopted == [("as0", child.pid, "adopt")]
+        assert child.poll() is None         # zero signals, zero boots
+        # the adopted backend wraps the journaled url, launcher-shaped
+        b = router.added[0]
+        assert b.url == answerer and b.timeout_s == 5.0
+
+
+# -- the honest 503 window ---------------------------------------------------
+
+class TestReconcileWindow:
+    def test_predict_refuses_with_retry_after_until_settled(
+            self, tmp_path):
+        store = StateStore(str(tmp_path))
+        router = FleetRouter(
+            [Backend("http://127.0.0.1:1/", name="b0",
+                     breaker=CircuitBreaker(failure_threshold=2,
+                                            cooldown_s=0.5))],
+            probe_interval_s=30.0, statestore=store).start()
+        try:
+            router.begin_reconcile(deadline_s=30.0)
+            req = urllib.request.Request(
+                router.url + "predict",
+                json.dumps({"inputs": [[0.0]]}).encode(),
+                {"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 503
+            ra = ei.value.headers.get("Retry-After")
+            body = json.loads(ei.value.read())
+            assert ra is not None and 1 <= int(ra) <= 30
+            assert body["retry_after_s"] == int(ra)
+            assert "reconciliation" in body["error"]
+            with urllib.request.urlopen(router.url + "healthz",
+                                        timeout=10) as r:
+                h = json.loads(r.read())
+            assert h["reconcile"]["state"] == "reconciling"
+            assert h["reconcile"]["journal"] == store.path
+            assert h["reconcile"]["retry_after_s"] >= 1
+
+            router.end_reconcile()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            # still refused (the only backend is dead) but it is the
+            # ROUTING refusal now, not the reconciliation window
+            body = json.loads(ei.value.read())
+            assert ei.value.code == 503
+            assert "reconciliation" not in body["error"]
+            with urllib.request.urlopen(router.url + "healthz",
+                                        timeout=10) as r:
+                h = json.loads(r.read())
+            assert h["reconcile"]["state"] == "settled"
+            assert "retry_after_s" not in h["reconcile"]
+        finally:
+            router.stop()
+
+    def test_blown_deadline_reopens_routing(self, tmp_path):
+        """A reconcile that outlives its own deadline must not refuse
+        forever — the window expires into normal routing."""
+        store = StateStore(str(tmp_path))
+        router = FleetRouter(
+            [Backend("http://127.0.0.1:1/", name="b0")],
+            probe_interval_s=30.0, statestore=store)
+        router.begin_reconcile(deadline_s=0.05)
+        time.sleep(0.1)
+        assert router.reconcile_retry_after() is None

@@ -1,0 +1,189 @@
+#!/bin/bash
+# Crash-safe control-plane smoke (ISSUE 17 acceptance,
+# operator-runnable):
+#
+#   1. `python -m znicz_tpu chaos --scenario controlplane` — a REAL
+#      `route --autoscale --state-dir` process boots two managed
+#      serve children, takes admin mutations (weight override +
+#      placement pin), and is SIGKILLed mid-burst; the restart on the
+#      same port + state dir restores the journaled decisions,
+#      re-adopts both children in place (same pids, zero
+#      double-boots), answers 503 + Retry-After while reconciling,
+#      gray-demotes a healthz-green/predict-sick backend to ~zero
+#      effective weight, and serves zero raw 500s throughout.
+#
+#   2. a direct router-SIGKILL → restart → re-adopt phase from the
+#      CLI surface: boot, kill -9, restart, and assert by pid
+#      accounting that the SAME child serve process is re-adopted —
+#      no orphan, no double-boot — then that the journal-and-keep
+#      SIGTERM default leaves the child running for a third restart.
+#
+# Registered beside tools/fleet_smoke.sh / tools/placement_smoke.sh.
+#
+# Usage:  bash tools/controlplane_smoke.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: chaos --scenario controlplane =="
+JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario controlplane || exit 1
+
+echo "== phase 2: SIGKILL -> restart -> re-adopt, by pid accounting =="
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, signal, socket, subprocess, sys, tempfile, time
+import urllib.request
+
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def healthz(url):
+    with urllib.request.urlopen(url + "healthz", timeout=5) as r:
+        return json.loads(r.read())
+
+
+def journal(state_dir):
+    out = []
+    try:
+        with open(os.path.join(state_dir, "controlplane.jsonl")) as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+child_pid = None
+router = None
+try:
+    with tempfile.TemporaryDirectory(prefix="znicz_cp_smoke_") as tmp:
+        from znicz_tpu.resilience.chaos import _write_demo_znn
+
+        model = os.path.join(tmp, "m.znn")
+        state = os.path.join(tmp, "state")
+        _write_demo_znn(model)
+        rport = free_port()
+        url = f"http://127.0.0.1:{rport}/"
+        argv = [sys.executable, "-m", "znicz_tpu", "route",
+                "--port", str(rport), "--autoscale",
+                "--min-backends", "1", "--max-backends", "2",
+                "--state-dir", state,
+                "--reconcile-deadline-s", "20",
+                "--probe-interval-s", "0.3",
+                "--boot-timeout-s", "180",
+                "--serve-arg=--model", f"--serve-arg={model}",
+                "--serve-arg=--max-wait-ms", "--serve-arg=1"]
+
+        def boot():
+            return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT)
+
+        def wait_up(proc, what):
+            for _ in range(360):
+                try:
+                    return healthz(url)
+                except Exception:
+                    if proc.poll() is not None:
+                        print(f"FAIL {what} exited rc={proc.returncode}")
+                        print(proc.stdout.read()
+                              .decode(errors="replace")[-600:])
+                        sys.exit(1)
+                    time.sleep(0.5)
+            print(f"FAIL {what} never answered /healthz")
+            sys.exit(1)
+
+        def wait_settled(what):
+            for _ in range(150):
+                rc = healthz(url).get("reconcile") or {}
+                if rc.get("state") == "settled":
+                    return True
+                time.sleep(0.2)
+            check(False, f"{what} never settled reconciliation")
+            return False
+
+        router = boot()
+        wait_up(router, "router")
+        wait_settled("first boot")
+        boots = [e for e in journal(state) if e.get("kind") == "boot"]
+        check(len(boots) == 1,
+              f"first boot journals one child boot ({len(boots)})")
+        child_pid = int(boots[0]["pid"]) if boots else None
+        check(child_pid is not None and alive(child_pid),
+              f"the managed child (pid {child_pid}) is alive")
+
+        router.kill()                      # a CRASH, not a drain
+        router.wait(timeout=15)
+        check(child_pid is not None and alive(child_pid),
+              "the child survives the router SIGKILL")
+
+        router = boot()
+        wait_up(router, "restarted router")
+        wait_settled("restart")
+        entries = journal(state)
+        adopts = [e for e in entries if e.get("kind") == "adopt"]
+        boots = [e for e in entries if e.get("kind") == "boot"]
+        check(len(adopts) == 1
+              and int(adopts[0]["pid"]) == child_pid,
+              f"restart re-adopts the SAME pid {child_pid} "
+              f"(adopts={[(e['backend'], e['pid']) for e in adopts]})")
+        check(len(boots) == 1,
+              f"zero double-boots ({len(boots)} boot records)")
+
+        body = json.dumps({"inputs": [[0.1, -0.2, 0.3, 0.4]]}).encode()
+        req = urllib.request.Request(
+            url + "predict", body,
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+            check(r.status == 200,
+                  "predict 200 through the re-adopted child")
+
+        router.send_signal(signal.SIGTERM)
+        try:
+            rc = router.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            router.kill()
+            rc = router.wait(timeout=10)
+        check(rc == 0, f"router SIGTERM exit rc {rc}")
+        check(child_pid is not None and alive(child_pid),
+              "journal-and-keep: the child outlives SIGTERM for the "
+              "next restart to re-adopt")
+finally:
+    if router is not None and router.poll() is None:
+        router.kill()
+    if child_pid is not None and alive(child_pid):
+        os.kill(child_pid, signal.SIGTERM)
+        for _ in range(100):
+            if not alive(child_pid):
+                break
+            time.sleep(0.1)
+        else:
+            os.kill(child_pid, signal.SIGKILL)
+
+print()
+if fails:
+    print(f"controlplane smoke: {len(fails)} failure(s)")
+    sys.exit(1)
+print("controlplane smoke: all checks passed")
+PY

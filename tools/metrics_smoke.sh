@@ -282,6 +282,66 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
         except subprocess.TimeoutExpired:
             proc.kill()
 
+    # control-plane + gray-demotion families (znicz_tpu.fleet, ISSUE
+    # 17): registered when the ROUTER process imports, scraped from
+    # zero on a router that has no state dir and has demoted nothing —
+    # dashboards see the series before the first crash or gray backend
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        rport = s.getsockname()[1]
+    with socket.socket() as s:                  # a dead backend is fine:
+        s.bind(("127.0.0.1", 0))                # the families must exist
+        bport = s.getsockname()[1]              # before any traffic
+    router = subprocess.Popen(
+        [sys.executable, "-m", "znicz_tpu", "route",
+         "--port", str(rport),
+         "--backend", f"http://127.0.0.1:{bport}/,name=b0",
+         "--probe-interval-s", "30"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    rurl = f"http://127.0.0.1:{rport}/"
+    try:
+        for _ in range(120):
+            try:
+                urllib.request.urlopen(rurl + "healthz", timeout=2)
+                break
+            except Exception:
+                if router.poll() is not None:
+                    out = router.stdout.read().decode(errors="replace")
+                    sys.exit(f"route exited rc={router.returncode}:\n"
+                             + out[-2000:])
+                time.sleep(0.5)
+        else:
+            sys.exit("route never answered /healthz")
+        req = urllib.request.Request(rurl + "metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            series, typed = parse_exposition(r.read().decode())
+        for fam, kind in (
+                ("controlplane_journal_records_total", "counter"),
+                ("backend_adopted_total", "counter"),
+                ("gray_demotions_total", "counter"),
+                ("backend_predict_ewma_ms", "gauge"),
+                ("controlplane_reconcile_state", "gauge")):
+            check(typed.get(fam) == kind, f"{fam} typed {kind}")
+        check(series.get("controlplane_journal_records_total") == 0.0,
+              "journal counter scrapes zero without --state-dir")
+        check(series.get("backend_adopted_total") == 0.0,
+              "backend_adopted_total scrapes zero before any restart")
+        check(series.get("gray_demotions_total") == 0.0,
+              "gray_demotions_total scrapes zero on a healthy fleet")
+        check(series.get("controlplane_reconcile_state") == 0.0,
+              "reconcile state == 0 (no state dir attached)")
+        check(series.get('backend_predict_ewma_ms{backend="b0"}')
+              == 0.0,
+              "backend_predict_ewma_ms carries a zero child per "
+              "backend before any predict")
+    finally:
+        router.send_signal(signal.SIGTERM)
+        try:
+            router.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            router.kill()
+
 print(json.dumps({"ok": not fails, "violations": fails}))
 sys.exit(1 if fails else 0)
 PY

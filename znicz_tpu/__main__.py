@@ -72,6 +72,21 @@ Examples::
         # the graceful drain, with hysteresis + cooldown so a
         # one-window blip never flaps the fleet; placement re-runs on
         # every membership change (fleet.autoscaler; docs/fleet.md)
+    python -m znicz_tpu autoscale --serve-arg=--zoo --serve-arg=DIR \
+            --state-dir /var/lib/znicz-router
+        # + crash-safe control plane (fleet.statestore; docs/fleet.md
+        # "Control-plane durability"): every admin weight, placement
+        # pin, membership change and child boot/drain is journaled to
+        # an fsync'd torn-tail-tolerant JSONL; a restarted router
+        # replays its decisions, answers 503 + Retry-After while it
+        # RECONCILES the journaled children — re-adopting live ones
+        # in place (pid + start-time identity + healthz + a predict
+        # canary), draining half-dead or unknown-generation ones —
+        # and the SIGTERM default flips to journal-and-keep
+        # (--teardown restores drain-everything).  Gray-failure
+        # demotion rides the same bookkeeping: a probe-green backend
+        # whose real predicts fail or stall is weight-decayed to
+        # zero and ejected (disable with --no-gray-demotion)
     python -m znicz_tpu promote --candidates DIR \
             --url http://127.0.0.1:8200/ --fleet
         # promote-one-then-fleet over a router: canary ONE backend
@@ -79,7 +94,7 @@ Examples::
         # backends with weighted traffic splitting and fleet-wide
         # rollback on a mid-walk burn-rate breach (fleet.rollout)
     python -m znicz_tpu chaos \
-            [--scenario reload|promote|overload|zoo|slo|wire|fleet|placement]
+            [--scenario reload|promote|overload|zoo|slo|wire|fleet|placement|controlplane]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
         # --scenario reload drills corrupt-artifact rollback;
@@ -100,7 +115,13 @@ Examples::
         # memoization + int8 serving under a transient device fault
         # (zero raw 500s on either format, junk binary answers 400
         # fast, cross-format parity, reload swaps the memo key space;
-        # docs/serving.md "Wire protocol")
+        # docs/serving.md "Wire protocol");
+        # --scenario controlplane drills the crash-safe control plane
+        # (SIGKILL the router mid-burst, restart with --state-dir,
+        # weights/pins restored, children re-adopted with zero
+        # orphans/double-boots, 503+Retry-After while reconciling, a
+        # healthz-green/predict-sick backend gray-demoted to ~zero
+        # effective weight; docs/fleet.md)
     python -m znicz_tpu promote --candidates DIR --url http://host:port/
         # closed-loop promotion controller sidecar: watch a trainer's
         # export directory, verify + canary-deploy each new candidate

@@ -25,6 +25,13 @@ The cross-process layer of the serving stack (docs/fleet.md):
   ``route --autoscale`` boots and drains real serve processes on the
   SLO burn-rate signal, re-running placement on every membership
   change.
+* :mod:`~znicz_tpu.fleet.statestore` — crash-safe control plane:
+  ``route --state-dir`` journals every weight / pin / membership /
+  child mutation to an fsync'd torn-tail-tolerant JSONL file; a
+  restarted router replays its decisions and **reconciles** the
+  journaled children (re-adopt alive ones in place, drain half-dead
+  or unknown-generation ones, never signal a recycled pid) instead
+  of re-booting the fleet.
 
 This is the modern rebuild of the paper's VELES master–slave topology
 (the Twisted/ZeroMQ master fanning work to slave processes) on
@@ -32,8 +39,12 @@ JAX-era serving primitives.
 """
 
 from .router import (Backend, BackendDown, FleetRouter,  # noqa: F401
-                     parse_backend_spec)
+                     GrayPolicy, parse_backend_spec)
 from .rollout import FleetTarget, merge_samples  # noqa: F401
 from .placement import (PlacementCandidate,  # noqa: F401
                         PlacementEngine, rank_backends, score_weight)
-from .autoscaler import Autoscaler, ServeLauncher  # noqa: F401
+from .statestore import (ControlPlaneState,  # noqa: F401
+                         OrphanProcess, StateStore, pid_alive,
+                         process_identity)
+from .autoscaler import (Autoscaler, ServeLauncher,  # noqa: F401
+                         reconcile_children)

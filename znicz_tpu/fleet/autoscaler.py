@@ -47,18 +47,22 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import socket
 import subprocess
 import sys
 import threading
 import time
+from urllib.parse import urlsplit
 
 from ..promotion.slo import SLOSample, _route_code_sum
 from ..resilience.breaker import CircuitBreaker
 from ..telemetry import sloengine
 from ..telemetry.registry import (DEFAULT_LATENCY_BUCKETS_MS, REGISTRY)
 from .router import Backend, BackendDown
+from .statestore import (OrphanProcess, _backend_adopted, pid_alive,
+                         process_identity)
 
 _backends_g = REGISTRY.gauge(
     "autoscale_backends",
@@ -210,7 +214,8 @@ class Autoscaler:
                  breach_windows: int = 2, idle_windows: int = 6,
                  idle_rps: float = 0.5, cooldown_s: float = 30.0,
                  drain_timeout_s: float = 20.0,
-                 sample_fn=None, clock=time.monotonic):
+                 sample_fn=None, clock=time.monotonic,
+                 statestore=None):
         if int(min_backends) < 1:
             raise ValueError(f"min_backends must be >= 1, "
                              f"got {min_backends!r}")
@@ -247,6 +252,7 @@ class Autoscaler:
         self._sample_fn = sample_fn if sample_fn is not None \
             else router_sample
         self._clock = clock
+        self.statestore = statestore
         self._lock = threading.Lock()
         self._managed: list[tuple] = []       # (backend, handle), LIFO
         self._spawned = 0
@@ -263,21 +269,58 @@ class Autoscaler:
         self._thread: threading.Thread | None = None
 
     # -- membership bookkeeping -------------------------------------------
-    def adopt(self, backend, handle) -> None:
+    def adopt(self, backend, handle, *,
+              journal: str | None = "boot") -> None:
         """Track an already-booted backend as managed (the CLI boots
-        the min-floor before the router exists, then adopts here)."""
+        the min-floor before the router exists, then adopts here; the
+        reconcile path re-adopts journaled survivors with
+        ``journal="adopt"``).  The index counter advances past the
+        adopted name so a later spawn can never collide with it."""
+        m = re.fullmatch(r"as(\d+)", str(backend.name))
         with self._lock:
             self._managed.append((backend, handle))
-            self._spawned += 1
+            if m:
+                self._spawned = max(self._spawned, int(m.group(1)) + 1)
+            else:
+                self._spawned += 1
+        if journal:
+            self._journal_child(journal, backend, handle)
 
     def managed_names(self) -> list[str]:
         with self._lock:
             return [b.name for b, _h in self._managed]
 
-    def _next_index(self) -> int:
+    def next_index(self) -> int:
+        """Claim the next never-used boot index (→ backend ``asN``)."""
         with self._lock:
             self._spawned += 1
             return self._spawned - 1
+
+    def _journal_child(self, kind: str, backend, handle) -> None:
+        """Durably record one managed-child mutation (boot / adopt /
+        drain) so a restarted router can reconcile instead of
+        re-booting.  Journal trouble is reported, never raised — the
+        child is already running (or already gone); bookkeeping must
+        not take the fleet down with it."""
+        if self.statestore is None:
+            return
+        pid = getattr(handle, "pid", None)
+        fields = {"backend": backend.name, "pid": pid}
+        if kind != "drain":
+            try:
+                fields["port"] = urlsplit(backend.url).port
+            except ValueError:
+                fields["port"] = None
+            fields["url"] = backend.url
+            fields["args"] = (list(self.launcher.serve_args)
+                              if self.launcher is not None else [])
+            fields["identity"] = (getattr(handle, "identity", None)
+                                  or (process_identity(pid)
+                                      if pid else None))
+        try:
+            self.statestore.append(kind, **fields)
+        except OSError as e:
+            self._last_error = f"journal append failed: {e}"
 
     # -- the state machine -------------------------------------------------
     def tick(self, now: float | None = None) -> dict:
@@ -329,7 +372,7 @@ class Autoscaler:
             self._last_error = "no spawn path configured"
             return None
         try:
-            backend, handle = self._spawn(self._next_index())
+            backend, handle = self._spawn(self.next_index())
         except Exception as e:
             self._last_error = f"scale-out failed: {e}"
             self._acted(now)   # cooldown anyway: don't hammer boots
@@ -347,6 +390,7 @@ class Autoscaler:
             return None
         with self._lock:
             self._managed.append((backend, handle))
+        self._journal_child("boot", backend, handle)
         self._scale_outs += 1
         self._last_error = None
         _events.inc(direction="out")
@@ -369,6 +413,7 @@ class Autoscaler:
             self._last_error = f"scale-in drain failed: {e}"
             self._acted(now)
             return None
+        self._journal_child("drain", backend, handle)
         self._scale_ins += 1
         self._last_error = None
         _events.inc(direction="in")
@@ -413,10 +458,16 @@ class Autoscaler:
         if self._thread is not None:
             self._thread.join(5.0)
 
-    def shutdown(self) -> None:
-        """Stop the loop and drain EVERY managed backend (the CLI's
-        SIGTERM path — the router's static floor is left alone)."""
+    def shutdown(self, teardown: bool = True) -> None:
+        """Stop the loop; with ``teardown`` drain EVERY managed
+        backend (the CLI's SIGTERM path — the router's static floor
+        is left alone).  ``teardown=False`` is journal-and-keep: the
+        children stay up, their boot/adopt records stay in the
+        journal, and the next ``route --state-dir`` re-adopts them
+        instead of re-booting (docs/fleet.md)."""
         self.stop()
+        if not teardown:
+            return
         while True:
             with self._lock:
                 if not self._managed:
@@ -431,6 +482,137 @@ class Autoscaler:
                     self._retire(backend, handle)
             except Exception as e:
                 self._last_error = f"shutdown drain failed: {e}"
+            self._journal_child("drain", backend, handle)
+
+
+def reconcile_children(router, scaler: Autoscaler,
+                       launcher: ServeLauncher, children: dict, *,
+                       deadline_s: float = 30.0,
+                       poll_interval_s: float = 0.2) -> dict:
+    """Reconcile journaled autoscaler children after a router restart:
+    re-adopt instead of re-boot, drain instead of leak.
+
+    ``children`` is :attr:`~znicz_tpu.fleet.statestore
+    .ControlPlaneState.children` — the journal's live boot/adopt
+    records.  Each child gets one verdict (the
+    ``backend_adopted_total{outcome}`` vocabulary):
+
+    * ``adopted`` — pid alive, identity matches, boot args match this
+      router's ``--serve-arg`` generation, AND healthz + a real
+      ``/predict`` canary both answer → re-enters rotation in place,
+      zero double-boot.
+    * ``dead`` — nothing wears the pid; the record is drained away.
+    * ``stale_pid`` — the pid is alive but its kernel start-time
+      identity differs: an unrelated process recycled the number.
+      NEVER signalled; drained from the journal and replaced.
+    * ``stale_args`` — alive, ours, but booted under different serve
+      args (unknown generation): drained via SIGTERM and replaced.
+    * ``replaced`` — alive but half-dead (healthz or the predict
+      canary refused within its slice of ``deadline_s``): drained.
+    * ``invalid`` — the record lacks a pid/url to act on.
+
+    Every wait in here is bounded — ``deadline_s`` is split across
+    the children so a wedged child cannot stall the whole
+    reconciliation past the router's advertised Retry-After."""
+    outcomes: dict[str, int] = {}
+
+    def verdict(name: str, outcome: str, detail: str = "") -> None:
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        _backend_adopted.inc(outcome=outcome)
+        extra = f" ({detail})" if detail else ""
+        print(f"reconcile: child {name}: {outcome}{extra}", flush=True)
+
+    def drain_record(name: str) -> None:
+        if scaler.statestore is None:
+            return
+        try:
+            scaler.statestore.append("drain", backend=name,
+                                     source="reconcile")
+        except OSError:
+            pass
+
+    per = max(2.0, float(deadline_s) / max(1, len(children)))
+    probe_timeout = min(5.0, per)
+    want_args = list(launcher.serve_args)
+    for name, rec in sorted(children.items()):
+        pid, url = rec.get("pid"), rec.get("url")
+        if not pid or not url:
+            drain_record(name)
+            verdict(name, "invalid", "journal record lacks pid/url")
+            continue
+        pid = int(pid)
+        if not pid_alive(pid):
+            drain_record(name)
+            verdict(name, "dead", f"pid {pid} gone")
+            continue
+        recorded = rec.get("identity")
+        live = process_identity(pid)
+        if recorded is not None and live != recorded:
+            # recycled pid: an unrelated process wears the number now —
+            # treat the child as dead and do not signal anyone
+            drain_record(name)
+            verdict(name, "stale_pid",
+                    f"pid {pid} identity {live} != recorded {recorded}")
+            continue
+        handle = OrphanProcess(pid, recorded or live)
+        backend = Backend(
+            str(url), name=str(name),
+            timeout_s=launcher.forward_timeout_s,
+            breaker=CircuitBreaker(
+                failure_threshold=launcher.breaker_threshold,
+                cooldown_s=launcher.breaker_cooldown_s))
+        if list(rec.get("args") or []) != want_args:
+            try:
+                launcher.retire(backend, handle, drain_timeout_s=per)
+            except Exception:
+                pass
+            drain_record(name)
+            verdict(name, "stale_args",
+                    "booted under a different serve-arg generation")
+            continue
+        # alive and the right generation: healthz AND a predict canary
+        # must both answer before it re-enters rotation — a pid that
+        # exists but serves nothing is half-dead, not adopted
+        healthy = False
+        deadline = time.monotonic() + per
+        while time.monotonic() < deadline:
+            try:
+                if backend.canary("GET", "/healthz", None, {},
+                                  timeout_s=probe_timeout) == 200:
+                    healthy = True
+                    break
+            except BackendDown:
+                pass
+            if handle.poll() is not None:
+                break
+            time.sleep(poll_interval_s)
+        answered = False
+        if healthy:
+            try:
+                backend.canary("POST", "/predict", b'{"inputs": []}',
+                               {"Content-Type": "application/json"},
+                               timeout_s=probe_timeout)
+                answered = True   # ANY status: the predict path answers
+            except BackendDown:
+                answered = False
+        if not (healthy and answered):
+            try:
+                launcher.retire(backend, handle, drain_timeout_s=per)
+            except Exception:
+                pass
+            drain_record(name)
+            verdict(name, "replaced",
+                    "healthz" if not healthy else "predict canary")
+            continue
+        try:
+            router.add_backend(backend)
+        except Exception as e:
+            drain_record(name)
+            verdict(name, "invalid", f"add_backend: {e}")
+            continue
+        scaler.adopt(backend, handle, journal="adopt")
+        verdict(name, "adopted", f"pid {pid} re-adopted in place")
+    return outcomes
 
 
 def main(argv=None) -> int:

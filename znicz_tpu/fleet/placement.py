@@ -174,6 +174,17 @@ class PlacementEngine:
         with self._lock:
             return dict(self._pins)
 
+    def restore_pins(self, pins: dict) -> None:
+        """Bulk-reinstall journaled pins in one shot (control-plane
+        replay on ``route --state-dir``): last-write-wins state from
+        :meth:`~znicz_tpu.fleet.statestore.StateStore.replay`, so
+        entries replace the pin table rather than merging into it.
+        Callers recompute the plan once afterwards — one rebalance
+        for the whole replay, not one per journaled pin."""
+        with self._lock:
+            self._pins = {str(m): tuple(str(b) for b in names)
+                          for m, names in pins.items() if names}
+
     # -- the plan ----------------------------------------------------------
     def plan(self, models, candidates, *, cause: str = "manual") -> dict:
         """Assign every model to its top-``replication`` backends.

@@ -67,8 +67,10 @@ capacity.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import http.client as _http_client
 import json
+import logging
 import threading
 import time
 import urllib.parse
@@ -78,6 +80,7 @@ import hashlib
 import numpy as np
 
 from . import placement as placement_mod
+from . import statestore as statestore_mod
 from ..resilience import overload
 from ..resilience.breaker import CircuitBreaker
 from ..serving.memo import ResponseCache
@@ -128,6 +131,45 @@ _fleet_request_hist = REGISTRY.histogram(
     "forwards, failovers and refusals all observe) — the e2e signal "
     "the autoscaler's latency-objective burn judges, milliseconds",
     buckets=DEFAULT_LATENCY_BUCKETS_MS)
+_gray_demotions = REGISTRY.counter(
+    "gray_demotions_total",
+    "gray-failure demotion episodes per backend: the differential "
+    "prober + forwarded-predict EWMA judged a probe-green backend "
+    "predict-sick for the full hysteresis window and began decaying "
+    "its effective weight (counted once per episode, not per decay "
+    "step)")
+
+log = logging.getLogger("fleet")
+
+
+@dataclasses.dataclass(frozen=True)
+class GrayPolicy:
+    """Knobs of the gray-failure detector (docs/fleet.md).
+
+    A *gray* backend answers ``/healthz`` but fails or stalls real
+    predicts — the transport breaker never sees a failure, so it
+    never ejects.  The detector keeps a per-backend EWMA over real
+    forwarded-predict outcomes and latency, refreshed between
+    requests by a differential prober that POSTs a tiny canary
+    predict (a recently-seen request body), and on each probe tick
+    judges the EWMA: ``strikes`` CONSECUTIVE gray ticks (hysteresis —
+    one slow answer cannot demote) start decaying the backend's
+    effective routing weight by ``decay`` per tick; below
+    ``eject_below`` the weight zeroes and the breaker is tripped
+    (recovery rides the existing half-open path).  Healthy ticks
+    reset the strikes and regrow the weight by ``recover``× per
+    tick."""
+
+    alpha: float = 0.3             # EWMA coefficient per observation
+    min_observations: int = 3      # EWMA proves nothing before this
+    ok_floor: float = 0.5          # ok-EWMA below this is gray
+    latency_threshold_ms: float | None = None  # ms-EWMA above is gray
+    strikes: int = 3               # consecutive gray ticks to demote
+    decay: float = 0.5             # weight multiplier per gray tick
+    eject_below: float = 0.05      # factor floor -> trip the breaker
+    recover: float = 2.0           # factor regrowth per healthy tick
+    canary_timeout_s: float = 5.0  # canary predict socket bound
+    canary_max_bytes: int = 4096   # biggest body kept as template
 
 
 class BackendDown(Exception):
@@ -175,6 +217,15 @@ class Backend:
         #: prober already fetches (placement's load input)
         self._busy = 0.0
         self._device_ms: float | None = None
+        #: gray-failure detector state (router-driven: note_predict
+        #: feeds the EWMAs from real forwards + canary probes,
+        #: gray_step advances strikes/decay once per probe tick)
+        self._p_ok = 1.0           # EWMA of predict success in [0, 1]
+        self._p_ms = 0.0           # EWMA of predict latency, ms
+        self._p_obs = 0            # observations folded so far
+        self._gray_factor = 1.0    # effective-weight multiplier
+        self._gray_strikes = 0     # consecutive gray probe ticks
+        self._gray_episode = False  # demotion episode in progress
         #: smooth-WRR accumulator — owned (and locked) by the router's
         #: pick loop, not by this object
         self.wrr_current = 0.0
@@ -190,6 +241,68 @@ class Backend:
             raise ValueError(f"weight must be >= 0, got {weight}")
         with self._lock:
             self._weight = float(weight)
+
+    def effective_weight(self) -> float:
+        """Base weight × the gray-failure factor: what the WRR pick
+        actually spreads on.  The operator/rollout weight is never
+        touched by demotion — recovery restores the split exactly."""
+        with self._lock:
+            return self._weight * self._gray_factor
+
+    # -- gray-failure detector (the router's probe tick drives it) ---------
+    def note_predict(self, ok: bool, ms: float,
+                     alpha: float = 0.3) -> None:
+        """Fold one real predict outcome (a forwarded request or a
+        canary probe) into the EWMAs — timeouts and 5xx answers count
+        as failures; 2xx–4xx are the backend answering."""
+        with self._lock:
+            self._p_ok = ((1.0 - alpha) * self._p_ok
+                          + alpha * (1.0 if ok else 0.0))
+            self._p_ms = (ms if self._p_obs == 0
+                          else (1.0 - alpha) * self._p_ms + alpha * ms)
+            self._p_obs += 1
+
+    def predict_ewma(self) -> tuple[float, float, int]:
+        """(ok EWMA, latency-ms EWMA, observations)."""
+        with self._lock:
+            return self._p_ok, self._p_ms, self._p_obs
+
+    def gray_factor(self) -> float:
+        with self._lock:
+            return self._gray_factor
+
+    def gray_step(self, gray: bool,
+                  policy: "GrayPolicy") -> str | None:
+        """Advance the hysteresis machine one probe tick.  Returns
+        the transition this tick caused — ``"demoted"`` (strike
+        threshold crossed, decay begins: count it), ``"ejected"``
+        (factor fell through ``eject_below``: trip the breaker),
+        ``"recovered"`` (factor regrew to 1.0) — or None."""
+        with self._lock:
+            if gray:
+                self._gray_strikes += 1
+                if self._gray_strikes < policy.strikes:
+                    return None
+                event = None
+                if not self._gray_episode:
+                    self._gray_episode = True
+                    event = "demoted"
+                if self._gray_factor > 0.0:
+                    self._gray_factor *= policy.decay
+                    if self._gray_factor < policy.eject_below:
+                        self._gray_factor = 0.0
+                        event = "ejected"
+                return event
+            self._gray_strikes = 0
+            if self._gray_factor >= 1.0:
+                return None
+            self._gray_factor = min(
+                1.0, max(self._gray_factor, policy.eject_below)
+                * policy.recover)
+            if self._gray_factor >= 1.0 and self._gray_episode:
+                self._gray_episode = False
+                return "recovered"
+            return None
 
     # -- cached health snapshot (the prober writes it) ---------------------
     @staticmethod
@@ -298,6 +411,26 @@ class Backend:
             raise BackendDown(f"backend {self.name}: "
                               f"{type(e).__name__}: {e}") from e
 
+    def canary(self, method: str, path: str, body: bytes | None,
+               headers: dict, *, timeout_s: float) -> int:
+        """One probe exchange on a FRESH connection with its own
+        (short) socket bound — never the pooled 60 s forward timeout,
+        so a wedged backend costs the prober ``timeout_s``, not a
+        probe-thread outage.  Returns the HTTP status; raises
+        :class:`BackendDown` on transport failure or timeout."""
+        conn = _http_client.HTTPConnection(self.host, self.port,
+                                           timeout=float(timeout_s))
+        try:
+            conn.request(method, path, body, headers)
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status
+        except (OSError, _http_client.HTTPException) as e:
+            raise BackendDown(f"backend {self.name}: "
+                              f"{type(e).__name__}: {e}") from e
+        finally:
+            conn.close()
+
     def close(self) -> None:
         with self._lock:
             pool, self._pool = self._pool, collections.deque()
@@ -318,8 +451,14 @@ class Backend:
 
     def metrics(self) -> dict:
         snap, age = self.health()
+        ok, ms, obs = self.predict_ewma()
         return {"name": self.name, "url": self.url,
                 "weight": self.weight,
+                "effective_weight": round(self.effective_weight(), 4),
+                "gray": {"factor": round(self.gray_factor(), 4),
+                         "ok_ewma": round(ok, 4),
+                         "ewma_ms": round(ms, 2),
+                         "observations": obs},
                 "breaker": self.breaker.metrics(),
                 "generation": snap.get("model_generation"),
                 "backend_rev": snap.get("rev"),
@@ -407,8 +546,12 @@ class FleetRouter:
                  max_body_mb: float = 64.0, max_hops: int = 2,
                  memo_entries: int = 0, memo_mb: float = 32.0,
                  placement: "placement_mod.PlacementEngine | None"
-                 = None):
-        if not backends:
+                 = None,
+                 statestore:
+                 "statestore_mod.StateStore | None" = None,
+                 gray: GrayPolicy | None = None,
+                 allow_empty: bool = False):
+        if not backends and not allow_empty:
             raise ValueError("a router needs at least one backend")
         names = [b.name for b in backends]
         if len(set(names)) != len(names):
@@ -452,6 +595,27 @@ class FleetRouter:
                          _fleet_cache_bytes))
             if memo_entries > 0 else None)
         self.rev = buildinfo.cached_rev()
+        #: control-plane durability (route --state-dir): every admin
+        #: weight, pin, membership change and breaker ejection is
+        #: journaled so a restarted router replays its decisions
+        #: (docs/fleet.md "Control-plane durability")
+        self.statestore = statestore
+        #: gray-failure demotion policy (None = detector off: the
+        #: EWMAs still fold, nothing decays)
+        self.gray = gray
+        self._reconcile_lock = threading.Lock()
+        self._reconcile_until: float | None = None   # monotonic
+        statestore_mod.set_reconcile_state(
+            statestore_mod.RECONCILE_OFF if statestore is None
+            else statestore_mod.RECONCILE_SETTLED)
+        #: the differential prober's canary template: the most recent
+        #: small request body a backend answered 200 — (ctype, accept,
+        #: model, raw bytes)
+        self._canary_lock = threading.Lock()
+        self._canary_template: tuple | None = None
+        #: breaker states at the last probe sweep, for journaling
+        #: ejection transitions (audit records, not replayed state)
+        self._breaker_seen: dict[str, str] = {}
         self._wrr_lock = threading.Lock()
         self._stop_event = threading.Event()
         self._stopped = False
@@ -648,6 +812,7 @@ class FleetRouter:
                 except ValueError as e:
                     self._reply(400, {"error": str(e)})
                     return
+                outer._journal("weight", backend=name, weight=weight)
                 self._reply(200, {"backend": name, "weight": weight})
 
             def _admin_placement(self):
@@ -713,14 +878,31 @@ class FleetRouter:
                         return
                 if model is not None:
                     outer.placement.pin(model, pin)
+                    outer._journal("pin", model=model, backends=pin)
                     plan = outer.recompute_placement(cause="pin")
                 else:
+                    outer._journal("rebalance")
                     plan = outer.recompute_placement(cause="admin")
                 self._reply(200, plan)
 
             def _predict(self, t0: float):
                 raw = self._read_body()
                 if raw is None:
+                    return
+                ra = outer.reconcile_retry_after()
+                if ra is not None:
+                    # restart reconciliation in progress: the journal
+                    # is replayed but children are not yet re-probed —
+                    # routing now could land on a half-adopted
+                    # backend.  Honest refusal, sized from the
+                    # reconciliation deadline; never a hang, never a
+                    # raw 500.
+                    self._rec_error = "reconciling after restart"
+                    self._reply(503, {
+                        "error": "router restarting: control-plane "
+                                 "reconciliation in progress",
+                        "retry_after_s": ra},
+                        {"Retry-After": str(ra)})
                     return
                 try:
                     # the hop's header policy, re-pinned here: empty/
@@ -821,6 +1003,9 @@ class FleetRouter:
                                 "POST", "/predict", raw, fwd)
                     except BackendDown as e:
                         backend.breaker.record_failure()
+                        backend.note_predict(
+                            False, (time.monotonic() - t_f) * 1e3,
+                            alpha=outer.gray_alpha())
                         _fleet_failovers.inc(backend=backend.name)
                         tried.add(backend.name)
                         last_err = str(e)
@@ -828,6 +1013,12 @@ class FleetRouter:
                     dt = (time.monotonic() - t_f) * 1e3
                     _fleet_forward_hist.observe(dt,
                                                 backend=backend.name)
+                    # real-traffic half of the gray detector: 5xx
+                    # answers and slow answers count against the
+                    # backend's predict EWMA (a 4xx is the client's
+                    # problem and the backend answering fine)
+                    backend.note_predict(status < 500, dt,
+                                         alpha=outer.gray_alpha())
                     backend.breaker.record_success()
                     _fleet_requests.inc(backend=backend.name,
                                         code=str(status))
@@ -857,6 +1048,17 @@ class FleetRouter:
                         # health snapshot NOW — consensus breaks and
                         # the cache bypasses until probes re-converge
                         backend.observe_generation(resp_gen)
+                    if outer.gray is not None and status == 200 \
+                            and len(raw) \
+                            <= outer.gray.canary_max_bytes:
+                        # keep the freshest small 200-answered body as
+                        # the differential prober's canary template —
+                        # a probe that exercises the REAL predict
+                        # path, not just /healthz
+                        with outer._canary_lock:
+                            outer._canary_template = (
+                                fwd["Content-Type"], accept or "",
+                                model, raw)
                     out = {"X-Fleet-Backend": backend.name}
                     if outer.placement is not None:
                         # placed = inside the tenant's set; degraded =
@@ -891,6 +1093,55 @@ class FleetRouter:
                                         daemon=True,
                                         name="znicz-fleet-prober")
 
+    # -- control-plane journal (route --state-dir) -------------------------
+    def _journal(self, kind: str, **fields) -> None:
+        """Durably record one control-plane mutation.  Best-effort by
+        design: a full disk must degrade durability, never take down
+        the data plane."""
+        if self.statestore is None:
+            return
+        try:
+            self.statestore.append(kind, **fields)
+        except OSError as e:
+            log.warning("control-plane journal append failed "
+                        "(%s: %s) — continuing without durability",
+                        kind, e)
+
+    def gray_alpha(self) -> float:
+        return self.gray.alpha if self.gray is not None else 0.3
+
+    # -- restart reconciliation (satellite: honest 503s meanwhile) ---------
+    def begin_reconcile(self, deadline_s: float) -> None:
+        """Enter the reconciliation window: until
+        :meth:`end_reconcile` (or the deadline, whichever first),
+        ``/predict`` answers 503 with Retry-After sized from the
+        remaining deadline instead of routing at half-adopted
+        backends."""
+        with self._reconcile_lock:
+            self._reconcile_until = time.monotonic() + float(deadline_s)
+        statestore_mod.set_reconcile_state(
+            statestore_mod.RECONCILE_RECONCILING)
+
+    def end_reconcile(self) -> None:
+        with self._reconcile_lock:
+            self._reconcile_until = None
+        statestore_mod.set_reconcile_state(
+            statestore_mod.RECONCILE_SETTLED)
+
+    def reconcile_retry_after(self) -> int | None:
+        """Whole seconds of reconciliation left (ceil, >= 1) while
+        the window is open; None once settled — including a blown
+        deadline, where refusing forever would turn a slow reconcile
+        into an outage."""
+        with self._reconcile_lock:
+            until = self._reconcile_until
+        if until is None:
+            return None
+        left = until - time.monotonic()
+        if left <= 0.0:
+            return None
+        return max(1, int(left) + (0 if left == int(left) else 1))
+
     # -- membership (live: the autoscaler adds/removes) --------------------
     def _backend_list(self) -> list[Backend]:
         with self._wrr_lock:
@@ -909,6 +1160,7 @@ class FleetRouter:
                                  f"already in rotation")
             self.backends.append(backend)
             self.by_name[backend.name] = backend
+        self._journal("join", backend=backend.name, url=backend.url)
         self.recompute_placement(cause="join")
 
     def remove_backend(self, name: str) -> Backend:
@@ -923,6 +1175,7 @@ class FleetRouter:
                 raise ValueError("cannot remove the last backend")
             backend = self.by_name.pop(name)
             self.backends.remove(backend)
+        self._journal("leave", backend=name)
         self.recompute_placement(cause="leave")
         return backend
 
@@ -966,14 +1219,19 @@ class FleetRouter:
         considered candidate; the chosen backend's outcome MUST be
         recorded (the forward loop does)."""
         with self._wrr_lock:
-            cands = [(b, b.weight) for b in self.backends
+            # gray demotion multiplies into the spread here: base
+            # weight × gray factor, so a predict-sick backend decays
+            # out of rotation while its operator weight is preserved
+            cands = [(b, b.effective_weight()) for b in self.backends
                      if b.name not in exclude
                      and (restrict is None or b.name in restrict)]
             weighted = [(b, w) for b, w in cands if w > 0]
             if not weighted:
                 # every candidate is weighted out (a mid-walk dark
-                # canary fleet-wide would be operator error): fall
-                # back to equal weights rather than refusing traffic
+                # canary fleet-wide would be operator error; a fleet
+                # gray-demoted to zero everywhere means nothing
+                # better exists): fall back to equal weights rather
+                # than refusing traffic
                 weighted = [(b, 1.0) for b, _w in cands]
             total = sum(w for _b, w in weighted)
             for b, w in weighted:
@@ -1114,6 +1372,9 @@ class FleetRouter:
                 if self._stop_event.is_set():
                     return
                 self.probe_backend(b)
+                self.canary_probe(b)
+            self._gray_tick()
+            self._note_ejections()
             self._maybe_recompute_placement()
 
     def _maybe_recompute_placement(self) -> None:
@@ -1156,6 +1417,97 @@ class FleetRouter:
         backend.set_health(snap)
         return True
 
+    # -- gray-failure demotion (docs/fleet.md) ------------------------------
+    def canary_probe(self, backend: Backend) -> bool | None:
+        """The differential prober: POST a tiny canary predict (the
+        most recent small 200-answered request body) at the backend —
+        ``/healthz`` proves the process answers, the canary proves the
+        PREDICT path does.  Feeds the same EWMA as real traffic; on a
+        healthy backend fast canaries wash a one-off slow answer out
+        of the EWMA before the hysteresis strikes out (one slow
+        answer cannot demote).  None when the detector is off, no
+        template was captured yet, or the breaker refuses the hop."""
+        if self.gray is None:
+            return None
+        with self._canary_lock:
+            tmpl = self._canary_template
+        if tmpl is None or self.breaker_refuses(backend):
+            return None
+        ctype, accept, model, body = tmpl
+        headers = {"Content-Type": ctype,
+                   "X-Deadline-Ms":
+                   f"{self.gray.canary_timeout_s * 1e3:.0f}"}
+        if accept:
+            headers["Accept"] = accept
+        if model:
+            headers["X-Model"] = model
+        t_c = time.monotonic()
+        try:
+            status = backend.canary("POST", "/predict", body, headers,
+                                    timeout_s=self.gray.canary_timeout_s)
+            ok = status < 500
+        except BackendDown:
+            ok = False
+        backend.note_predict(ok, (time.monotonic() - t_c) * 1e3,
+                             alpha=self.gray.alpha)
+        return ok
+
+    @staticmethod
+    def breaker_refuses(backend: Backend) -> bool:
+        """True while the backend's circuit is open inside its
+        cooldown — the canary must not burn the single half-open
+        probe slot the healthz prober (or a live request) owns."""
+        return backend.breaker.state == "open"
+
+    def _gray_tick(self) -> None:
+        """Judge each backend's predict EWMA once per probe sweep and
+        advance its hysteresis machine: sustained gray decays the
+        effective weight and ultimately trips the breaker; healthy
+        ticks regrow it (recovery through the existing half-open
+        path)."""
+        if self.gray is None:
+            return
+        pol = self.gray
+        for b in self._backend_list():
+            ok, ms, obs = b.predict_ewma()
+            if obs < pol.min_observations:
+                continue
+            gray = ok < pol.ok_floor or (
+                pol.latency_threshold_ms is not None
+                and ms > pol.latency_threshold_ms)
+            event = b.gray_step(gray, pol)
+            if event == "demoted":
+                _gray_demotions.inc(backend=b.name)
+                self._journal("ejection", backend=b.name,
+                              source="gray", phase="demoted",
+                              ok_ewma=round(ok, 4),
+                              ewma_ms=round(ms, 2))
+                log.warning("gray demotion: backend %s predict EWMA "
+                            "ok=%.3f ms=%.1f — decaying effective "
+                            "weight", b.name, ok, ms)
+            elif event == "ejected":
+                b.breaker.trip()
+                self._journal("ejection", backend=b.name,
+                              source="gray", phase="ejected")
+                log.warning("gray ejection: backend %s effective "
+                            "weight reached zero — breaker tripped",
+                            b.name)
+            elif event == "recovered":
+                log.info("gray recovery: backend %s predict path "
+                         "healthy again, full weight restored",
+                         b.name)
+
+    def _note_ejections(self) -> None:
+        """Journal breaker ejection transitions observed since the
+        last sweep (audit records — replay does not act on them)."""
+        for b in self._backend_list():
+            state = b.breaker.state
+            if state == "open" \
+                    and self._breaker_seen.get(b.name) != "open":
+                self._journal("ejection", backend=b.name,
+                              source="breaker")
+            self._breaker_seen[b.name] = state
+
     # -- aggregated surfaces ----------------------------------------------
     def attach_rollout(self, status_fn) -> None:
         """Surface a rollout driver's ``status()`` on ``/healthz`` —
@@ -1183,6 +1535,14 @@ class FleetRouter:
                "backend_count": len(backends),
                "rev": self.rev,
                "uptime_s": round(debugz.process_uptime_s(), 1)}
+        if self.statestore is not None:
+            ra = self.reconcile_retry_after()
+            out["reconcile"] = {
+                "state": ("reconciling" if ra is not None
+                          else "settled"),
+                "journal": self.statestore.path}
+            if ra is not None:
+                out["reconcile"]["retry_after_s"] = ra
         ps = self.placement_status()
         if ps is not None:
             # opt-in block, the zoo-surface rule: the placement-less
@@ -1228,7 +1588,7 @@ class FleetRouter:
         (healthy/weight/generation) plus the breaker-trip counter,
         sampled at scrape time — the ``fleet_*{backend=...}``
         inventory in docs/observability.md."""
-        healthy, weights, gens, trips = [], [], [], []
+        healthy, weights, gens, trips, ewmas = [], [], [], [], []
         for b in self._backend_list():
             labels = {"backend": b.name}
             healthy.append((labels,
@@ -1240,6 +1600,8 @@ class FleetRouter:
                 gens.append((labels, float(gen)))
             trips.append((labels,
                           float(b.breaker.metrics().get("trips", 0))))
+            _ok, ms, _obs = b.predict_ewma()
+            ewmas.append((labels, float(ms)))
         fams = [
             ("gauge", "fleet_backend_healthy",
              "whether the router considers this backend routable "
@@ -1249,7 +1611,12 @@ class FleetRouter:
              "walk shifts these to split traffic)", weights),
             ("counter", "fleet_backend_ejections_total",
              "circuit-breaker trips per backend at the router tier "
-             "(closed/half_open -> open transitions)", trips)]
+             "(closed/half_open -> open transitions)", trips),
+            ("gauge", "backend_predict_ewma_ms",
+             "EWMA of real forwarded-predict + canary-probe latency "
+             "per backend, milliseconds — the gray-failure "
+             "detector's latency signal (0 until the first "
+             "observation)", ewmas)]
         if gens:
             fams.append((
                 "gauge", "fleet_backend_generation",
@@ -1347,6 +1714,45 @@ def main(argv=None) -> int:
                         "residency-/load-scored) and route it only "
                         "there, degrading to any-healthy when the "
                         "set cannot answer (0 = off; docs/fleet.md)")
+    d = p.add_argument_group(
+        "control-plane durability (docs/fleet.md)")
+    d.add_argument("--state-dir", default=None, metavar="DIR",
+                   help="journal every control-plane mutation (admin "
+                        "weights, pins, membership, autoscaler "
+                        "boots/drains) to DIR/controlplane.jsonl and "
+                        "replay it on restart: weights/pins are "
+                        "restored and surviving autoscaler children "
+                        "are re-adopted in place instead of "
+                        "re-booted.  Changes the SIGTERM default to "
+                        "journal-and-keep (see --teardown)")
+    d.add_argument("--reconcile-deadline-s", type=float, default=30.0,
+                   help="restart-reconciliation budget: until the "
+                        "journaled children are re-probed (or this "
+                        "deadline passes) /predict answers 503 with "
+                        "Retry-After sized from the remainder")
+    d.add_argument("--teardown", action="store_true",
+                   help="drain every managed backend on shutdown "
+                        "even with --state-dir (the pre-state-dir "
+                        "behavior; without --state-dir teardown is "
+                        "always on — there is no journal to re-adopt "
+                        "from)")
+    d.add_argument("--no-gray-demotion", dest="gray",
+                   action="store_false", default=True,
+                   help="disable gray-failure demotion (on by "
+                        "default: a probe-green backend whose real "
+                        "predicts fail or stall has its effective "
+                        "weight decayed toward zero and is "
+                        "ultimately ejected)")
+    d.add_argument("--gray-threshold-ms", type=float, default=None,
+                   help="predict-latency EWMA above which a backend "
+                        "counts as gray (default: error ratio only)")
+    d.add_argument("--gray-strikes", type=int, default=3,
+                   help="consecutive gray probe ticks before the "
+                        "weight decay starts (the hysteresis: one "
+                        "slow answer never demotes)")
+    d.add_argument("--gray-decay", type=float, default=0.5,
+                   help="effective-weight multiplier applied per "
+                        "gray probe tick past the strike threshold")
     g = p.add_argument_group(
         "autoscaling (route --autoscale / python -m znicz_tpu "
         "autoscale)")
@@ -1415,8 +1821,19 @@ def main(argv=None) -> int:
                 "entries to cover --min-backends")
     if args.placement < 0:
         p.error("--placement must be >= 0")
+    if args.gray_strikes < 1:
+        p.error("--gray-strikes must be >= 1")
+    if not 0.0 < args.gray_decay < 1.0:
+        p.error("--gray-decay must be in (0, 1)")
     token = args.admin_token if args.admin_token is not None \
         else os.environ.get("ZNICZ_ADMIN_TOKEN") or None
+    gray_policy = (GrayPolicy(
+        latency_threshold_ms=args.gray_threshold_ms,
+        strikes=args.gray_strikes, decay=args.gray_decay)
+        if args.gray else None)
+    store = (statestore_mod.StateStore(args.state_dir)
+             if args.state_dir else None)
+    replayed = store.replay() if store is not None else None
     backends = []
     for i, spec in enumerate(args.backend):
         try:
@@ -1446,13 +1863,18 @@ def main(argv=None) -> int:
                 forward_timeout_s=args.forward_timeout_s,
                 breaker_threshold=args.breaker_threshold,
                 breaker_cooldown_s=args.breaker_cooldown_s)
-            # boot the floor BEFORE the router: it needs >= 1 backend
-            while len(backends) + len(booted) < max(1,
-                                                    args.min_backends):
-                b, proc = launcher.spawn(len(booted))
-                booted.append((b, proc))
-                print(f"autoscale: booted floor backend {b.name} "
-                      f"at {b.url}", flush=True)
+            # Without a journal the floor boots BEFORE the router (it
+            # needs >= 1 backend).  With one, journaled children are
+            # reconciled AFTER the router is up — it answers honest
+            # 503s meanwhile — so nothing is double-booted: the floor
+            # only covers what reconciliation could not re-adopt.
+            if store is None:
+                while len(backends) + len(booted) \
+                        < max(1, args.min_backends):
+                    b, proc = launcher.spawn(len(booted))
+                    booted.append((b, proc))
+                    print(f"autoscale: booted floor backend {b.name} "
+                          f"at {b.url}", flush=True)
         router = FleetRouter(
             backends + [b for b, _p in booted],
             host=args.host, port=args.port,
@@ -1460,7 +1882,11 @@ def main(argv=None) -> int:
             probe_interval_s=args.probe_interval_s,
             admin_token=token, max_body_mb=args.max_body_mb,
             max_hops=args.max_hops, memo_entries=args.memoize,
-            memo_mb=args.memoize_mb, placement=engine)
+            memo_mb=args.memoize_mb, placement=engine,
+            statestore=store, gray=gray_policy,
+            allow_empty=store is not None and args.autoscale)
+        if store is not None:
+            router.begin_reconcile(args.reconcile_deadline_s)
         router.start()
         if args.autoscale:
             scaler = Autoscaler(
@@ -1477,9 +1903,48 @@ def main(argv=None) -> int:
                 idle_windows=args.idle_windows,
                 idle_rps=args.idle_rps,
                 cooldown_s=args.autoscale_cooldown_s,
-                drain_timeout_s=args.drain_timeout_s)
+                drain_timeout_s=args.drain_timeout_s,
+                statestore=store)
             for b, proc in booted:
                 scaler.adopt(b, proc)
+        if store is not None:
+            if scaler is not None and replayed.children:
+                from .autoscaler import reconcile_children
+                outcomes = reconcile_children(
+                    router, scaler, launcher, replayed.children,
+                    deadline_s=args.reconcile_deadline_s)
+                print(f"reconcile: {outcomes}", flush=True)
+            elif replayed.children:
+                print(f"reconcile: journal records "
+                      f"{len(replayed.children)} children but "
+                      f"--autoscale is off — leaving them untouched",
+                      flush=True)
+            if scaler is not None:
+                # the floor covers only what re-adoption missed
+                while router.backend_count() < max(1,
+                                                   args.min_backends):
+                    b, proc = launcher.spawn(scaler.next_index())
+                    router.add_backend(b)
+                    scaler.adopt(b, proc)
+                    print(f"autoscale: booted floor backend {b.name} "
+                          f"at {b.url}", flush=True)
+            # replay the operator's decisions onto the reconciled
+            # membership: last-write-wins weights, then pins in one
+            # recompute
+            for nm, w in replayed.weights.items():
+                rb = router.by_name.get(nm)
+                if rb is not None:
+                    try:
+                        rb.set_weight(w)
+                    except ValueError:
+                        pass
+            if replayed.pins and engine is not None:
+                engine.restore_pins(replayed.pins)
+                router.recompute_placement(cause="admin")
+            router.end_reconcile()
+            print(f"reconcile: settled ({replayed.records} journal "
+                  f"records replayed)", flush=True)
+        if scaler is not None:
             scaler.start()
         names = [b.name for b in router._backend_list()]
         print(f"routing {len(names)} backend(s) {names} at "
@@ -1506,9 +1971,12 @@ def main(argv=None) -> int:
         pass
     finally:
         if scaler is not None:
-            # drain every managed backend gracefully (SIGTERM → the
-            # serve drain path → exit 0), THEN stop routing
-            scaler.shutdown()
+            # without a journal: drain every managed backend
+            # gracefully (SIGTERM → the serve drain path → exit 0),
+            # THEN stop routing.  With --state-dir the default flips
+            # to journal-and-keep — children survive for re-adoption
+            # — unless --teardown restores the drain-everything path.
+            scaler.shutdown(teardown=args.teardown or store is None)
         elif booted:
             for b, proc in booted:
                 proc.terminate()

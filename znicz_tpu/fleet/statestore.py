@@ -1,0 +1,298 @@
+"""Durable fleet control-plane state: journal, replay, reconcile.
+
+The router is the fleet's master (PAPER.md lineage: the VELES master
+decides where work lives), and until this module every decision it
+made — admin weight overrides, placement pins, membership changes,
+and each autoscaler-booted serve process — lived only in router
+memory.  A router crash therefore lost all operator intent AND
+orphaned real child processes: the classic unprotected-control-plane
+failure.  This module gives the control plane the same crash-state
+discipline the data plane already has (PR 5's invalidate→blob→
+manifest protocol, PR 6's fsync'd promotion ledger):
+
+* :class:`StateStore` — an append-only JSONL journal
+  (``<state-dir>/controlplane.jsonl``), fsync'd per record, tolerant
+  of exactly one torn final line (crash mid-append): everything
+  *before* the tear is durable history, the torn record is dropped
+  with a warning, never a crash.  Record kinds: ``weight`` / ``pin``
+  / ``rebalance`` (admin mutations), ``join`` / ``leave`` /
+  ``ejection`` (membership + breaker audit), ``boot`` / ``adopt`` /
+  ``drain`` (autoscaler children, with pid, port, url, boot args and
+  a pid-reuse-proof process identity).
+* :meth:`StateStore.replay` — folds the stream into
+  :class:`ControlPlaneState`: last-write-wins weights and pins, the
+  member audit set, and the live children map a restarted autoscaler
+  reconciles against (``boot``/``adopt`` adds, ``drain`` removes).
+* **Pid-reuse safety** — :func:`process_identity` reads the process
+  start time from ``/proc/<pid>/stat`` (field 22: clock ticks since
+  boot, immutable for the life of the pid).  A journaled pid whose
+  current identity differs is a RECYCLED pid: the child is dead and
+  some unrelated process now wears its number — it must be treated
+  as dead and never signalled (:class:`OrphanProcess` refuses it).
+* :class:`OrphanProcess` — a ``subprocess.Popen``-shaped handle for
+  a re-adopted child the restarted router did not spawn (the crash
+  reparented it to init, so ``waitpid`` is unavailable): ``poll`` /
+  ``send_signal`` / ``terminate`` / ``kill`` / bounded ``wait`` via
+  signal-0 liveness polling, every signal gated on the identity
+  check above.
+
+Families: ``controlplane_journal_records_total{kind}``,
+``backend_adopted_total{outcome}`` (reconciliation verdicts, one per
+journaled child), and the ``controlplane_reconcile_state`` enum gauge
+(0 = no journal attached, 1 = replaying/reconciling, 2 = settled) —
+docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal as _signal
+import subprocess
+import threading
+import time
+
+from ..telemetry.registry import REGISTRY
+
+log = logging.getLogger("fleet")
+
+JOURNAL_NAME = "controlplane.jsonl"
+
+#: controlplane_reconcile_state values (enum gauge)
+RECONCILE_OFF = 0          # router runs without a state dir
+RECONCILE_RECONCILING = 1  # journal replayed, children being re-probed
+RECONCILE_SETTLED = 2      # reconciliation finished, serving normally
+
+_journal_records = REGISTRY.counter(
+    "controlplane_journal_records_total",
+    "control-plane mutations durably journaled (route --state-dir), "
+    "by record kind (weight | pin | rebalance | join | leave | "
+    "ejection | boot | adopt | drain)")
+_backend_adopted = REGISTRY.counter(
+    "backend_adopted_total",
+    "journaled autoscaler children a restarted router reconciled, by "
+    "verdict (adopted = re-entered rotation in place | dead = pid "
+    "gone | stale_pid = pid recycled by an unrelated process, never "
+    "signalled | stale_args = unknown boot generation, drained | "
+    "replaced = alive but failed healthz/predict canary, drained | "
+    "invalid = unusable journal record)")
+_reconcile_g = REGISTRY.gauge(
+    "controlplane_reconcile_state",
+    "restart-reconciliation state of the fleet control plane (0 = no "
+    "state dir attached, 1 = journal replayed and children being "
+    "re-probed — /predict answers 503 + Retry-After, 2 = settled)")
+
+
+def set_reconcile_state(state: int) -> None:
+    _reconcile_g.set(float(state))
+
+
+def process_identity(pid: int) -> str | None:
+    """A pid-reuse-proof identity for a live process: the kernel's
+    start time in clock ticks since boot (``/proc/<pid>/stat`` field
+    22), constant for the pid's whole life and different for any
+    later process recycling the number.  None when unreadable (no
+    procfs, process gone) — callers must treat None as *unverifiable*,
+    not as a match."""
+    try:
+        with open(f"/proc/{int(pid)}/stat", "rb") as fh:
+            stat = fh.read().decode("ascii", "replace")
+    except OSError:
+        return None
+    # the command field (2) is parenthesized and may itself contain
+    # spaces/parens — split AFTER its closing paren, not on spaces
+    _, _, tail = stat.rpartition(")")
+    fields = tail.split()
+    if len(fields) < 20:
+        return None
+    return fields[19]                      # field 22, 1-indexed
+
+
+def pid_alive(pid: int) -> bool:
+    """Signal-0 liveness: True while a process wears this pid (even
+    one we may not signal — EPERM proves existence)."""
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+class OrphanProcess:
+    """Popen-shaped handle for a journaled child this process did not
+    spawn.  Every signal is identity-gated: if the recorded identity
+    no longer matches the live pid, the number was recycled by an
+    unrelated process and we must neither signal nor count it."""
+
+    def __init__(self, pid: int, identity: str | None = None):
+        self.pid = int(pid)
+        self.identity = identity
+        self.returncode: int | None = None
+
+    def _mine(self) -> bool:
+        if not pid_alive(self.pid):
+            return False
+        if self.identity is None:
+            return True                    # unverifiable: assume ours
+        return process_identity(self.pid) == self.identity
+
+    def poll(self) -> int | None:
+        """None while the recorded child is alive; -1 once it is gone
+        (or its pid was recycled — same thing for our bookkeeping)."""
+        if self.returncode is not None:
+            return self.returncode
+        if self._mine():
+            return None
+        self.returncode = -1
+        return self.returncode
+
+    def send_signal(self, sig: int) -> None:
+        if self.poll() is not None:
+            return
+        try:
+            os.kill(self.pid, sig)
+        except ProcessLookupError:
+            self.returncode = -1
+
+    def terminate(self) -> None:
+        self.send_signal(_signal.SIGTERM)
+
+    def kill(self) -> None:
+        self.send_signal(_signal.SIGKILL)
+
+    def wait(self, timeout: float) -> int:
+        """Bounded reap-by-polling (the crash reparented the child to
+        init, so a real ``waitpid`` is not ours to call).  Raises
+        :class:`subprocess.TimeoutExpired` like Popen does."""
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            rc = self.poll()
+            if rc is not None:
+                return rc
+            time.sleep(0.05)
+        rc = self.poll()
+        if rc is not None:
+            return rc
+        raise subprocess.TimeoutExpired(f"pid {self.pid}", timeout)
+
+
+@dataclasses.dataclass
+class ControlPlaneState:
+    """What a restarted router recovers from the journal."""
+
+    #: last-write-wins admin weight overrides, by backend name
+    weights: dict = dataclasses.field(default_factory=dict)
+    #: last-write-wins placement pins, {model: [backend names]};
+    #: a cleared pin (backends null) removes the entry
+    pins: dict = dataclasses.field(default_factory=dict)
+    #: membership audit: joined-minus-left backend names → url
+    members: dict = dataclasses.field(default_factory=dict)
+    #: live autoscaler children: name → latest boot/adopt record
+    #: (pid, port, url, args, identity), minus drained ones
+    children: dict = dataclasses.field(default_factory=dict)
+    #: parseable records folded (torn/junk lines excluded)
+    records: int = 0
+
+
+class StateStore:
+    """Append/replay over one fsync'd JSONL journal (the
+    ``promotion/ledger.py`` idiom, holding control-plane mutations
+    instead of promotion outcomes).  A missing file is an empty
+    history; the directory is created on first append."""
+
+    def __init__(self, state_dir: str):
+        self.state_dir = os.fspath(state_dir)
+        self.path = os.path.join(self.state_dir, JOURNAL_NAME)
+        self._lock = threading.Lock()
+
+    def append(self, kind: str, **fields) -> dict:
+        """Durably journal one mutation (``{"ts", "kind", ...}``).
+        fsync per record: control-plane mutations are rare and each
+        one is exactly what a post-crash replay needs."""
+        entry = {"ts": time.time(), "kind": kind, **fields}
+        line = json.dumps(entry, sort_keys=True, default=str) + "\n"
+        with self._lock:
+            os.makedirs(self.state_dir, exist_ok=True)
+            with open(self.path, "a") as fh:
+                fh.write(line)
+                fh.flush()
+                os.fsync(fh.fileno())
+        _journal_records.inc(kind=str(kind))
+        return entry
+
+    def entries(self) -> list:
+        """Every parseable record, oldest first.  A torn FINAL line
+        (crash mid-append) is skipped with a warning; a torn line
+        anywhere else is corruption worth the same warning but never
+        a crash — refusing to restart the router over one bad line
+        would turn bookkeeping into an outage."""
+        try:
+            with open(self.path) as fh:
+                lines = fh.read().splitlines()
+        except FileNotFoundError:
+            return []
+        out = []
+        for i, line in enumerate(lines, start=1):
+            if not line.strip():
+                continue
+            try:
+                entry = json.loads(line)
+                if not isinstance(entry, dict):
+                    raise ValueError("not an object")
+            except ValueError:
+                log.warning("%s:%d: skipping torn/unparseable "
+                            "journal record", self.path, i)
+                continue
+            out.append(entry)
+        return out
+
+    def replay(self) -> ControlPlaneState:
+        """Fold the journal into restart state: weights and pins are
+        last-write-wins; ``boot``/``adopt`` add (or refresh) a child,
+        ``drain`` and ``leave`` remove it; ``ejection`` and
+        ``rebalance`` are audit-only."""
+        st = ControlPlaneState()
+        for entry in self.entries():
+            kind = entry.get("kind")
+            name = entry.get("backend")
+            st.records += 1
+            if kind == "weight" and name:
+                try:
+                    st.weights[str(name)] = float(entry.get("weight"))
+                except (TypeError, ValueError):
+                    pass
+            elif kind == "pin":
+                model = entry.get("model")
+                if not model:
+                    continue
+                pin = entry.get("backends")
+                if pin:
+                    st.pins[str(model)] = [str(n) for n in pin]
+                else:
+                    st.pins.pop(str(model), None)
+            elif kind == "join" and name:
+                st.members[str(name)] = entry.get("url")
+            elif kind == "leave" and name:
+                st.members.pop(str(name), None)
+                st.children.pop(str(name), None)
+            elif kind in ("boot", "adopt") and name:
+                st.children[str(name)] = {
+                    "pid": entry.get("pid"),
+                    "port": entry.get("port"),
+                    "url": entry.get("url"),
+                    "args": entry.get("args") or [],
+                    "identity": entry.get("identity")}
+            elif kind == "drain" and name:
+                st.children.pop(str(name), None)
+        return st
+
+    def status(self) -> dict:
+        st = self.replay()
+        return {"path": self.path, "records": st.records,
+                "children": sorted(st.children),
+                "weights": st.weights,
+                "pins": {m: list(v) for m, v in st.pins.items()}}

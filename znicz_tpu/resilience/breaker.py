@@ -127,6 +127,24 @@ class CircuitBreaker:
             self._trips += 1
         _note_transition(old, OPEN)
 
+    def trip(self) -> None:
+        """Force the circuit open on an EXTERNAL verdict (e.g. the
+        fleet tier's gray-failure demotion: probes green, real
+        predicts sick — the failure count never reaches the
+        threshold because transport-wise nothing failed).  Cooldown
+        and the single half-open probe apply exactly as for a
+        threshold trip, so recovery rides the existing path."""
+        with self._lock:
+            if self._state == OPEN:
+                return
+            old = self._state
+            self._state = OPEN
+            self._opened_at = self._clock()
+            self._probe_inflight = False
+            self._probe_owner = None
+            self._trips += 1
+        _note_transition(old, OPEN)
+
     def abandon(self) -> None:
         with self._lock:
             # only the thread HOLDING the half-open probe may free the

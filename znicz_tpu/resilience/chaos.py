@@ -2285,6 +2285,356 @@ def _placement_scenario(args) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _controlplane_scenario(args) -> int:
+    """``--scenario controlplane`` — the crash-safe control plane
+    acceptance (docs/fleet.md "Control-plane durability"): a REAL
+    ``route --autoscale --state-dir`` process boots two managed serve
+    children, takes admin mutations (a weight override + a placement
+    pin), and is then SIGKILLed mid-burst.  Asserted:
+
+    * the children survive the router crash (reparented, still
+      serving) and a restarted router on the same port + state dir
+      **re-adopts them in place**: same pids, journal shows ``adopt``
+      records and exactly the original two ``boot`` records — zero
+      orphans, zero double-boots, pinned by pid accounting;
+    * while the restarted router reconciles, ``/predict`` answers
+      503 + Retry-After (at least one observed) — never a hang, never
+      a raw 500;
+    * the journaled weight override and placement pin are live again
+      after restart without any re-issued admin calls;
+    * a static backend that answers ``/healthz`` green but serves
+      latency-faulted predicts (the gray-failure mode) is demoted:
+      its effective weight decays to ~zero (and its breaker trips)
+      within a bounded number of probe intervals, while its own
+      healthz stays 200;
+    * zero raw 500s throughout; connection errors only inside the
+      kill→restart gap; after SIGTERM the journal-and-keep default
+      leaves the children running for the NEXT restart to re-adopt.
+    """
+    import collections
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import threading
+
+    bad: list[str] = []
+    x = [[0.1, 0.2, 0.3, 0.4]]
+    tmp = tempfile.mkdtemp(prefix="znicz_chaos_cp_")
+    state_dir = os.path.join(tmp, "state")
+    child_pids: list[int] = []
+    gray_proc = None
+    router_proc = None
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def wait_healthz(url: str, proc, what: str,
+                     tries: int = 240) -> bool:
+        for _ in range(tries):
+            try:
+                with urllib.request.urlopen(url + "healthz",
+                                            timeout=2) as r:
+                    json.loads(r.read())
+                return True
+            except Exception:
+                if proc is not None and proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    bad.append(f"{what} exited rc={proc.returncode}: "
+                               f"{out[-300:]}")
+                    return False
+                time.sleep(0.25)
+        bad.append(f"{what} never answered /healthz")
+        return False
+
+    def journal() -> list[dict]:
+        path = os.path.join(state_dir, "controlplane.jsonl")
+        out = []
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+        except FileNotFoundError:
+            pass
+        return out
+
+    def alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
+    def boot_router(rport: int, extra: list[str]) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "route",
+             "--port", str(rport), "--autoscale",
+             "--min-backends", "2", "--max-backends", "3",
+             "--placement", "1", "--state-dir", state_dir,
+             "--probe-interval-s", "0.3",
+             "--breaker-threshold", "2",
+             "--breaker-cooldown-s", "1.0",
+             "--reconcile-deadline-s", "20",
+             "--serve-arg=--model", f"--serve-arg={model}",
+             "--serve-arg=--max-wait-ms", "--serve-arg=1"] + extra,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    try:
+        model = os.path.join(tmp, "demo.znn")
+        _write_demo_znn(model)
+        rport = free_port()
+        router_url = f"http://127.0.0.1:{rport}/"
+
+        # ---- phase 1: first boot — floor children + admin mutations
+        router_proc = boot_router(rport, [])
+        if not wait_healthz(router_url, router_proc, "router",
+                            tries=480):
+            return 1
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            boots = [e for e in journal() if e.get("kind") == "boot"]
+            if len(boots) >= 2:
+                break
+            time.sleep(0.25)
+        boots = [e for e in journal() if e.get("kind") == "boot"]
+        child_pids = [int(e["pid"]) for e in boots]
+        names = sorted(e["backend"] for e in boots)
+        print(json.dumps({"phase": "boot", "children": names,
+                          "pids": child_pids}))
+        if len(boots) != 2 or not all(alive(p) for p in child_pids):
+            bad.append(f"expected 2 live floor children, journal has "
+                       f"{boots}")
+            return 1
+        req = urllib.request.Request(
+            router_url + "admin/weight",
+            json.dumps({"backend": names[0],
+                        "weight": 2.5}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            if r.status != 200:
+                bad.append(f"admin/weight answered {r.status}")
+        req = urllib.request.Request(
+            router_url + "admin/placement",
+            json.dumps({"model": "demo",
+                        "backends": [names[0]]}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            if r.status != 200:
+                bad.append(f"admin/placement answered {r.status}")
+
+        # ---- phase 2: burst clients + SIGKILL the control plane
+        answers: list[tuple] = []
+        mu = threading.Lock()
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    code, _b, headers = _post(router_url,
+                                              {"inputs": x},
+                                              timeout=15)
+                except Exception:
+                    code, headers = -1, {}
+                with mu:
+                    answers.append((time.monotonic(), code,
+                                    "Retry-After" in headers))
+                stop.wait(0.002)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        t_kill = time.monotonic()
+        router_proc.kill()              # a CRASH, not a drain
+        router_proc.wait(timeout=15)
+        if not all(alive(p) for p in child_pids):
+            bad.append("children died with the router — nothing to "
+                       "re-adopt")
+            return 1
+
+        # a gray backend: healthz green, predicts latency-faulted
+        gport = free_port()
+        gray_url = f"http://127.0.0.1:{gport}/"
+        gray_proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "serve",
+             "--model", model, "--port", str(gport),
+             "--max-wait-ms", "1", "--fault-plan",
+             json.dumps({"faults": [
+                 {"site": "engine.forward", "kind": "latency",
+                  "latency_s": 0.4, "p": 1.0}]})],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+        # ---- phase 3: restart on the same port + state dir
+        router_proc = boot_router(rport, [
+            "--backend", f"{gray_url},name=gray",
+            "--gray-threshold-ms", "150",
+            "--gray-strikes", "2", "--gray-decay", "0.3"])
+        if not wait_healthz(router_url, router_proc, "router "
+                            "(restarted)", tries=480):
+            return 1
+        t_up = time.monotonic()
+        settled = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            rc = _health(router_url).get("reconcile") or {}
+            if rc.get("state") == "settled":
+                settled = True
+                break
+            time.sleep(0.2)
+        t_settled = time.monotonic()
+        if not settled:
+            bad.append("restarted router never settled reconciliation")
+
+        # re-adoption by pid accounting: same pids adopted, no new
+        # boots for the managed names, every child accounted for
+        entries = journal()
+        adopts = [e for e in entries if e.get("kind") == "adopt"]
+        boots2 = [e for e in entries if e.get("kind") == "boot"]
+        adopted_pids = sorted(int(e["pid"]) for e in adopts)
+        print(json.dumps({"phase": "reconcile", "settled": settled,
+                          "adopted": sorted(e["backend"]
+                                            for e in adopts),
+                          "adopted_pids": adopted_pids,
+                          "boot_records": len(boots2)}))
+        if adopted_pids != sorted(child_pids):
+            bad.append(f"re-adoption pids {adopted_pids} != surviving "
+                       f"children {sorted(child_pids)}")
+        if len(boots2) != 2:
+            bad.append(f"{len(boots2)} boot records after restart — "
+                       f"expected the original 2 (double-boot or "
+                       f"leaked child)")
+        if not all(alive(p) for p in child_pids):
+            bad.append("a re-adopted child died during reconciliation")
+
+        # journaled decisions are live again, with no re-issued admin
+        health = _health(router_url)
+        rows = {r["name"]: r for r in health.get("backends") or []}
+        if names[0] not in rows:
+            bad.append(f"{names[0]} missing after re-adoption")
+        elif abs(rows[names[0]]["weight"] - 2.5) > 1e-6:
+            bad.append(f"journaled weight lost: {names[0]} weighs "
+                       f"{rows[names[0]]['weight']}, expected 2.5")
+        pins = (health.get("placement") or {}).get("pins") or {}
+        if pins.get("demo") != [names[0]]:
+            bad.append(f"journaled pin lost: pins={pins}")
+
+        # ---- phase 4: gray demotion — probe-green, predict-sick
+        demoted = False
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            rows = {r["name"]: r
+                    for r in _health(router_url).get("backends") or []}
+            g = rows.get("gray")
+            if g is not None and g["effective_weight"] <= 0.05:
+                demoted = True
+                break
+            time.sleep(0.3)
+        gray_rows = rows.get("gray") or {}
+        try:
+            with urllib.request.urlopen(gray_url + "healthz",
+                                        timeout=5) as r:
+                gray_healthz = r.status
+        except Exception:
+            gray_healthz = -1
+        print(json.dumps({"phase": "gray", "demoted": demoted,
+                          "effective_weight":
+                              gray_rows.get("effective_weight"),
+                          "breaker":
+                              (gray_rows.get("breaker")
+                               or {}).get("state"),
+                          "gray_healthz": gray_healthz}))
+        if not demoted:
+            bad.append(f"gray backend never demoted: {gray_rows}")
+        if gray_healthz != 200:
+            bad.append(f"gray backend healthz answered {gray_healthz}"
+                       f" — the drill needs probe-green")
+
+        stop.set()
+        for t in threads:
+            t.join(20.0)
+
+        # ---- the ledger of every answer across the whole arc
+        codes = collections.Counter(c for _t, c, _ra in answers)
+        in_gap = [c for t, c, _ra in answers if t_kill <= t <= t_up]
+        stray = sum(1 for t, c, _ra in answers
+                    if c == -1 and not t_kill <= t <= t_up)
+        reconcile_503 = sum(
+            1 for t, c, ra in answers
+            if c == 503 and ra and t_kill <= t <= t_settled)
+        naked = sum(1 for _t, c, ra in answers
+                    if c in (429, 503) and not ra)
+        print(json.dumps({"phase": "ledger",
+                          "codes": dict(sorted(codes.items())),
+                          "gap_answers": len(in_gap),
+                          "reconcile_503s": reconcile_503}))
+        if codes.get(500):
+            bad.append(f"{codes[500]} raw 500(s) during the arc")
+        if stray:
+            bad.append(f"{stray} connection error(s) OUTSIDE the "
+                       f"kill→restart gap")
+        if not reconcile_503:
+            bad.append("no 503+Retry-After observed during restart "
+                       "reconciliation")
+        if naked:
+            bad.append(f"{naked} refusal(s) carried no Retry-After")
+        if not codes.get(200):
+            bad.append("no successful answers at all — the burst "
+                       "never exercised the fleet")
+
+        # ---- phase 5: journal-and-keep — SIGTERM leaves children up
+        router_proc.send_signal(signal.SIGTERM)
+        try:
+            router_proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            router_proc.kill()
+            bad.append("router did not exit on SIGTERM")
+        survivors = [p for p in child_pids if alive(p)]
+        print(json.dumps({"phase": "journal-and-keep",
+                          "surviving_children": survivors}))
+        if sorted(survivors) != sorted(child_pids):
+            bad.append(f"journal-and-keep default still drained "
+                       f"children: survivors={survivors}")
+        print(json.dumps({"scenario": "controlplane", "ok": not bad,
+                          "violations": bad}))
+        return 1 if bad else 0
+    finally:
+        for proc in (router_proc, gray_proc):
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for pid in child_pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + 15.0
+        for proc in (router_proc, gray_proc):
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1,
+                                      deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for pid in child_pids:
+            for _ in range(100):
+                if not alive(pid):
+                    break
+                time.sleep(0.1)
+            else:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _admin_reload_named(url: str, name: str, model: str,
                         timeout: float = 60.0):
     """(status, body) of a synchronous per-model ``POST
@@ -2320,7 +2670,7 @@ def main(argv=None) -> int:
     p.add_argument("--scenario", default="breaker",
                    choices=("breaker", "reload", "promote", "overload",
                             "zoo", "slo", "wire", "fleet", "online",
-                            "placement"),
+                            "placement", "controlplane"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -2375,7 +2725,16 @@ def main(argv=None) -> int:
                         "(1+replication) x one zoo, and SIGKILLing "
                         "the hot tenant's owner mid-burst heals via "
                         "re-placement with zero raw 500s "
-                        "(docs/fleet.md)")
+                        "(docs/fleet.md); controlplane: a route "
+                        "--autoscale --state-dir process SIGKILLed "
+                        "mid-burst and restarted — journaled weights/"
+                        "pins restored, surviving children re-adopted "
+                        "in place (zero orphans/double-boots, pinned "
+                        "by pid accounting), 503+Retry-After while "
+                        "reconciling, and a healthz-green/predict-"
+                        "sick backend gray-demoted to ~zero effective "
+                        "weight (docs/fleet.md 'Control-plane "
+                        "durability')")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -2440,6 +2799,8 @@ def main(argv=None) -> int:
         return _online_scenario(args)
     if args.scenario == "placement":
         return _placement_scenario(args)
+    if args.scenario == "controlplane":
+        return _controlplane_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

@@ -311,15 +311,28 @@ def fleet_statusz_text(router, *, recorder=None) -> str:
     lines.append(f"fleet: {health['status']}  "
                  f"healthy={health['healthy_backends']}/"
                  f"{health['backend_count']}")
+    rc = health.get("reconcile")
+    if rc is not None:
+        # mid-incident the first question after a restart is "is it
+        # still reconciling and how long will clients see 503s"
+        extra = (f"  retry_after_s={rc['retry_after_s']}"
+                 if "retry_after_s" in rc else "")
+        lines.append(f"control-plane: {rc['state']}{extra}  "
+                     f"journal={rc['journal']}")
     lines += ["", "backends", "-" * 8]
-    lines.append(f"  {'name':<16} {'weight':>7} {'breaker':<10} "
-                 f"{'gen':>4} {'probe_age_s':>11} {'status':<12} url")
+    lines.append(f"  {'name':<16} {'weight':>7} {'eff':>6} "
+                 f"{'breaker':<10} {'gen':>4} {'ewma_ms':>8} "
+                 f"{'probe_age_s':>11} {'status':<12} url")
     for r in router.backend_rows():
         age = r.get("probe_age_s")
+        gray = r.get("gray") or {}
+        eff = r.get("effective_weight", r["weight"])
+        ewma = gray.get("ewma_ms")
         lines.append(
-            f"  {r['name']:<16} {r['weight']:>7.2f} "
+            f"  {r['name']:<16} {r['weight']:>7.2f} {eff:>6.2f} "
             f"{r['breaker']['state']:<10} "
             f"{r['generation'] if r['generation'] is not None else '?':>4} "
+            f"{f'{ewma:.1f}' if ewma is not None else '-':>8} "
             f"{age if age is not None else '-':>11} "
             f"{(r.get('backend_status') or '?'):<12} {r['url']}")
     rs = router.rollout_status
