@@ -778,12 +778,81 @@ def bench_serve(args) -> int:
         fixed_body = body_for(-1)
         repeat_pct = int(round(args.repeat_fraction * 100))
         n_clients = max(1, args.serve_clients)
+        traced = bool(getattr(args, "trace_breakdown", False))
+        if traced:
+            from znicz_tpu.telemetry import tracestore as ts_mod
+            from znicz_tpu.telemetry import tracing as tracing_mod
+        trace_mu = threading.Lock()
+        trace_collect = threading.Event()
+        stage_samples: dict = collections.defaultdict(list)
+        trace_pairs: list = []       # (e2e_ms, sum-of-stages_ms)
+
+        def _note_trace(tr, resp, data, e2e_ms):
+            # the stage split comes back in-band: router-assembled
+            # ("stages" present) in fleet mode, or the single server's
+            # raw span summary — assembled locally with pick=0 and
+            # the measured wall as the forward envelope — otherwise.
+            # A spilled wire trailer beats the header when present.
+            raw = resp.getheader(ts_mod.SPANS_HEADER)
+            summary = ts_mod.decode_summary(raw)
+            if binary:
+                _clean, trailer = wire_mod.split_trailer(data)
+                if trailer is not None:
+                    summary = ts_mod.decode_summary(trailer)
+            if summary is None:
+                return
+            stages = summary.get("stages")
+            if isinstance(stages, dict):
+                # router-assembled split: the residual between the
+                # client's wall and the router's measured total is the
+                # client<->router network leg — fold it into net.hop
+                # so the seven stages cover the FULL e2e path
+                rt = summary.get("total_ms")
+                if isinstance(rt, (int, float)):
+                    residual = max(0.0, e2e_ms - float(rt))
+                    stages = dict(stages)
+                    stages["net.hop"] = round(
+                        float(stages.get("net.hop") or 0.0)
+                        + residual, 3)
+            else:
+                stages = ts_mod.assemble(
+                    trace_id=tr.trace_id, request_id="",
+                    model="default", backend="local", outcome="ok",
+                    total_ms=e2e_ms, pick_ms=0.0, forward_ms=e2e_ms,
+                    summary=summary,
+                    started_at=time.time())["stages"]
+            present = {k: float(v) for k, v in stages.items()
+                       if v is not None}
+            if not present:
+                return
+            with trace_mu:
+                for name, ms in present.items():
+                    stage_samples[name].append(ms)
+                trace_pairs.append((e2e_ms, sum(present.values())))
 
         def post_conn(conn, body, hdrs=None):
-            conn.request("POST", "/predict", body,
-                         hdrs if hdrs is not None else headers)
+            hh = hdrs if hdrs is not None else headers
+            tr = None
+            if traced:
+                # every driven request carries its own root context —
+                # the breakdown wants the full population, not the
+                # router's head-sampled fraction
+                tr = tracing_mod.TraceContext(
+                    tracing_mod.new_trace_id(),
+                    tracing_mod.new_span_id())
+                hh = dict(hh)
+                hh[ts_mod.TRACE_HEADER] = \
+                    tracing_mod.format_traceparent(tr)
+            t_req = time.monotonic()
+            conn.request("POST", "/predict", body, hh)
             r = conn.getresponse()
-            r.read()
+            data = r.read()
+            if traced and trace_collect.is_set() and r.status == 200:
+                try:
+                    _note_trace(tr, r, data,
+                                (time.monotonic() - t_req) * 1e3)
+                except Exception:
+                    pass          # a torn summary never fails a bench
             return r.status
 
         warm = http.client.HTTPConnection("127.0.0.1", port,
@@ -795,6 +864,7 @@ def bench_serve(args) -> int:
         else:
             post_conn(warm, fixed_body)
         warm.close()
+        trace_collect.set()           # warm-lap compiles stay out
         answers = []                  # (latency_ms, code)
         mu = threading.Lock()
         stop = threading.Event()
@@ -899,6 +969,33 @@ def bench_serve(args) -> int:
         # like-for-like when the row says WHICH path was driven
         result["payload"] = args.payload
         result["repeat_fraction"] = args.repeat_fraction
+        if traced:
+            # the p99 decomposition: per-stage quantiles over every
+            # assembled trace, plus the honesty check — the stage sum
+            # must track the measured e2e wall (the acceptance gate
+            # wants the medians within ~10%)
+            def _q(sorted_vals, frac):
+                return round(sorted_vals[min(len(sorted_vals) - 1,
+                                             int(len(sorted_vals)
+                                                 * frac))], 3)
+            br: dict = {}
+            for name in ts_mod.STAGES:
+                vals = sorted(stage_samples.get(name) or [])
+                if vals:
+                    br[name] = {"p50_ms": _q(vals, 0.5),
+                                "p99_ms": _q(vals, 0.99)}
+            sums = sorted(s for _e, s in trace_pairs)
+            e2es = sorted(e for e, _s in trace_pairs)
+            result["trace_breakdown"] = {
+                "traces": len(trace_pairs),
+                "stages": br,
+                "stage_sum_p50_ms": _q(sums, 0.5) if sums else None,
+                "e2e_p50_ms": _q(e2es, 0.5) if e2es else None,
+                "stage_sum_p99_ms": _q(sums, 0.99) if sums else None,
+                "e2e_p99_ms": _q(e2es, 0.99) if e2es else None,
+                "sum_over_e2e": (
+                    round(_q(sums, 0.5) / max(1e-9, _q(e2es, 0.5)), 3)
+                    if sums and e2es else None)}
         rev = _git_rev()
         if rev:
             result["rev"] = rev
@@ -1785,6 +1882,14 @@ def main(argv=None) -> int:
                         "fleet_resident_bytes/zoo_total_bytes, so the "
                         "footprint win of placement over N-clones is "
                         "measured, not asserted (docs/fleet.md)")
+    p.add_argument("--trace-breakdown", action="store_true",
+                   help="serve bench: stamp a traceparent on every "
+                        "driven request and report the per-stage "
+                        "p50/p99 latency decomposition (router-"
+                        "assembled in --fleet mode, assembled locally "
+                        "from the server's in-band span summary "
+                        "otherwise), plus the stage-sum vs e2e "
+                        "honesty ratio (docs/observability.md)")
     p.add_argument("--repeat-fraction", type=float, default=0.0,
                    help="serve bench: fraction [0,1] of requests "
                         "reusing ONE fixed input (the rest are "

@@ -16,9 +16,9 @@ import pytest
 from znicz_tpu.analysis import (Analyzer, DeadlineDisciplineRule,
                                 DurationClockRule, HandlerSafetyRule,
                                 JaxHygieneRule, LockDisciplineRule,
-                                MetricDriftRule, UnseededRandomRule,
-                                load_baseline, run_repo,
-                                write_baseline)
+                                MetricDriftRule, SpanNameDriftRule,
+                                UnseededRandomRule, load_baseline,
+                                run_repo, write_baseline)
 from znicz_tpu.analysis import cli as zlint_cli
 
 
@@ -820,6 +820,99 @@ class TestDurationClock:
             "return time.time() - t0",
             "return time.time() - t0  # zlint: disable=duration-clock")
         assert lint(tmp_path, src, [DurationClockRule()]) == []
+
+    def test_span_gap_on_wall_clock_fires(self, tmp_path):
+        # the trace assembler's exact shape (ISSUE 18): per-stage
+        # gaps between measured durations — wall-clock stamps entering
+        # that arithmetic is precisely the cross-process clock bug
+        # the stage split is designed to avoid
+        found = lint(tmp_path, """
+    import time
+
+    def assemble_stages(pick_ms, forward_ms):
+        t0 = time.time()
+        total_ms = (time.time() - t0) * 1e3
+        recv = max(0.0, total_ms - pick_ms - forward_ms)
+        return {"router.recv": recv}
+""", [DurationClockRule()])
+        assert rules_of(found) == ["duration-clock"]
+
+    def test_span_gap_on_monotonic_with_wall_stamp_passes(self,
+                                                          tmp_path):
+        # the assembler's real discipline: every DURATION from the
+        # monotonic clock, the wall clock only as the trace's `at`
+        # stamp, never in the gap arithmetic
+        assert lint(tmp_path, """
+    import time
+
+    def assemble_stages(pick_ms, forward_ms):
+        t0 = time.monotonic()
+        total_ms = (time.monotonic() - t0) * 1e3
+        recv = max(0.0, total_ms - pick_ms - forward_ms)
+        return {"router.recv": recv, "at": time.time()}
+""", [DurationClockRule()]) == []
+
+
+# -- span-name drift -------------------------------------------------------
+
+def _span_repo(tmp_path, code_names, doc_lines):
+    mod = tmp_path / "pkg" / "m.py"
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    lines = ["from telemetry import tracing", ""]
+    for name in code_names:
+        lines.append(f'_ = tracing.span("{name}")')
+    mod.write_text("\n".join(lines) + "\n")
+    doc = tmp_path / "docs" / "obs.md"
+    doc.parent.mkdir(parents=True, exist_ok=True)
+    doc.write_text("\n".join(doc_lines) + "\n")
+    rule = SpanNameDriftRule(doc_paths=("docs/obs.md",))
+    return Analyzer([rule], root=str(tmp_path)).run(["pkg/m.py"])
+
+
+class TestSpanNameDrift:
+    def test_in_sync_is_silent(self, tmp_path):
+        assert _span_repo(
+            tmp_path, ("engine.forward", "batcher.wait"),
+            ["the `engine.forward` stage follows `batcher.wait`"]) == []
+
+    def test_ghost_stage_fires(self, tmp_path):
+        found = _span_repo(
+            tmp_path, ("engine.forward",),
+            ["| `engine.fwd` | the device stage |"])
+        assert rules_of(found) == ["span-name-drift"]
+        assert len(found) == 1
+        assert "engine.fwd" in found[0].message
+        assert found[0].path == "docs/obs.md"
+
+    def test_stages_tuple_registers(self, tmp_path):
+        # the tracestore STAGES tuple is a registration site even
+        # with no span() call naming its entries
+        mod = tmp_path / "pkg" / "m.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text('STAGES = ("router.recv", "net.hop")\n')
+        doc = tmp_path / "docs" / "obs.md"
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        doc.write_text("`router.recv` then `net.hop`\n")
+        rule = SpanNameDriftRule(doc_paths=("docs/obs.md",))
+        assert Analyzer([rule],
+                        root=str(tmp_path)).run(["pkg/m.py"]) == []
+
+    def test_prose_dotted_tokens_stay_out(self, tmp_path):
+        # `np.asarray`, `lax.scan`, module paths: dotted but not
+        # rooted in a stage namespace — never cross-checked
+        found = _span_repo(
+            tmp_path, ("engine.forward",),
+            ["call `np.asarray` inside `lax.scan` via "
+             "`znicz_tpu.telemetry.tracing`"])
+        assert found == []
+
+    def test_labeled_stage_reference(self, tmp_path):
+        # `trace_stage_ms{stage=...}`-style prose often backticks the
+        # stage with a label set attached — still a reference
+        found = _span_repo(
+            tmp_path, ("engine.forward",),
+            ['slowest is `net.hop{stage="net.hop"}` today'])
+        assert rules_of(found) == ["span-name-drift"]
 
 
 # -- deadline discipline ---------------------------------------------------

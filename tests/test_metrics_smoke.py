@@ -14,6 +14,35 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_trace_families_registered_and_scrape_at_zero():
+    """Fast (in-process) slice of the smoke contract for the tracing
+    families (ISSUE 18): importing tracestore registers them, and an
+    idle registry scrapes them as typed zero samples — dashboards see
+    the series before the first trace assembles."""
+    from znicz_tpu.telemetry import registry
+    from znicz_tpu.telemetry import tracestore  # noqa: F401 registers
+    text = registry.REGISTRY.render_prometheus()
+    typed = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            typed[name] = kind
+    assert typed.get("trace_stage_ms") == "histogram"
+    assert typed.get("traces_retained_total") == "counter"
+    assert typed.get("traces_dropped_total") == "counter"
+    assert typed.get("trace_exemplars_total") == "counter"
+    # zero-valued samples present (not just TYPE headers): a scrape
+    # before any traffic still yields series for each family
+    lines = text.splitlines()
+    assert any(ln.startswith("trace_stage_ms_count") for ln in lines)
+    assert any(ln == "traces_retained_total 0"
+               or ln.startswith("traces_retained_total{")
+               for ln in lines)
+    assert any(ln == "traces_dropped_total 0"
+               or ln.startswith("traces_dropped_total{")
+               for ln in lines)
+
+
 @pytest.mark.slow
 def test_metrics_smoke_script_passes():
     proc = subprocess.run(

@@ -40,6 +40,8 @@ def parse_exposition(text):
             continue
         if line.startswith("# HELP "):
             continue
+        if line.startswith("# EXEMPLAR "):
+            continue                # trace-id exemplars ride as comments
         assert not line.startswith("#"), f"unknown comment {line!r}"
         m = _SERIES_RE.fullmatch(line)
         assert m is not None, f"unparseable exposition line: {line!r}"

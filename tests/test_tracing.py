@@ -1,0 +1,418 @@
+"""Distributed-tracing tests (ISSUE 18): the request-id hash-suffix
+regression, traceparent parse/format, the binary wire trailer, span
+summaries and hop-level assembly (stage math sums to the total), the
+tail-sampling TraceStore policy, histogram exemplars, and trace-context
+survival across the batcher's thread boundary (including coalesced-
+batch rider tagging).
+
+Process-level coverage (real route + serve processes) lives in
+tools/trace_smoke.sh and `chaos --scenario trace`.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu.serving import wire
+from znicz_tpu.serving.batcher import MicroBatcher
+from znicz_tpu.telemetry import tracestore, tracing
+from znicz_tpu.telemetry.registry import MetricsRegistry
+
+
+# -- request-id truncation (the _MAX_ID_LEN collision fix) ----------------
+
+class TestRequestIdTruncation:
+    def test_long_ids_sharing_a_prefix_stay_distinct(self):
+        """The regression: plain rid[:120] collapsed two client ids
+        sharing a long prefix into ONE id, cross-wiring their spans.
+        The hash suffix keeps them distinct."""
+        base = "tenant-alpha-" + "x" * 150
+        a = tracing.accept_request_id(base + "-retry-1")
+        b = tracing.accept_request_id(base + "-retry-2")
+        assert a != b
+        assert len(a) <= 120 and len(b) <= 120
+
+    def test_truncation_is_deterministic(self):
+        # a retry echoing the same over-long id must still correlate
+        rid = "r" * 400
+        assert tracing.accept_request_id(rid) \
+            == tracing.accept_request_id(rid)
+
+    def test_truncated_id_keeps_prefix_and_marks_digest(self):
+        rid = "abcdefgh" * 40                      # 320 chars
+        out = tracing.accept_request_id(rid)
+        assert len(out) == 120
+        assert out.startswith(rid[:100])
+        head, _, digest = out.rpartition(".")
+        assert len(digest) == 8
+        assert head == rid[:111]
+
+    def test_short_ids_pass_through_unchanged(self):
+        assert tracing.accept_request_id("abc-123") == "abc-123"
+        assert len(tracing.accept_request_id("y" * 120)) == 120
+        assert "." not in tracing.accept_request_id("y" * 120)
+
+
+# -- traceparent parse/format ---------------------------------------------
+
+class TestTraceparent:
+    def test_round_trip(self):
+        ctx = tracing.TraceContext(tracing.new_trace_id(),
+                                   tracing.new_span_id())
+        assert len(ctx.trace_id) == 32 and len(ctx.parent_id) == 16
+        back = tracing.parse_traceparent(
+            tracing.format_traceparent(ctx))
+        assert back == ctx and back.sampled
+
+    def test_unsampled_flag_round_trips(self):
+        ctx = tracing.TraceContext("ab" * 16, "cd" * 8, sampled=False)
+        hdr = tracing.format_traceparent(ctx)
+        assert hdr.endswith("-00")
+        assert tracing.parse_traceparent(hdr).sampled is False
+
+    def test_whitespace_and_case_tolerated(self):
+        hdr = f"  00-{'AB' * 16}-{'CD' * 8}-01  "
+        ctx = tracing.parse_traceparent(hdr)
+        assert ctx is not None and ctx.trace_id == "ab" * 16
+
+    @pytest.mark.parametrize("raw", [
+        None, "", "junk",
+        "01-" + "ab" * 16 + "-" + "cd" * 8 + "-01",   # wrong version
+        "00-" + "ab" * 15 + "-" + "cd" * 8 + "-01",   # short trace id
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",   # non-hex
+        "00-" + "00" * 16 + "-" + "cd" * 8 + "-01",   # all-zero trace
+        "00-" + "ab" * 16 + "-" + "00" * 8 + "-01",   # all-zero parent
+        "00-" + "ab" * 16 + "-" + "cd" * 8,           # missing flags
+        "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01-extra",
+    ])
+    def test_malformed_is_untraced_never_an_error(self, raw):
+        assert tracing.parse_traceparent(raw) is None
+
+
+# -- binary wire trailer ---------------------------------------------------
+
+class TestWireTrailer:
+    def test_append_then_split_restores_exact_frame(self):
+        frame = wire.encode_tensor(
+            np.arange(12, dtype=np.float32).reshape(3, 4))
+        trailer = json.dumps({"v": 1, "spans": []}).encode()
+        carrying = wire.append_trailer(frame, trailer)
+        assert carrying != frame
+        clean, got = wire.split_trailer(carrying)
+        assert clean == frame                      # byte-identical
+        assert got == trailer
+
+    def test_trailer_carrying_frame_still_decodes(self):
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        carrying = wire.append_trailer(wire.encode_tensor(arr), b"{}")
+        np.testing.assert_array_equal(wire.decode_tensor(carrying), arr)
+
+    def test_plain_frame_passes_through(self):
+        frame = wire.encode_tensor(np.ones((1, 4), np.float32))
+        assert wire.split_trailer(frame) == (frame, None)
+        assert wire.split_trailer(b'{"outputs": [[1.0]]}') \
+            == (b'{"outputs": [[1.0]]}', None)
+
+    def test_torn_trailer_passes_through_untouched(self):
+        carrying = wire.append_trailer(
+            wire.encode_tensor(np.ones((1, 4), np.float32)),
+            b"0123456789")
+        torn = carrying[:-3]
+        assert wire.split_trailer(torn) == (torn, None)
+
+    def test_double_append_and_oversize_refused(self):
+        frame = wire.encode_tensor(np.ones((1, 4), np.float32))
+        carrying = wire.append_trailer(frame, b"x")
+        with pytest.raises(wire.WireError):
+            wire.append_trailer(carrying, b"y")
+        with pytest.raises(wire.WireError):
+            wire.append_trailer(
+                frame, b"z" * (wire.MAX_TRAILER_BYTES + 1))
+
+
+# -- span summary codec ----------------------------------------------------
+
+def _summary(spans):
+    return {"v": 1, "spans": spans}
+
+
+class TestSummaryCodec:
+    def test_export_spans_carries_queue_wait_and_synthetic_predict(self):
+        tracing.clear()
+        with tracing.span("batcher.dispatch", queue_wait_ms=2.5):
+            pass
+        spans = tracing.recent_spans(name="batcher.dispatch", n=1)
+        out = tracestore.export_spans(spans, server_predict_ms=9.0)
+        by_name = {s["n"]: s for s in out["spans"]}
+        assert by_name["batcher.dispatch"]["q"] == 2.5
+        assert by_name["server.predict"]["d"] == 9.0
+
+    def test_encode_decode_round_trip(self):
+        s = _summary([{"n": "engine.forward", "d": 3.2, "s": "ok"}])
+        assert tracestore.decode_summary(
+            tracestore.encode_summary(s)) == s
+
+    def test_decode_accepts_assembled_stage_shape(self):
+        # the router hands the CLIENT an already-assembled split —
+        # same channel, second legitimate shape
+        s = {"v": 1, "trace_id": "ab" * 16, "total_ms": 5.0,
+             "stages": {"net.hop": 1.0}}
+        assert tracestore.decode_summary(
+            tracestore.encode_summary(s)) == s
+
+    @pytest.mark.parametrize("raw", [
+        None, b"", "not json", b"\xff\xfe", "[1,2]", '"str"',
+        '{"v": 1}', '{"spans": 3}', '{"stages": []}'])
+    def test_malformed_decodes_to_none(self, raw):
+        assert tracestore.decode_summary(raw) is None
+
+    def test_prune_keeps_stage_spans_and_flags_truncation(self):
+        spans = [{"n": f"other.span{i}", "d": 1.0, "s": "ok"}
+                 for i in range(50)]
+        spans += [{"n": "engine.forward", "d": 3.0, "s": "ok"},
+                  {"n": "server.encode", "d": 0.5, "s": "ok"}]
+        pruned = tracestore.prune_summary(_summary(spans))
+        assert pruned["truncated"] is True
+        names = {s["n"] for s in pruned["spans"]}
+        assert names == {"engine.forward", "server.encode"}
+        assert len(tracestore.encode_summary(pruned)) \
+            < len(tracestore.encode_summary(_summary(spans)))
+
+
+# -- hop-level assembly ----------------------------------------------------
+
+class TestAssemble:
+    def _assemble(self, **kw):
+        base = dict(trace_id="t" * 32, request_id="r1", model="m",
+                    backend="b0", outcome="ok", total_ms=100.0,
+                    pick_ms=5.0, forward_ms=80.0,
+                    summary=None, started_at=1.0)
+        base.update(kw)
+        return tracestore.assemble(**base)
+
+    def test_full_summary_stages_sum_to_total(self):
+        summary = _summary([
+            {"n": "server.predict", "d": 70.0, "s": "ok"},
+            {"n": "batcher.dispatch", "d": 52.0, "s": "ok", "q": 10.0},
+            {"n": "engine.forward", "d": 40.0, "s": "ok"},
+            {"n": "server.encode", "d": 5.0, "s": "ok"}])
+        tr = self._assemble(summary=summary)
+        st = tr["stages"]
+        assert st == {"router.recv": 15.0, "router.pick_backend": 5.0,
+                      "net.hop": 10.0, "server.predict": 15.0,
+                      "batcher.wait": 10.0, "engine.forward": 40.0,
+                      "server.encode": 5.0}
+        assert sum(st.values()) == pytest.approx(tr["total_ms"])
+        assert set(st) == set(tracestore.STAGES)
+
+    def test_negative_gaps_clamp_to_zero(self):
+        # clocks ticking between reads can push a gap negative; the
+        # assembled stage must clamp, never report -0.3ms
+        summary = _summary([
+            {"n": "server.predict", "d": 90.0, "s": "ok"}])
+        tr = self._assemble(total_ms=80.0, pick_ms=5.0,
+                            forward_ms=85.0, summary=summary)
+        assert tr["stages"]["router.recv"] == 0.0
+        assert tr["stages"]["net.hop"] == 0.0
+
+    def test_no_backend_reached(self):
+        tr = self._assemble(forward_ms=None, outcome="deadline")
+        st = tr["stages"]
+        assert st["router.recv"] == 95.0
+        assert st["router.pick_backend"] == 5.0
+        assert st["net.hop"] is None and st["engine.forward"] is None
+
+    def test_summaryless_hop_collapses_into_net_hop(self):
+        tr = self._assemble(summary=None)
+        assert tr["stages"]["net.hop"] == 80.0
+        assert tr["stages"]["server.predict"] is None
+
+    def test_truncated_summary_marks_the_trace(self):
+        summary = dict(_summary(
+            [{"n": "server.predict", "d": 10.0, "s": "ok"}]),
+            truncated=True)
+        assert self._assemble(summary=summary)["truncated"] is True
+
+
+# -- the tail-sampling store -----------------------------------------------
+
+def _trace(outcome="ok", model="m", total_ms=10.0, at=0.0, n=0):
+    return {"trace_id": f"{n:032x}", "request_id": f"r{n}",
+            "model": model, "backend": "b0", "outcome": outcome,
+            "total_ms": total_ms, "at": at,
+            "stages": dict.fromkeys(tracestore.STAGES, 1.0)}
+
+
+class TestTraceStore:
+    def test_refusals_always_retained(self):
+        st = tracestore.TraceStore(head_rate=0.0, tail_fraction=0.0)
+        assert st.record(_trace(outcome="error", n=1)) == "error"
+        assert st.record(_trace(outcome="shed", n=2)) == "shed"
+        assert st.record(_trace(outcome="deadline", n=3)) == "deadline"
+        snap = st.snapshot()
+        assert snap["retained"] == 3
+        assert {t["retained"] for t in snap["traces"]} \
+            == {"error", "shed", "deadline"}
+
+    def test_healthy_flood_cannot_evict_refusals(self):
+        st = tracestore.TraceStore(capacity=8, error_capacity=8,
+                                   head_rate=1.0, tail_fraction=0.0)
+        st.record(_trace(outcome="error", n=0))
+        for i in range(1, 100):
+            st.record(_trace(n=i))
+        assert st.snapshot(outcome="error")["retained"] == 1
+
+    def test_head_sampling_is_a_deterministic_stride(self):
+        st = tracestore.TraceStore(head_rate=0.25, tail_fraction=0.0)
+        reasons = [st.record(_trace(n=i)) for i in range(16)]
+        assert reasons.count("head") == 4            # every 4th
+        assert reasons[3] == "head" and reasons[0] is None
+
+    def test_zero_rates_sample_everything_out(self):
+        st = tracestore.TraceStore(head_rate=0.0, tail_fraction=0.0)
+        assert all(st.record(_trace(n=i)) is None for i in range(8))
+        assert st.stats()["healthy_seen"] == 8
+
+    def test_slow_tail_retained_after_window_warms(self):
+        st = tracestore.TraceStore(head_rate=0.0, tail_fraction=0.1)
+        for i in range(32):                          # warm the window
+            st.record(_trace(total_ms=float(i + 1), n=i))
+        assert st.record(_trace(total_ms=500.0, n=99)) == "tail"
+        # and a typical-latency trace still samples out
+        assert st.record(_trace(total_ms=5.0, n=100)) is None
+
+    def test_tail_threshold_is_per_tenant(self):
+        st = tracestore.TraceStore(head_rate=0.0, tail_fraction=0.1)
+        for i in range(32):
+            st.record(_trace(model="fast", total_ms=5.0, n=i))
+            st.record(_trace(model="slow", total_ms=500.0, n=100 + i))
+        # 50ms: a tail outlier for "fast", typical for "slow"
+        assert st.record(
+            _trace(model="fast", total_ms=50.0, n=200)) == "tail"
+        assert st.record(
+            _trace(model="slow", total_ms=50.0, n=201)) is None
+
+    def test_snapshot_filters_and_ordering(self):
+        st = tracestore.TraceStore(head_rate=1.0, tail_fraction=0.0)
+        st.record(_trace(model="a", total_ms=5.0, at=1.0, n=1))
+        st.record(_trace(model="b", total_ms=50.0, at=2.0, n=2))
+        st.record(_trace(model="a", outcome="error", at=3.0, n=3))
+        assert st.snapshot(model="a")["retained"] == 2
+        assert st.snapshot(min_ms=40.0)["retained"] == 1
+        assert st.snapshot(outcome="error")["retained"] == 1
+        snap = st.snapshot()
+        ats = [t["at"] for t in snap["traces"]]
+        assert ats == sorted(ats, reverse=True)      # newest first
+        assert snap["stages"] == list(tracestore.STAGES)
+        assert len(st.snapshot(n=2)["traces"]) == 2
+
+
+# -- histogram exemplars ---------------------------------------------------
+
+class TestExemplars:
+    def test_exemplar_lands_in_its_bucket_and_renders_as_comment(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", "latency", buckets=(1.0, 10.0))
+        h.observe(5.0, exemplar="ab" * 16)
+        ex = h.exemplars()
+        assert ex == {"le=10": {"exemplar": "ab" * 16, "value": 5.0,
+                                "at": ex["le=10"]["at"]}}
+        text = reg.render_prometheus()
+        assert any(ln.startswith("# EXEMPLAR lat_ms_bucket")
+                   and "trace_id=" + "ab" * 16 in ln
+                   for ln in text.splitlines())
+        # every non-comment line still parses as strict v0.0.4
+        for ln in text.splitlines():
+            assert ln.startswith("#") or " " in ln
+
+    def test_observe_exemplar_respects_sampling_decision(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat2_ms", "latency", buckets=(1.0,))
+        unsampled = tracing.TraceContext("ab" * 16, "cd" * 8,
+                                         sampled=False)
+        tracestore.observe_exemplar(h, 0.5, unsampled)
+        tracestore.observe_exemplar(h, 0.5, None)
+        assert h.exemplars() == {}
+        sampled = tracing.TraceContext("ef" * 16, "cd" * 8)
+        tracestore.observe_exemplar(h, 0.5, sampled)
+        assert h.exemplars()["le=1"]["exemplar"] == "ef" * 16
+
+
+# -- trace context across the batcher thread boundary ---------------------
+
+X = np.asarray([[0.1, -0.2, 0.3, 0.4]], np.float32)
+
+
+class TestBatcherTraceBoundary:
+    def test_trace_survives_the_dispatch_thread_hop(self):
+        tracing.clear()
+        ctx = tracing.TraceContext(tracing.new_trace_id(),
+                                   tracing.new_span_id())
+        b = MicroBatcher(lambda x: np.asarray(x), max_batch=4,
+                         max_wait_ms=1.0)
+        try:
+            with tracing.request("req-traced", trace=ctx):
+                b.predict(X, timeout=10.0)
+        finally:
+            b.close()
+        spans = [s for s in tracing.recent_spans(
+            name="batcher.dispatch") if "req-traced" in s.request_ids]
+        assert spans, "dispatch span lost its request id"
+        assert ctx.trace_id in spans[-1].trace_ids
+
+    def test_coalesced_batch_tags_every_rider(self):
+        """Two traced requests coalescing into ONE batch: the single
+        dispatch span must carry BOTH request ids and BOTH trace ids —
+        exactly where a naive contextvar hand-off would drop to one."""
+        tracing.clear()
+        release = threading.Event()
+        b = MicroBatcher(lambda x: (release.wait(5.0),
+                                    np.asarray(x))[1],
+                         max_batch=4, max_wait_ms=1.0)
+        ctxs = [tracing.TraceContext(tracing.new_trace_id(),
+                                     tracing.new_span_id())
+                for _ in range(2)]
+        try:
+            plug = b.submit(X)          # occupies the dispatch thread
+            time.sleep(0.1)
+            handles = []
+            for i, ctx in enumerate(ctxs):
+                with tracing.request(f"rider-{i}", trace=ctx):
+                    handles.append(b.submit(X))
+            release.set()
+            for h in [plug] + handles:
+                assert h.event.wait(10.0)
+        finally:
+            release.set()
+            b.close()
+        spans = [s for s in tracing.recent_spans(
+            name="batcher.dispatch")
+            if {"rider-0", "rider-1"} <= set(s.request_ids)]
+        assert spans, "riders did not coalesce into one dispatch span"
+        assert set(spans[-1].trace_ids) \
+            == {c.trace_id for c in ctxs}
+
+    def test_untraced_riders_contribute_no_trace_ids(self):
+        tracing.clear()
+        b = MicroBatcher(lambda x: np.asarray(x), max_batch=4,
+                         max_wait_ms=1.0)
+        try:
+            with tracing.request("req-plain"):
+                b.predict(X, timeout=10.0)
+        finally:
+            b.close()
+        spans = [s for s in tracing.recent_spans(
+            name="batcher.dispatch") if "req-plain" in s.request_ids]
+        assert spans and spans[-1].trace_ids == ()
+
+    def test_request_scope_resets_context(self):
+        ctx = tracing.TraceContext(tracing.new_trace_id(),
+                                   tracing.new_span_id())
+        with tracing.request("scoped", trace=ctx):
+            assert tracing.current_trace() is ctx
+            assert tracing.current_request_id() == "scoped"
+        assert tracing.current_trace() is None
+        assert tracing.current_request_id() is None

@@ -275,6 +275,25 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
               "response-cache families scrape zero without --memoize")
         check(series.get("quantize_fallback_total") == 0.0,
               "quantize_fallback_total present (fp32 serving, zero)")
+        # distributed-tracing families (telemetry.tracestore, ISSUE
+        # 18): registered at import, so an UNtraced replica (serve
+        # defaults to --trace-sample 0) still scrapes them from zero —
+        # and grows no stage-labeled children until a trace assembles
+        for fam, kind in (("trace_stage_ms", "histogram"),
+                          ("traces_retained_total", "counter"),
+                          ("traces_dropped_total", "counter"),
+                          ("trace_exemplars_total", "counter")):
+            check(typed.get(fam) == kind, f"{fam} typed {kind}")
+        check(series.get("trace_stage_ms_count") == 0.0,
+              "trace_stage_ms scrapes zero on an untraced replica")
+        check(not any(k.startswith("trace_stage_ms_bucket{")
+                      and "stage=" in k for k in series),
+              "no stage-labeled trace children without tracing")
+        check(series.get("traces_retained_total") == 0.0
+              and series.get("traces_dropped_total") == 0.0,
+              "trace store counters scrape zero while untraced")
+        check(series.get("trace_exemplars_total") == 0.0,
+              "trace_exemplars_total scrapes zero while untraced")
     finally:
         proc.send_signal(signal.SIGINT)
         try:
@@ -335,6 +354,13 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
               == 0.0,
               "backend_predict_ewma_ms carries a zero child per "
               "backend before any predict")
+        # the router registers the same tracing families (its store
+        # and assembler live here) — present before any traffic
+        for fam, kind in (("trace_stage_ms", "histogram"),
+                          ("traces_retained_total", "counter"),
+                          ("trace_exemplars_total", "counter")):
+            check(typed.get(fam) == kind,
+                  f"router scrape: {fam} typed {kind}")
     finally:
         router.send_signal(signal.SIGTERM)
         try:

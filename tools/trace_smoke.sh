@@ -1,0 +1,153 @@
+#!/bin/bash
+# Distributed-tracing smoke (ISSUE 18, operator-runnable): boot the
+# REAL fleet — `python -m znicz_tpu route` over two real `serve`
+# backends — fire a mixed burst, then assert the cross-hop tracing
+# contract end to end:
+#   * the router's GET /tracez holds >= 1 assembled multi-hop trace;
+#   * every assembled trace carries ALL seven canonical stages
+#     (tracestore.STAGES) as non-negative durations;
+#   * each trace's stage sum reconciles with its end-to-end wall
+#     (within tolerance: the stages are clamped monotonic gaps);
+#   * a client-supplied X-Znicz-Trace id is honored (continue, never
+#     re-root) and the response hands back the assembled per-stage
+#     split in X-Znicz-Spans.
+#
+# Deeper drills (fault-dominated stages, refusal retention, bench
+# decomposition) live in `chaos --scenario trace`; this is the quick
+# always-green slice, registered beside tools/metrics_smoke.sh.
+#
+# Usage:  bash tools/trace_smoke.sh [n_requests]
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - "${1:-24}" <<'PY'
+import json, os, signal, socket, subprocess, sys, tempfile, time
+import urllib.request
+
+from znicz_tpu.telemetry import tracestore, tracing
+
+n_req = int(sys.argv[1])
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_healthz(url, proc, what):
+    for _ in range(240):
+        try:
+            urllib.request.urlopen(url + "healthz", timeout=2)
+            return
+        except Exception:
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode(errors="replace")
+                sys.exit(f"{what} exited rc={proc.returncode}:\n"
+                         + out[-2000:])
+            time.sleep(0.25)
+    sys.exit(f"{what} never answered /healthz")
+
+
+procs = []
+with tempfile.TemporaryDirectory(prefix="znicz_trace_smoke_") as tmp:
+    model = os.path.join(tmp, "demo.znn")
+    from znicz_tpu.resilience.chaos import _write_demo_znn
+    _write_demo_znn(model)
+    bports = [free_port(), free_port()]
+    rport = free_port()
+    try:
+        for port in bports:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "znicz_tpu", "serve",
+                 "--model", model, "--port", str(port),
+                 "--max-wait-ms", "1", "--warmup-shape", "4"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        for i, port in enumerate(bports):
+            wait_healthz(f"http://127.0.0.1:{port}/", procs[i],
+                         f"backend {i}")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "route",
+             "--port", str(rport),
+             "--trace-sample", "1.0", "--trace-head-rate", "1.0"]
+            + [f for i, port in enumerate(bports)
+               for f in ("--backend",
+                         f"http://127.0.0.1:{port}/,name=b{i}")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        url = f"http://127.0.0.1:{rport}/"
+        wait_healthz(url, procs[-1], "router")
+
+        body = json.dumps({"inputs": [[0.1, -0.2, 0.3, 0.4]]}).encode()
+        for _ in range(n_req):           # router-rooted traffic
+            req = urllib.request.Request(
+                url + "predict", body,
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                pass
+
+        # one client-rooted request: the router must CONTINUE the
+        # supplied context and answer with the assembled stage split
+        ctx = tracing.TraceContext(tracing.new_trace_id(),
+                                   tracing.new_span_id())
+        req = urllib.request.Request(
+            url + "predict", body,
+            {"Content-Type": "application/json",
+             tracestore.TRACE_HEADER: tracing.format_traceparent(ctx)})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            spans_hdr = r.headers.get(tracestore.SPANS_HEADER)
+        summary = tracestore.decode_summary(spans_hdr)
+        check(summary is not None,
+              "client-traced request answered with X-Znicz-Spans")
+        check(summary is not None
+              and summary.get("trace_id") == ctx.trace_id,
+              "router continued the client's trace id (no re-root)")
+        check(summary is not None
+              and set(summary.get("stages") or {}) ==
+              set(tracestore.STAGES),
+              "in-band split carries all seven stages")
+
+        with urllib.request.urlopen(url + "tracez", timeout=10) as r:
+            tz = json.loads(r.read())
+        traces = tz.get("traces") or []
+        check(len(traces) >= 1,
+              f"/tracez holds assembled traces ({len(traces)})")
+        check(any(t.get("trace_id") == ctx.trace_id for t in traces),
+              "client-rooted trace retained in the store")
+        full = [t for t in traces
+                if set(t.get("stages") or {}) == set(tracestore.STAGES)
+                and all(v >= 0.0 for v in t["stages"].values())]
+        check(len(full) >= 1,
+              f"multi-hop traces carry all seven stages as "
+              f"non-negative durations ({len(full)}/{len(traces)})")
+        backends = {t.get("backend") for t in full}
+        check(len(backends) >= 2,
+              f"traces span both backends (saw {sorted(backends)})")
+        recon = bad_recon = 0
+        for t in full:
+            ssum = sum(t["stages"].values())
+            tol = max(0.15 * t["total_ms"], 1.0)
+            if abs(ssum - t["total_ms"]) <= tol:
+                recon += 1
+            else:
+                bad_recon += 1
+        check(bad_recon == 0 and recon >= 1,
+              f"stage sum ~= e2e wall on every full trace "
+              f"({recon} ok, {bad_recon} off)")
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+print(json.dumps({"ok": not fails, "violations": fails}))
+sys.exit(1 if fails else 0)
+PY

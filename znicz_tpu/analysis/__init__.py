@@ -38,6 +38,7 @@ from .handlers import HandlerSafetyRule
 from .jaxrules import JaxHygieneRule, UnseededRandomRule
 from .locks import LockDisciplineRule
 from .metric_drift import MetricDriftRule
+from .span_drift import SpanNameDriftRule
 
 __all__ = [
     "Analyzer", "Finding", "ModuleInfo", "Rule", "RepoRule",
@@ -45,4 +46,5 @@ __all__ = [
     "main", "LockDisciplineRule", "JaxHygieneRule",
     "UnseededRandomRule", "HandlerSafetyRule", "MetricDriftRule",
     "DurationClockRule", "DeadlineDisciplineRule",
+    "SpanNameDriftRule",
 ]

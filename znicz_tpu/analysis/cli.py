@@ -22,6 +22,7 @@ from .handlers import HandlerSafetyRule
 from .jaxrules import JaxHygieneRule, UnseededRandomRule
 from .locks import LockDisciplineRule
 from .metric_drift import MetricDriftRule
+from .span_drift import SpanNameDriftRule
 
 DEFAULT_BASELINE = "tools/zlint_baseline.json"
 
@@ -30,7 +31,7 @@ def default_rules() -> list:
     return [LockDisciplineRule(), JaxHygieneRule(),
             UnseededRandomRule(), HandlerSafetyRule(),
             MetricDriftRule(), DurationClockRule(),
-            DeadlineDisciplineRule()]
+            DeadlineDisciplineRule(), SpanNameDriftRule()]
 
 
 def run_repo(root: str | None = None, baseline: str | None = None,

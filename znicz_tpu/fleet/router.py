@@ -69,6 +69,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import http.client as _http_client
+import itertools
 import json
 import logging
 import threading
@@ -83,16 +84,19 @@ from . import placement as placement_mod
 from . import statestore as statestore_mod
 from ..resilience import overload
 from ..resilience.breaker import CircuitBreaker
+from ..serving import wire as wire_mod
 from ..serving.memo import ResponseCache
 from ..serving.server import (DeepBacklogHTTPServer, FastHTTPHandler,
-                              _json_object)
-from ..telemetry import buildinfo, debugz, flightrecorder, tracing
+                              _json_object, _outcome_of,
+                              _tracez_filters)
+from ..telemetry import (buildinfo, debugz, flightrecorder, tracestore,
+                         tracing)
 from ..telemetry.registry import (DEFAULT_LATENCY_BUCKETS_MS,
                                   PROMETHEUS_CONTENT_TYPE, REGISTRY)
 
 #: routes with their own label value in requests_total/errors_total
 #: (same bounded-cardinality rule as the serving front)
-_ROUTES = ("/predict", "/healthz", "/metrics", "/statusz",
+_ROUTES = ("/predict", "/healthz", "/metrics", "/statusz", "/tracez",
            "/admin/weight", "/admin/placement")
 
 _fleet_requests = REGISTRY.counter(
@@ -550,7 +554,10 @@ class FleetRouter:
                  statestore:
                  "statestore_mod.StateStore | None" = None,
                  gray: GrayPolicy | None = None,
-                 allow_empty: bool = False):
+                 allow_empty: bool = False,
+                 trace_sample: float = 1.0,
+                 trace_head_rate: float = 0.05,
+                 trace_tail_fraction: float = 0.05):
         if not backends and not allow_empty:
             raise ValueError("a router needs at least one backend")
         names = [b.name for b in backends]
@@ -633,6 +640,17 @@ class FleetRouter:
         #: optional status() of an in-process autoscaler loop
         #: (fleet.autoscaler.Autoscaler) — same attach idiom
         self.autoscale_status = None
+        #: distributed tracing (ISSUE 18): the router is the fleet's
+        #: root hop — it stamps a traceparent context on a
+        #: deterministic ``trace_sample`` fraction of forwards (every
+        #: request when a client already carries one), assembles the
+        #: seven-stage trace from the backend's in-band span summary,
+        #: and retains tail-first into this store (GET /tracez)
+        self.trace_sample = min(1.0, max(0.0, float(trace_sample)))
+        self.tracestore = tracestore.TraceStore(
+            head_rate=trace_head_rate,
+            tail_fraction=trace_tail_fraction)
+        self._trace_counter = itertools.count(1)
         outer = self
 
         class Handler(FastHTTPHandler):
@@ -738,6 +756,13 @@ class FleetRouter:
                                    PROMETHEUS_CONTENT_TYPE)
                     else:
                         self._reply(200, outer.metrics())
+                elif path == "/tracez":
+                    # the fleet-aggregated trace surface: assembled
+                    # cross-hop traces, retention stats, exemplars
+                    query = (self.path.split("?", 1)[1]
+                             if "?" in self.path else "")
+                    self._reply(200, outer.tracez(
+                        **_tracez_filters(query)))
                 else:
                     self._reply(404, {"error": f"no route {self.path!r}"})
 
@@ -755,19 +780,56 @@ class FleetRouter:
                     return
                 rid = tracing.accept_request_id(
                     self.headers.get("X-Request-Id"))
+                # trace root (ISSUE 18): continue a client-supplied
+                # context, else root a deterministic trace_sample
+                # fraction of requests here (no RNG on this path)
+                trace = tracing.parse_traceparent(
+                    self.headers.get(tracestore.TRACE_HEADER))
+                self._client_traced = trace is not None
+                if trace is None and outer.trace_sample > 0.0:
+                    stride = max(1, round(1.0 / outer.trace_sample))
+                    if next(outer._trace_counter) % stride == 0:
+                        trace = tracing.TraceContext(
+                            tracing.new_trace_id(),
+                            tracing.new_span_id())
                 t0 = time.monotonic()
+                started_at = time.time()
                 self._status_code = None
                 self._rec_error = None
                 self._rec_backend = None
-                with tracing.collect(rid) as collected:
-                    with tracing.request(rid):
-                        with tracing.span("router.predict"):
-                            self._predict(t0)
+                self._trace_ctx = trace
+                self._trace_pick_ms = 0.0
+                self._trace_forward_ms = None
+                self._trace_summary = None
+                self._trace_model = None
+                try:
+                    with tracing.collect(rid) as collected:
+                        with tracing.request(rid, trace=trace):
+                            with tracing.span("router.predict"):
+                                self._predict(t0)
+                finally:
+                    self._trace_ctx = None
                 dt_ms = (time.monotonic() - t0) * 1e3
                 # the router's own e2e latency signal (memo hits and
                 # refusals included) — the autoscaler's burn input
-                _fleet_request_hist.observe(dt_ms)
+                tracestore.observe_exemplar(_fleet_request_hist,
+                                            dt_ms, trace)
                 code = self._status_code or 500
+                if trace is not None:
+                    # assemble the hop-level trace — errors, sheds and
+                    # refusals included: those are exactly the traces
+                    # tail retention must never drop
+                    tr = tracestore.assemble(
+                        trace_id=trace.trace_id, request_id=rid,
+                        model=self._trace_model or "default",
+                        backend=self._rec_backend or "",
+                        outcome=_outcome_of(code), total_ms=dt_ms,
+                        pick_ms=self._trace_pick_ms,
+                        forward_ms=self._trace_forward_ms,
+                        summary=self._trace_summary,
+                        started_at=started_at)
+                    tracestore.observe_stages(tr)
+                    outer.tracestore.record(tr)
                 spans = [s.to_dict() for s in collected
                          if s._t0 >= t0]
                 flightrecorder.RECORDER.record(
@@ -958,11 +1020,22 @@ class FleetRouter:
                                         "X-Model-Generation":
                                             str(memo_gen)})
                             return
+                self._trace_model = model
                 fwd = {"Content-Type":
                        (self.headers.get("Content-Type")
                         or "application/json"),
                        "X-Request-Id":
                        tracing.current_request_id() or ""}
+                if self._trace_ctx is not None:
+                    # stamp the hop context: same trace id, a fresh
+                    # parent span id for THIS forward — the backend
+                    # tags its span tree with it and returns its
+                    # summary in-band for assembly
+                    fwd[tracestore.TRACE_HEADER] = \
+                        tracing.format_traceparent(tracing.TraceContext(
+                            self._trace_ctx.trace_id,
+                            tracing.new_span_id(),
+                            self._trace_ctx.sampled))
                 accept = self.headers.get("Accept")
                 if accept:
                     fwd["Accept"] = accept
@@ -985,8 +1058,13 @@ class FleetRouter:
                             "error": "deadline exceeded at the "
                                      "router hop"})
                         return
+                    t_p = time.monotonic()
                     backend, pick_mode = outer.pick_for(model,
                                                         exclude=tried)
+                    # the router.pick_backend stage: accumulated over
+                    # failover retries — re-picking IS pick cost
+                    self._trace_pick_ms += \
+                        (time.monotonic() - t_p) * 1e3
                     if backend is None:
                         break
                     if deadline.at is not None:
@@ -1013,6 +1091,20 @@ class FleetRouter:
                     dt = (time.monotonic() - t_f) * 1e3
                     _fleet_forward_hist.observe(dt,
                                                 backend=backend.name)
+                    # the wire trailer is consumed HERE regardless of
+                    # this router's own sampling (a self-rooting
+                    # backend may spill one): the client — and the
+                    # memo cache below — must see the exact
+                    # pre-trailer byte stream
+                    data, trailer = wire_mod.split_trailer(data)
+                    if self._trace_ctx is not None:
+                        self._trace_forward_ms = dt
+                        summary_raw = rheaders.get(
+                            tracestore.SPANS_HEADER)
+                        if trailer is not None:
+                            summary_raw = trailer
+                        self._trace_summary = \
+                            tracestore.decode_summary(summary_raw)
                     # real-traffic half of the gray detector: 5xx
                     # answers and slow answers count against the
                     # backend's predict EWMA (a 4xx is the client's
@@ -1060,6 +1152,30 @@ class FleetRouter:
                                 fwd["Content-Type"], accept or "",
                                 model, raw)
                     out = {"X-Fleet-Backend": backend.name}
+                    if self._client_traced \
+                            and self._trace_ctx is not None:
+                        # the client carried its own traceparent:
+                        # return the assembled stage split in-band so
+                        # a tracing caller (bench --trace-breakdown)
+                        # needs no second round-trip to /tracez
+                        part = tracestore.assemble(
+                            trace_id=self._trace_ctx.trace_id,
+                            request_id=(tracing.current_request_id()
+                                        or ""),
+                            model=model or "default",
+                            backend=backend.name,
+                            outcome=_outcome_of(status),
+                            total_ms=(time.monotonic() - t0) * 1e3,
+                            pick_ms=self._trace_pick_ms,
+                            forward_ms=self._trace_forward_ms,
+                            summary=self._trace_summary,
+                            started_at=time.time())
+                        out[tracestore.SPANS_HEADER] = \
+                            tracestore.encode_summary(
+                                {"v": 1,
+                                 "trace_id": part["trace_id"],
+                                 "total_ms": part["total_ms"],
+                                 "stages": part["stages"]}).decode()
                     if outer.placement is not None:
                         # placed = inside the tenant's set; degraded =
                         # the set could not take it and any-healthy
@@ -1260,6 +1376,20 @@ class FleetRouter:
             return None
         gen = gens.pop()
         return int(gen) if gen is not None else None
+
+    def tracez(self, model: str | None = None,
+               min_ms: float | None = None,
+               outcome: str | None = None, n: int = 64) -> dict:
+        """The fleet-aggregated trace surface behind ``GET /tracez``:
+        assembled cross-hop traces (tail-first retention), store
+        stats, and the exemplar trace ids currently pinned to the
+        router's e2e latency buckets."""
+        out = self.tracestore.snapshot(model=model, min_ms=min_ms,
+                                       outcome=outcome, n=n)
+        out["store"] = self.tracestore.stats()
+        out["exemplars"] = {"fleet_request_latency_ms":
+                            _fleet_request_hist.exemplars()}
+        return out
 
     def retry_after(self) -> int:
         """Honest come-back time when no backend can take the
@@ -1708,6 +1838,24 @@ def main(argv=None) -> int:
                         "POST /admin/weight and POST "
                         "/admin/placement; defaults to "
                         "$ZNICZ_ADMIN_TOKEN")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   metavar="RATE",
+                   help="fraction of untraced requests the router "
+                        "roots a distributed trace for "
+                        "(deterministic stride, no RNG on the "
+                        "request path; client-supplied traceparent "
+                        "contexts are always honored; "
+                        "docs/observability.md)")
+    p.add_argument("--trace-head-rate", type=float, default=0.05,
+                   metavar="RATE",
+                   help="fraction of HEALTHY assembled traces the "
+                        "store retains (every error/shed/deadline "
+                        "trace and the slowest tail are always kept)")
+    p.add_argument("--trace-tail-fraction", type=float, default=0.05,
+                   metavar="FRAC",
+                   help="slowest fraction of each model's recent "
+                        "latency window that always wins retention "
+                        "(the tail the p99 decomposition needs)")
     p.add_argument("--placement", type=int, default=0, metavar="R",
                    help="placement-aware routing: assign each zoo "
                         "tenant to R backends (weighted rendezvous, "
@@ -1884,6 +2032,9 @@ def main(argv=None) -> int:
             max_hops=args.max_hops, memo_entries=args.memoize,
             memo_mb=args.memoize_mb, placement=engine,
             statestore=store, gray=gray_policy,
+            trace_sample=args.trace_sample,
+            trace_head_rate=args.trace_head_rate,
+            trace_tail_fraction=args.trace_tail_fraction,
             allow_empty=store is not None and args.autoscale)
         if store is not None:
             router.begin_reconcile(args.reconcile_deadline_s)

@@ -2635,6 +2635,247 @@ def _controlplane_scenario(args) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _trace_scenario(args) -> int:
+    """``--scenario trace`` — the distributed-tracing acceptance
+    (docs/observability.md "Distributed tracing"): two REAL ``serve``
+    backends behind a REAL ``route`` process, one backend slowed by an
+    injected ``engine.forward`` latency fault.  A mixed burst (JSON +
+    error + deadline-expired traffic) must leave the router's
+    ``/tracez`` holding assembled cross-hop traces: the slow ones
+    (``?min_ms=``) dominated by the injected stage, EVERY
+    error/deadline trace retained, and each full trace's stage sum
+    within tolerance of its measured e2e wall.  Then ``bench.py serve
+    --trace-breakdown`` (when the repo checkout is present) must print
+    a per-stage decomposition whose p50 stage sum lands within 10% of
+    the e2e p50."""
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import threading
+
+    bad: list[str] = []
+    x = [[0.1, -0.2, 0.3, 0.4]]
+    slow_s = max(0.05, float(args.slow_s))
+    tmp = tempfile.mkdtemp(prefix="znicz_chaos_trace_")
+    procs: list = []
+    router_proc = None
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def wait_healthz(url: str, proc, what: str,
+                     tries: int = 240) -> bool:
+        for _ in range(tries):
+            try:
+                with urllib.request.urlopen(url + "healthz",
+                                            timeout=2) as r:
+                    json.loads(r.read())
+                return True
+            except Exception:
+                if proc is not None and proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    bad.append(f"{what} exited rc={proc.returncode}: "
+                               f"{out[-300:]}")
+                    return False
+                time.sleep(0.25)
+        bad.append(f"{what} never answered /healthz")
+        return False
+
+    try:
+        model = os.path.join(tmp, "demo.znn")
+        _write_demo_znn(model)
+        ports = [free_port(), free_port()]
+        rport = free_port()
+        router_url = f"http://127.0.0.1:{rport}/"
+        slow_plan = json.dumps({"faults": [
+            {"site": "engine.forward", "kind": "latency",
+             "latency_s": slow_s, "p": 1.0}]})
+        for i, port in enumerate(ports):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "znicz_tpu", "serve",
+                 "--model", model, "--port", str(port),
+                 "--max-wait-ms", "1", "--warmup-shape", "4"]
+                + (["--fault-plan", slow_plan] if i == 1 else []),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        for i, port in enumerate(ports):
+            if not wait_healthz(f"http://127.0.0.1:{port}/",
+                                procs[i], f"backend {i}"):
+                return 1
+        # head-rate 1.0: the drill asserts RETENTION CONTENT, so every
+        # assembled trace must land in the store (the sampling-policy
+        # math itself is pinned by tests/test_tracing.py)
+        router_proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "route",
+             "--port", str(rport), "--probe-interval-s", "0.3",
+             "--trace-sample", "1.0", "--trace-head-rate", "1.0"]
+            + [f for i, port in enumerate(ports)
+               for f in ("--backend",
+                         f"http://127.0.0.1:{port}/,name=b{i}")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        if not wait_healthz(router_url, router_proc, "router"):
+            return 1
+
+        # ---- the mixed burst: plain traffic spread over both
+        # backends, plus deliberate error and dead-on-arrival traffic
+        n_ok = 40
+        n_err = 5
+        n_dead = 3
+        codes: list = []
+        walls: dict = {}        # trace_id -> client-measured e2e ms
+
+        def one(hdrs: dict | None = None,
+                body: dict | None = None) -> tuple:
+            t0 = time.monotonic()
+            code, _b, headers = _post(router_url,
+                                      body or {"inputs": x},
+                                      timeout=60, headers=hdrs)
+            return code, headers, (time.monotonic() - t0) * 1e3
+
+        mu = threading.Lock()
+
+        def burst(n: int):
+            for _ in range(n):
+                try:
+                    code, _h, _w = one()
+                except Exception:
+                    code = -1
+                with mu:
+                    codes.append(code)
+
+        threads = [threading.Thread(target=burst, args=(n_ok // 4,),
+                                    daemon=True) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        for _ in range(n_err):     # unknown tenant -> backend 404
+            code, _h, _w = one(hdrs={"X-Model": "no-such-tenant"})
+            codes.append(code)
+        for _ in range(n_dead):    # dead on arrival -> router 504
+            code, _h, _w = one(hdrs={"X-Deadline-Ms": "0.000001"})
+            codes.append(code)
+        if codes.count(-1):
+            bad.append(f"{codes.count(-1)} request(s) hung during "
+                       f"the burst")
+
+        def tracez(qs: str = "") -> dict:
+            with urllib.request.urlopen(router_url + "tracez" + qs,
+                                        timeout=10) as r:
+                return json.loads(r.read())
+
+        # ---- assertion 1: the slow traces exist, are fully
+        # assembled, and the injected hop dominates them
+        min_ms = slow_s * 1e3 * 0.6
+        slow = tracez(f"?min_ms={min_ms:.0f}&outcome=ok")
+        slow_traces = [t for t in slow.get("traces", ())
+                       if t.get("backend") == "b1"]
+        print(json.dumps({"phase": "slow-tail",
+                          "retained_over_min_ms": slow.get("retained"),
+                          "b1_traces": len(slow_traces)}))
+        if not slow_traces:
+            bad.append(f"/tracez?min_ms={min_ms:.0f} holds no trace "
+                       f"from the slowed backend b1")
+        for t in slow_traces:
+            stages = t.get("stages") or {}
+            present = {k: v for k, v in stages.items()
+                       if v is not None}
+            if set(present) != set(slow.get("stages", ())):
+                bad.append(f"slow trace {t.get('trace_id')} is not "
+                           f"fully assembled: {sorted(present)}")
+                break
+            dominant = max(present, key=present.get)
+            if dominant != "engine.forward":
+                bad.append(f"slow trace {t.get('trace_id')} is "
+                           f"dominated by {dominant} "
+                           f"({present[dominant]:.1f}ms), expected "
+                           f"the injected engine.forward")
+                break
+            total = t.get("total_ms") or 0.0
+            sum_ms = sum(present.values())
+            if total > 0 and abs(sum_ms - total) / total > 0.10:
+                bad.append(f"slow trace {t.get('trace_id')}: stage "
+                           f"sum {sum_ms:.1f}ms vs e2e "
+                           f"{total:.1f}ms — off by more than 10%")
+                break
+
+        # ---- assertion 2: every error/deadline trace retained
+        errs = tracez("?outcome=error")
+        deads = tracez("?outcome=deadline")
+        print(json.dumps({"phase": "error-retention",
+                          "errors": errs.get("retained"),
+                          "deadlines": deads.get("retained")}))
+        if (errs.get("retained") or 0) < n_err:
+            bad.append(f"only {errs.get('retained')} error traces "
+                       f"retained, {n_err} were driven")
+        if (deads.get("retained") or 0) < n_dead:
+            bad.append(f"only {deads.get('retained')} deadline traces "
+                       f"retained, {n_dead} were driven")
+        for t in deads.get("traces", ()):
+            if (t.get("stages") or {}).get("net.hop") is not None:
+                bad.append("a dead-on-arrival trace claims a net.hop "
+                           "stage — it never reached a backend")
+                break
+
+        # ---- assertion 3: bench's client-side decomposition agrees
+        # with its own e2e measurement (the repo checkout's bench.py;
+        # absent in an installed-package run — skipped, not failed)
+        bench = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "bench.py")
+        if os.path.exists(bench):
+            out = subprocess.run(
+                [sys.executable, bench, "serve",
+                 "--serve-duration-s", "2", "--serve-clients", "2",
+                 "--trace-breakdown"],
+                capture_output=True, text=True, timeout=300)
+            row = {}
+            for line in out.stdout.strip().splitlines():
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+            br = row.get("trace_breakdown") or {}
+            print(json.dumps({"phase": "bench-breakdown",
+                              "traces": br.get("traces"),
+                              "sum_over_e2e": br.get("sum_over_e2e")}))
+            if not br.get("traces"):
+                bad.append(f"bench --trace-breakdown assembled no "
+                           f"traces: {row.get('error')!r}")
+            elif not 0.9 <= (br.get("sum_over_e2e") or 0.0) <= 1.1:
+                bad.append(f"bench stage sum is off its own e2e by "
+                           f"more than 10%: "
+                           f"sum_over_e2e={br.get('sum_over_e2e')}")
+            missing = [s for s in (slow.get("stages") or ())
+                       if s not in (br.get("stages") or {})]
+            if br.get("traces") and missing:
+                bad.append(f"bench breakdown is missing stages: "
+                           f"{missing}")
+        else:
+            print(json.dumps({"phase": "bench-breakdown",
+                              "skipped": "no repo bench.py"}))
+
+        print(json.dumps({"scenario": "trace", "ok": not bad,
+                          "violations": bad}))
+        return 1 if bad else 0
+    finally:
+        for proc in [router_proc] + procs:
+            if proc is not None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 15.0
+        for proc in [router_proc] + procs:
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1,
+                                      deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _admin_reload_named(url: str, name: str, model: str,
                         timeout: float = 60.0):
     """(status, body) of a synchronous per-model ``POST
@@ -2670,7 +2911,7 @@ def main(argv=None) -> int:
     p.add_argument("--scenario", default="breaker",
                    choices=("breaker", "reload", "promote", "overload",
                             "zoo", "slo", "wire", "fleet", "online",
-                            "placement", "controlplane"),
+                            "placement", "controlplane", "trace"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -2734,7 +2975,16 @@ def main(argv=None) -> int:
                         "reconciling, and a healthz-green/predict-"
                         "sick backend gray-demoted to ~zero effective "
                         "weight (docs/fleet.md 'Control-plane "
-                        "durability')")
+                        "durability'); trace: two serve backends "
+                        "behind a route process, one slowed by an "
+                        "injected engine.forward latency — /tracez"
+                        "?min_ms= must hold fully-assembled cross-hop "
+                        "traces dominated by the injected stage, "
+                        "every error/deadline trace retained, stage "
+                        "sums within 10% of e2e, and bench.py serve "
+                        "--trace-breakdown agreeing with its own e2e "
+                        "(docs/observability.md 'Distributed "
+                        "tracing')")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -2801,6 +3051,8 @@ def main(argv=None) -> int:
         return _placement_scenario(args)
     if args.scenario == "controlplane":
         return _controlplane_scenario(args)
+    if args.scenario == "trace":
+        return _trace_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

@@ -56,7 +56,7 @@ class QueueFull(Exception):
 
 class _Request:
     __slots__ = ("x", "arrival", "deadline", "criticality", "event",
-                 "result", "error", "done_at", "request_id")
+                 "result", "error", "done_at", "request_id", "trace")
 
     def __init__(self, x, deadline, criticality="default"):
         self.x = x
@@ -68,9 +68,11 @@ class _Request:
         self.error = None
         self.done_at = None
         # captured at submit: the dispatch thread re-installs the whole
-        # batch's ids so downstream spans (engine.forward) stay
-        # correlated across the thread hop
+        # batch's ids AND trace contexts so downstream spans
+        # (engine.forward) stay correlated — and trace-tagged — across
+        # the thread hop
         self.request_id = tracing.current_request_id()
+        self.trace = tracing.current_trace()
 
     @property
     def shape_key(self):
@@ -330,8 +332,10 @@ class MicroBatcher:
             self._queue_waits.append(queue_wait_s)
         if self.shedder is not None:
             self.shedder.note_queue_wait(queue_wait_s * 1e3)
+        riders = [r for r in live if r.request_id]
         token = tracing.set_request_ids(
-            [r.request_id for r in live if r.request_id])
+            [r.request_id for r in riders],
+            traces=[r.trace for r in riders])
         # the batch's deadline scope uses the LATEST rider deadline:
         # the forward is still useful while ANY rider can consume the
         # result, and the downstream hops (replica dispatch, engine

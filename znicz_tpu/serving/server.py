@@ -136,7 +136,8 @@ import numpy as np
 
 from ..resilience import overload
 from ..resilience.breaker import EngineUnavailable
-from ..telemetry import buildinfo, debugz, flightrecorder, tracing
+from ..telemetry import (buildinfo, debugz, flightrecorder, tracestore,
+                         tracing)
 from ..telemetry.registry import (PROMETHEUS_CONTENT_TYPE, REGISTRY,
                                   DEFAULT_LATENCY_BUCKETS_MS)
 from . import wire
@@ -149,7 +150,7 @@ from .memo import ResponseCache
 #: anything else pools under "other" (label cardinality stays bounded
 #: no matter what paths clients probe)
 _ROUTES = ("/predict", "/healthz", "/metrics", "/admin/reload",
-           "/admin/placement", "/statusz", "/alertz",
+           "/admin/placement", "/statusz", "/alertz", "/tracez",
            "/debug/flightrecorder", "/debug/threadz")
 
 _wire_requests = REGISTRY.counter(
@@ -377,6 +378,44 @@ def _memo_generation(engine) -> int | None:
     return gens.pop() if len(gens) == 1 else None
 
 
+def _outcome_of(code: int) -> str:
+    """Final HTTP status → the trace-store outcome vocabulary: 504 is
+    a deadline, 429/503 are sheds (quota, queue, brownout, breaker),
+    other 4xx/5xx are errors — the classes the tail-based retention
+    policy never samples out."""
+    code = int(code)
+    if code < 400:
+        return "ok"
+    if code == 504:
+        return "deadline"
+    if code in (429, 503):
+        return "shed"
+    return "error"
+
+
+def _tracez_filters(query: str) -> dict:
+    """``/tracez`` query → snapshot kwargs (shared with the fleet
+    router's handler; junk values are ignored, not 400s — a debug
+    surface should answer with its defaults, not argue)."""
+    out: dict = {}
+    for part in query.split("&"):
+        if part.startswith("model="):
+            out["model"] = part[len("model="):] or None
+        elif part.startswith("outcome="):
+            out["outcome"] = part[len("outcome="):] or None
+        elif part.startswith("min_ms="):
+            try:
+                out["min_ms"] = float(part[len("min_ms="):])
+            except ValueError:
+                pass
+        elif part.startswith("n="):
+            try:
+                out["n"] = max(1, int(part[2:]))
+            except ValueError:
+                pass
+    return out
+
+
 class ServingServer:
     """Engine + batcher behind an HTTP front (start()/stop()/url)."""
 
@@ -395,7 +434,8 @@ class ServingServer:
                  shed_interval_ms: float = 500.0,
                  memo_entries: int = 0,
                  memo_mb: float = 32.0,
-                 capture=None):
+                 capture=None,
+                 trace_sample: float = 0.0):
         knobs = (max_batch, max_wait_ms, max_queue, shed_target_ms)
         if batcher is not None and any(k is not None for k in knobs):
             # silently dropping the knobs would look like they applied
@@ -524,6 +564,16 @@ class ServingServer:
             "POST /predict wall time at the HTTP front (parse + queue "
             "+ batch + forward), milliseconds",
             buckets=DEFAULT_LATENCY_BUCKETS_MS)
+        #: distributed tracing (ISSUE 18): requests arriving with an
+        #: X-Znicz-Trace context tag their span tree with it and
+        #: return the compact span summary in-band (header or wire
+        #: trailer) for the router to assemble; ``trace_sample`` > 0
+        #: additionally ROOTS a deterministic fraction of untraced
+        #: requests locally, so a router-less replica still fills its
+        #: own /tracez
+        self.trace_sample = min(1.0, max(0.0, float(trace_sample)))
+        self.tracestore = tracestore.TraceStore(head_rate=1.0)
+        self._trace_seen = 0
         outer = self
 
         class Handler(FastHTTPHandler):
@@ -538,8 +588,46 @@ class ServingServer:
                 path = path.split("?")[0].rstrip("/")
                 return path if path in _ROUTES else "other"
 
+            def _trace_export(self, body: bytes, ctype: str):
+                """The in-band span summary for the active traced
+                /predict: every span the request collected so far plus
+                a synthetic ``server.predict`` total (the span itself
+                is still open while the response is written — now − t0
+                is its honest duration).  Small summaries ride the
+                X-Znicz-Spans header; big ones spill into the binary
+                wire trailer, or are pruned to the stage spans when
+                the response is JSON."""
+                spans = [s for s in (self._trace_collected or ())
+                         if s._t0 >= self._trace_t0]
+                spd_ms = (time.monotonic() - self._trace_t0) * 1e3
+                summary = tracestore.export_spans(
+                    spans, server_predict_ms=spd_ms)
+                payload = tracestore.encode_summary(summary)
+                if len(payload) > tracestore.MAX_HEADER_BYTES:
+                    if ctype == wire.CONTENT_TYPE:
+                        try:
+                            return (wire.append_trailer(body, payload),
+                                    None)
+                        except wire.WireError:
+                            pass
+                    payload = tracestore.encode_summary(
+                        tracestore.prune_summary(summary))
+                    if len(payload) > tracestore.MAX_HEADER_BYTES:
+                        return body, None
+                return body, payload.decode()
+
             def _send(self, code: int, body: bytes, ctype: str,
                       headers: dict | None = None):
+                ctx = getattr(self, "_trace_ctx", None)
+                if ctx is not None and ctx.sampled:
+                    try:
+                        body, spans_hdr = self._trace_export(body,
+                                                             ctype)
+                    except Exception:
+                        spans_hdr = None    # tracing never fails a
+                    if spans_hdr is not None:  # response it rides on
+                        headers = dict(headers or {})
+                        headers[tracestore.SPANS_HEADER] = spans_hdr
                 self._status_code = code    # flight-record outcome
                 route = self._route()
                 outer._requests.inc(route=route, code=str(code))
@@ -735,6 +823,14 @@ class ServingServer:
                     self._reply(200,
                                 flightrecorder.RECORDER.snapshot(
                                     n, model=model))
+                elif path == "/tracez":
+                    # open like /healthz: trace timings are monitoring
+                    # infrastructure (request ids and stage splits, no
+                    # payloads).  Filters mirror the store snapshot.
+                    query = (self.path.split("?", 1)[1]
+                             if "?" in self.path else "")
+                    self._reply(200, outer.tracez(
+                        **_tracez_filters(query)))
                 elif path == "/debug/threadz":
                     self._reply(200, debugz.threadz())
                 elif path == "/metrics":
@@ -777,17 +873,41 @@ class ServingServer:
                 # dispatch-thread hop
                 rid = tracing.accept_request_id(
                     self.headers.get("X-Request-Id"))
+                # cross-hop trace context (ISSUE 18): the router's
+                # X-Znicz-Trace stamp, or — at a configured sample
+                # rate — a locally-rooted trace so a router-less
+                # replica still decomposes its own tail
+                trace = tracing.parse_traceparent(
+                    self.headers.get(tracestore.TRACE_HEADER))
+                rooted = False
+                if trace is None and outer.trace_sample > 0.0:
+                    outer._trace_seen += 1
+                    stride = max(1, round(1.0 / outer.trace_sample))
+                    if outer._trace_seen % stride == 0:
+                        trace = tracing.TraceContext(
+                            tracing.new_trace_id(),
+                            tracing.new_span_id())
+                        rooted = True
                 t0 = time.monotonic()
+                started_at = time.time()
                 self._status_code = None
                 self._rec_shape = self._rec_rows = None
                 self._rec_error = None
                 self._model_name = None
-                with tracing.collect(rid) as collected:
-                    with tracing.request(rid):
-                        with tracing.span("server.predict"):
-                            self._predict()
+                self._trace_ctx = trace
+                self._trace_t0 = t0
+                try:
+                    with tracing.collect(rid) as collected:
+                        self._trace_collected = collected
+                        with tracing.request(rid, trace=trace):
+                            with tracing.span("server.predict"):
+                                self._predict()
+                finally:
+                    self._trace_ctx = None
+                    self._trace_collected = None
                 dt_ms = (time.monotonic() - t0) * 1e3
-                outer._latency.observe(dt_ms)
+                tracestore.observe_exemplar(outer._latency, dt_ms,
+                                            trace)
                 # flight record, AFTER the handler span closed so the
                 # record's span tree includes it (telemetry.
                 # flightrecorder; served on /debug/flightrecorder)
@@ -803,7 +923,22 @@ class ServingServer:
                     # histogram the SLO engine's latency objectives
                     # judge
                     zoo_mod.note_model_request(self._model_name, code,
-                                               dt_ms)
+                                               dt_ms, trace=trace)
+                if rooted:
+                    # this replica is the trace's root hop: assemble
+                    # its local stage split (no router stages) and
+                    # apply the store's tail-first retention
+                    summary = tracestore.export_spans(
+                        [s for s in collected if s._t0 >= t0],
+                        server_predict_ms=dt_ms)
+                    local = tracestore.assemble(
+                        trace_id=trace.trace_id, request_id=rid,
+                        model=self._model_name or "default",
+                        backend="local", outcome=_outcome_of(code),
+                        total_ms=dt_ms, pick_ms=0.0, forward_ms=dt_ms,
+                        summary=summary, started_at=started_at)
+                    tracestore.observe_stages(local)
+                    outer.tracestore.record(local)
                 # the collector gathered this request's own spans in
                 # O(own spans) — no per-request ring rescan.  The
                 # since=t0 filter still applies: a straggler span of a
@@ -1430,6 +1565,20 @@ class ServingServer:
         component collector) as Prometheus text exposition v0.0.4."""
         return REGISTRY.render_prometheus()
 
+    def tracez(self, model: str | None = None,
+               min_ms: float | None = None,
+               outcome: str | None = None, n: int = 64) -> dict:
+        """``GET /tracez`` body: the tail-sampled store's filtered
+        snapshot, the store's retention stats, and the latency
+        histogram's bucket exemplars (trace ids a dashboard can join
+        back to the stored traces)."""
+        out = self.tracestore.snapshot(model=model, min_ms=min_ms,
+                                       outcome=outcome, n=n)
+        out["store"] = self.tracestore.stats()
+        out["exemplars"] = {"predict_latency_ms":
+                            self._latency.exemplars()}
+        return out
+
     def _collect_components(self):
         """Registry collector: flatten the batcher/engine JSON scalars
         into ``serving_batcher_*`` / ``serving_engine_*`` gauges and
@@ -1738,6 +1887,16 @@ def main(argv=None) -> int:
     p.add_argument("--fault-plan", default=None,
                    help="chaos: install a fault plan (inline JSON or "
                         "@file; see znicz_tpu.resilience.faults)")
+    p.add_argument("--trace-sample", type=float, default=0.0,
+                   metavar="RATE",
+                   help="root a deterministic RATE fraction [0,1] of "
+                        "UNTRACED /predict requests as local "
+                        "distributed traces (GET /tracez); requests "
+                        "arriving with an X-Znicz-Trace context are "
+                        "always honored regardless — the fleet "
+                        "router, not this flag, decides fleet "
+                        "sampling (docs/observability.md "
+                        "'Distributed tracing')")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the serving "
                         "process into DIR (also: $ZNICZ_PROFILE_DIR; "
@@ -1968,7 +2127,8 @@ def main(argv=None) -> int:
                       shed_target_ms=shed_target_ms,
                       memo_entries=args.memoize,
                       memo_mb=args.memoize_mb,
-                      capture=capture)
+                      capture=capture,
+                      trace_sample=args.trace_sample)
         server = (ServingServer(engine, **kwargs) if zoo is None
                   else ServingServer(zoo=zoo, **kwargs))
         server.start()

@@ -18,10 +18,20 @@ magic                 4 bytes  ``b"ZNTW"``
 version               u8       format version, currently 1
 dtype code            u8       see :data:`DTYPE_CODES`
 ndim                  u8       1..8
-reserved              u8       must be 0
+flags                 u8       0, or :data:`TRAILER_FLAG` (0x1)
 dims                  ndim×u32 shape, row-major (C) order
 payload               —        exactly ``prod(dims) * itemsize`` bytes
+trailer               u32+N    only with TRAILER_FLAG: length + bytes
 ====================  =======  =========================================
+
+The **trailer** (flags bit 0, ISSUE 18) is a bounded JSON side channel
+riding AFTER the tensor payload — the spill path for span summaries
+too large for the ``X-Znicz-Spans`` response header.  Byte 7 was the
+always-zero reserved byte through version 1, so every pre-trailer
+decoder already rejects trailer-carrying frames loudly (WireError,
+never silent corruption), and :func:`split_trailer` restores the
+historical byte stream exactly (flags byte zeroed, trailer sliced
+off) before a frame is forwarded to a client that didn't ask for it.
 
 Decoding is a single bounds-checked ``np.frombuffer`` — zero copy, no
 per-element Python objects.  Every malformed input (short header, bad
@@ -62,8 +72,14 @@ DTYPE_CODES = {
 }
 _CODE_BY_DTYPE = {dt: code for code, dt in DTYPE_CODES.items()}
 
-_HEADER = struct.Struct("<4sBBBB")      # magic, version, dtype, ndim, 0
+_HEADER = struct.Struct("<4sBBBB")   # magic, version, dtype, ndim, flags
 MAX_NDIM = 8
+#: flags bit 0: a u32-length-prefixed JSON trailer follows the payload
+TRAILER_FLAG = 0x1
+#: trailer size ceiling — the side channel must stay a footnote to the
+#: tensor bytes, never a second body
+MAX_TRAILER_BYTES = 64 * 1024
+_FLAGS_OFFSET = 7                    # byte index of the flags field
 #: element-count ceiling: a header claiming more rows than any real
 #: request must fail the size check, not attempt an allocation (the
 #: HTTP front's --max-body-mb already bounds the payload; this bounds
@@ -111,9 +127,9 @@ def decode_tensor(buf: bytes) -> np.ndarray:
     if dtype is None:
         raise WireError(f"unknown dtype code {code} (supported: "
                         f"{sorted(DTYPE_CODES)})")
-    if reserved != 0:
-        raise WireError(f"reserved header byte must be 0, got "
-                        f"{reserved}")
+    if reserved not in (0, TRAILER_FLAG):
+        raise WireError(f"unknown flags byte {reserved} (this decoder "
+                        f"speaks 0 and {TRAILER_FLAG})")
     if ndim < 1 or ndim > MAX_NDIM:
         raise WireError(f"ndim must be 1..{MAX_NDIM}, got {ndim}")
     dims_end = _HEADER.size + 4 * ndim
@@ -130,12 +146,82 @@ def decode_tensor(buf: bytes) -> np.ndarray:
     if n == 0:
         raise WireError(f"empty tensor (shape {shape})")
     expected = dims_end + n * dtype.itemsize
-    if len(buf) != expected:
+    if reserved & TRAILER_FLAG:
+        if len(buf) < expected + 4:
+            raise WireError(f"flags claim a trailer but {len(buf)} "
+                            f"bytes end before its length word at "
+                            f"{expected}")
+        (tlen,) = struct.unpack_from("<I", buf, expected)
+        if tlen > MAX_TRAILER_BYTES:
+            raise WireError(f"trailer length {tlen} exceeds the "
+                            f"{MAX_TRAILER_BYTES}-byte bound")
+        if len(buf) != expected + 4 + tlen:
+            raise WireError(f"trailer size mismatch: {len(buf)} bytes,"
+                            f" payload {expected} + trailer {tlen} "
+                            f"needs {expected + 4 + tlen}")
+    elif len(buf) != expected:
         raise WireError(f"payload size mismatch: {len(buf)} bytes, "
                         f"shape {shape} dtype {dtype} needs "
                         f"{expected}")
     return np.frombuffer(buf, dtype=dtype, count=n,
                          offset=dims_end).reshape(shape)
+
+
+def append_trailer(frame: bytes, trailer: bytes) -> bytes:
+    """Attach a bounded side-channel ``trailer`` to an encoded tensor
+    ``frame``: sets :data:`TRAILER_FLAG` and appends ``u32 length +
+    bytes``.  The frame must be flag-free (one trailer per frame)."""
+    if len(trailer) > MAX_TRAILER_BYTES:
+        raise WireError(f"trailer {len(trailer)} bytes exceeds the "
+                        f"{MAX_TRAILER_BYTES}-byte bound")
+    if len(frame) < _HEADER.size or frame[:4] != MAGIC:
+        raise WireError("append_trailer needs an encoded tensor frame")
+    if frame[_FLAGS_OFFSET] != 0:
+        raise WireError(f"frame already carries flags "
+                        f"{frame[_FLAGS_OFFSET]}")
+    out = bytearray(frame)
+    out[_FLAGS_OFFSET] = TRAILER_FLAG
+    out += struct.pack("<I", len(trailer))
+    out += trailer
+    return bytes(out)
+
+
+def split_trailer(buf: bytes):
+    """``(tensor frame with flags cleared, trailer bytes | None)``.
+
+    The forwarding-path inverse of :func:`append_trailer`: the router
+    consumes the side channel and restores the exact byte stream a
+    pre-trailer client expects.  Anything that doesn't parse as a
+    trailer-carrying frame passes through untouched with ``None`` —
+    this function must never fail a response it cannot improve."""
+    if len(buf) < _HEADER.size:
+        return buf, None
+    magic, version, code, ndim, flags = _HEADER.unpack_from(buf)
+    if magic != MAGIC or version != VERSION \
+            or not (flags & TRAILER_FLAG):
+        return buf, None
+    dtype = DTYPE_CODES.get(code)
+    if dtype is None or ndim < 1 or ndim > MAX_NDIM:
+        return buf, None
+    dims_end = _HEADER.size + 4 * ndim
+    if len(buf) < dims_end + 4:
+        return buf, None
+    shape = struct.unpack_from(f"<{ndim}I", buf, _HEADER.size)
+    n = 1
+    for d in shape:
+        n *= int(d)
+        if n > MAX_ELEMENTS:
+            return buf, None
+    payload_end = dims_end + n * dtype.itemsize
+    if len(buf) < payload_end + 4:
+        return buf, None
+    (tlen,) = struct.unpack_from("<I", buf, payload_end)
+    if tlen > MAX_TRAILER_BYTES \
+            or len(buf) != payload_end + 4 + tlen:
+        return buf, None
+    clean = bytearray(buf[:payload_end])
+    clean[_FLAGS_OFFSET] = 0
+    return bytes(clean), bytes(buf[payload_end + 4:])
 
 
 def encode_json_outputs(y: np.ndarray) -> bytes:

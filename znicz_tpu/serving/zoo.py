@@ -114,7 +114,8 @@ _device_ms = REGISTRY.counter(
 
 
 def note_model_request(name: str, code: int,
-                       duration_ms: float | None = None) -> None:
+                       duration_ms: float | None = None,
+                       trace=None) -> None:
     """Count one routed /predict outcome (the HTTP front calls this
     once per request, with the final status and wall latency).
 
@@ -123,10 +124,17 @@ def note_model_request(name: str, code: int,
     make a server that is 503ing a tenant look latency-HEALTHY —
     refusals burn the availability SLO instead (found by the live
     drive: a latency-faulted sheddable tenant's burn rate fell as the
-    shed ladder kicked in)."""
+    shed ladder kicked in).
+
+    ``trace`` (a sampled :class:`~znicz_tpu.telemetry.tracing.
+    TraceContext`, when the request rode one) attaches the trace id as
+    the latency bucket's exemplar — the jump from "this tenant's p99
+    bucket filled" to one concrete assembled trace."""
     _model_requests.inc(model=name, code=str(code))
     if duration_ms is not None and 200 <= int(code) < 300:
-        _model_latency.observe(duration_ms, model=name)
+        from znicz_tpu.telemetry import tracestore
+        tracestore.observe_exemplar(_model_latency, duration_ms,
+                                    trace, model=name)
 
 
 class UnknownModel(KeyError):

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 
 #: default bucket edges (milliseconds) for latency histograms — spans
 #: the sub-ms jit-cache-hit path through cold-compile multi-second tails
@@ -169,8 +170,18 @@ class Histogram:
         self._lock = threading.Lock()
         # label key -> [per-edge counts..., +Inf count, sum]
         self._children: dict[tuple, list[float]] = {}
+        # (label key, bucket index) -> (exemplar id, value, wall stamp)
+        # — last-write-wins per bucket, so memory is bounded by
+        # children × buckets regardless of traffic (the same trade the
+        # bucket counts make); the wall stamp is a display field only,
+        # never duration arithmetic
+        self._exemplars: dict[tuple, tuple[str, float, float]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: str | None = None,
+                **labels) -> None:
+        """Record ``value``; ``exemplar`` (e.g. a trace id) tags the
+        bucket the observation lands in, so a dashboard can jump from
+        a latency bucket to one concrete trace that filled it."""
         v = float(value)
         key = _label_key(labels)
         with self._lock:
@@ -181,10 +192,30 @@ class Histogram:
             for i, edge in enumerate(self.edges):
                 if v <= edge:
                     child[i] += 1
+                    bucket = i
                     break
             else:
                 child[len(self.edges)] += 1
+                bucket = len(self.edges)
             child[-1] += v
+            if exemplar is not None:
+                self._exemplars[(key, bucket)] = (str(exemplar)[:128],
+                                                  v, time.time())
+
+    def exemplars(self) -> dict:
+        """``{"le,label=v": {"exemplar","value","at"}}`` snapshot of
+        the per-bucket exemplars (``/tracez`` joins these back to the
+        stored traces)."""
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        out = {}
+        for (key, bucket), (ex, v, at) in items:
+            le = (_fmt_value(self.edges[bucket])
+                  if bucket < len(self.edges) else "+Inf")
+            tag = ",".join([f"le={le}"]
+                           + [f"{k}={val}" for k, val in key])
+            out[tag] = {"exemplar": ex, "value": v, "at": at}
+        return out
 
     def _cumulative(self, child):
         """(per-le cumulative counts incl. +Inf, total count, sum)."""
@@ -217,6 +248,7 @@ class Histogram:
         with self._lock:
             children = (sorted(self._children.items())
                         or [((), [0.0] * (len(self.edges) + 2))])
+            exemplars = sorted(self._exemplars.items())
             for key, child in children:
                 cum, count, total = self._cumulative(child)
                 for i, edge in enumerate(self.edges):
@@ -229,6 +261,17 @@ class Histogram:
                 lines.append(_fmt_series(f"{self.name}_sum", key, total))
                 lines.append(_fmt_series(f"{self.name}_count", key,
                                          count))
+        # exemplars ride as comments: v0.0.4 has no exemplar syntax and
+        # a bare `# {...}` OpenMetrics suffix would fail strict 0.0.4
+        # parsers (tools/metrics_smoke.sh's included), so the trace-id
+        # attachment stays scrape-safe while remaining greppable
+        for (key, bucket), (ex, v, _at) in exemplars:
+            le = (_fmt_value(self.edges[bucket])
+                  if bucket < len(self.edges) else "+Inf")
+            series = _fmt_series(f"{self.name}_bucket",
+                                 key + (("le", le),), v)
+            lines.append(f"# EXEMPLAR {series.rsplit(' ', 1)[0]} "
+                         f"trace_id={ex} value={_fmt_value(v)}")
         return lines
 
 
