@@ -42,6 +42,11 @@ def pytest_configure(config):
                    "(znicz_tpu.analysis over the whole package; part "
                    "of tier-1, runnable standalone via `pytest -m "
                    "lint`)")
+    config.addinivalue_line(
+        "markers", "san: zsan runtime concurrency-sanitizer lane "
+                   "(znicz_tpu.sanitizer around real lock traffic; "
+                   "part of tier-1, runnable standalone via `pytest "
+                   "-m san` — tools/san_smoke.sh)")
 
 
 @pytest.fixture(autouse=True)

@@ -13,10 +13,13 @@ import textwrap
 
 import pytest
 
-from znicz_tpu.analysis import (Analyzer, DeadlineDisciplineRule,
+from znicz_tpu.analysis import (Analyzer, ConditionWaitPredicateRule,
+                                DeadlineDisciplineRule,
                                 DurationClockRule, HandlerSafetyRule,
                                 JaxHygieneRule, LockDisciplineRule,
-                                MetricDriftRule, SpanNameDriftRule,
+                                LockLeakRule, LockOrderCycleRule,
+                                MetricDriftRule, RetryAfterRule,
+                                SpanNameDriftRule,
                                 UnseededRandomRule, load_baseline,
                                 run_repo, write_baseline)
 from znicz_tpu.analysis import cli as zlint_cli
@@ -1110,6 +1113,407 @@ class TestDeadlineDiscipline:
         assert len(found) == 3          # the .get() finding is muted
 
 
+# -- lock-order cycles (zsan static layer) ---------------------------------
+
+ORDER_BAD = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition()
+
+        def one(self):
+            with self._lock:
+                with self._cond:
+                    pass
+
+        def two(self):
+            with self._cond:
+                with self._lock:
+                    pass
+"""
+
+ORDER_GOOD = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition()
+
+        def one(self):
+            with self._lock:
+                with self._cond:
+                    pass
+
+        def two(self):
+            with self._lock:        # same order everywhere
+                with self._cond:
+                    pass
+"""
+
+# the intra-class fixpoint: `two` acquires via a helper called under
+# the other lock — the cycle is interprocedural
+ORDER_HELPER_BAD = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def one(self):
+            with self._a_lock:
+                self._grab_b()
+
+        def _grab_b(self):
+            with self._b_lock:
+                pass
+
+        def two(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+"""
+
+# the zoo->engine->zoo shape: each class's own order is consistent,
+# the cycle only exists across the two objects
+ORDER_CROSS_BAD = """
+    import threading
+
+    class DemoZoo:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.engine = DemoEngine()
+
+        def touch_resident(self):
+            with self._lock:
+                self.engine.swap_weights()
+
+        def note_pages(self):
+            with self._lock:
+                pass
+
+    class DemoEngine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.zoo = None
+
+        def swap_weights(self):
+            with self._lock:
+                pass
+
+        def observer_fire(self):
+            with self._lock:
+                self.zoo.note_pages()
+"""
+
+# same shape, engine calls back OUTSIDE its lock (the repo's actual
+# discipline: "fire the observer lock-free") — no cycle
+ORDER_CROSS_GOOD = """
+    import threading
+
+    class DemoZoo:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.engine = DemoEngine()
+
+        def touch_resident(self):
+            with self._lock:
+                self.engine.swap_weights()
+
+        def note_pages(self):
+            with self._lock:
+                pass
+
+    class DemoEngine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.zoo = None
+
+        def swap_weights(self):
+            with self._lock:
+                pass
+
+        def observer_fire(self):
+            with self._lock:
+                pass
+            self.zoo.note_pages()       # outside the engine lock
+"""
+
+
+class TestLockOrderCycle:
+    def test_direct_nesting_cycle_fires(self, tmp_path):
+        fs = lint(tmp_path, ORDER_BAD, [LockOrderCycleRule()])
+        assert rules_of(fs) == ["lock-order-cycle"]
+        assert len(fs) == 1             # one finding per cycle
+        assert "_lock" in fs[0].message and "_cond" in fs[0].message
+        # provenance: both edges with path:line
+        assert fs[0].message.count("pkg/mod.py:") == 2
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        assert lint(tmp_path, ORDER_GOOD, [LockOrderCycleRule()]) == []
+
+    def test_interprocedural_cycle_via_helper_fires(self, tmp_path):
+        fs = lint(tmp_path, ORDER_HELPER_BAD, [LockOrderCycleRule()])
+        assert rules_of(fs) == ["lock-order-cycle"]
+
+    def test_cross_object_cycle_fires(self, tmp_path):
+        fs = lint(tmp_path, ORDER_CROSS_BAD, [LockOrderCycleRule()])
+        assert rules_of(fs) == ["lock-order-cycle"]
+        assert "DemoZoo._lock" in fs[0].message
+        assert "DemoEngine._lock" in fs[0].message
+
+    def test_cross_object_lock_free_callback_is_clean(self, tmp_path):
+        assert lint(tmp_path, ORDER_CROSS_GOOD,
+                    [LockOrderCycleRule()]) == []
+
+    def test_reentrant_reacquire_not_a_cycle(self, tmp_path):
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:        # reentrant
+                        pass
+        """
+        assert lint(tmp_path, src, [LockOrderCycleRule()]) == []
+
+
+# -- lock leaks ------------------------------------------------------------
+
+LEAK_BAD = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def work(self):
+            self._lock.acquire()
+            do_something()              # raises -> lock leaked
+            self._lock.release()
+"""
+
+LEAK_GOOD = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def work(self):
+            self._lock.acquire()
+            try:
+                do_something()
+            finally:
+                self._lock.release()
+
+        def probe(self):
+            # the engine-reload idiom: checked non-blocking probe
+            if not self._lock.acquire(blocking=False):
+                raise RuntimeError("busy")
+            try:
+                do_something()
+            finally:
+                self._lock.release()
+
+        def inside_try(self):
+            try:
+                self._lock.acquire()
+                do_something()
+            finally:
+                self._lock.release()
+"""
+
+
+class TestLockLeak:
+    def test_unprotected_acquire_fires(self, tmp_path):
+        fs = lint(tmp_path, LEAK_BAD, [LockLeakRule()])
+        assert rules_of(fs) == ["lock-leak"]
+        assert "self._lock" in fs[0].message
+
+    def test_try_finally_and_probe_idioms_are_clean(self, tmp_path):
+        assert lint(tmp_path, LEAK_GOOD, [LockLeakRule()]) == []
+
+    def test_acquire_then_try_inside_if_is_clean(self, tmp_path):
+        src = """
+            import threading
+            io_lock = threading.Lock()
+
+            def work(flag):
+                if flag:
+                    io_lock.acquire()
+                    try:
+                        pass
+                    finally:
+                        io_lock.release()
+        """
+        assert lint(tmp_path, src, [LockLeakRule()]) == []
+
+    def test_unchecked_probe_fires(self, tmp_path):
+        src = """
+            import threading
+            io_lock = threading.Lock()
+
+            def work():
+                io_lock.acquire(blocking=False)     # result dropped
+                io_lock.release()
+        """
+        fs = lint(tmp_path, src, [LockLeakRule()])
+        assert rules_of(fs) == ["lock-leak"]
+
+
+# -- condition-wait predicates ---------------------------------------------
+
+WAIT_BAD = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.ready = False
+
+        def take(self):
+            with self._cond:
+                if not self.ready:
+                    self._cond.wait(1.0)    # spurious wakeup -> torn
+                return self.ready
+"""
+
+WAIT_GOOD = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.ready = False
+
+        def take(self):
+            with self._cond:
+                while not self.ready:
+                    self._cond.wait(1.0)
+                return self.ready
+
+        def take_pred(self):
+            with self._cond:
+                self._cond.wait_for(lambda: self.ready, 1.0)
+                return self.ready
+"""
+
+
+class TestConditionWaitPredicate:
+    def test_if_guarded_wait_fires(self, tmp_path):
+        fs = lint(tmp_path, WAIT_BAD, [ConditionWaitPredicateRule()])
+        assert rules_of(fs) == ["condition-wait-predicate"]
+        assert "_cond" in fs[0].message
+
+    def test_while_loop_and_wait_for_are_clean(self, tmp_path):
+        assert lint(tmp_path, WAIT_GOOD,
+                    [ConditionWaitPredicateRule()]) == []
+
+    def test_event_wait_not_flagged(self, tmp_path):
+        # Event.wait has no predicate contract; a non-cond-ish
+        # receiver must not fire
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._stop = threading.Event()
+
+                def run(self):
+                    self._stop.wait(1.0)
+        """
+        assert lint(tmp_path, src,
+                    [ConditionWaitPredicateRule()]) == []
+
+
+# -- retry-after discipline ------------------------------------------------
+
+RETRY_BAD = """
+    class Handler:
+        def _predict(self):
+            try:
+                work()
+            except QueueFull as e:
+                self._reply(429, {"error": str(e)})
+            except Exception as e:
+                self._reply(503, {"error": str(e)})
+"""
+
+RETRY_GOOD = """
+    class Handler:
+        def _predict(self):
+            try:
+                work()
+            except QueueFull as e:
+                self._reply(429, {"error": str(e)},
+                            {"Retry-After": str(e.retry_after)})
+            except Exception as e:
+                ra = 1
+                self._reply(503, {"error": str(e)},
+                            {"Retry-After": str(ra)})
+
+        def _passthrough(self, status, data, out):
+            # variable status: the upstream tier enforced the literal
+            out["Retry-After"] = "1"
+            self._send(status, data, "application/json", out)
+
+        def _built_headers(self):
+            h = {}
+            h["Retry-After"] = "2"
+            self._reply(503, {"error": "x"}, h)
+"""
+
+RETRY_REL = "znicz_tpu/serving/mod.py"
+
+
+class TestRetryAfter:
+    def test_refusal_without_header_fires(self, tmp_path):
+        fs = lint(tmp_path, RETRY_BAD, [RetryAfterRule()],
+                  rel=RETRY_REL)
+        assert rules_of(fs) == ["retry-after-discipline"]
+        assert len(fs) == 2             # the 429 and the 503
+
+    def test_header_shapes_are_clean(self, tmp_path):
+        assert lint(tmp_path, RETRY_GOOD, [RetryAfterRule()],
+                    rel=RETRY_REL) == []
+
+    def test_out_of_scope_paths_ignored(self, tmp_path):
+        # the rule pins the serving/ + fleet/ contract only
+        assert lint(tmp_path, RETRY_BAD, [RetryAfterRule()],
+                    rel="znicz_tpu/telemetry/mod.py") == []
+
+    def test_send_error_for_refusal_codes_fires(self, tmp_path):
+        src = """
+            class Handler:
+                def do_GET(self):
+                    self.send_error(503, "nope")
+        """
+        fs = lint(tmp_path, src, [RetryAfterRule()], rel=RETRY_REL)
+        assert rules_of(fs) == ["retry-after-discipline"]
+
+    def test_send_response_with_send_header_is_clean(self, tmp_path):
+        src = """
+            class Handler:
+                def do_GET(self):
+                    self.send_response(429)
+                    self.send_header("Retry-After", "1")
+                    self.end_headers()
+        """
+        assert lint(tmp_path, src, [RetryAfterRule()],
+                    rel=RETRY_REL) == []
+
+
 # -- suppression + baseline ------------------------------------------------
 
 class TestSuppression:
@@ -1253,6 +1657,50 @@ class TestCli:
         with pytest.raises(SystemExit) as exc:
             zlint_cli.main(["pkg/mod.py", "--root", str(tmp_path),
                             "--write-baseline"])
+        assert exc.value.code == 2
+
+    def test_list_rules_covers_every_default_rule(self, capsys):
+        rc = zlint_cli.main(["--list-rules"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for rule in zlint_cli.default_rules():
+            assert rule.id in out, f"--list-rules missing {rule.id}"
+        for rid in ("lock-order-cycle", "lock-leak",
+                    "condition-wait-predicate",
+                    "retry-after-discipline"):
+            assert rid in out
+
+    def test_changed_mode_scopes_to_git_diff(self, tmp_path):
+        """--changed lints only walked files git reports as touched;
+        a dirty file with a finding fails, a clean tree exits 0."""
+        def git(*args):
+            subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                           capture_output=True)
+
+        pkg = tmp_path / "znicz_tpu"
+        pkg.mkdir()
+        (pkg / "clean.py").write_text("x = 1\n")
+        (pkg / "dirty.py").write_text("x = 1\n")
+        git("init", "-q")
+        git("config", "user.email", "t@t")
+        git("config", "user.name", "t")
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        # clean tree: nothing to check
+        rc = zlint_cli.main(["--changed", "--root", str(tmp_path),
+                             "--no-baseline"])
+        assert rc == 0
+        # dirty a file with a finding; --changed must catch it
+        (pkg / "dirty.py").write_text(textwrap.dedent(LOCKED_BAD))
+        assert zlint_cli.changed_paths(str(tmp_path)) \
+            == ["znicz_tpu/dirty.py"]
+        rc = zlint_cli.main(["--changed", "--root", str(tmp_path),
+                             "--no-baseline"])
+        assert rc == 1
+        # paths and --changed are mutually exclusive
+        with pytest.raises(SystemExit) as exc:
+            zlint_cli.main(["znicz_tpu/dirty.py", "--changed",
+                            "--root", str(tmp_path)])
         assert exc.value.code == 2
 
 

@@ -1,0 +1,34 @@
+#!/bin/bash
+# zsan runtime-sanitizer smoke (ISSUE 19 acceptance, operator-runnable):
+#
+#   1. `pytest -m san tests/test_sanitizer.py` — the fixture lane:
+#      a seeded two-lock inversion IS detected (with both acquisition
+#      stacks in the report), consistent-order code runs clean, RLock
+#      reentrancy is not a false positive, the report survives thread
+#      death, and real package concurrency (batcher dispatch, zoo
+#      bursts) runs sanitized with zero inversions.
+#
+#   2. `python -m znicz_tpu chaos --scenario san` — the full
+#      multi-tenant zoo drill re-run under the sanitizer: client
+#      bursts, budget evictions, a latency fault, a mid-burst reload
+#      and the page-in observer all interleave while every package
+#      lock is tracked.  Asserted: the drill still passes, the
+#      observed acquisition graph is non-trivial, and it contains
+#      ZERO lock-order inversions.
+#
+# The static half of zsan (lock-order-cycle / lock-leak /
+# condition-wait-predicate / retry-after-discipline) runs in
+# tools/lint.sh; this script is the runtime half.
+#
+# Usage:  bash tools/san_smoke.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: pytest -m san (fixture lane) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_sanitizer.py -m san -q \
+    -p no:cacheprovider || exit 1
+
+echo "== phase 2: chaos --scenario san (sanitized zoo drill) =="
+JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario san || exit 1
+
+echo "PASS"

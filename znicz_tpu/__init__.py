@@ -13,6 +13,23 @@ Layering (mirrors SURVEY.md §1, redesigned JAX/XLA/Pallas-first):
 * ``models/``   — runnable samples (MNIST, CIFAR-10, AlexNet, AE, Kohonen).
 """
 
+import os as _os
+
+if _os.environ.get("ZNICZ_SAN") == "1":
+    # zsan runtime layer (docs/static_analysis.md): must engage BEFORE
+    # any package module runs, so every module-level and instance lock
+    # the package creates is a tracked wrapper.  The report prints at
+    # exit; the san test lane and chaos scenario gate on it.
+    from . import sanitizer as _sanitizer
+    _sanitizer.enable()
+
+    import atexit as _atexit
+    import sys as _sys
+
+    @_atexit.register
+    def _san_report():
+        print(_sanitizer.format_report(), file=_sys.stderr)
+
 from .accelerated_units import AcceleratedUnit, AcceleratedWorkflow
 from .backends import Device, NumpyDevice, XLADevice
 from .config import Config, root

@@ -94,7 +94,7 @@ Examples::
         # backends with weighted traffic splitting and fleet-wide
         # rollback on a mid-walk burn-rate breach (fleet.rollout)
     python -m znicz_tpu chaos \
-            [--scenario reload|promote|overload|zoo|slo|wire|fleet|placement|controlplane]
+            [--scenario reload|promote|overload|zoo|slo|wire|fleet|placement|controlplane|san]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
         # --scenario reload drills corrupt-artifact rollback;
@@ -121,7 +121,12 @@ Examples::
         # weights/pins restored, children re-adopted with zero
         # orphans/double-boots, 503+Retry-After while reconciling, a
         # healthz-green/predict-sick backend gray-demoted to ~zero
-        # effective weight; docs/fleet.md)
+        # effective weight; docs/fleet.md);
+        # --scenario san replays the zoo drill with every package lock
+        # wrapped by the runtime concurrency sanitizer — fails on any
+        # observed lock-order inversion or an empty acquisition graph
+        # (znicz_tpu.sanitizer; docs/static_analysis.md "Runtime
+        # sanitizer"; tools/san_smoke.sh)
     python -m znicz_tpu promote --candidates DIR --url http://host:port/
         # closed-loop promotion controller sidecar: watch a trainer's
         # export directory, verify + canary-deploy each new candidate
@@ -141,10 +146,13 @@ Examples::
         # a held-back slice, export only blessed candidates — which
         # `promote [--fleet]` then canaries/watches/rolls out with
         # zero new promotion code (docs/online.md)
-    python -m znicz_tpu lint [--format json|text] [--baseline ...]
+    python -m znicz_tpu lint [--format json|text] [--baseline ...] \
+            [--changed] [--list-rules]
         # zlint: AST-based concurrency & JAX-hygiene analyzer over the
         # package (znicz_tpu.analysis; docs/static_analysis.md); exits
-        # non-zero on new findings — tier-1 gates on it (pytest -m lint)
+        # non-zero on new findings — tier-1 gates on it (pytest -m lint);
+        # --changed scopes the per-module pass to git-modified files
+        # (repo-wide rules like lock-order-cycle still see everything)
 """
 
 from __future__ import annotations

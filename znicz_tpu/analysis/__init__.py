@@ -21,7 +21,14 @@ PRs grew (serving, resilience, telemetry, elastic):
   ``Event.wait`` / ``Condition.wait`` / bare ``join`` / socket
   connects without timeout) on serving dispatch paths, where every
   wait must be bounded so end-to-end deadlines can fire
-  (:mod:`.deadlines`).
+  (:mod:`.deadlines`);
+* ``lock-order-cycle`` / ``lock-leak`` / ``condition-wait-predicate``
+  — the zsan static layer: cycles in the interprocedural lock-
+  acquisition-order graph, ``.acquire()`` without a guaranteed
+  release, and ``cond.wait()`` outside a ``while`` predicate loop
+  (:mod:`.concurrency`; runtime twin: :mod:`znicz_tpu.sanitizer`);
+* ``retry-after-discipline`` — 429/503/504 refusals in serving/ +
+  fleet/ without a ``Retry-After`` header (:mod:`.retry_after`).
 
 Run it: ``python -m znicz_tpu lint`` (or ``tools/lint.sh``); gate:
 ``pytest -m lint``.  Suppress: ``# zlint: disable=RULE`` inline, or a
@@ -30,21 +37,25 @@ justified entry in ``tools/zlint_baseline.json``.  Full docs:
 """
 
 from .clocks import DurationClockRule
+from .concurrency import (ConditionWaitPredicateRule, LockLeakRule,
+                          LockOrderCycleRule)
 from .core import (Analyzer, Finding, ModuleInfo, RepoRule, Rule,
                    load_baseline, write_baseline)
-from .cli import default_rules, main, run_repo
+from .cli import changed_paths, default_rules, main, run_repo
 from .deadlines import DeadlineDisciplineRule
 from .handlers import HandlerSafetyRule
 from .jaxrules import JaxHygieneRule, UnseededRandomRule
 from .locks import LockDisciplineRule
 from .metric_drift import MetricDriftRule
+from .retry_after import RetryAfterRule
 from .span_drift import SpanNameDriftRule
 
 __all__ = [
     "Analyzer", "Finding", "ModuleInfo", "Rule", "RepoRule",
     "load_baseline", "write_baseline", "default_rules", "run_repo",
-    "main", "LockDisciplineRule", "JaxHygieneRule",
+    "changed_paths", "main", "LockDisciplineRule", "JaxHygieneRule",
     "UnseededRandomRule", "HandlerSafetyRule", "MetricDriftRule",
     "DurationClockRule", "DeadlineDisciplineRule",
-    "SpanNameDriftRule",
+    "SpanNameDriftRule", "LockOrderCycleRule", "LockLeakRule",
+    "ConditionWaitPredicateRule", "RetryAfterRule",
 ]

@@ -16,12 +16,15 @@ import os
 import sys
 
 from .clocks import DurationClockRule
-from .core import Analyzer, default_root, write_baseline
+from .concurrency import (ConditionWaitPredicateRule, LockLeakRule,
+                          LockOrderCycleRule)
+from .core import Analyzer, default_root, iter_py_files, write_baseline
 from .deadlines import DeadlineDisciplineRule
 from .handlers import HandlerSafetyRule
 from .jaxrules import JaxHygieneRule, UnseededRandomRule
 from .locks import LockDisciplineRule
 from .metric_drift import MetricDriftRule
+from .retry_after import RetryAfterRule
 from .span_drift import SpanNameDriftRule
 
 DEFAULT_BASELINE = "tools/zlint_baseline.json"
@@ -31,7 +34,30 @@ def default_rules() -> list:
     return [LockDisciplineRule(), JaxHygieneRule(),
             UnseededRandomRule(), HandlerSafetyRule(),
             MetricDriftRule(), DurationClockRule(),
-            DeadlineDisciplineRule(), SpanNameDriftRule()]
+            DeadlineDisciplineRule(), SpanNameDriftRule(),
+            LockOrderCycleRule(), LockLeakRule(),
+            ConditionWaitPredicateRule(), RetryAfterRule()]
+
+
+def changed_paths(root: str) -> list:
+    """Root-relative walked .py files touched since HEAD (unstaged,
+    staged, and untracked) — the ``lint --changed`` pre-commit set."""
+    import subprocess
+    out = []
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return []
+        if res.returncode != 0:
+            return []
+        out.extend(line.strip() for line in res.stdout.splitlines()
+                   if line.strip())
+    walked = set(iter_py_files(root))
+    return sorted({p.replace(os.sep, "/") for p in out}
+                  & walked)
 
 
 def run_repo(root: str | None = None, baseline: str | None = None,
@@ -66,6 +92,11 @@ def main(argv=None) -> int:
                    help="regenerate the baseline from current findings "
                         "and exit 0")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--changed", action="store_true",
+                   help="check only walked files changed since HEAD "
+                        "(git diff + untracked) — the fast pre-commit "
+                        "loop; repo-wide rules still see the full "
+                        "module universe")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -76,14 +107,22 @@ def main(argv=None) -> int:
                 print(f"{rid:20s} {rule.doc}")
         return 0
 
-    if args.write_baseline and args.paths:
+    if args.write_baseline and (args.paths or args.changed):
         # a subset's findings are a subset — regenerating the baseline
         # from them would silently drop every entry for unanalyzed
         # files (and their hand-written notes with them)
         p.error("--write-baseline requires a full run "
-                "(no positional paths)")
+                "(no positional paths / --changed)")
+    if args.changed and args.paths:
+        p.error("--changed and positional paths are mutually "
+                "exclusive")
 
     root = args.root or default_root()
+    if args.changed:
+        args.paths = changed_paths(root)
+        if not args.paths:
+            print("zlint: no changed files to check")
+            return 0
     findings, new, an = run_repo(
         root=root,
         baseline=None if args.no_baseline else args.baseline,

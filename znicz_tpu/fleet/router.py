@@ -1054,9 +1054,14 @@ class FleetRouter:
                         # answer nobody is waiting for
                         overload.note_deadline("router")
                         self._rec_error = "deadline exceeded at router"
+                        # Retry-After 0: the budget was the client's —
+                        # an immediate retry with a fresh deadline is
+                        # fine, the refusal just must not be header-
+                        # silent (the 429/503/504 contract)
                         self._reply(504, {
                             "error": "deadline exceeded at the "
-                                     "router hop"})
+                                     "router hop"},
+                            {"Retry-After": "0"})
                         return
                     t_p = time.monotonic()
                     backend, pick_mode = outer.pick_for(model,

@@ -1208,6 +1208,58 @@ def _zoo_scenario(args) -> int:
     return 1 if bad else 0
 
 
+def _san_scenario(args) -> int:
+    """``--scenario san`` — the zoo drill, sanitized
+    (tools/san_smoke.sh): enable the zsan runtime layer
+    (:mod:`znicz_tpu.sanitizer`) and re-run the full multi-tenant
+    ``zoo`` scenario under it.  Every lock the drill's server / zoo /
+    engines / batchers create is a tracked wrapper; the observed
+    acquisition graph is printed at the end.  Asserted:
+
+    * the zoo drill itself still passes (the sanitizer must not change
+      behaviour, only watch it);
+    * ZERO lock-order inversions across the whole drill — client
+      bursts, budget evictions, the latency fault, the mid-burst
+      reload and the page-in observer all interleave, so a cycle in
+      the real lock web has every chance to show up here;
+    * the acquisition graph is non-trivial (edges were actually
+      observed — a zero-edge run means the instrumentation fell off,
+      not that the code is clean).
+
+    Long holds are reported but not fatal: the drill deliberately
+    pays cold jit compiles under the generation lock.
+    """
+    from .. import sanitizer
+
+    if sanitizer.enabled():
+        # ZNICZ_SAN=1 got there first: ride the existing state
+        sanitizer.reset()
+        rc = _zoo_scenario(args)
+        rep = sanitizer.report()
+    else:
+        sanitizer.enable()
+        try:
+            rc = _zoo_scenario(args)
+        finally:
+            rep = sanitizer.disable()
+    bad = []
+    if rc != 0:
+        bad.append(f"sanitized zoo drill failed (rc {rc})")
+    if rep["inversions"]:
+        bad.append(f"{len(rep['inversions'])} lock-order "
+                   f"inversion(s) observed")
+    if rep["edges"] == 0:
+        bad.append("no acquisition edges observed — sanitizer "
+                   "instrumentation is not engaged")
+    print(sanitizer.format_report(rep))
+    print(json.dumps({
+        "scenario": "san", "ok": not bad, "violations": bad,
+        "acquires": rep["acquires"], "edges": rep["edges"],
+        "inversions": len(rep["inversions"]),
+        "long_holds": len(rep["long_holds"])}))
+    return 1 if bad else 0
+
+
 def _wire_scenario(args) -> int:
     """``--scenario wire`` — the request-path wire-protocol acceptance
     (docs/serving.md "Wire protocol"): ONE server serving the demo
@@ -2911,7 +2963,8 @@ def main(argv=None) -> int:
     p.add_argument("--scenario", default="breaker",
                    choices=("breaker", "reload", "promote", "overload",
                             "zoo", "slo", "wire", "fleet", "online",
-                            "placement", "controlplane", "trace"),
+                            "placement", "controlplane", "trace",
+                            "san"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -3053,6 +3106,8 @@ def main(argv=None) -> int:
         return _controlplane_scenario(args)
     if args.scenario == "trace":
         return _trace_scenario(args)
+    if args.scenario == "san":
+        return _san_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

@@ -1224,8 +1224,15 @@ class ServingServer:
                                       "retry_after_s": e.retry_after},
                                 {"Retry-After": str(e.retry_after)})
                 except DeadlineExceeded as e:
+                    # the deadline died in the queue: the honest
+                    # come-back time is the routed tenant's backlog —
+                    # a fresh deadline submitted into the same backlog
+                    # would die the same way
                     self._rec_error = str(e)
-                    self._reply(504, {"error": str(e)})
+                    ra = entry.batcher.retry_after()
+                    self._reply(504, {"error": str(e),
+                                      "retry_after_s": ra},
+                                {"Retry-After": str(ra)})
                 except TimeoutError as e:
                     # server-side wait timeout (e.g. a slow first jit
                     # compile): retryable, and NOT an engine failure.
@@ -1255,8 +1262,11 @@ class ServingServer:
                     self._rec_error = "".join(
                         traceback.format_exception(
                             type(e), e, e.__traceback__))
+                    ra = entry.batcher.retry_after()
                     self._reply(503, {"error": f"inference failed: "
-                                               f"{e!r}"[:300]})
+                                               f"{e!r}"[:300],
+                                      "retry_after_s": ra},
+                                {"Retry-After": str(ra)})
                 else:
                     y = np.asarray(y)
                     if not np.isfinite(y).all():
