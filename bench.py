@@ -623,6 +623,8 @@ def bench_serve(args) -> int:
 
     n_fleet = max(0, getattr(args, "fleet", 0))
     place = bool(getattr(args, "placement", False))
+    ext_urls = [u if u.endswith("/") else u + "/"
+                for u in (getattr(args, "router_url", None) or [])]
     result = {"metric": "serve_requests_per_sec_per_core",
               "value": None, "unit": "req/s/core",
               "vs_baseline": None}
@@ -634,10 +636,18 @@ def bench_serve(args) -> int:
         result["error"] = "--placement needs --fleet N (it shards a " \
                           "zoo over a fleet)"
         return _emit(result)
+    if ext_urls and (n_fleet or place):
+        result["error"] = "--router-url drives an EXISTING fleet; " \
+                          "it excludes --fleet/--placement"
+        return _emit(result)
     try:
         model = args.serve_model
         width = args.serve_width
-        if model is None:
+        if ext_urls:
+            # external mode boots nothing — the payload just has to
+            # match the EXISTING servers' model (demo width default)
+            width = width or 4
+        elif model is None:
             from znicz_tpu.resilience.chaos import _write_demo_znn
             model = os.path.join(tmp, "demo.znn")
             width = 4
@@ -692,7 +702,36 @@ def bench_serve(args) -> int:
             result["error"] = f"{what} never answered /healthz"
             return None
 
-        if n_fleet:
+        if ext_urls:
+            # external mode: drive EXISTING router(s) instead of
+            # booting a fleet here — several urls name an HA pair
+            # (primary + hot standbys) and the clients fail over
+            # between them on transport error (docs/fleet.md "Router
+            # high availability")
+            url = None
+            health = None
+            deadline = time.monotonic() + 30
+            while health is None and time.monotonic() < deadline:
+                for u in ext_urls:
+                    try:
+                        with urllib.request.urlopen(u + "healthz",
+                                                    timeout=2) as r:
+                            health = json.loads(r.read())
+                            url = u
+                            break
+                    except Exception:
+                        continue
+                if health is None:
+                    time.sleep(0.5)
+            if health is None:
+                result["error"] = ("no router of "
+                                   f"{', '.join(ext_urls)} answered "
+                                   "/healthz")
+                return _emit(result)
+            # put the answering router first so the warm lap and the
+            # clients start against a live frontend
+            ext_urls = [url] + [u for u in ext_urls if u != url]
+        elif n_fleet:
             # fleet mode: N serve backends behind a REAL route
             # process — the router's forwarding overhead is IN the
             # measurement, which is the point (the fleetxN trajectory
@@ -855,8 +894,13 @@ def bench_serve(args) -> int:
                     pass          # a torn summary never fails a bench
             return r.status
 
-        warm = http.client.HTTPConnection("127.0.0.1", port,
-                                          timeout=60)
+        if ext_urls:
+            from urllib.parse import urlsplit
+            targets = [((urlsplit(u).hostname or "127.0.0.1"),
+                        (urlsplit(u).port or 80)) for u in ext_urls]
+        else:
+            targets = [("127.0.0.1", port)]
+        warm = http.client.HTTPConnection(*targets[0], timeout=60)
         if place:                     # one warm lap per tenant
             for name in tenants:
                 post_conn(warm, tenant_bodies[name],
@@ -873,9 +917,18 @@ def bench_serve(args) -> int:
             # one persistent connection per closed-loop client — the
             # HTTP/1.1 keep-alive contract is part of what's measured;
             # a dropped connection re-opens (that request's latency
-            # carries the reconnect, like a real client's would)
-            conn = http.client.HTTPConnection("127.0.0.1", port,
-                                              timeout=30)
+            # carries the reconnect, like a real client's would).
+            # With several --router-url targets (an HA pair) a
+            # transport error ALSO rotates to the next router; an HTTP
+            # answer never does — a 503 + Retry-After refusal during a
+            # takeover is an answer, and shows up in the codes map
+            active = 0
+
+            def connect():
+                return http.client.HTTPConnection(
+                    *targets[active % len(targets)], timeout=30)
+
+            conn = connect()
             i = ci
             while not stop.is_set():
                 if place:
@@ -891,8 +944,8 @@ def bench_serve(args) -> int:
                     code = post_conn(conn, body, hdrs)
                 except Exception:
                     conn.close()
-                    conn = http.client.HTTPConnection("127.0.0.1",
-                                                      port, timeout=30)
+                    active += 1
+                    conn = connect()
                     code = -1
                 dt_ms = (time.monotonic() - t0) * 1e3
                 with mu:
@@ -905,6 +958,10 @@ def bench_serve(args) -> int:
             # their ledgers (the router itself runs no device code);
             # a zoo backend's ledger is per-tenant, so placement mode
             # sums the healthz model rows instead of the engine total
+            if ext_urls:
+                # external routers: the backends aren't ours to
+                # scrape — device-ms is reported as 0, not guessed
+                return 0.0
             if place:
                 return sum(_scrape_zoo_device_ms(u)
                            for u in backend_urls)
@@ -942,9 +999,10 @@ def bench_serve(args) -> int:
                 zoo_total = max(zoo_total, sum(
                     int(row.get("weight_bytes") or 0)
                     for row in snap.get("models") or []))
-        for p_ in [proc] + fleet_procs:
+        own_procs = ([proc] if proc is not None else []) + fleet_procs
+        for p_ in own_procs:
             p_.send_signal(signal.SIGINT)
-        for p_ in [proc] + fleet_procs:
+        for p_ in own_procs:
             try:
                 p_.wait(timeout=15)
             except subprocess.TimeoutExpired:
@@ -1002,7 +1060,8 @@ def bench_serve(args) -> int:
         # the topology is part of a serve measurement's identity,
         # exactly like the mesh scheme on the training side: fleetxN
         # rows only pair with fleetxN rows in decide_levers
-        result["sharding"] = (f"fleetx{n_fleet}+place" if place
+        result["sharding"] = (f"externalx{len(ext_urls)}" if ext_urls
+                              else f"fleetx{n_fleet}+place" if place
                               else f"fleetx{n_fleet}" if n_fleet
                               else "1x1")
         if n_fleet:
@@ -1874,6 +1933,17 @@ def main(argv=None) -> int:
                         "backends), so the fabric's forwarding "
                         "overhead vs the single-process rows is a "
                         "measured trajectory (docs/fleet.md)")
+    p.add_argument("--router-url", action="append", default=[],
+                   metavar="URL",
+                   help="serve bench: drive EXISTING router(s) "
+                        "instead of booting a fleet — repeatable to "
+                        "name an HA pair (primary + hot standbys): "
+                        "clients fail over to the next url on "
+                        "transport error, a 503 + Retry-After "
+                        "takeover refusal stays an answer; the row "
+                        "stamps sharding='externalxN' and device-ms "
+                        "0 (the backends aren't ours to scrape) "
+                        "(docs/fleet.md 'Router high availability')")
     p.add_argument("--placement", action="store_true",
                    help="serve bench with --fleet N: backends serve "
                         "the demo ZOO and the router runs "

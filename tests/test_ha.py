@@ -1,0 +1,583 @@
+"""Highly-available fleet front (fleet.ha): leased leadership, hot
+standby, split-brain fencing.
+
+Pins the ISSUE 20 robustness contracts at unit scale (the end-to-end
+failover drill is ``chaos --scenario ha`` / tools/ha_smoke.sh):
+
+* lease lifecycle — first acquire is epoch 1, a live holder blocks a
+  contender, step-down/TTL-expiry/dead-holder-pid all hand over with
+  exactly one epoch bump, junk lease files are acquirable not fatal;
+* epoch fencing — a deposed writer's ``StateStore.append`` raises
+  :class:`FencedError` without touching the journal, records are
+  stamped with the writer's epoch, and the autoscaler refuses
+  boot/drain while fenced (poking the coordinator to demote);
+* honest ENOSPC degradation — the ``statestore.append`` fault site:
+  an unwritable journal refuses admin mutations with
+  503 + Retry-After while /healthz and /predict keep answering, and
+  the PR 15 capture tap stays FAIL-OPEN under the very same fault;
+* crash-loop fail-fast — N immediate boot failures inside the window
+  stop the boot loop for good, ElasticRunner-style;
+* the standby gate — a hot standby answers /predict and admin
+  mutations 503 + Retry-After (with the primary's url as a failover
+  hint), and the coordinator's role machine promotes/demotes through
+  :meth:`HACoordinator.step` with the journal tailer's warm state;
+* zlint scope — deadline-discipline and retry-after-discipline
+  patrol ``fleet/ha.py``-shaped modules.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from znicz_tpu.analysis import (Analyzer, DeadlineDisciplineRule,
+                                RetryAfterRule)
+from znicz_tpu.fleet import (Autoscaler, Backend, FencedError,
+                             FleetRouter, HACoordinator, JournalTailer,
+                             LeaseManager, StateStore, read_lease,
+                             write_lease)
+from znicz_tpu.fleet import ha as ha_mod
+from znicz_tpu.resilience import faults
+from znicz_tpu.resilience.breaker import CircuitBreaker
+
+
+# -- lease lifecycle ---------------------------------------------------------
+
+class TestLease:
+    def test_first_acquire_is_epoch_1_with_identity(self, tmp_path):
+        lm = LeaseManager(str(tmp_path), holder="a",
+                          url="http://127.0.0.1:1/", ttl_s=5.0)
+        assert lm.acquire() is True
+        assert lm.epoch == 1
+        rec = read_lease(str(tmp_path))
+        assert rec["epoch"] == 1 and rec["holder"] == "a"
+        assert rec["pid"] == os.getpid()
+        assert rec["identity"] is not None
+        assert rec["url"] == "http://127.0.0.1:1/"
+        assert float(rec["ttl_s"]) == 5.0
+
+    def test_live_holder_blocks_a_contender(self, tmp_path):
+        a = LeaseManager(str(tmp_path), holder="a", ttl_s=60.0)
+        b = LeaseManager(str(tmp_path), holder="b", ttl_s=60.0)
+        assert a.acquire() is True
+        assert b.acquire() is False
+        assert b.epoch is None
+        assert b.observed_epoch() == 1
+
+    def test_reacquire_own_lease_keeps_epoch(self, tmp_path):
+        a = LeaseManager(str(tmp_path), holder="a", ttl_s=60.0)
+        assert a.acquire() and a.acquire()
+        assert a.epoch == 1
+
+    def test_step_down_hands_over_with_one_epoch_bump(self, tmp_path):
+        a = LeaseManager(str(tmp_path), holder="a", ttl_s=60.0)
+        b = LeaseManager(str(tmp_path), holder="b", ttl_s=60.0)
+        assert a.acquire()
+        a.step_down()
+        assert a.epoch is None
+        assert b.acquire() is True
+        assert b.epoch == 2
+        # the deposed holder cannot renew against the newer epoch
+        assert a.renew() is False
+
+    def test_ttl_expiry_allows_takeover(self, tmp_path):
+        clock = [1000.0]
+        a = LeaseManager(str(tmp_path), holder="a", ttl_s=5.0,
+                         clock=lambda: clock[0])
+        b = LeaseManager(str(tmp_path), holder="b", ttl_s=5.0,
+                         clock=lambda: clock[0])
+        assert a.acquire()
+        assert b.acquire() is False
+        clock[0] += 6.0                 # past the TTL, holder silent
+        assert b.acquire() is True and b.epoch == 2
+
+    def test_dead_holder_pid_acquirable_before_ttl(self, tmp_path):
+        """The same-host fast path: a SIGKILLed primary's lease is
+        acquirable IMMEDIATELY — the recorded pid is gone, no TTL
+        wait (what makes the chaos drill's takeover sub-second)."""
+        # a fresh (not expired) lease held by a pid that cannot exist
+        write_lease(str(tmp_path), {
+            "epoch": 3, "holder": "dead", "url": None,
+            "pid": 2 ** 22 + 17, "identity": "424242",
+            "acquired_ts": time.time(), "renewed_ts": time.time(),
+            "ttl_s": 3600.0})
+        b = LeaseManager(str(tmp_path), holder="b", ttl_s=3600.0)
+        assert b.acquire() is True
+        assert b.epoch == 4             # exactly one bump
+
+    def test_renew_detects_deposition(self, tmp_path):
+        a = LeaseManager(str(tmp_path), holder="a", ttl_s=60.0)
+        assert a.acquire()
+        assert a.renew() is True
+        # a peer force-writes a newer epoch (partition heals and the
+        # other side won): renew must refuse to touch it
+        write_lease(str(tmp_path), {
+            "epoch": 2, "holder": "b", "url": None, "pid": 1,
+            "identity": None, "acquired_ts": time.time(),
+            "renewed_ts": time.time(), "ttl_s": 60.0})
+        assert a.renew() is False and a.epoch is None
+
+    def test_junk_lease_file_is_acquirable_not_fatal(self, tmp_path):
+        os.makedirs(str(tmp_path), exist_ok=True)
+        with open(os.path.join(str(tmp_path), ha_mod.LEASE_NAME),
+                  "w") as fh:
+            fh.write("NOT JSON {{{")
+        assert read_lease(str(tmp_path)) is None
+        b = LeaseManager(str(tmp_path), holder="b", ttl_s=5.0)
+        assert b.acquire() is True and b.epoch == 1
+
+    def test_zero_ttl_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            LeaseManager(str(tmp_path), holder="a", ttl_s=0.0)
+
+
+class _MiniRouter:
+    """The minimal router surface Autoscaler.status()/_scale_out need."""
+
+    def __init__(self, names=()):
+        self.names = list(names)
+
+    def backend_count(self):
+        return len(self.names)
+
+    def add_backend(self, backend):
+        self.names.append(backend.name)
+
+
+# -- epoch fencing -----------------------------------------------------------
+
+class TestEpochFencing:
+    def test_fenced_append_raises_without_touching_journal(
+            self, tmp_path):
+        store = StateStore(str(tmp_path))
+        store.append("weight", backend="b0", weight=1.0)
+        store.set_writer_epoch(1, fence=lambda: 2)
+        with pytest.raises(FencedError) as ei:
+            store.append("weight", backend="b0", weight=9.0)
+        assert ei.value.action == "weight"
+        assert ei.value.writer_epoch == 1
+        assert ei.value.authoritative_epoch == 2
+        # the journal never saw the refused mutation
+        assert len(store.entries()) == 1
+        assert store.replay().weights == {"b0": 1.0}
+
+    def test_records_stamped_with_writer_epoch(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        store.set_writer_epoch(3, fence=lambda: 3)
+        store.append("weight", backend="b0", weight=2.0)
+        store.append("lease", holder="x", url=None)
+        [w, lease] = store.entries()
+        assert w["epoch"] == 3 and lease["epoch"] == 3
+        # the lease record is the replayed epoch high-water mark
+        st = store.replay()
+        assert st.epoch == 3 and st.weights == {"b0": 2.0}
+        assert store.status()["epoch"] == 3
+
+    def test_unfenced_store_accepts_and_does_not_stamp(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        store.append("weight", backend="b0", weight=2.0)
+        assert "epoch" not in store.entries()[0]
+        assert store.fenced() is False
+
+    def test_unreadable_fence_does_not_wedge_the_primary(
+            self, tmp_path):
+        store = StateStore(str(tmp_path))
+
+        def broken_fence():
+            raise OSError("lease dir gone")
+
+        store.set_writer_epoch(1, fence=broken_fence)
+        assert store.authoritative_epoch() is None
+        assert store.fenced() is False
+        store.append("weight", backend="b0", weight=2.0)   # serves on
+
+    def test_autoscaler_refuses_boot_and_drain_while_fenced(
+            self, tmp_path):
+        store = StateStore(str(tmp_path))
+        store.set_writer_epoch(1, fence=lambda: 5)
+        scaler = Autoscaler(router=_MiniRouter(),
+                            spawn=lambda i: (_ for _ in ()).throw(
+                                AssertionError("booted while fenced")),
+                            statestore=store)
+        poked = []
+        scaler.on_fenced = lambda: poked.append(True)
+        assert scaler._fenced("boot") is True
+        assert scaler._fenced("drain") is True
+        assert len(poked) == 2
+        assert "fenced" in scaler.status()["last_error"]
+        assert scaler._scale_out(now=0.0) is None
+
+    def test_fence_disarms_with_none(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        store.set_writer_epoch(1, fence=lambda: 5)
+        assert store.fenced() is True
+        store.set_writer_epoch(None)
+        assert store.fenced() is False
+        store.append("weight", backend="b0", weight=1.0)
+        assert "epoch" not in store.entries()[0]
+
+
+# -- crash-loop fail-fast ----------------------------------------------------
+
+class TestCrashLoopFailFast:
+    def test_trips_after_threshold_inside_window_and_sticks(self):
+        def boom(index):
+            raise RuntimeError(f"exec failed for as{index}")
+
+        scaler = Autoscaler(router=_MiniRouter(), spawn=boom,
+                            crash_loop_threshold=3,
+                            crash_loop_window_s=60.0,
+                            cooldown_s=0.0)
+        for now in (1.0, 2.0, 3.0):
+            scaler._scale_out(now=now)
+        st = scaler.status()
+        assert st["crash_looping"] is True
+        # the 4th attempt is refused WITHOUT calling spawn
+        scaler._spawn = lambda i: (_ for _ in ()).throw(
+            AssertionError("boot loop not stopped"))
+        assert scaler._scale_out(now=4.0) is None
+        assert "crash loop" in scaler.status()["last_error"]
+
+    def test_spread_out_failures_do_not_trip(self):
+        def boom(index):
+            raise RuntimeError("nope")
+
+        scaler = Autoscaler(router=_MiniRouter(), spawn=boom,
+                            crash_loop_threshold=3,
+                            crash_loop_window_s=5.0,
+                            cooldown_s=0.0)
+        for now in (0.0, 10.0, 20.0):   # outside any shared window
+            scaler._scale_out(now=now)
+        assert scaler.status()["crash_looping"] is False
+
+
+# -- honest ENOSPC degradation ----------------------------------------------
+
+def _admin_weight(url, backend, weight, timeout=10):
+    req = urllib.request.Request(
+        url + "admin/weight",
+        json.dumps({"backend": backend, "weight": weight}).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+class TestHonestDegradation:
+    def _router(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        router = FleetRouter(
+            [Backend("http://127.0.0.1:1/", name="b0",
+                     breaker=CircuitBreaker(failure_threshold=2,
+                                            cooldown_s=0.5))],
+            probe_interval_s=30.0, statestore=store).start()
+        return store, router
+
+    def test_unwritable_journal_refuses_mutation_keeps_reads(
+            self, tmp_path):
+        """The ``statestore.append`` fault site: a failed journal
+        fsync refuses the admin mutation with 503 + Retry-After —
+        never half-applies it — while /healthz keeps answering and
+        surfaces ``degraded``."""
+        store, router = self._router(tmp_path)
+        try:
+            plan = faults.FaultPlan([faults.FaultSpec(
+                "statestore.append", times=1, exc="OSError",
+                message="test: no space left on device")])
+            with plan:
+                code, body, hdrs = _admin_weight(router.url, "b0", 2.0)
+            assert code == 503
+            assert "journal" in body["error"]
+            assert hdrs.get("Retry-After") is not None
+            assert body["retry_after_s"] == int(hdrs["Retry-After"])
+            assert store.degraded is True
+            # the mutation was refused BEFORE the in-memory flip
+            assert router.by_name["b0"].weight == 1.0
+            assert store.entries() == []
+            # reads still serve, and healthz says DEGRADED honestly
+            with urllib.request.urlopen(router.url + "healthz",
+                                        timeout=10) as r:
+                h = json.loads(r.read())
+            assert r.status == 200
+            assert h["reconcile"]["degraded"] is True
+            # the fault exhausted: the next mutation lands + clears
+            code, _b, _h = _admin_weight(router.url, "b0", 2.5)
+            assert code == 200
+            assert store.degraded is False
+            assert store.replay().weights == {"b0": 2.5}
+        finally:
+            router.stop()
+
+    def test_fenced_mutation_refused_with_retry_after(self, tmp_path):
+        store, router = self._router(tmp_path)
+        try:
+            store.set_writer_epoch(1, fence=lambda: 2)
+            code, body, hdrs = _admin_weight(router.url, "b0", 2.0)
+            assert code == 503
+            assert "fenced" in body["error"]
+            assert hdrs.get("Retry-After") is not None
+            assert router.by_name["b0"].weight == 1.0
+        finally:
+            router.stop()
+
+    def test_capture_tap_stays_fail_open_under_same_fault(
+            self, tmp_path):
+        """Re-verify the PR 15 pin under THIS PR's fault plan shape:
+        one plan arms both sites — the journal is FAIL-CLOSED for
+        mutations (raises to the caller), the capture tap is
+        FAIL-OPEN (counted drop, never a failed append call)."""
+        import numpy as np
+
+        from znicz_tpu.online.capture import CaptureLog
+
+        store = StateStore(str(tmp_path / "state"))
+        log = CaptureLog(str(tmp_path / "cap"), max_bytes=65536)
+        try:
+            plan = faults.FaultPlan([
+                faults.FaultSpec("statestore.append", times=1,
+                                 exc="OSError", message="test: enospc"),
+                faults.FaultSpec("capture.append", times=1,
+                                 message="test: tap failure")])
+            x = np.ones((1, 4), np.float32)
+            with plan:
+                with pytest.raises(OSError):
+                    store.append("weight", backend="b0", weight=1.0)
+                assert log.append(x, x) is False    # dropped, no raise
+                assert log.append(x, x) is True     # fault exhausted
+            assert store.degraded is True
+            assert log.metrics()["dropped_error"] == 1
+        finally:
+            log.close()
+
+
+# -- the standby gate + the role machine -------------------------------------
+
+class _FakeHA:
+    def __init__(self, primary="http://primary:1/"):
+        self._primary = primary
+
+    def retry_after_s(self):
+        return 2
+
+    def primary_url(self):
+        return self._primary
+
+    def status(self):
+        return {"role": "standby", "epoch": 7}
+
+    def note_fenced(self):
+        pass
+
+
+class TestStandbyGate:
+    def test_standby_refuses_predict_and_admin_with_retry_after(
+            self, tmp_path):
+        store = StateStore(str(tmp_path))
+        router = FleetRouter(
+            [Backend("http://127.0.0.1:1/", name="b0")],
+            probe_interval_s=30.0, statestore=store).start()
+        try:
+            router.attach_ha(_FakeHA())
+            router.set_standby(True)
+            req = urllib.request.Request(
+                router.url + "predict",
+                json.dumps({"inputs": [[0.0]]}).encode(),
+                {"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert "standby" in body["error"]
+            assert ei.value.headers.get("Retry-After") == "2"
+            assert body["primary"] == "http://primary:1/"
+            code, body, hdrs = _admin_weight(router.url, "b0", 2.0)
+            assert code == 503 and "standby" in body["error"]
+            assert hdrs.get("Retry-After") == "2"
+            assert router.by_name["b0"].weight == 1.0
+            # healthz keeps answering, carrying the role
+            with urllib.request.urlopen(router.url + "healthz",
+                                        timeout=10) as r:
+                h = json.loads(r.read())
+            assert h["ha"]["role"] == "standby"
+            # the gate reopens on promotion
+            router.set_standby(False)
+            code, _b, _h = _admin_weight(router.url, "b0", 2.0)
+            assert code == 200
+        finally:
+            router.stop()
+
+    def test_coordinator_promotes_with_warm_journal_state(
+            self, tmp_path):
+        """The takeover arc, in-process: a primary journals state and
+        steps down; the standby's next step() acquires, folds the
+        journal tail, and hands the WARM state to the promote hook
+        with exactly one epoch bump (journaled as a ``lease``
+        record)."""
+        store = StateStore(str(tmp_path))
+        a = HACoordinator(store, url="http://a:1/", holder="a",
+                          ttl_s=60.0)
+        assert a.try_acquire() is True
+        assert a.role == "primary" and a.epoch == 1
+        store.append("weight", backend="b0", weight=2.5)
+        store.append("pin", model="demo", backends=["b0"])
+        assert a.step() == "renewed"
+
+        promoted = []
+        b = HACoordinator(store, url="http://b:1/", holder="b",
+                          ttl_s=60.0)
+        b.attach(promote=promoted.append)
+        assert b.role == "standby"
+        assert b.step() == "watching"       # the primary is live
+        a.lease.step_down()                 # clean handoff
+        assert b.step() == "promoted"
+        assert b.role == "primary" and b.epoch == 2
+        [state] = promoted
+        assert state.weights == {"b0": 2.5}
+        assert state.pins == {"demo": ["b0"]}
+        leases = [e for e in store.entries()
+                  if e.get("kind") == "lease"]
+        assert [e["epoch"] for e in leases] == [1, 2]
+        assert b.status()["takeovers"] == 1
+
+    def test_fenced_event_demotes_on_next_step(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        a = HACoordinator(store, url="http://a:1/", holder="a",
+                          ttl_s=60.0)
+        demoted = []
+        a.attach(demote=lambda: demoted.append(True))
+        assert a.try_acquire() is True
+        a.note_fenced()
+        assert a.step() == "demoted"
+        assert a.role == "standby" and demoted == [True]
+        assert a.status()["demotions"] == 1
+        # the store is disarmed: mutations are not stamped anymore
+        store.append("weight", backend="b0", weight=1.0)
+        assert "epoch" not in store.entries()[-1]
+
+    def test_deposed_primary_demotes_when_lease_stolen(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        a = HACoordinator(store, url="http://a:1/", holder="a",
+                          ttl_s=60.0)
+        assert a.try_acquire() is True
+        # a partition heals: a peer's newer epoch owns the lease file
+        write_lease(store.state_dir, {
+            "epoch": 2, "holder": "b", "url": "http://b:1/", "pid": 1,
+            "identity": None, "acquired_ts": time.time(),
+            "renewed_ts": time.time(), "ttl_s": 60.0})
+        assert a.step() == "demoted"
+        assert a.role == "standby"
+        # and its own journal writes are now fenced
+        store.set_writer_epoch(1, fence=a.lease.observed_epoch)
+        with pytest.raises(FencedError):
+            store.append("weight", backend="b0", weight=9.0)
+
+    def test_retry_after_is_one_lease_ttl_bounded(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        c = HACoordinator(store, holder="a", ttl_s=2.5)
+        assert c.retry_after_s() == 3
+        c2 = HACoordinator(store, holder="b", ttl_s=900.0)
+        assert c2.retry_after_s() == 30
+
+
+# -- the journal tailer ------------------------------------------------------
+
+class TestJournalTailer:
+    def test_folds_incrementally_and_defers_torn_tail(self, tmp_path):
+        store = StateStore(str(tmp_path))
+        tailer = JournalTailer(store)
+        assert tailer.poll() == 0           # no journal yet
+        store.append("weight", backend="b0", weight=2.0)
+        store.append("join", backend="b1", url="http://h:1/")
+        assert tailer.poll() == 2
+        assert tailer.state.weights == {"b0": 2.0}
+        assert tailer.state.members == {"b1": "http://h:1/"}
+        # a torn tail (no newline) is deferred, not consumed
+        with open(store.path, "a") as fh:
+            fh.write('{"kind": "weight", "backend": "b0", "wei')
+        assert tailer.poll() == 0
+        with open(store.path, "a") as fh:
+            fh.write('ght": 9.0}\n')
+        assert tailer.poll() == 1
+        assert tailer.state.weights == {"b0": 9.0}
+        assert tailer.state.records == 3
+
+
+# -- zlint scope: the HA module is patrolled ---------------------------------
+
+HA_DEADLINE_BAD = """
+    import threading
+    import urllib.request
+
+    class Coordinator:
+        def __init__(self):
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._run)
+
+        def probe_peer(self, url):
+            return urllib.request.urlopen(url)   # no timeout
+
+        def stop(self):
+            self._thread.join()                  # unbounded
+"""
+
+HA_RETRY_BAD = """
+    class Handler:
+        def _predict(self):
+            refusal = self.standby_refusal()
+            if refusal is not None:
+                self._reply(503, refusal)        # no Retry-After
+"""
+
+HA_RETRY_GOOD = """
+    class Handler:
+        def _predict(self):
+            refusal = self.standby_refusal()
+            if refusal is not None:
+                hdrs = {"Retry-After": str(refusal["retry_after_s"])}
+                self._reply(503, refusal, hdrs)
+"""
+
+
+def _lint(tmp_path, source, rules, rel):
+    import textwrap
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return Analyzer(rules, root=str(tmp_path)).run([rel])
+
+
+class TestHALintScope:
+    REL = "znicz_tpu/fleet/ha.py"
+
+    def test_deadline_discipline_patrols_fleet_ha(self, tmp_path):
+        found = _lint(tmp_path, HA_DEADLINE_BAD,
+                      [DeadlineDisciplineRule()], rel=self.REL)
+        assert sorted({f.rule for f in found}) == \
+            ["deadline-discipline"]
+        assert len(found) == 2          # the urlopen and the join
+
+    def test_retry_after_patrols_standby_refusal_sites(self, tmp_path):
+        found = _lint(tmp_path, HA_RETRY_BAD, [RetryAfterRule()],
+                      rel="znicz_tpu/fleet/router.py")
+        assert sorted({f.rule for f in found}) == \
+            ["retry-after-discipline"]
+        assert _lint(tmp_path, HA_RETRY_GOOD, [RetryAfterRule()],
+                     rel="znicz_tpu/fleet/router.py") == []
+
+
+# -- the end-to-end drill (slow) ---------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_ha_scenario_end_to_end():
+    """Two real route processes over three real serve backends: the
+    primary SIGKILLed mid-burst, the standby takes the lease within
+    2x the TTL, the resurrected primary rejoins fenced — the full
+    ISSUE 20 acceptance (also: tools/ha_smoke.sh)."""
+    from znicz_tpu.resilience.chaos import main as chaos_main
+
+    assert chaos_main(["--scenario", "ha"]) == 0

@@ -110,6 +110,60 @@ class TestJournal:
             assert st.weights == {
                 "b0": 9.0 if tail_complete else 2.0}
 
+    def test_replay_fuzz_random_truncations_fold_consistent_prefix(
+            self, tmp_path):
+        """Seeded fuzz over a MULTI-EPOCH journal: random truncation
+        offsets — mid-record, mid-line, on boundaries — must all
+        replay without raising to exactly the fold of the complete
+        lines in the surviving prefix (weights/pins/epoch included).
+        The oracle is a line-by-line fold of ``data[:cut]``, so any
+        divergence pinpoints the offset and the field."""
+        import random
+
+        from znicz_tpu.fleet.statestore import (ControlPlaneState,
+                                                fold_entry)
+
+        store = StateStore(str(tmp_path))
+        rng = random.Random(0xF1EE7)
+        store.set_writer_epoch(1, fence=lambda: 1)
+        store.append("lease", holder="a", url=None)
+        for i in range(8):
+            store.append("weight", backend=f"b{i % 3}",
+                         weight=round(rng.uniform(0.1, 9.0), 3))
+        store.append("pin", model="demo", backends=["b0", "b1"])
+        store.set_writer_epoch(2, fence=lambda: 2)
+        store.append("lease", holder="b", url="http://b:1/")
+        for i in range(8):
+            store.append("weight", backend=f"b{i % 3}",
+                         weight=round(rng.uniform(0.1, 9.0), 3))
+        store.append("pin", model="demo", backends=["b2"])
+        store.append("unpin", model="demo")
+        data = open(store.path, "rb").read()
+
+        cuts = sorted({rng.randrange(0, len(data) + 1)
+                       for _ in range(64)} | {0, len(data)})
+        for cut in cuts:
+            prefix = data[:cut]
+            oracle = ControlPlaneState()
+            for line in prefix.split(b"\n"):
+                # a torn tail is exactly a line the oracle can't parse
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(entry, dict):
+                    fold_entry(oracle, entry)
+                    oracle.records += 1     # replay counts, fold doesn't
+            torn = StateStore(str(tmp_path / f"fuzz{cut}"))
+            os.makedirs(torn.state_dir, exist_ok=True)
+            with open(torn.path, "wb") as fh:
+                fh.write(prefix)
+            st = torn.replay()                  # must never raise
+            assert st.records == oracle.records, f"cut={cut}"
+            assert st.weights == oracle.weights, f"cut={cut}"
+            assert st.pins == oracle.pins, f"cut={cut}"
+            assert st.epoch == oracle.epoch, f"cut={cut}"
+
     def test_junk_mid_file_skipped_not_fatal(self, tmp_path):
         store = StateStore(str(tmp_path))
         store.append("weight", backend="b0", weight=2.0)

@@ -1,0 +1,204 @@
+#!/bin/bash
+# Highly-available fleet front smoke (ISSUE 20 acceptance,
+# operator-runnable):
+#
+#   1. `python -m znicz_tpu chaos --scenario ha` — two REAL `route
+#      --state-dir` processes (one primary, one --standby-of) over
+#      three REAL autoscaler-booted serve backends; the primary is
+#      SIGKILLed mid-burst and the standby must acquire the lease,
+#      bump the epoch exactly once, adopt the surviving children and
+#      serve within 2x the lease TTL — zero raw 500s, only bounded
+#      503 + Retry-After; the resurrected primary rejoins FENCED
+#      (demoted to standby, its stale mutations refused, no
+#      double-boot).
+#
+#   2. a clean-handoff phase from the CLI surface: primary + standby
+#      booted by hand, the primary SIGTERMed (journal-and-keep), and
+#      the standby must promote, re-adopt the SAME child pid, and
+#      answer a real /predict — the planned-maintenance twin of the
+#      drill's crash path.
+#
+# Registered beside tools/controlplane_smoke.sh; pytest wrapper
+# (marked slow): tests/test_ha.py::test_chaos_ha_scenario_end_to_end.
+#
+# Usage:  bash tools/ha_smoke.sh
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: chaos --scenario ha =="
+JAX_PLATFORMS=cpu python -m znicz_tpu chaos --scenario ha || exit 1
+
+echo "== phase 2: SIGTERM handoff -> standby promotes, re-adopts =="
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, signal, socket, subprocess, sys, tempfile, time
+import urllib.request
+
+fails = []
+
+
+def check(cond, msg):
+    print(("ok  " if cond else "FAIL") + " " + msg)
+    if not cond:
+        fails.append(msg)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def healthz(url):
+    with urllib.request.urlopen(url + "healthz", timeout=5) as r:
+        return json.loads(r.read())
+
+
+def role_of(url):
+    try:
+        return (healthz(url).get("ha") or {}).get("role")
+    except Exception:
+        return None
+
+
+def journal(state_dir):
+    out = []
+    try:
+        with open(os.path.join(state_dir, "controlplane.jsonl")) as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+procs, child_pid = [], None
+try:
+    with tempfile.TemporaryDirectory(prefix="znicz_ha_smoke_") as tmp:
+        from znicz_tpu.resilience.chaos import _write_demo_znn
+
+        model = os.path.join(tmp, "m.znn")
+        state = os.path.join(tmp, "state")
+        _write_demo_znn(model)
+
+        def boot(port, extra):
+            argv = [sys.executable, "-m", "znicz_tpu", "route",
+                    "--port", str(port), "--autoscale",
+                    "--min-backends", "1", "--max-backends", "2",
+                    "--state-dir", state,
+                    "--lease-ttl-s", "2.0",
+                    "--reconcile-deadline-s", "20",
+                    "--probe-interval-s", "0.3",
+                    "--boot-timeout-s", "180",
+                    "--serve-arg=--model", f"--serve-arg={model}",
+                    "--serve-arg=--max-wait-ms", "--serve-arg=1",
+                    *extra]
+            p = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+            procs.append(p)
+            return p
+
+        def wait_role(url, want, deadline_s, what):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if role_of(url) == want:
+                    return True
+                time.sleep(0.2)
+            check(False, f"{what} never reached role {want!r}")
+            return False
+
+        aport, bport = free_port(), free_port()
+        a_url = f"http://127.0.0.1:{aport}/"
+        b_url = f"http://127.0.0.1:{bport}/"
+
+        def wait_settled(url, what, deadline_s=60):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                try:
+                    rc = healthz(url).get("reconcile") or {}
+                    if rc.get("state") == "settled":
+                        return True
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            check(False, f"{what} never settled reconciliation")
+            return False
+
+        prim = boot(aport, [])
+        wait_role(a_url, "primary", 180, "primary")
+        wait_settled(a_url, "primary", 180)
+        check(role_of(a_url) == "primary", "primary holds the lease")
+        boots = [e for e in journal(state) if e.get("kind") == "boot"]
+        check(len(boots) == 1,
+              f"primary journals one child boot ({len(boots)})")
+        child_pid = int(boots[0]["pid"]) if boots else None
+
+        stand = boot(bport, ["--standby-of", a_url])
+        wait_role(b_url, "standby", 60, "standby")
+        check(role_of(b_url) == "standby", "standby is watching")
+
+        prim.send_signal(signal.SIGTERM)       # planned maintenance
+        try:
+            rc = prim.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            prim.kill()
+            rc = prim.wait(timeout=10)
+        check(rc == 0, f"primary SIGTERM exit rc {rc}")
+        check(child_pid is not None and alive(child_pid),
+              "journal-and-keep: the child outlives the primary")
+
+        wait_role(b_url, "primary", 30, "standby promotion")
+        wait_settled(b_url, "promoted standby")
+        ha = healthz(b_url).get("ha") or {}
+        check(int(ha.get("epoch", 0)) == 2,
+              f"exactly one epoch bump (epoch {ha.get('epoch')})")
+        entries = journal(state)
+        adopts = [e for e in entries if e.get("kind") == "adopt"]
+        boots = [e for e in entries if e.get("kind") == "boot"]
+        check(any(int(e.get("pid", -1)) == child_pid for e in adopts),
+              f"promoted standby re-adopts the SAME pid {child_pid}")
+        check(len(boots) == 1,
+              f"zero double-boots ({len(boots)} boot records)")
+
+        body = json.dumps({"inputs": [[0.1, -0.2, 0.3, 0.4]]}).encode()
+        req = urllib.request.Request(
+            b_url + "predict", body,
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+            check(r.status == 200,
+                  "predict 200 through the promoted standby")
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    if child_pid is not None and alive(child_pid):
+        os.kill(child_pid, signal.SIGTERM)
+        for _ in range(100):
+            if not alive(child_pid):
+                break
+            time.sleep(0.1)
+        else:
+            os.kill(child_pid, signal.SIGKILL)
+
+print()
+if fails:
+    print(f"ha smoke: {len(fails)} failure(s)")
+    sys.exit(1)
+print("ha smoke: all checks passed")
+PY

@@ -354,6 +354,27 @@ with tempfile.TemporaryDirectory(prefix="znicz_metrics_smoke_") as tmp:
               == 0.0,
               "backend_predict_ewma_ms carries a zero child per "
               "backend before any predict")
+        # HA + crash-loop families (znicz_tpu.fleet.ha, ISSUE 20):
+        # registered at import, so a standalone router with no lease
+        # attached still scrapes them — role/epoch zero, no takeovers,
+        # nothing fenced, no crash loops
+        for fam, kind in (("fleet_role", "gauge"),
+                          ("ha_epoch", "gauge"),
+                          ("ha_lease_renewals_total", "counter"),
+                          ("ha_takeovers_total", "counter"),
+                          ("ha_demotions_total", "counter"),
+                          ("ha_fenced_mutations_total", "counter"),
+                          ("autoscaler_crash_loops_total", "counter")):
+            check(typed.get(fam) == kind, f"{fam} typed {kind}")
+        check(series.get("ha_takeovers_total") == 0.0
+              and series.get("ha_demotions_total") == 0.0,
+              "HA takeover/demotion counters scrape zero without a "
+              "lease attached")
+        check(series.get("ha_fenced_mutations_total") == 0.0,
+              "ha_fenced_mutations_total scrapes zero (nothing fenced)")
+        check(series.get("autoscaler_crash_loops_total") == 0.0,
+              "autoscaler_crash_loops_total scrapes zero on a healthy "
+              "boot path")
         # the router registers the same tracing families (its store
         # and assembler live here) — present before any traffic
         for fam, kind in (("trace_stage_ms", "histogram"),

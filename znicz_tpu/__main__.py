@@ -87,6 +87,23 @@ Examples::
         # demotion rides the same bookkeeping: a probe-green backend
         # whose real predicts fail or stall is weight-decayed to
         # zero and ejected (disable with --no-gray-demotion)
+    python -m znicz_tpu route --state-dir S --port 8200 &
+    python -m znicz_tpu route --state-dir S --port 8201 \
+            --standby-of http://127.0.0.1:8200/
+        # highly-available fleet front (fleet.ha; docs/fleet.md
+        # "Router high availability"): any --state-dir router holds
+        # an fsync'd LEASE carrying a monotonically increasing epoch;
+        # the hot standby tails the same journal (weights/pins/
+        # members stay warm), probes the primary's /healthz, answers
+        # its own traffic 503 + Retry-After, and on lease expiry —
+        # or a dead holder pid — takes over: epoch bump, adopt the
+        # journal's live children, serve.  Every journal mutation and
+        # autoscaler boot/drain is epoch-FENCED: a deposed primary
+        # waking from a GC pause/partition sees the newer epoch,
+        # refuses its own stale mutations and demotes itself to
+        # standby (never double-boots a backend).  --peer URL races
+        # two symmetric routers for the lease instead; --lease-ttl-s
+        # / --lease-renew-s tune the failover window
     python -m znicz_tpu promote --candidates DIR \
             --url http://127.0.0.1:8200/ --fleet
         # promote-one-then-fleet over a router: canary ONE backend
@@ -94,7 +111,7 @@ Examples::
         # backends with weighted traffic splitting and fleet-wide
         # rollback on a mid-walk burn-rate breach (fleet.rollout)
     python -m znicz_tpu chaos \
-            [--scenario reload|promote|overload|zoo|slo|wire|fleet|placement|controlplane|san]
+            [--scenario reload|promote|overload|zoo|slo|wire|fleet|placement|controlplane|san|ha]
         # serving-under-fault smoke: boots the server under a canned
         # fault plan and checks graceful degradation (resilience.chaos);
         # --scenario reload drills corrupt-artifact rollback;
@@ -122,6 +139,12 @@ Examples::
         # orphans/double-boots, 503+Retry-After while reconciling, a
         # healthz-green/predict-sick backend gray-demoted to ~zero
         # effective weight; docs/fleet.md);
+        # --scenario ha drills the highly-available fleet front
+        # (primary + hot standby over one state dir, primary
+        # SIGKILLed mid-burst: one lease epoch bump, children
+        # adopted, first 200 within 2x the lease TTL, the
+        # resurrected old primary fenced to standby, zero raw 500s;
+        # docs/fleet.md "Router high availability");
         # --scenario san replays the zoo drill with every package lock
         # wrapped by the runtime concurrency sanitizer — fails on any
         # observed lock-order inversion or an empty acquisition graph

@@ -32,6 +32,12 @@ The cross-process layer of the serving stack (docs/fleet.md):
   journaled children (re-adopt alive ones in place, drain half-dead
   or unknown-generation ones, never signal a recycled pid) instead
   of re-booting the fleet.
+* :mod:`~znicz_tpu.fleet.ha` — no single point of failure: leased
+  router leadership over the state dir (fsync'd epoch-carrying
+  lease), hot standbys (``route --standby-of`` / ``--peer``) that
+  tail the journal and take over on lease expiry, and split-brain
+  **epoch fencing** — a deposed primary refuses its own stale
+  mutations and demotes itself instead of double-driving the fleet.
 
 This is the modern rebuild of the paper's VELES master–slave topology
 (the Twisted/ZeroMQ master fanning work to slave processes) on
@@ -44,7 +50,10 @@ from .rollout import FleetTarget, merge_samples  # noqa: F401
 from .placement import (PlacementCandidate,  # noqa: F401
                         PlacementEngine, rank_backends, score_weight)
 from .statestore import (ControlPlaneState,  # noqa: F401
-                         OrphanProcess, StateStore, pid_alive,
-                         process_identity)
+                         FencedError, OrphanProcess, StateStore,
+                         fold_entry, pid_alive, process_identity)
 from .autoscaler import (Autoscaler, ServeLauncher,  # noqa: F401
                          reconcile_children)
+from .ha import (HACoordinator, JournalTailer,  # noqa: F401
+                 LeaseManager, read_lease, settle_control_plane,
+                 write_lease)

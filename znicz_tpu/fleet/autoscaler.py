@@ -30,9 +30,22 @@ processes:
 * **Cooldown** — after any action the loop holds ``cooldown_s``
   before acting again: a boot takes seconds to absorb load, and
   judging its effect mid-boot would flap.
+* **Crash-loop fail-fast** — ``crash_loop_threshold`` boot failures
+  inside ``crash_loop_window_s`` stop the boot loop for good (sticky
+  until an operator restarts), printing the failing child's log tail
+  — the ElasticRunner discipline (parallel/elastic.py): a child that
+  dies instantly on every boot means the *command* is broken, and
+  hot-looping boots just burns pids and disk while hiding the real
+  error.  ``autoscaler_crash_loops_total`` counts the trips.
+* **Epoch fencing** (fleet/ha.py) — when leased HA is active, every
+  boot and drain re-checks :meth:`StateStore.fenced` first: a
+  deposed primary (newer epoch in the lease) never double-boots or
+  double-drains a backend the new primary now owns, and ``shutdown``
+  flips to journal-and-keep for the same reason.
 
 Families: ``autoscale_backends``, ``autoscale_events_total
-{direction}``, ``autoscale_burn_rate`` (docs/observability.md).  The
+{direction}``, ``autoscale_burn_rate``,
+``autoscaler_crash_loops_total`` (docs/observability.md).  The
 loop's state is surfaced on the router's ``/healthz``/``/statusz``
 via ``router.attach_autoscaler`` — the same attach idiom as the
 rollout driver.
@@ -45,6 +58,7 @@ with fake samples and no processes (tests/test_placement.py).
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import re
@@ -61,7 +75,8 @@ from ..resilience.breaker import CircuitBreaker
 from ..telemetry import sloengine
 from ..telemetry.registry import (DEFAULT_LATENCY_BUCKETS_MS, REGISTRY)
 from .router import Backend, BackendDown
-from .statestore import (OrphanProcess, _backend_adopted, pid_alive,
+from .statestore import (FencedError, OrphanProcess, _backend_adopted,
+                         _fenced_mutations, pid_alive,
                          process_identity)
 
 _backends_g = REGISTRY.gauge(
@@ -79,6 +94,13 @@ _burn_g = REGISTRY.gauge(
     "error-budget burn rate of the autoscaler's last sampling window "
     "over the router's own request-path signals (the scale-out "
     "trigger, sloengine.burn_between arithmetic)")
+_crash_loops = REGISTRY.counter(
+    "autoscaler_crash_loops_total",
+    "boot loops stopped by the crash-loop fail-fast: "
+    "crash_loop_threshold immediate boot failures inside "
+    "crash_loop_window_s — the serve command itself is broken; the "
+    "loop stays stopped (with the failing child's log tail printed) "
+    "until an operator intervenes")
 
 
 def router_sample() -> SLOSample:
@@ -137,6 +159,22 @@ class ServeLauncher:
             return subprocess.DEVNULL
         os.makedirs(self.log_dir, exist_ok=True)
         return open(os.path.join(self.log_dir, f"{name}.log"), "ab")
+
+    def log_tail(self, name: str, lines: int = 20) -> str | None:
+        """The last ``lines`` lines of one child's log (None without
+        a log dir or file) — what the crash-loop fail-fast prints so
+        the operator sees WHY the boots die instead of a bare
+        counter."""
+        if self.log_dir is None:
+            return None
+        path = os.path.join(self.log_dir, f"{name}.log")
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return None
+        tail = data.decode("utf-8", "replace").splitlines()[-lines:]
+        return "\n".join(tail) if tail else None
 
     def spawn(self, index: int) -> tuple[Backend, subprocess.Popen]:
         """Boot one serve process and wait (bounded) for its /healthz;
@@ -214,6 +252,8 @@ class Autoscaler:
                  breach_windows: int = 2, idle_windows: int = 6,
                  idle_rps: float = 0.5, cooldown_s: float = 30.0,
                  drain_timeout_s: float = 20.0,
+                 crash_loop_threshold: int = 3,
+                 crash_loop_window_s: float = 60.0,
                  sample_fn=None, clock=time.monotonic,
                  statestore=None):
         if int(min_backends) < 1:
@@ -265,6 +305,14 @@ class Autoscaler:
         self._scale_outs = 0
         self._scale_ins = 0
         self._last_error: str | None = None
+        self.crash_loop_threshold = max(1, int(crash_loop_threshold))
+        self.crash_loop_window_s = float(crash_loop_window_s)
+        self._boot_failures: collections.deque = collections.deque()
+        self._crash_looping = False
+        #: optional hook (HA coordinator's note_fenced) called when a
+        #: boot/drain is refused by epoch fencing — the demotion runs
+        #: on the coordinator's thread, never inline in a tick
+        self.on_fenced = None
         self._stop_event = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -319,8 +367,61 @@ class Autoscaler:
                                       if pid else None))
         try:
             self.statestore.append(kind, **fields)
+        except FencedError as e:
+            # the action already happened; record the deposition and
+            # let the coordinator demote us from ITS thread
+            self._last_error = str(e)
+            self._note_fenced()
         except OSError as e:
             self._last_error = f"journal append failed: {e}"
+
+    # -- epoch fencing ------------------------------------------------------
+    def _note_fenced(self) -> None:
+        if self.on_fenced is not None:
+            try:
+                self.on_fenced()
+            except Exception:
+                pass
+
+    def _fenced(self, action: str) -> bool:
+        """True when leased HA says a newer epoch owns the fleet — a
+        deposed primary must not boot or drain anything (the new
+        primary owns those children now).  Counts the refusal and
+        pokes the coordinator to demote us."""
+        if self.statestore is None or not self.statestore.fenced():
+            return False
+        _fenced_mutations.inc(action=action)
+        self._last_error = (f"{action} refused: writer epoch "
+                            f"{self.statestore.writer_epoch} fenced "
+                            f"by a newer leadership epoch")
+        self._note_fenced()
+        return True
+
+    # -- crash-loop fail-fast -----------------------------------------------
+    def _note_boot_failure(self, now: float, name: str,
+                           error: Exception) -> None:
+        """One failed boot; trip the sticky fail-fast when
+        ``crash_loop_threshold`` of them land inside
+        ``crash_loop_window_s``."""
+        self._boot_failures.append(now)
+        while self._boot_failures and \
+                now - self._boot_failures[0] > self.crash_loop_window_s:
+            self._boot_failures.popleft()
+        if len(self._boot_failures) < self.crash_loop_threshold \
+                or self._crash_looping:
+            return
+        self._crash_looping = True
+        _crash_loops.inc()
+        print(f"autoscale: CRASH LOOP — "
+              f"{len(self._boot_failures)} boot failures within "
+              f"{self.crash_loop_window_s:g}s (last: {error}); "
+              f"stopping the boot loop until an operator intervenes",
+              flush=True)
+        tail = (self.launcher.log_tail(name)
+                if self.launcher is not None else None)
+        if tail:
+            print(f"autoscale: log tail of failing child {name}:\n"
+                  f"{tail}", flush=True)
 
     # -- the state machine -------------------------------------------------
     def tick(self, now: float | None = None) -> dict:
@@ -371,10 +472,18 @@ class Autoscaler:
         if self._spawn is None:
             self._last_error = "no spawn path configured"
             return None
+        if self._crash_looping:
+            self._last_error = ("crash loop: boot loop stopped "
+                                "(see log tail above)")
+            return None
+        if self._fenced("boot"):
+            return None
+        idx = self.next_index()
         try:
-            backend, handle = self._spawn(self.next_index())
+            backend, handle = self._spawn(idx)
         except Exception as e:
             self._last_error = f"scale-out failed: {e}"
+            self._note_boot_failure(now, f"as{idx}", e)
             self._acted(now)   # cooldown anyway: don't hammer boots
             return None
         try:
@@ -398,6 +507,8 @@ class Autoscaler:
         return f"scale_out:{backend.name}"
 
     def _scale_in(self, now: float) -> str | None:
+        if self._fenced("drain"):
+            return None
         with self._lock:
             if not self._managed:
                 return None
@@ -436,6 +547,7 @@ class Autoscaler:
                 "cooldown_remaining_s": round(cooldown, 1),
                 "scale_outs": self._scale_outs,
                 "scale_ins": self._scale_ins,
+                "crash_looping": self._crash_looping,
                 "last_error": self._last_error}
 
     # -- lifecycle ---------------------------------------------------------
@@ -447,6 +559,9 @@ class Autoscaler:
                 self._last_error = f"tick failed: {e}"
 
     def start(self) -> "Autoscaler":
+        # clear, don't assume fresh: a standby promotion restarts the
+        # loop after a demotion's stop() set the event
+        self._stop_event.clear()
         self.router.attach_autoscaler(self.status)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="znicz-fleet-autoscaler")
@@ -464,8 +579,18 @@ class Autoscaler:
         is left alone).  ``teardown=False`` is journal-and-keep: the
         children stay up, their boot/adopt records stay in the
         journal, and the next ``route --state-dir`` re-adopts them
-        instead of re-booting (docs/fleet.md)."""
+        instead of re-booting (docs/fleet.md).  A FENCED shutdown
+        always keeps the children: a newer epoch owns them, and
+        draining them out from under the new primary would be the
+        double-drain this fencing exists to prevent."""
         self.stop()
+        if teardown and self.statestore is not None \
+                and self.statestore.fenced():
+            _fenced_mutations.inc(action="drain")
+            print("autoscale: shutdown fenced by a newer leadership "
+                  "epoch — keeping children for the new primary",
+                  flush=True)
+            teardown = False
         if not teardown:
             return
         while True:

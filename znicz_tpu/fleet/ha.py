@@ -1,0 +1,623 @@
+"""Highly-available fleet front: leased leadership, hot standby,
+split-brain fencing.
+
+PR 17 made the control plane crash-safe *below* the router — but the
+router itself stayed a single process, and the paper's master–slave
+topology (PAPER.md ``apply_data_from_slave`` lineage) always assumed
+exactly one live master.  This module finishes that story: the master
+role survives the master's death, and two masters can never both
+drive the autoscaler or the admin state.
+
+* **Leased leadership** — one fsync'd, atomically-renamed lease
+  record (``<state-dir>/lease.json``) carries a monotonically
+  increasing **epoch** plus the PR 17 pid + kernel-start-time process
+  identity (:func:`~znicz_tpu.fleet.statestore.process_identity`).
+  The primary re-writes ``renewed_ts`` on a tick; a lease whose
+  holder is provably dead (pid gone, or the identity says the pid was
+  recycled) is acquirable immediately — no TTL wait for a clean
+  crash on the same host.
+* **Hot standby** — ``route --standby-of URL`` (or the symmetric
+  ``--peer URL``) runs a full router process that answers
+  ``/healthz``/``/metrics`` but refuses ``/predict`` and admin
+  mutations with 503 + ``Retry-After`` (the 200-or-503 contract —
+  a standby is *honestly not serving*, never half-serving).  Its
+  :class:`JournalTailer` follows ``controlplane.jsonl`` so weights,
+  pins, members and the live-children map are warm in memory; its
+  watch loop probes the primary's ``/healthz`` and the lease file.
+  On lease expiry it acquires the lease, **bumps the epoch**, adopts
+  the journal's live children in place (PR 17
+  :class:`~znicz_tpu.fleet.statestore.OrphanProcess` — zero
+  double-boots), replays weights/pins, and starts serving.
+* **Epoch fencing — the hard half.**  Every journal mutation is
+  stamped with the writer's epoch and *gated* on it
+  (:meth:`StateStore.append` raises
+  :class:`~znicz_tpu.fleet.statestore.FencedError` when the lease
+  shows a newer epoch), and every autoscaler boot/drain re-checks the
+  fence before acting.  A deposed primary waking from a GC pause or a
+  partition sees the newer epoch, refuses its own pending mutations,
+  demotes itself to standby, and never double-boots or double-drains
+  a backend.
+
+Families: ``fleet_role``, ``ha_epoch``, ``ha_lease_renewals_total``,
+``ha_takeovers_total``, ``ha_demotions_total`` (here) and
+``ha_fenced_mutations_total`` (statestore) — docs/observability.md.
+The acceptance drill is ``chaos --scenario ha`` / tools/ha_smoke.sh.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+
+from ..telemetry.registry import REGISTRY
+from .statestore import (ControlPlaneState, fold_entry, pid_alive,
+                         process_identity)
+
+log = logging.getLogger("fleet")
+
+LEASE_NAME = "lease.json"
+
+_role_g = REGISTRY.gauge(
+    "fleet_role",
+    "this router process's high-availability role (1 = primary, "
+    "holding the leadership lease and serving /predict; 0 = hot "
+    "standby, tailing the journal and refusing traffic with 503 + "
+    "Retry-After)")
+_epoch_g = REGISTRY.gauge(
+    "ha_epoch",
+    "the leadership epoch this process holds (primary) or last "
+    "observed in the lease file (standby) — strictly increasing "
+    "across failovers; journal mutations from older epochs are "
+    "fenced")
+_renewals = REGISTRY.counter(
+    "ha_lease_renewals_total",
+    "successful leadership-lease renewals by the primary's renew "
+    "tick (a flatlined rate with a live primary is the pre-failover "
+    "alarm)")
+_takeovers = REGISTRY.counter(
+    "ha_takeovers_total",
+    "standby promotions: the lease expired (or its holder was "
+    "provably dead) and this process acquired it, bumped the epoch "
+    "and started serving")
+_demotions = REGISTRY.counter(
+    "ha_demotions_total",
+    "self-demotions by a deposed primary: a renew tick or a fenced "
+    "journal mutation revealed a newer epoch, so this process "
+    "stopped mutating and fell back to standby")
+
+
+def lease_path(state_dir: str) -> str:
+    return os.path.join(os.fspath(state_dir), LEASE_NAME)
+
+
+def read_lease(state_dir: str) -> dict | None:
+    """The current lease record, or None when absent/unreadable.
+    Writes are atomic renames, so a torn read is impossible; junk is
+    treated as no-lease (acquirable) rather than a crash."""
+    try:
+        with open(lease_path(state_dir)) as fh:
+            raw = fh.read()
+    except OSError:
+        return None
+    try:
+        obj = json.loads(raw)
+    except ValueError:
+        log.warning("%s: unparseable lease record — treating as "
+                    "absent", lease_path(state_dir))
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+def write_lease(state_dir: str, record: dict) -> None:
+    """Atomically publish one lease record: write-temp, fsync,
+    rename, fsync the directory — the PR 5 invalidate→blob→manifest
+    durability discipline, sized down to one file."""
+    state_dir = os.fspath(state_dir)
+    os.makedirs(state_dir, exist_ok=True)
+    path = lease_path(state_dir)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(json.dumps(record, sort_keys=True))
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    dirfd = os.open(state_dir, os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+
+
+def current_epoch(state_dir: str) -> int:
+    """The authoritative epoch: what the lease file says right now
+    (0 before any lease exists).  This is the fence every journal
+    mutation is gated on."""
+    rec = read_lease(state_dir)
+    if rec is None:
+        return 0
+    try:
+        return int(rec.get("epoch", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+class LeaseManager:
+    """Acquire/renew/step-down over the one lease file.
+
+    Single-writer-per-epoch by construction: acquisition bumps the
+    epoch and then re-reads to confirm the atomic rename race was won
+    (last writer wins; the loser sees the winner's record and stays
+    standby).  ``epoch`` is None while not holding."""
+
+    def __init__(self, state_dir: str, *, holder: str,
+                 url: str | None = None, ttl_s: float = 3.0,
+                 clock=time.time):
+        if float(ttl_s) <= 0:
+            raise ValueError(f"lease ttl must be > 0, got {ttl_s!r}")
+        self.state_dir = os.fspath(state_dir)
+        self.holder = str(holder)
+        self.url = url
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self.epoch: int | None = None
+
+    # -- reads -------------------------------------------------------------
+    def read(self) -> dict | None:
+        return read_lease(self.state_dir)
+
+    def observed_epoch(self) -> int:
+        return current_epoch(self.state_dir)
+
+    def expired(self, rec: dict, now: float | None = None) -> bool:
+        """True once the record's own TTL has elapsed since its last
+        renewal (junk fields read as expired — an unparseable lease
+        must be acquirable, not a deadlock)."""
+        now = self._clock() if now is None else now
+        try:
+            renewed = float(rec.get("renewed_ts", 0.0))
+            ttl = float(rec.get("ttl_s", self.ttl_s))
+        except (TypeError, ValueError):
+            return True
+        return now - renewed > ttl
+
+    @staticmethod
+    def holder_alive(rec: dict) -> bool:
+        """Same-host liveness shortcut: the recorded pid must exist
+        AND wear the recorded kernel start-time identity.  A dead or
+        recycled pid means the holder is gone — the lease is
+        acquirable without waiting out the TTL."""
+        pid = rec.get("pid")
+        if not pid:
+            return False
+        try:
+            pid = int(pid)
+        except (TypeError, ValueError):
+            return False
+        if not pid_alive(pid):
+            return False
+        recorded = rec.get("identity")
+        if recorded is not None \
+                and process_identity(pid) != recorded:
+            return False
+        return True
+
+    def _mine(self, rec: dict) -> bool:
+        return (rec.get("pid") == os.getpid()
+                and rec.get("holder") == self.holder)
+
+    # -- writes ------------------------------------------------------------
+    def acquire(self) -> bool:
+        """Try to take leadership: succeeds against no lease, an
+        expired lease, a provably-dead holder, or our own record.
+        Bumps the epoch (unless re-acquiring our own), publishes, and
+        re-reads to confirm the rename race was won."""
+        rec = self.read()
+        if rec is not None and not self._mine(rec):
+            if not self.expired(rec) and self.holder_alive(rec):
+                return False
+        try:
+            held = int(rec.get("epoch", 0)) if rec is not None else 0
+        except (TypeError, ValueError):
+            held = 0
+        epoch = held if (rec is not None and self._mine(rec)
+                         and held > 0) else held + 1
+        now = self._clock()
+        record = {"epoch": epoch, "holder": self.holder,
+                  "url": self.url, "pid": os.getpid(),
+                  "identity": process_identity(os.getpid()),
+                  "acquired_ts": now, "renewed_ts": now,
+                  "ttl_s": self.ttl_s}
+        try:
+            write_lease(self.state_dir, record)
+        except OSError as e:
+            log.warning("lease acquire failed to publish: %s", e)
+            return False
+        cur = self.read()
+        if cur is not None and self._mine(cur) \
+                and cur.get("epoch") == epoch:
+            self.epoch = epoch
+            return True
+        return False                      # lost the rename race
+
+    def renew(self) -> bool:
+        """The primary's heartbeat: push ``renewed_ts`` forward.
+        Returns False — and drops the held epoch — when the lease is
+        no longer ours (a newer epoch exists: we are DEPOSED and must
+        not write)."""
+        if self.epoch is None:
+            return False
+        rec = self.read()
+        if rec is None or not self._mine(rec):
+            self.epoch = None
+            return False
+        try:
+            if int(rec.get("epoch", -1)) != self.epoch:
+                self.epoch = None
+                return False
+        except (TypeError, ValueError):
+            self.epoch = None
+            return False
+        rec["renewed_ts"] = self._clock()
+        try:
+            write_lease(self.state_dir, rec)
+        except OSError as e:
+            # a failed renewal is NOT a deposition — the lease still
+            # bears our epoch; the next tick retries while the TTL
+            # window holds
+            log.warning("lease renew failed to publish: %s", e)
+            return True
+        _renewals.inc()
+        return True
+
+    def step_down(self) -> None:
+        """Stop holding.  If the lease is still ours, back-date its
+        renewal so a peer can take over immediately instead of
+        waiting out the TTL (the clean-handoff path)."""
+        rec = self.read()
+        if rec is not None and self._mine(rec) \
+                and rec.get("epoch") == self.epoch:
+            rec["renewed_ts"] = (self._clock()
+                                 - float(rec.get("ttl_s", self.ttl_s))
+                                 - 1.0)
+            try:
+                write_lease(self.state_dir, rec)
+            except OSError:
+                pass                     # expiry will release it
+        self.epoch = None
+
+
+class JournalTailer:
+    """Incremental follower of ``controlplane.jsonl`` — the standby's
+    warm state.  Consumes only complete lines (a torn tail is left
+    for the next poll, the same tolerance as
+    :meth:`StateStore.entries`), folding each record into one
+    :class:`ControlPlaneState` so promotion starts from the journal's
+    live weights/pins/members/children without a full re-read."""
+
+    def __init__(self, store):
+        self.store = store
+        self.state = ControlPlaneState()
+        self._offset = 0
+
+    def poll(self) -> int:
+        """Fold newly appended complete records; returns how many."""
+        try:
+            with open(self.store.path, "rb") as fh:
+                fh.seek(self._offset)
+                chunk = fh.read()
+        except OSError:
+            return 0
+        if not chunk:
+            return 0
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return 0                      # torn tail: wait for more
+        folded = 0
+        for line in chunk[:end].split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                entry = json.loads(line)
+                if not isinstance(entry, dict):
+                    raise ValueError("not an object")
+            except ValueError:
+                continue                  # torn/junk line: skip
+            fold_entry(self.state, entry)
+            self.state.records += 1
+            folded += 1
+        self._offset += end + 1
+        return folded
+
+
+class HACoordinator:
+    """The role state machine: primary renew tick, standby watch
+    loop, promotion and self-demotion.
+
+    Wiring (the route CLI does this): ``attach(router=...,
+    promote=..., demote=...)`` then :meth:`try_acquire` (symmetric
+    start) and :meth:`start`.  The promote hook adopts children and
+    opens the traffic gate; the demote hook closes it and stops the
+    autoscaler loop — children are NEVER drained on demotion, they
+    belong to the new primary now."""
+
+    def __init__(self, store, *, url: str | None = None,
+                 peer_url: str | None = None,
+                 holder: str | None = None, ttl_s: float = 3.0,
+                 renew_interval_s: float | None = None,
+                 probe_timeout_s: float = 2.0):
+        self.store = store
+        self.lease = LeaseManager(
+            store.state_dir,
+            holder=holder or f"router-{os.getpid()}",
+            url=url, ttl_s=ttl_s)
+        self.peer_url = peer_url
+        self.ttl_s = float(ttl_s)
+        self.renew_interval_s = (float(renew_interval_s)
+                                 if renew_interval_s is not None
+                                 else max(0.2, self.ttl_s / 3.0))
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.tailer = JournalTailer(store)
+        self._lock = threading.Lock()
+        self._role = "standby"
+        self._promote_fn = None
+        self._demote_fn = None
+        self._fenced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._takeovers = 0
+        self._demotions = 0
+        self._peer_healthy: bool | None = None
+        _role_g.set(0.0)
+        _epoch_g.set(float(self.lease.observed_epoch()))
+
+    # -- wiring ------------------------------------------------------------
+    def attach(self, router=None, promote=None, demote=None) -> None:
+        if promote is not None:
+            self._promote_fn = promote
+        if demote is not None:
+            self._demote_fn = demote
+        if router is not None:
+            router.attach_ha(self)
+
+    # -- role surface ------------------------------------------------------
+    @property
+    def role(self) -> str:
+        with self._lock:
+            return self._role
+
+    @property
+    def epoch(self) -> int:
+        held = self.lease.epoch
+        return held if held is not None else self.lease.observed_epoch()
+
+    def is_primary(self) -> bool:
+        return self.role == "primary"
+
+    def primary_url(self) -> str | None:
+        """Where traffic should go instead of this standby: the
+        lease holder's advertised url, else the configured peer."""
+        rec = self.lease.read()
+        if rec is not None and rec.get("url") \
+                and not self.lease._mine(rec):
+            return str(rec["url"])
+        return self.peer_url
+
+    def retry_after_s(self) -> int:
+        """Honest come-back bound for a standby refusal: one lease
+        TTL — by then either the primary answered or this standby
+        owns the lease, bounded [1, 30] like the router's."""
+        return max(1, min(30, int(self.ttl_s)
+                          + (0 if self.ttl_s == int(self.ttl_s)
+                             else 1)))
+
+    def status(self) -> dict:
+        with self._lock:
+            role = self._role
+            takeovers, demotions = self._takeovers, self._demotions
+            peer_healthy = self._peer_healthy
+        out = {"role": role, "epoch": self.epoch,
+               "lease_ttl_s": self.ttl_s,
+               "takeovers": takeovers, "demotions": demotions}
+        if role != "primary":
+            out["primary_url"] = self.primary_url()
+            if peer_healthy is not None:
+                out["primary_healthy"] = peer_healthy
+        return out
+
+    # -- transitions -------------------------------------------------------
+    def note_fenced(self) -> None:
+        """A journal mutation hit :class:`FencedError`: a newer epoch
+        owns the fleet.  Callable from any thread (the demotion runs
+        on the coordinator thread — never inline, a fenced autoscaler
+        tick must not join its own thread)."""
+        self._fenced.set()
+
+    def try_acquire(self) -> bool:
+        """One acquisition attempt + role flip on success (the
+        symmetric ``--peer`` start and the standby's takeover path)."""
+        if not self.lease.acquire():
+            _epoch_g.set(float(self.lease.observed_epoch()))
+            return False
+        self._become_primary()
+        return True
+
+    def _become_primary(self) -> None:
+        with self._lock:
+            self._role = "primary"
+        self._fenced.clear()
+        epoch = self.lease.epoch or 0
+        self.store.set_writer_epoch(epoch,
+                                    fence=self.lease.observed_epoch)
+        _role_g.set(1.0)
+        _epoch_g.set(float(epoch))
+        try:
+            # the epoch bump is itself journaled: replay and the
+            # chaos drill read leadership history from the one log
+            self.store.append("lease", epoch=epoch,
+                              holder=self.lease.holder,
+                              url=self.lease.url)
+        except OSError as e:
+            log.warning("lease journal record not durable: %s", e)
+        log.info("ha: primary (epoch %d, holder %s)", epoch,
+                 self.lease.holder)
+
+    def _demote(self, reason: str) -> None:
+        with self._lock:
+            already = self._role == "standby"
+            self._role = "standby"
+            if not already:
+                self._demotions += 1
+        if already:
+            return
+        _demotions.inc()
+        _role_g.set(0.0)
+        self.store.set_writer_epoch(None)
+        self.lease.step_down()
+        _epoch_g.set(float(self.lease.observed_epoch()))
+        self._fenced.clear()
+        log.warning("ha: demoted to standby (%s) — refusing "
+                    "mutations, children left to the new primary",
+                    reason)
+        if self._demote_fn is not None:
+            try:
+                self._demote_fn()
+            except Exception:
+                log.exception("ha: demote hook failed")
+
+    def _promote(self) -> None:
+        with self._lock:
+            self._takeovers += 1
+        _takeovers.inc()
+        log.warning("ha: lease acquired (epoch %d) — promoting",
+                    self.lease.epoch or 0)
+        if self._promote_fn is not None:
+            try:
+                self._promote_fn(self.tailer.state)
+            except Exception:
+                # a half-failed promotion still holds the lease: the
+                # router serves what it adopted; the next renew tick
+                # keeps leadership honest
+                log.exception("ha: promote hook failed")
+
+    # -- the watch/renew loop ----------------------------------------------
+    def probe_peer(self) -> bool | None:
+        """One bounded ``/healthz`` probe at the primary (None when
+        no peer url is known).  Advisory only: leadership is decided
+        by the lease, not the probe — a partition that hides the
+        primary's healthz must NOT start a second primary while the
+        lease is being renewed."""
+        url = self.primary_url()
+        if not url:
+            return None
+        probe = url if url.endswith("/") else url + "/"
+        try:
+            with urllib.request.urlopen(
+                    probe + "healthz",
+                    timeout=self.probe_timeout_s) as r:
+                ok = r.status == 200
+        except Exception:
+            ok = False
+        with self._lock:
+            self._peer_healthy = ok
+        return ok
+
+    def step(self) -> str:
+        """One tick of the role machine (the loop body, callable
+        directly from tests): renew when primary, watch/acquire when
+        standby.  Returns the action taken."""
+        if self.is_primary():
+            if self._fenced.is_set():
+                self._demote("fenced journal mutation")
+                return "demoted"
+            if not self.lease.renew():
+                self._demote(f"lease lost to epoch "
+                             f"{self.lease.observed_epoch()}")
+                return "demoted"
+            _epoch_g.set(float(self.lease.epoch or 0))
+            return "renewed"
+        # standby: keep state warm, watch the primary, take over on
+        # an expired/abandoned lease
+        self.tailer.poll()
+        self.probe_peer()
+        rec = self.lease.read()
+        _epoch_g.set(float(self.lease.observed_epoch()))
+        if rec is not None and not self.lease.expired(rec) \
+                and self.lease.holder_alive(rec):
+            return "watching"
+        if self.try_acquire():
+            self.tailer.poll()            # fold the journal's tail
+            self._promote()
+            return "promoted"
+        return "watching"
+
+    def _run(self) -> None:
+        while True:
+            interval = (self.renew_interval_s if self.is_primary()
+                        else max(0.1, self.ttl_s / 4.0))
+            if self._stop.wait(interval):
+                return
+            try:
+                self.step()
+            except Exception:             # the loop must outlive a tick
+                log.exception("ha: coordinator tick failed")
+
+    def start(self) -> "HACoordinator":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="znicz-fleet-ha")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        if self.is_primary():
+            self.lease.step_down()
+
+
+def settle_control_plane(router, scaler, launcher, store, state, *,
+                         reconcile_deadline_s: float = 30.0,
+                         min_backends: int = 1) -> dict:
+    """Bring a router's control plane to SETTLED from replayed journal
+    state: re-adopt journaled children in place (never re-boot a
+    survivor), boot only the floor shortfall, replay last-write-wins
+    weights and pins, then close the reconcile window.  Shared by the
+    route CLI's primary boot and the standby's promotion — both paths
+    answer 503 + Retry-After while this runs."""
+    outcomes: dict = {}
+    if scaler is not None and state.children:
+        from .autoscaler import reconcile_children
+        outcomes = reconcile_children(
+            router, scaler, launcher, state.children,
+            deadline_s=reconcile_deadline_s)
+        print(f"reconcile: {outcomes}", flush=True)
+    elif state.children:
+        print(f"reconcile: journal records {len(state.children)} "
+              f"children but --autoscale is off — leaving them "
+              f"untouched", flush=True)
+    if scaler is not None and launcher is not None:
+        # the floor covers only what re-adoption missed
+        while router.backend_count() < max(1, int(min_backends)):
+            b, proc = launcher.spawn(scaler.next_index())
+            router.add_backend(b)
+            scaler.adopt(b, proc)
+            print(f"autoscale: booted floor backend {b.name} at "
+                  f"{b.url}", flush=True)
+    for nm, w in state.weights.items():
+        rb = router.by_name.get(nm)
+        if rb is not None:
+            try:
+                rb.set_weight(w)
+            except ValueError:
+                pass
+    if state.pins and router.placement is not None:
+        router.placement.restore_pins(state.pins)
+        router.recompute_placement(cause="admin")
+    router.end_reconcile()
+    print(f"reconcile: settled ({state.records} journal records "
+          f"replayed)", flush=True)
+    return outcomes

@@ -37,10 +37,23 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 from ..promotion.controller import HttpTarget
 from ..promotion.slo import BurnRatePolicy, SLOSample
+
+
+def _as_url_list(router_url) -> list:
+    """Normalize ``router_url`` (None | str | iterable of str) to a
+    trailing-slash url list — the HA client contract: a fleet fronted
+    by a primary + hot standbys is addressed by ALL router urls, and
+    callers fail over on transport error."""
+    if router_url is None:
+        return []
+    if isinstance(router_url, str):
+        router_url = [router_url]
+    return [u if u.endswith("/") else u + "/" for u in router_url]
 
 
 def merge_samples(samples) -> SLOSample:
@@ -75,9 +88,15 @@ class FleetTarget:
     through their own ``/admin/reload`` + ``/metrics`` surfaces; the
     router is only consulted for traffic weights (``POST
     /admin/weight``) — ``router_url=None`` degrades to a walk without
-    traffic splitting."""
+    traffic splitting.
 
-    def __init__(self, backend_urls, *, router_url: str | None = None,
+    ``router_url`` accepts one url or a LIST of them (an HA pair:
+    primary + hot standbys, fleet/ha.py): requests go to the active
+    url and rotate to the next on transport error — an HTTP answer,
+    including a standby's 503 + Retry-After, is handled by the
+    existing best-effort discipline, not treated as router death."""
+
+    def __init__(self, backend_urls, *, router_url=None,
                  admin_token: str | None = None, timeout_s: float = 60.0,
                  canary_weight: float | None = 0.25,
                  walk_weight: float | None = None,
@@ -89,9 +108,8 @@ class FleetTarget:
                              "backend url")
         self.urls = [u if u.endswith("/") else u + "/"
                      for u in backend_urls]
-        self.router_url = (None if router_url is None else
-                           (router_url if router_url.endswith("/")
-                            else router_url + "/"))
+        self.router_urls = _as_url_list(router_url)
+        self._router_active = 0
         self.admin_token = admin_token
         self.timeout_s = float(timeout_s)
         #: router-weight multiplier for the canarying backend during
@@ -121,21 +139,41 @@ class FleetTarget:
                         "fleet_size": len(self.urls),
                         "last_outcome": None}
 
+    @property
+    def router_url(self) -> str | None:
+        """The currently-active router url (the one the last request
+        succeeded against); None without a router."""
+        if not self.router_urls:
+            return None
+        return self.router_urls[self._router_active
+                                % len(self.router_urls)]
+
     @classmethod
-    def from_router(cls, router_url: str, **kwargs) -> "FleetTarget":
+    def from_router(cls, router_url, **kwargs) -> "FleetTarget":
         """Discover the backend urls from a running router's
         ``/healthz`` and build a target over them (the
-        ``promote --fleet`` CLI path)."""
-        url = router_url if router_url.endswith("/") else \
-            router_url + "/"
-        with urllib.request.urlopen(url + "healthz",
-                                    timeout=30) as r:
-            health = json.loads(r.read())
+        ``promote --fleet`` CLI path).  ``router_url`` may be a list
+        (HA pair): discovery tries each in order — any replica's
+        /healthz lists the fleet, primary or standby."""
+        urls = _as_url_list(router_url)
+        last_error: Exception | None = None
+        health = None
+        for url in urls:
+            try:
+                with urllib.request.urlopen(url + "healthz",
+                                            timeout=30) as r:
+                    health = json.loads(r.read())
+                break
+            except Exception as e:
+                last_error = e
+        if health is None:
+            raise ValueError(f"no router of {urls} answered "
+                             f"/healthz: {last_error}")
         rows = health.get("backends") or []
         if not rows:
             raise ValueError(f"router {router_url} reports no "
                              f"backends")
-        return cls([row["url"] for row in rows], router_url=url,
+        return cls([row["url"] for row in rows], router_url=urls,
                    **kwargs)
 
     # -- controller protocol ----------------------------------------------
@@ -323,18 +361,45 @@ class FleetTarget:
         return ok
 
     # -- router weight control --------------------------------------------
+    def _router_request(self, path: str, body: bytes | None = None,
+                        headers: dict | None = None) -> bytes:
+        """One request against the active router url, failing over
+        to the next url on TRANSPORT error only (connection refused,
+        reset, timeout).  An HTTP error status is an ANSWER — a
+        standby's 503 + Retry-After or a 404 must reach the caller's
+        own discipline, not trigger a pointless rotation.  The url
+        that answers becomes the new active one.  Raises the last
+        transport error when every url is down."""
+        last: Exception | None = None
+        n = len(self.router_urls)
+        for hop in range(n):
+            i = (self._router_active + hop) % n
+            url = self.router_urls[i]
+            req = urllib.request.Request(url + path, body,
+                                         headers or {})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    data = r.read()
+                self._router_active = i
+                return data
+            except urllib.error.HTTPError:
+                self._router_active = i
+                raise
+            except (urllib.error.URLError, OSError) as e:
+                last = e
+        raise last if last is not None \
+            else OSError("no router urls configured")
+
     def _backend_names(self) -> dict:
         """url -> (router backend name, base weight), fetched once
         from the router's /healthz; {} without a router."""
         if self._names is not None:
             return self._names
-        if self.router_url is None:
+        if not self.router_urls:
             self._names = {}
             return self._names
         try:
-            with urllib.request.urlopen(self.router_url + "healthz",
-                                        timeout=30) as r:
-                health = json.loads(r.read())
+            health = json.loads(self._router_request("healthz"))
             self._names = {row["url"]: (row["name"], row["weight"])
                            for row in health.get("backends") or []}
         except Exception:
@@ -359,11 +424,8 @@ class FleetTarget:
         headers = {"Content-Type": "application/json"}
         if self.admin_token is not None:
             headers["X-Admin-Token"] = self.admin_token
-        req = urllib.request.Request(
-            self.router_url + "admin/weight", body, headers)
         try:
-            with urllib.request.urlopen(req, timeout=30) as r:
-                r.read()
+            self._router_request("admin/weight", body, headers)
         except Exception:
             pass
 
@@ -374,16 +436,13 @@ class FleetTarget:
         ``--placement`` (404), or a transient refusal must not fail
         the promotion — the prober's discovery recompute converges
         the map anyway, just later."""
-        if self.router_url is None:
+        if not self.router_urls:
             return
         body = json.dumps({"action": "rebalance"}).encode()
         headers = {"Content-Type": "application/json"}
         if self.admin_token is not None:
             headers["X-Admin-Token"] = self.admin_token
-        req = urllib.request.Request(
-            self.router_url + "admin/placement", body, headers)
         try:
-            with urllib.request.urlopen(req, timeout=30) as r:
-                r.read()
+            self._router_request("admin/placement", body, headers)
         except Exception:
             pass

@@ -607,6 +607,15 @@ class FleetRouter:
         #: journaled so a restarted router replays its decisions
         #: (docs/fleet.md "Control-plane durability")
         self.statestore = statestore
+        #: leased high availability (fleet/ha.py): while ``_standby``
+        #: is True this process does not hold the leadership lease —
+        #: /predict and admin mutations answer 503 + Retry-After
+        #: (with the primary's url as a hint) and only /healthz,
+        #: /statusz, /metrics and /tracez serve.  Flag reads/writes
+        #: are plain attribute ops (atomic under the GIL): the gate
+        #: must never take a lock on the request path.
+        self._standby = False
+        self._ha = None
         #: gray-failure demotion policy (None = detector off: the
         #: EWMAs still fold, nothing decays)
         self.gray = gray
@@ -855,6 +864,12 @@ class FleetRouter:
                 raw = self._read_body()
                 if raw is None:
                     return
+                refusal = outer.standby_refusal()
+                if refusal is not None:
+                    hdrs = {"Retry-After":
+                            str(refusal["retry_after_s"])}
+                    self._reply(503, refusal, hdrs)
+                    return
                 try:
                     payload = _json_object(raw)
                     name = payload["backend"]
@@ -869,12 +884,22 @@ class FleetRouter:
                                  f"(backends: "
                                  f"{sorted(outer.by_name)})"})
                     return
-                try:
-                    backend.set_weight(weight)
-                except ValueError as e:
-                    self._reply(400, {"error": str(e)})
+                if weight < 0:
+                    self._reply(400, {"error": f"weight must be "
+                                               f">= 0, got {weight}"})
                     return
-                outer._journal("weight", backend=name, weight=weight)
+                # journal FIRST: an un-journalable or fenced mutation
+                # is refused before any in-memory state moves — a
+                # weight that applied but didn't persist would
+                # silently revert on the next failover
+                refused = outer.journal_mutation(
+                    "weight", backend=name, weight=weight)
+                if refused is not None:
+                    hdrs = {"Retry-After":
+                            str(refused["retry_after_s"])}
+                    self._reply(503, refused, hdrs)
+                    return
+                backend.set_weight(weight)
                 self._reply(200, {"backend": name, "weight": weight})
 
             def _admin_placement(self):
@@ -895,6 +920,12 @@ class FleetRouter:
                     return
                 raw = self._read_body()
                 if raw is None:
+                    return
+                refusal = outer.standby_refusal()
+                if refusal is not None:
+                    hdrs = {"Retry-After":
+                            str(refusal["retry_after_s"])}
+                    self._reply(503, refusal, hdrs)
                     return
                 if outer.placement is None:
                     self._reply(404, {
@@ -938,18 +969,39 @@ class FleetRouter:
                                      f"{unknown[0]!r} (backends: "
                                      f"{sorted(outer.by_name)})"})
                         return
+                # journal FIRST (same discipline as /admin/weight)
+                if model is not None:
+                    refused = outer.journal_mutation(
+                        "pin", model=model, backends=pin)
+                else:
+                    refused = outer.journal_mutation("rebalance")
+                if refused is not None:
+                    hdrs = {"Retry-After":
+                            str(refused["retry_after_s"])}
+                    self._reply(503, refused, hdrs)
+                    return
                 if model is not None:
                     outer.placement.pin(model, pin)
-                    outer._journal("pin", model=model, backends=pin)
                     plan = outer.recompute_placement(cause="pin")
                 else:
-                    outer._journal("rebalance")
                     plan = outer.recompute_placement(cause="admin")
                 self._reply(200, plan)
 
             def _predict(self, t0: float):
                 raw = self._read_body()
                 if raw is None:
+                    return
+                refusal = outer.standby_refusal()
+                if refusal is not None:
+                    # hot standby: honestly not serving.  Bounded
+                    # 503 + Retry-After (one lease TTL) with the
+                    # primary's url as the failover hint — never a
+                    # silent forward from a replica that doesn't own
+                    # the lease.
+                    self._rec_error = "standby: not the primary"
+                    hdrs = {"Retry-After":
+                            str(refusal["retry_after_s"])}
+                    self._reply(503, refusal, hdrs)
                     return
                 ra = outer.reconcile_retry_after()
                 if ra is not None:
@@ -1216,17 +1268,82 @@ class FleetRouter:
 
     # -- control-plane journal (route --state-dir) -------------------------
     def _journal(self, kind: str, **fields) -> None:
-        """Durably record one control-plane mutation.  Best-effort by
+        """Durably record one control-plane mutation that ALREADY
+        happened (membership, ejection audit).  Best-effort by
         design: a full disk must degrade durability, never take down
-        the data plane."""
+        the data plane.  A FENCED append additionally pokes the HA
+        coordinator — a newer epoch owns the fleet and this process
+        must demote (on the coordinator's thread, never this one)."""
         if self.statestore is None:
             return
         try:
             self.statestore.append(kind, **fields)
+        except statestore_mod.FencedError as e:
+            log.warning("control-plane journal append fenced "
+                        "(%s): %s", kind, e)
+            if self._ha is not None:
+                self._ha.note_fenced()
         except OSError as e:
             log.warning("control-plane journal append failed "
                         "(%s: %s) — continuing without durability",
                         kind, e)
+
+    def journal_mutation(self, kind: str, **fields) -> dict | None:
+        """Journal-FIRST gate for admin mutations (weight, pin,
+        rebalance): the record must be durable BEFORE the in-memory
+        state changes.  Returns None when journaled (or no journal is
+        attached — plain routers stay available), else a refusal body
+        for an honest 503: an un-journalable mutation (ENOSPC — the
+        ``statestore.append`` fault site) or a fenced one (a newer
+        leadership epoch) is REFUSED, never half-applied, while reads
+        and /predict keep serving."""
+        if self.statestore is None:
+            return None
+        try:
+            self.statestore.append(kind, **fields)
+        except statestore_mod.FencedError as e:
+            if self._ha is not None:
+                self._ha.note_fenced()
+            return {"error": f"mutation fenced: {e}",
+                    "retry_after_s": self.retry_after()}
+        except OSError as e:
+            return {"error": f"control-plane journal unavailable "
+                             f"({e}) — mutation refused, reads still "
+                             f"serving",
+                    "retry_after_s": self.retry_after()}
+        return None
+
+    # -- leased high availability (fleet/ha.py) ----------------------------
+    def attach_ha(self, coordinator) -> None:
+        """Surface an HA coordinator's role/epoch on ``/healthz`` /
+        ``/statusz`` and let fenced journal appends trigger its
+        demotion — the same attach idiom as :meth:`attach_rollout`."""
+        self._ha = coordinator
+
+    def set_standby(self, standby: bool) -> None:
+        self._standby = bool(standby)
+
+    def is_standby(self) -> bool:
+        return self._standby
+
+    def standby_refusal(self) -> dict | None:
+        """The refusal body while this process is a hot standby
+        (None when primary): 503-shaped, Retry-After sized to one
+        lease TTL (by then either the primary answered or this
+        standby owns the lease), with the primary's url as a
+        failover hint for multi-url clients."""
+        if not self._standby:
+            return None
+        ha = self._ha
+        ra = (ha.retry_after_s() if ha is not None
+              else self.retry_after())
+        out = {"error": "standby router: this replica does not hold "
+                        "the leadership lease",
+               "retry_after_s": ra}
+        primary = ha.primary_url() if ha is not None else None
+        if primary:
+            out["primary"] = primary
+        return out
 
     def gray_alpha(self) -> float:
         return self.gray.alpha if self.gray is not None else 0.3
@@ -1678,6 +1795,17 @@ class FleetRouter:
                 "journal": self.statestore.path}
             if ra is not None:
                 out["reconcile"]["retry_after_s"] = ra
+            if self.statestore.degraded:
+                # honest degradation (ENOSPC): mutations refused,
+                # reads still serving
+                out["reconcile"]["degraded"] = True
+        if self._ha is not None:
+            # opt-in block, same rule as placement/autoscale: the
+            # HA-less /healthz shape must not grow keys
+            try:
+                out["ha"] = self._ha.status()
+            except Exception:
+                out["ha"] = {"role": "unknown"}
         ps = self.placement_status()
         if ps is not None:
             # opt-in block, the zoo-surface rule: the placement-less
@@ -1889,6 +2017,32 @@ def main(argv=None) -> int:
                         "behavior; without --state-dir teardown is "
                         "always on — there is no journal to re-adopt "
                         "from)")
+    ha_g = p.add_argument_group(
+        "high availability (docs/fleet.md 'Router high "
+        "availability') — leased leadership over --state-dir: the "
+        "primary renews DIR/lease.json on a tick; a standby tails "
+        "the journal, probes the primary, and takes over (bumping "
+        "the fencing epoch) when the lease expires")
+    ha_g.add_argument("--standby-of", default=None, metavar="URL",
+                      help="start as a hot standby of the primary at "
+                           "URL: refuse /predict and admin mutations "
+                           "with 503 + Retry-After, tail the journal "
+                           "to keep weights/pins/children warm, and "
+                           "take over on lease expiry (requires "
+                           "--state-dir on the SAME directory)")
+    ha_g.add_argument("--peer", default=None, metavar="URL",
+                      help="symmetric HA: race for the lease at boot "
+                           "— winner serves, loser runs as a hot "
+                           "standby of URL (requires --state-dir; "
+                           "mutually exclusive with --standby-of)")
+    ha_g.add_argument("--lease-ttl-s", type=float, default=3.0,
+                      help="leadership lease TTL: a standby may take "
+                           "over this long after the primary's last "
+                           "renewal (failover completes within ~2x "
+                           "this; standby 503s advertise it as "
+                           "Retry-After)")
+    ha_g.add_argument("--lease-renew-s", type=float, default=None,
+                      help="primary renew tick (default: ttl/3)")
     d.add_argument("--no-gray-demotion", dest="gray",
                    action="store_false", default=True,
                    help="disable gray-failure demotion (on by "
@@ -1962,6 +2116,15 @@ def main(argv=None) -> int:
     g.add_argument("--autoscale-log-dir", default=None,
                    help="directory for booted backends' logs "
                         "(default: discard)")
+    g.add_argument("--crash-loop-threshold", type=int, default=3,
+                   help="boot failures inside --crash-loop-window-s "
+                        "that stop the boot loop for good (sticky, "
+                        "with the failing child's log tail printed) "
+                        "— a child that dies instantly on every boot "
+                        "means the serve command is broken")
+    g.add_argument("--crash-loop-window-s", type=float, default=60.0,
+                   help="sliding window the crash-loop threshold "
+                        "counts boot failures over")
     args = p.parse_args(argv)
     if not args.backend and not args.autoscale:
         p.error("at least one --backend is required (or --autoscale, "
@@ -1974,6 +2137,15 @@ def main(argv=None) -> int:
                 "entries to cover --min-backends")
     if args.placement < 0:
         p.error("--placement must be >= 0")
+    if args.standby_of and args.peer:
+        p.error("--standby-of and --peer are mutually exclusive "
+                "(--standby-of starts as standby; --peer races for "
+                "the lease)")
+    if (args.standby_of or args.peer) and not args.state_dir:
+        p.error("--standby-of/--peer need --state-dir: the lease and "
+                "the journal live there, shared by both replicas")
+    if args.lease_ttl_s <= 0:
+        p.error("--lease-ttl-s must be > 0")
     if args.gray_strikes < 1:
         p.error("--gray-strikes must be >= 1")
     if not 0.0 < args.gray_decay < 1.0:
@@ -2006,6 +2178,7 @@ def main(argv=None) -> int:
     scaler = None
     booted = []
     router = None
+    coordinator = None
     try:
         if args.autoscale:
             from .autoscaler import Autoscaler, ServeLauncher
@@ -2041,8 +2214,25 @@ def main(argv=None) -> int:
             trace_head_rate=args.trace_head_rate,
             trace_tail_fraction=args.trace_tail_fraction,
             allow_empty=store is not None and args.autoscale)
+        primary = True
         if store is not None:
-            router.begin_reconcile(args.reconcile_deadline_s)
+            # HA is always on with a state dir: a solo router simply
+            # holds an uncontested lease (epoch 1).  --standby-of
+            # starts watching; --peer (or a plain second route over a
+            # LIVE lease) races and the loser auto-demotes — a
+            # resurrected old primary rejoins as a fenced standby.
+            from . import ha as ha_mod
+            coordinator = ha_mod.HACoordinator(
+                store, url=router.url,
+                peer_url=args.standby_of or args.peer,
+                ttl_s=args.lease_ttl_s,
+                renew_interval_s=args.lease_renew_s)
+            primary = (False if args.standby_of
+                       else coordinator.try_acquire())
+            if primary:
+                router.begin_reconcile(args.reconcile_deadline_s)
+            else:
+                router.set_standby(True)
         router.start()
         if args.autoscale:
             scaler = Autoscaler(
@@ -2060,47 +2250,48 @@ def main(argv=None) -> int:
                 idle_rps=args.idle_rps,
                 cooldown_s=args.autoscale_cooldown_s,
                 drain_timeout_s=args.drain_timeout_s,
+                crash_loop_threshold=args.crash_loop_threshold,
+                crash_loop_window_s=args.crash_loop_window_s,
                 statestore=store)
             for b, proc in booted:
                 scaler.adopt(b, proc)
         if store is not None:
-            if scaler is not None and replayed.children:
-                from .autoscaler import reconcile_children
-                outcomes = reconcile_children(
-                    router, scaler, launcher, replayed.children,
-                    deadline_s=args.reconcile_deadline_s)
-                print(f"reconcile: {outcomes}", flush=True)
-            elif replayed.children:
-                print(f"reconcile: journal records "
-                      f"{len(replayed.children)} children but "
-                      f"--autoscale is off — leaving them untouched",
-                      flush=True)
+            def _on_promote(state):
+                # takeover: close the gate last — reconcile first so
+                # the first served request lands on adopted, probed
+                # backends, not half-warm ones
+                router.begin_reconcile(args.reconcile_deadline_s)
+                router.set_standby(False)
+                ha_mod.settle_control_plane(
+                    router, scaler, launcher, store, state,
+                    reconcile_deadline_s=args.reconcile_deadline_s,
+                    min_backends=max(1, args.min_backends))
+                if scaler is not None:
+                    scaler.start()
+
+            def _on_demote():
+                # children are NOT drained: the new primary owns them
+                router.set_standby(True)
+                if scaler is not None:
+                    scaler.stop()
+
+            coordinator.attach(router=router, promote=_on_promote,
+                               demote=_on_demote)
             if scaler is not None:
-                # the floor covers only what re-adoption missed
-                while router.backend_count() < max(1,
-                                                   args.min_backends):
-                    b, proc = launcher.spawn(scaler.next_index())
-                    router.add_backend(b)
-                    scaler.adopt(b, proc)
-                    print(f"autoscale: booted floor backend {b.name} "
-                          f"at {b.url}", flush=True)
-            # replay the operator's decisions onto the reconciled
-            # membership: last-write-wins weights, then pins in one
-            # recompute
-            for nm, w in replayed.weights.items():
-                rb = router.by_name.get(nm)
-                if rb is not None:
-                    try:
-                        rb.set_weight(w)
-                    except ValueError:
-                        pass
-            if replayed.pins and engine is not None:
-                engine.restore_pins(replayed.pins)
-                router.recompute_placement(cause="admin")
-            router.end_reconcile()
-            print(f"reconcile: settled ({replayed.records} journal "
-                  f"records replayed)", flush=True)
-        if scaler is not None:
+                scaler.on_fenced = coordinator.note_fenced
+            if primary:
+                ha_mod.settle_control_plane(
+                    router, scaler, launcher, store, replayed,
+                    reconcile_deadline_s=args.reconcile_deadline_s,
+                    min_backends=max(1, args.min_backends))
+            else:
+                print(f"ha: standby (epoch "
+                      f"{coordinator.lease.observed_epoch()} held "
+                      f"elsewhere) — tailing the journal, refusing "
+                      f"traffic with 503 + Retry-After until the "
+                      f"lease is ours", flush=True)
+            coordinator.start()
+        if scaler is not None and primary:
             scaler.start()
         names = [b.name for b in router._backend_list()]
         print(f"routing {len(names)} backend(s) {names} at "
@@ -2110,6 +2301,9 @@ def main(argv=None) -> int:
               + (f"; placement replication={args.placement}"
                  if engine is not None else "")
               + ("; autoscale on" if scaler is not None else "")
+              + (f"; ha {'primary' if primary else 'standby'} "
+                 f"epoch {coordinator.epoch}"
+                 if coordinator is not None else "")
               + ")", flush=True)
         stop = threading.Event()
 
@@ -2126,6 +2320,10 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if coordinator is not None:
+            # step down FIRST: back-dating the lease lets the peer
+            # take over immediately instead of waiting out the TTL
+            coordinator.stop()
         if scaler is not None:
             # without a journal: drain every managed backend
             # gracefully (SIGTERM → the serve drain path → exit 0),

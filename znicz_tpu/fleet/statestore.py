@@ -35,10 +35,26 @@ manifest protocol, PR 6's fsync'd promotion ledger):
   ``send_signal`` / ``terminate`` / ``kill`` / bounded ``wait`` via
   signal-0 liveness polling, every signal gated on the identity
   check above.
+* **Epoch fencing** (fleet/ha.py) — when leased leadership is active
+  the store carries the holder's ``writer_epoch``: every appended
+  record is stamped with it, and a ``fence`` callable (the lease
+  file's authoritative epoch) is consulted first.  A newer epoch in
+  the lease means this writer was DEPOSED — the append raises
+  :class:`FencedError` *before* touching the journal, so a stale
+  primary waking from a GC pause can never journal a mutation the
+  new primary didn't make.
+* **Honest degradation** — :meth:`StateStore.append` is the
+  ``statestore.append`` chaos fault site (docs: faults.py table).  A
+  failed journal write/fsync (ENOSPC, dying disk) marks the store
+  ``degraded`` and propagates the OSError: callers refuse the
+  *mutation* (503 + Retry-After) while reads and /predict keep
+  serving — a full disk must not silently drop operator intent, and
+  must not take the data plane down either.
 
 Families: ``controlplane_journal_records_total{kind}``,
 ``backend_adopted_total{outcome}`` (reconciliation verdicts, one per
-journaled child), and the ``controlplane_reconcile_state`` enum gauge
+journaled child), ``ha_fenced_mutations_total{action}`` (stale-epoch
+writes refused), and the ``controlplane_reconcile_state`` enum gauge
 (0 = no journal attached, 1 = replaying/reconciling, 2 = settled) —
 docs/observability.md.
 """
@@ -54,6 +70,7 @@ import subprocess
 import threading
 import time
 
+from ..resilience import faults
 from ..telemetry.registry import REGISTRY
 
 log = logging.getLogger("fleet")
@@ -83,10 +100,34 @@ _reconcile_g = REGISTRY.gauge(
     "restart-reconciliation state of the fleet control plane (0 = no "
     "state dir attached, 1 = journal replayed and children being "
     "re-probed — /predict answers 503 + Retry-After, 2 = settled)")
+_fenced_mutations = REGISTRY.counter(
+    "ha_fenced_mutations_total",
+    "control-plane mutations refused by epoch fencing, by action "
+    "(journal record kind, or boot | drain for autoscaler actions "
+    "stopped before spawning/signalling): a deposed primary tried to "
+    "write with a stale leadership epoch")
 
 
 def set_reconcile_state(state: int) -> None:
     _reconcile_g.set(float(state))
+
+
+class FencedError(RuntimeError):
+    """A control-plane mutation was refused because the lease file
+    carries a newer leadership epoch than this writer holds: this
+    process was deposed (GC pause, partition, operator takeover) and
+    must demote itself instead of writing.  Deliberately NOT an
+    OSError — durability problems degrade, fencing *deposes*."""
+
+    def __init__(self, action: str, writer_epoch: int,
+                 authoritative_epoch: int):
+        super().__init__(
+            f"{action}: writer epoch {writer_epoch} fenced by "
+            f"authoritative epoch {authoritative_epoch} — this "
+            f"process is no longer the primary")
+        self.action = action
+        self.writer_epoch = writer_epoch
+        self.authoritative_epoch = authoritative_epoch
 
 
 def process_identity(pid: int) -> str | None:
@@ -194,8 +235,56 @@ class ControlPlaneState:
     #: live autoscaler children: name → latest boot/adopt record
     #: (pid, port, url, args, identity), minus drained ones
     children: dict = dataclasses.field(default_factory=dict)
+    #: highest leadership epoch journaled (fleet/ha.py ``lease``
+    #: records; 0 before HA ever ran)
+    epoch: int = 0
     #: parseable records folded (torn/junk lines excluded)
     records: int = 0
+
+
+def fold_entry(st: ControlPlaneState, entry: dict) -> None:
+    """Fold ONE journal record into the state: weights and pins are
+    last-write-wins; ``boot``/``adopt`` add (or refresh) a child,
+    ``drain`` and ``leave`` remove it; ``lease`` advances the epoch
+    high-water mark; ``ejection``/``rebalance`` and unknown kinds are
+    audit-only.  Shared by :meth:`StateStore.replay` and the HA
+    standby's incremental journal tailer (fleet/ha.py) so warm state
+    and restart state can never fold differently."""
+    kind = entry.get("kind")
+    name = entry.get("backend")
+    if kind == "weight" and name:
+        try:
+            st.weights[str(name)] = float(entry.get("weight"))
+        except (TypeError, ValueError):
+            pass
+    elif kind == "pin":
+        model = entry.get("model")
+        if not model:
+            return
+        pin = entry.get("backends")
+        if pin:
+            st.pins[str(model)] = [str(n) for n in pin]
+        else:
+            st.pins.pop(str(model), None)
+    elif kind == "join" and name:
+        st.members[str(name)] = entry.get("url")
+    elif kind == "leave" and name:
+        st.members.pop(str(name), None)
+        st.children.pop(str(name), None)
+    elif kind in ("boot", "adopt") and name:
+        st.children[str(name)] = {
+            "pid": entry.get("pid"),
+            "port": entry.get("port"),
+            "url": entry.get("url"),
+            "args": entry.get("args") or [],
+            "identity": entry.get("identity")}
+    elif kind == "drain" and name:
+        st.children.pop(str(name), None)
+    elif kind == "lease":
+        try:
+            st.epoch = max(st.epoch, int(entry.get("epoch", 0)))
+        except (TypeError, ValueError):
+            pass
 
 
 class StateStore:
@@ -208,19 +297,83 @@ class StateStore:
         self.state_dir = os.fspath(state_dir)
         self.path = os.path.join(self.state_dir, JOURNAL_NAME)
         self._lock = threading.Lock()
+        #: leadership epoch stamped on every append; None = HA off
+        #: (plain PR 17 operation, records carry no epoch)
+        self.writer_epoch: int | None = None
+        #: zero-arg callable returning the authoritative epoch (the
+        #: lease file); consulted before every stamped append
+        self._fence = None
+        #: True after a failed journal write (ENOSPC, dying disk) —
+        #: the control plane is refusing mutations but still serving
+        self.degraded = False
+
+    def set_writer_epoch(self, epoch: int | None,
+                         fence=None) -> None:
+        """Arm (or disarm, epoch None) epoch fencing.  ``fence`` is a
+        zero-arg callable returning the authoritative epoch — in
+        production the HA coordinator passes the lease-file reader,
+        so a deposed writer discovers its deposition on its very next
+        mutation, not on some later tick."""
+        self.writer_epoch = int(epoch) if epoch is not None else None
+        self._fence = fence if epoch is not None else None
+
+    def authoritative_epoch(self) -> int | None:
+        """What the fence says right now (None when unfenced)."""
+        if self._fence is None:
+            return None
+        try:
+            return int(self._fence())
+        except Exception:
+            # an unreadable lease must not wedge the primary: treat
+            # as "no newer epoch observed"
+            return None
+
+    def fenced(self) -> bool:
+        """True when the authoritative epoch has moved past ours —
+        every mutation path (append, autoscaler boot/drain) checks
+        this before acting."""
+        if self.writer_epoch is None:
+            return False
+        auth = self.authoritative_epoch()
+        return auth is not None and auth > self.writer_epoch
+
+    def _check_fence(self, action: str) -> None:
+        if self.writer_epoch is None:
+            return
+        auth = self.authoritative_epoch()
+        if auth is not None and auth > self.writer_epoch:
+            _fenced_mutations.inc(action=str(action))
+            raise FencedError(str(action), self.writer_epoch, auth)
 
     def append(self, kind: str, **fields) -> dict:
         """Durably journal one mutation (``{"ts", "kind", ...}``).
         fsync per record: control-plane mutations are rare and each
-        one is exactly what a post-crash replay needs."""
+        one is exactly what a post-crash replay needs.
+
+        With fencing armed the record is stamped with ``epoch`` and
+        the fence is checked FIRST — a deposed writer raises
+        :class:`FencedError` without touching the journal.  A write
+        failure (the ``statestore.append`` chaos fault site) marks
+        the store ``degraded`` and re-raises the OSError: the caller
+        refuses the mutation honestly instead of pretending it was
+        durable."""
+        self._check_fence(kind)
         entry = {"ts": time.time(), "kind": kind, **fields}
+        if self.writer_epoch is not None:
+            entry["epoch"] = self.writer_epoch
         line = json.dumps(entry, sort_keys=True, default=str) + "\n"
-        with self._lock:
-            os.makedirs(self.state_dir, exist_ok=True)
-            with open(self.path, "a") as fh:
-                fh.write(line)
-                fh.flush()
-                os.fsync(fh.fileno())
+        try:
+            faults.inject("statestore.append")
+            with self._lock:
+                os.makedirs(self.state_dir, exist_ok=True)
+                with open(self.path, "a") as fh:
+                    fh.write(line)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+        except OSError:
+            self.degraded = True
+            raise
+        self.degraded = False
         _journal_records.inc(kind=str(kind))
         return entry
 
@@ -251,43 +404,15 @@ class StateStore:
         return out
 
     def replay(self) -> ControlPlaneState:
-        """Fold the journal into restart state: weights and pins are
-        last-write-wins; ``boot``/``adopt`` add (or refresh) a child,
-        ``drain`` and ``leave`` remove it; ``ejection`` and
-        ``rebalance`` are audit-only."""
+        """Fold the journal into restart state via
+        :func:`fold_entry`: weights and pins are last-write-wins;
+        ``boot``/``adopt`` add (or refresh) a child, ``drain`` and
+        ``leave`` remove it; ``lease`` advances the epoch; unknown
+        kinds are audit-only."""
         st = ControlPlaneState()
         for entry in self.entries():
-            kind = entry.get("kind")
-            name = entry.get("backend")
             st.records += 1
-            if kind == "weight" and name:
-                try:
-                    st.weights[str(name)] = float(entry.get("weight"))
-                except (TypeError, ValueError):
-                    pass
-            elif kind == "pin":
-                model = entry.get("model")
-                if not model:
-                    continue
-                pin = entry.get("backends")
-                if pin:
-                    st.pins[str(model)] = [str(n) for n in pin]
-                else:
-                    st.pins.pop(str(model), None)
-            elif kind == "join" and name:
-                st.members[str(name)] = entry.get("url")
-            elif kind == "leave" and name:
-                st.members.pop(str(name), None)
-                st.children.pop(str(name), None)
-            elif kind in ("boot", "adopt") and name:
-                st.children[str(name)] = {
-                    "pid": entry.get("pid"),
-                    "port": entry.get("port"),
-                    "url": entry.get("url"),
-                    "args": entry.get("args") or [],
-                    "identity": entry.get("identity")}
-            elif kind == "drain" and name:
-                st.children.pop(str(name), None)
+            fold_entry(st, entry)
         return st
 
     def status(self) -> dict:
@@ -295,4 +420,5 @@ class StateStore:
         return {"path": self.path, "records": st.records,
                 "children": sorted(st.children),
                 "weights": st.weights,
-                "pins": {m: list(v) for m, v in st.pins.items()}}
+                "pins": {m: list(v) for m, v in st.pins.items()},
+                "epoch": st.epoch, "degraded": self.degraded}

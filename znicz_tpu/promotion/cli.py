@@ -31,10 +31,15 @@ def main(argv=None) -> int:
     p.add_argument("--candidates", required=True,
                    help="directory the trainer exports candidate .znn "
                         "files into")
-    p.add_argument("--url", required=True,
+    p.add_argument("--url", required=True, action="append",
                    help="base URL of the serving replica to drive "
                         "(e.g. http://127.0.0.1:8100/); with --fleet, "
-                        "the ROUTER whose backends are walked")
+                        "the ROUTER whose backends are walked — "
+                        "repeatable in fleet mode to name an HA "
+                        "pair's routers (primary + hot standbys): "
+                        "requests fail over to the next url on "
+                        "transport error (docs/fleet.md 'Router "
+                        "high availability')")
     p.add_argument("--fleet", action="store_true",
                    help="promote-one-then-fleet: --url names a fleet "
                         "router (python -m znicz_tpu route) — its "
@@ -85,6 +90,9 @@ def main(argv=None) -> int:
                    help="chaos: install a fault plan (inline JSON or "
                         "@file; see znicz_tpu.resilience.faults)")
     args = p.parse_args(argv)
+    if len(args.url) > 1 and not args.fleet:
+        p.error("multiple --url values need --fleet (failover across "
+                "an HA pair's routers is a fleet-mode feature)")
     if args.fault_plan is not None:
         from ..resilience import faults as _faults
         _faults.install(_faults.parse_plan(args.fault_plan))
@@ -115,7 +123,7 @@ def main(argv=None) -> int:
             p.error(f"--fleet could not discover backends from "
                     f"{args.url}: {e}")
     else:
-        target = HttpTarget(args.url, admin_token=token)
+        target = HttpTarget(args.url[0], admin_token=token)
     controller = PromotionController(
         DirectorySource(args.candidates),
         target,
@@ -131,7 +139,8 @@ def main(argv=None) -> int:
         return 0
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: controller.stop(timeout=None))
-    print(f"promote: watching {args.candidates} -> {args.url} "
+    print(f"promote: watching {args.candidates} -> "
+          f"{', '.join(args.url)} "
           f"(ledger {controller.ledger.path})", flush=True)
     try:
         controller.start()

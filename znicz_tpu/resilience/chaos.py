@@ -51,6 +51,15 @@ at blessing, a blessed-but-toxic candidate rolled back by the SLO
 watch with byte-identical outputs, the ``capture.append`` fail-open
 contract fault-injected, plus the Kohonen serve-and-train phase.
 
+The ``--scenario ha`` drill (tools/ha_smoke.sh) is the
+highly-available fleet front acceptance (docs/fleet.md "Router high
+availability"): a primary ``route --state-dir`` and a hot standby
+over the same journal, the primary SIGKILLed mid-burst — the standby
+takes the lease (exactly one epoch bump), adopts the journal's
+children and serves within 2x the lease TTL; the resurrected old
+primary rejoins as a FENCED standby whose stale mutations are
+refused with 503 + Retry-After; zero raw 500s across the arc.
+
 Exit code 0 when every invariant holds — tools/chaos_smoke.sh wires
 this into CI-ish usage.  The same ``FaultPlan`` mechanism drives the
 pytest ``chaos`` marker; this mode exists so an operator can smoke a
@@ -2687,6 +2696,378 @@ def _controlplane_scenario(args) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _ha_scenario(args) -> int:
+    """``--scenario ha`` — the highly-available fleet front
+    acceptance (docs/fleet.md "Router high availability"): a REAL
+    primary ``route --autoscale --state-dir`` boots three managed
+    serve children while a REAL hot standby (``--standby-of``) tails
+    the same journal, probes the primary, and refuses traffic with
+    503 + Retry-After.  The primary is SIGKILLed mid-burst.
+    Asserted:
+
+    * the standby acquires the lease (the dead holder's pid identity
+      makes the lease acquirable before TTL expiry), bumps the epoch
+      exactly once, adopts the journal's live children and serves:
+      failing-over clients see a 200 within 2x the lease TTL of the
+      kill — zero raw 500s across the whole arc, refusals always
+      carry Retry-After;
+    * the journaled admin weight override is live on the promoted
+      standby without any re-issued admin call (the journal tailer
+      kept the control plane warm);
+    * the resurrected old primary rejoins as a FENCED standby: it
+      sees the newer epoch, refuses admin mutations with
+      503 + Retry-After, and never double-boots a child;
+    * journal accounting: ``lease`` epochs exactly ``[1, 2]``,
+      exactly the original three ``boot`` records, zero ``drain``
+      records, and zero epoch-1 mutations after the epoch-2 bump.
+    """
+    import collections
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import threading
+
+    bad: list[str] = []
+    x = [[0.1, 0.2, 0.3, 0.4]]
+    ttl = 2.0
+    tmp = tempfile.mkdtemp(prefix="znicz_chaos_ha_")
+    state_dir = os.path.join(tmp, "state")
+    child_pids: list[int] = []
+    procs: list = []                  # every route proc ever booted
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def wait_healthz(url: str, proc, what: str,
+                     tries: int = 240) -> bool:
+        for _ in range(tries):
+            try:
+                with urllib.request.urlopen(url + "healthz",
+                                            timeout=2) as r:
+                    json.loads(r.read())
+                return True
+            except Exception:
+                if proc is not None and proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    bad.append(f"{what} exited rc={proc.returncode}: "
+                               f"{out[-300:]}")
+                    return False
+                time.sleep(0.25)
+        bad.append(f"{what} never answered /healthz")
+        return False
+
+    def journal() -> list[dict]:
+        path = os.path.join(state_dir, "controlplane.jsonl")
+        out = []
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+        except FileNotFoundError:
+            pass
+        return out
+
+    def alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
+    def role_of(url: str) -> str:
+        try:
+            return str((_health(url).get("ha") or {})
+                       .get("role") or "?")
+        except Exception:
+            return "?"
+
+    def boot_router(rport: int, extra: list[str]) -> subprocess.Popen:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "znicz_tpu", "route",
+             "--port", str(rport), "--autoscale",
+             "--min-backends", "3", "--max-backends", "4",
+             "--state-dir", state_dir,
+             "--lease-ttl-s", str(ttl),
+             "--probe-interval-s", "0.3",
+             "--reconcile-deadline-s", "20",
+             "--serve-arg=--model", f"--serve-arg={model}",
+             "--serve-arg=--max-wait-ms", "--serve-arg=1"] + extra,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        procs.append(proc)
+        return proc
+
+    try:
+        model = os.path.join(tmp, "demo.znn")
+        _write_demo_znn(model)
+        aport, bport = free_port(), free_port()
+        a_url = f"http://127.0.0.1:{aport}/"
+        b_url = f"http://127.0.0.1:{bport}/"
+
+        # ---- phase 1: primary boots the floor fleet + one mutation
+        proc_a = boot_router(aport, [])
+        if not wait_healthz(a_url, proc_a, "primary", tries=480):
+            return 1
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            boots = [e for e in journal() if e.get("kind") == "boot"]
+            if len(boots) >= 3:
+                break
+            time.sleep(0.25)
+        boots = [e for e in journal() if e.get("kind") == "boot"]
+        child_pids = [int(e["pid"]) for e in boots]
+        names = sorted(e["backend"] for e in boots)
+        print(json.dumps({"phase": "boot", "children": names,
+                          "pids": child_pids,
+                          "role": role_of(a_url)}))
+        if len(boots) != 3 or not all(alive(p) for p in child_pids):
+            bad.append(f"expected 3 live floor children, journal has "
+                       f"{boots}")
+            return 1
+        if role_of(a_url) != "primary":
+            bad.append("first router did not take the lease as "
+                       "primary")
+        req = urllib.request.Request(
+            a_url + "admin/weight",
+            json.dumps({"backend": names[0],
+                        "weight": 2.5}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            if r.status != 200:
+                bad.append(f"admin/weight answered {r.status}")
+
+        # ---- phase 2: hot standby tails the journal, refuses traffic
+        proc_b = boot_router(bport, ["--standby-of", a_url])
+        if not wait_healthz(b_url, proc_b, "standby", tries=480):
+            return 1
+        deadline = time.monotonic() + 20.0
+        while role_of(b_url) != "standby" \
+                and time.monotonic() < deadline:
+            time.sleep(0.2)
+        code, _body, hdrs = _post(b_url, {"inputs": x}, timeout=10)
+        print(json.dumps({"phase": "standby", "role": role_of(b_url),
+                          "refusal_code": code,
+                          "retry_after": hdrs.get("Retry-After")}))
+        if role_of(b_url) != "standby":
+            bad.append("second router never settled as standby")
+        if code != 503 or "Retry-After" not in hdrs:
+            bad.append(f"standby /predict answered {code} "
+                       f"(headers {sorted(hdrs)}) — wanted a "
+                       f"503 + Retry-After refusal")
+
+        # ---- phase 3: burst clients (failover list) + SIGKILL
+        urls = [a_url, b_url]
+        answers: list[tuple] = []
+        mu = threading.Lock()
+        stop = threading.Event()
+
+        def client():
+            active = 0
+            while not stop.is_set():
+                u = urls[active % len(urls)]
+                try:
+                    code, _b, headers = _post(u, {"inputs": x},
+                                              timeout=15)
+                except Exception:
+                    # transport error: rotate to the next router —
+                    # an HTTP answer (even a refusal) never rotates
+                    code, headers = -1, {}
+                    active += 1
+                with mu:
+                    answers.append((time.monotonic(), code,
+                                    "Retry-After" in headers))
+                stop.wait(0.002)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        t_kill = time.monotonic()
+        proc_a.kill()                 # a CRASH, not a handoff
+        proc_a.wait(timeout=15)
+        if not all(alive(p) for p in child_pids):
+            bad.append("children died with the primary — nothing for "
+                       "the standby to adopt")
+            return 1
+
+        # ---- phase 4: the standby takes over and serves
+        deadline = time.monotonic() + 30.0
+        while role_of(b_url) != "primary" \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        t_takeover = time.monotonic()
+        if role_of(b_url) != "primary":
+            bad.append("standby never took the lease after the kill")
+        first_ok = None
+        deadline = time.monotonic() + 30.0
+        while first_ok is None and time.monotonic() < deadline:
+            with mu:
+                oks = [t for t, c, _ra in answers
+                       if c == 200 and t > t_kill]
+            if oks:
+                first_ok = min(oks)
+                break
+            time.sleep(0.1)
+        gap_s = None if first_ok is None else first_ok - t_kill
+        print(json.dumps({"phase": "takeover",
+                          "role": role_of(b_url),
+                          "first_200_after_kill_s":
+                              None if gap_s is None
+                              else round(gap_s, 3)}))
+        if first_ok is None:
+            bad.append("no 200 at all after the kill — the standby "
+                       "never served")
+        elif gap_s > 2 * ttl:
+            bad.append(f"first 200 came {gap_s:.2f}s after the kill "
+                       f"— the 2x lease TTL bound is {2 * ttl:.1f}s")
+        # the journaled weight must come back live on the promoted
+        # standby — adoption + weight replay settle asynchronously
+        # after the lease flips (and the first 200 can be the dying
+        # primary's), so poll to the reconcile deadline
+        deadline = time.monotonic() + 20.0
+        weight = None
+        while time.monotonic() < deadline:
+            h = _health(b_url)
+            rows = {r["name"]: r for r in h.get("backends") or []}
+            weight = (rows.get(names[0]) or {}).get("weight")
+            if (h.get("reconcile") or {}).get("state") == "settled" \
+                    and weight is not None \
+                    and abs(weight - 2.5) <= 1e-6:
+                break
+            time.sleep(0.2)
+        if weight is None:
+            bad.append(f"{names[0]} missing on the promoted standby")
+        elif abs(weight - 2.5) > 1e-6:
+            bad.append(f"journaled weight lost across failover: "
+                       f"{names[0]} weighs {weight}, expected 2.5")
+
+        # ---- phase 5: the old primary resurrects as a fenced standby
+        proc_a2 = boot_router(aport, [])
+        if not wait_healthz(a_url, proc_a2, "resurrected primary",
+                            tries=480):
+            return 1
+        deadline = time.monotonic() + 20.0
+        while role_of(a_url) != "standby" \
+                and time.monotonic() < deadline:
+            time.sleep(0.2)
+        code, body, hdrs = 0, {}, {}
+        req = urllib.request.Request(
+            a_url + "admin/weight",
+            json.dumps({"backend": names[0],
+                        "weight": 9.0}).encode(),
+            {"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                code, hdrs = r.status, dict(r.headers)
+        except urllib.error.HTTPError as e:
+            code, hdrs = e.code, dict(e.headers)
+        print(json.dumps({"phase": "fenced-rejoin",
+                          "role": role_of(a_url),
+                          "stale_admin_code": code}))
+        if role_of(a_url) != "standby":
+            bad.append(f"resurrected old primary came back as "
+                       f"{role_of(a_url)!r} — wanted a fenced "
+                       f"standby")
+        if code != 503 or "Retry-After" not in hdrs:
+            bad.append(f"stale admin mutation answered {code} — "
+                       f"wanted a fenced 503 + Retry-After")
+        rows = {r["name"]: r
+                for r in _health(b_url).get("backends") or []}
+        if names[0] in rows \
+                and abs(rows[names[0]]["weight"] - 2.5) > 1e-6:
+            bad.append("a STALE admin mutation reached the fleet "
+                       "through the deposed primary")
+
+        stop.set()
+        for t in threads:
+            t.join(20.0)
+
+        # ---- the ledger + the journal's leadership history
+        codes = collections.Counter(c for _t, c, _ra in answers)
+        naked = sum(1 for _t, c, ra in answers
+                    if c in (429, 503) and not ra)
+        stray = sum(1 for t, c, _ra in answers
+                    if c == -1
+                    and not t_kill - 0.1 <= t <= t_takeover + 1.0)
+        entries = journal()
+        leases = [e for e in entries if e.get("kind") == "lease"]
+        epochs = [int(e.get("epoch", 0)) for e in leases]
+        boots2 = [e for e in entries if e.get("kind") == "boot"]
+        drains = [e for e in entries if e.get("kind") == "drain"]
+        stale_mut = []
+        if epochs == [1, 2]:
+            bump_at = entries.index(leases[1])
+            stale_mut = [e for e in entries[bump_at + 1:]
+                         if int(e.get("epoch", 2)) < 2]
+        print(json.dumps({"phase": "ledger",
+                          "codes": dict(sorted(codes.items())),
+                          "lease_epochs": epochs,
+                          "boot_records": len(boots2),
+                          "drain_records": len(drains),
+                          "stale_epoch_records": len(stale_mut)}))
+        if codes.get(500):
+            bad.append(f"{codes[500]} raw 500(s) during the arc")
+        if naked:
+            bad.append(f"{naked} refusal(s) carried no Retry-After")
+        if stray:
+            bad.append(f"{stray} connection error(s) outside the "
+                       f"kill→takeover window")
+        if not codes.get(200):
+            bad.append("no successful answers at all — the burst "
+                       "never exercised the fleet")
+        if epochs != [1, 2]:
+            bad.append(f"lease epochs {epochs} — wanted exactly one "
+                       f"takeover bump [1, 2]")
+        if len(boots2) != 3:
+            bad.append(f"{len(boots2)} boot records — expected the "
+                       f"original 3 (a double-boot leaked a child)")
+        if drains:
+            bad.append(f"{len(drains)} drain record(s) — nothing "
+                       f"should have been drained")
+        if stale_mut:
+            bad.append(f"{len(stale_mut)} stale epoch-1 record(s) "
+                       f"accepted after the epoch-2 bump")
+        print(json.dumps({"scenario": "ha", "ok": not bad,
+                          "violations": bad}))
+        return 1 if bad else 0
+    finally:
+        for proc in procs:
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for pid in child_pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + 15.0
+        for proc in procs:
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1,
+                                      deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for pid in child_pids:
+            for _ in range(100):
+                if not alive(pid):
+                    break
+                time.sleep(0.1)
+            else:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _trace_scenario(args) -> int:
     """``--scenario trace`` — the distributed-tracing acceptance
     (docs/observability.md "Distributed tracing"): two REAL ``serve``
@@ -2964,7 +3345,7 @@ def main(argv=None) -> int:
                    choices=("breaker", "reload", "promote", "overload",
                             "zoo", "slo", "wire", "fleet", "online",
                             "placement", "controlplane", "trace",
-                            "san"),
+                            "san", "ha"),
                    help="breaker: the engine-fault degradation arc "
                         "(default); reload: hot-reload a corrupted "
                         "artifact and assert rollback + zero downtime "
@@ -3034,10 +3415,18 @@ def main(argv=None) -> int:
                         "?min_ms= must hold fully-assembled cross-hop "
                         "traces dominated by the injected stage, "
                         "every error/deadline trace retained, stage "
-                        "sums within 10% of e2e, and bench.py serve "
+                        "sums within 10%% of e2e, and bench.py serve "
                         "--trace-breakdown agreeing with its own e2e "
                         "(docs/observability.md 'Distributed "
-                        "tracing')")
+                        "tracing'); ha: a primary route --state-dir "
+                        "and a hot standby over the same journal — "
+                        "the primary SIGKILLed mid-burst, the "
+                        "standby takes the lease (one epoch bump), "
+                        "adopts the children and serves within 2x "
+                        "the lease TTL, the resurrected old primary "
+                        "rejoins as a FENCED standby refusing stale "
+                        "mutations, zero raw 500s (docs/fleet.md "
+                        "'Router high availability')")
     p.add_argument("--promotions", type=int, default=3,
                    help="promote: good candidates to drive through "
                         "the loop before the regressed one")
@@ -3108,6 +3497,8 @@ def main(argv=None) -> int:
         return _trace_scenario(args)
     if args.scenario == "san":
         return _san_scenario(args)
+    if args.scenario == "ha":
+        return _ha_scenario(args)
 
     from ..serving.engine import ServingEngine
     from ..serving.server import ServingServer

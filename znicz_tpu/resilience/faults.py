@@ -62,6 +62,13 @@ Instrumented sites (grow this list as subsystems adopt injection):
                        drop, never as a failed or delayed /predict
                        answer (``chaos --scenario online`` +
                        tests/test_online.py pin this)
+``statestore.append``  the fleet control-plane journal's fsync'd write
+                       (fleet.statestore.StateStore.append) — the
+                       journal is FAIL-CLOSED for mutations: an error
+                       fault here must refuse the admin mutation with
+                       503 + Retry-After and mark the store degraded,
+                       while reads and /predict keep serving
+                       (tests/test_ha.py pins this)
 =====================  ====================================================
 """
 

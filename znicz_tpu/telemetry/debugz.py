@@ -311,14 +311,28 @@ def fleet_statusz_text(router, *, recorder=None) -> str:
     lines.append(f"fleet: {health['status']}  "
                  f"healthy={health['healthy_backends']}/"
                  f"{health['backend_count']}")
+    ha = health.get("ha")
+    if ha is not None:
+        # mid-failover the first question is "who is the primary and
+        # what epoch are we on"
+        extra = ""
+        if ha.get("primary_url"):
+            extra = f"  primary={ha['primary_url']}"
+        lines.append(f"ha: role={ha.get('role', '?')} "
+                     f"epoch={ha.get('epoch', '?')} "
+                     f"takeovers={ha.get('takeovers', 0)} "
+                     f"demotions={ha.get('demotions', 0)}{extra}")
     rc = health.get("reconcile")
     if rc is not None:
         # mid-incident the first question after a restart is "is it
         # still reconciling and how long will clients see 503s"
         extra = (f"  retry_after_s={rc['retry_after_s']}"
                  if "retry_after_s" in rc else "")
+        degraded = "  DEGRADED (journal unwritable: mutations " \
+                   "refused, reads serving)" if rc.get("degraded") \
+                   else ""
         lines.append(f"control-plane: {rc['state']}{extra}  "
-                     f"journal={rc['journal']}")
+                     f"journal={rc['journal']}{degraded}")
     lines += ["", "backends", "-" * 8]
     lines.append(f"  {'name':<16} {'weight':>7} {'eff':>6} "
                  f"{'breaker':<10} {'gen':>4} {'ewma_ms':>8} "
